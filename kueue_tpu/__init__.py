@@ -1,0 +1,20 @@
+"""kueue_tpu: a TPU-native job-level queueing and admission framework.
+
+A ground-up reimplementation of the capabilities of Kueue
+(sigs.k8s.io/kueue, reference at /root/reference): ClusterQueue /
+LocalQueue / Workload / ResourceFlavor APIs, StrictFIFO and
+BestEffortFIFO queueing, cohort borrowing/lending with hierarchical
+quotas, priority- and DRF-fair-share preemption, flavor fungibility,
+partial admission, admission checks (ProvisioningRequest-style gates and
+MultiKueue multi-cluster dispatch), a job-integration framework,
+webhook-equivalent validation, metrics, a visibility API and CLI.
+
+The defining difference from the reference: the per-cycle admission
+computation (flavor assignment + preemption over the ClusterQueue/Cohort
+snapshot; reference hot loop at pkg/scheduler/scheduler.go:197-353) is
+also available as one batched tensor program, jit-compiled with JAX and
+solved on TPU (`kueue_tpu.solver`), with the sequential CPU path
+(`kueue_tpu.scheduler`) as the conformance oracle and fallback.
+"""
+
+__version__ = "0.1.0"

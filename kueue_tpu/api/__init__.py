@@ -1,0 +1,65 @@
+"""API types for kueue_tpu.
+
+Python-native equivalents of the reference CRD Go structs
+(/root/reference/apis/kueue/v1beta1, apis/kueue/v1alpha1,
+apis/config/v1beta1). These are plain dataclasses; objects live in the
+in-process object store (`kueue_tpu.sim`) instead of etcd.
+"""
+
+from kueue_tpu.api.meta import (  # noqa: F401
+    Condition,
+    LabelSelector,
+    LabelSelectorRequirement,
+    ObjectMeta,
+    OwnerReference,
+    find_condition,
+    is_condition_true,
+    set_condition,
+)
+from kueue_tpu.api.corev1 import (  # noqa: F401
+    Affinity,
+    Container,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PodSpec,
+    PodTemplateSpec,
+    Taint,
+    Toleration,
+)
+from kueue_tpu.api.kueue import (  # noqa: F401
+    Admission,
+    AdmissionCheck,
+    AdmissionCheckSpec,
+    AdmissionCheckState,
+    AdmissionCheckStrategyRule,
+    BorrowWithinCohort,
+    ClusterQueue,
+    ClusterQueuePreemption,
+    ClusterQueueSpec,
+    ClusterQueueStatus,
+    Cohort,
+    CohortSpec,
+    FairSharing,
+    FlavorFungibility,
+    FlavorQuotas,
+    FlavorUsage,
+    LocalQueue,
+    LocalQueueSpec,
+    LocalQueueStatus,
+    PodSet,
+    PodSetAssignment,
+    PodSetUpdate,
+    ReclaimablePod,
+    RequeueState,
+    ResourceFlavor,
+    ResourceFlavorSpec,
+    ResourceGroup,
+    ResourceQuota,
+    ResourceUsage,
+    Workload,
+    WorkloadPriorityClass,
+    WorkloadSpec,
+    WorkloadStatus,
+)

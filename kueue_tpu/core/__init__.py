@@ -1,0 +1,6 @@
+"""Core model layer: resource arithmetic, workload Info, podset helpers,
+cohort hierarchy, priority resolution, limit ranges.
+
+Mirrors the reference's pkg/resources, pkg/workload, pkg/podset,
+pkg/hierarchy, pkg/util/{priority,limitrange}.
+"""

@@ -1,0 +1,1 @@
+"""Primitive utilities (reference: pkg/util/*)."""

@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU platform BEFORE jax is imported
+anywhere, so multi-chip sharding tests (Mesh/pjit/shard_map) run without
+TPU hardware. Benchmarks (bench.py) run outside pytest on the real chip.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS",
+                      (os.environ.get("XLA_FLAGS", "") +
+                       " --xla_force_host_platform_device_count=8").strip())
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+from kueue_tpu import features  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_features():
+    features.reset()
+    yield
+    features.reset()

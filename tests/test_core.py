@@ -1,0 +1,242 @@
+"""Unit tests for the core model layer (resources, workload Info, heap,
+hierarchy, podset, limitrange).
+
+Mirrors the reference's colocated unit suites for pkg/resources,
+pkg/workload, pkg/util/heap, pkg/hierarchy.
+"""
+
+import pytest
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.corev1 import (
+    Taint, Toleration, find_untolerated_taint, parse_quantity,
+)
+from kueue_tpu.api.meta import Condition, LabelSelector, LabelSelectorRequirement, set_condition
+from kueue_tpu.core import limitrange, podset
+from kueue_tpu.core import workload as wl
+from kueue_tpu.core.hierarchy import Manager
+from kueue_tpu.core.resources import FlavorResource, pod_effective_requests
+from kueue_tpu.utils.heap import Heap
+from tests.wrappers import WorkloadWrapper, make_flavor
+
+
+class TestQuantity:
+    def test_cpu_milli(self):
+        assert parse_quantity("500m", "cpu") == 500
+        assert parse_quantity("2", "cpu") == 2000
+        assert parse_quantity(1.5, "cpu") == 1500
+
+    def test_memory(self):
+        assert parse_quantity("2Gi", "memory") == 2 * 1024**3
+        assert parse_quantity("100M", "memory") == 100 * 10**6
+        assert parse_quantity("1024", "memory") == 1024
+
+    def test_count(self):
+        assert parse_quantity(3, "pods") == 3
+        assert parse_quantity("4", "nvidia.com/gpu") == 4
+
+
+class TestRequests:
+    def test_pod_effective_requests_max_of_init(self):
+        w = (WorkloadWrapper("w").pod_set(count=1, cpu="1", memory="1Gi")).obj()
+        spec = w.spec.pod_sets[0].template.spec
+        from kueue_tpu.api.corev1 import Container
+        spec.init_containers.append(Container(name="i", requests={"cpu": 3000}))
+        reqs = pod_effective_requests(spec)
+        assert reqs["cpu"] == 3000  # init max dominates the 1000m container sum
+        assert reqs["memory"] == 1024**3
+
+    def test_total_requests_scaled_by_count(self):
+        w = WorkloadWrapper("w").pod_set(count=3, cpu="1").obj()
+        info = wl.Info(w)
+        assert info.total_requests[0].requests["cpu"] == 3000
+        assert info.total_requests[0].count == 3
+
+    def test_reclaimable_pods_reduce_count(self):
+        w = WorkloadWrapper("w").pod_set(count=5, cpu="1").obj()
+        w.status.reclaimable_pods.append(api.ReclaimablePod(name="main", count=2))
+        info = wl.Info(w)
+        assert info.total_requests[0].count == 3
+        assert info.total_requests[0].requests["cpu"] == 3000
+
+    def test_scaled_to(self):
+        w = WorkloadWrapper("w").pod_set(count=4, cpu="500m").obj()
+        info = wl.Info(w)
+        scaled = info.total_requests[0].scaled_to(2)
+        assert scaled.requests["cpu"] == 1000
+        assert scaled.count == 2
+
+    def test_requests_from_admission(self):
+        w = WorkloadWrapper("w").pod_set(count=2, cpu="1").reserve("cq-a", "spot").obj()
+        info = wl.Info(w)
+        assert info.cluster_queue == "cq-a"
+        assert info.total_requests[0].flavors["cpu"] == "spot"
+        assert info.flavor_resource_usage()[FlavorResource("spot", "cpu")] == 2000
+
+    def test_can_be_partially_admitted(self):
+        w1 = WorkloadWrapper("w").pod_set(count=4, min_count=2, cpu="1").obj()
+        assert wl.Info(w1).can_be_partially_admitted()
+        w2 = WorkloadWrapper("w").pod_set(count=4, cpu="1").obj()
+        assert not wl.Info(w2).can_be_partially_admitted()
+
+
+class TestConditions:
+    def test_quota_reservation_lifecycle(self):
+        w = WorkloadWrapper("w").pod_set(count=1, cpu="1").obj()
+        adm = api.Admission(cluster_queue="cq")
+        wl.set_quota_reservation(w, adm, now=10.0)
+        assert wl.has_quota_reservation(w)
+        assert w.status.admission is adm
+        assert wl.sync_admitted_condition(w, now=11.0)
+        assert wl.is_admitted(w)
+        changed = wl.unset_quota_reservation_with_condition(w, "Pending", "requeued", now=12.0)
+        assert changed
+        assert not wl.has_quota_reservation(w)
+        assert not wl.is_admitted(w)
+        assert w.status.admission is None
+
+    def test_eviction_resets_on_new_reservation(self):
+        w = WorkloadWrapper("w").pod_set(count=1, cpu="1").obj()
+        wl.set_evicted_condition(w, api.EVICTED_BY_PREEMPTION, "bye", now=5.0)
+        assert wl.is_evicted(w)
+        wl.set_quota_reservation(w, api.Admission(cluster_queue="cq"), now=6.0)
+        assert not wl.is_evicted(w)
+
+    def test_admitted_requires_checks_ready(self):
+        w = WorkloadWrapper("w").pod_set(count=1, cpu="1").obj()
+        wl.set_quota_reservation(w, api.Admission(cluster_queue="cq"), now=1.0)
+        w.status.admission_checks.append(api.AdmissionCheckState(name="prov", state=api.CHECK_STATE_PENDING))
+        wl.sync_admitted_condition(w, now=2.0)
+        assert not wl.is_admitted(w)
+        w.status.admission_checks[0].state = api.CHECK_STATE_READY
+        wl.sync_admitted_condition(w, now=3.0)
+        assert wl.is_admitted(w)
+
+    def test_ordering_eviction_timestamp(self):
+        w = WorkloadWrapper("w").creation(100.0).pod_set(count=1, cpu="1").obj()
+        ordering = wl.Ordering()
+        assert ordering.queue_order_timestamp(w) == 100.0
+        set_condition(w.status.conditions, Condition(
+            type=api.WORKLOAD_EVICTED, status="True",
+            reason=api.EVICTED_BY_PODS_READY_TIMEOUT), now=250.0)
+        assert ordering.queue_order_timestamp(w) == 250.0
+        assert wl.Ordering(pods_ready_requeuing_timestamp="Creation").queue_order_timestamp(w) == 100.0
+
+
+class TestAdmissionCheckResolution:
+    def test_per_flavor_strategy(self):
+        w = WorkloadWrapper("w").pod_set(count=1, cpu="1").reserve("cq", flavor="spot").obj()
+        checks = {"always": set(), "spot-only": {"spot"}, "ondemand-only": {"on-demand"}}
+        assert wl.admission_checks_for_workload(w, checks) == {"always", "spot-only"}
+
+
+class TestHeap:
+    def test_ordering_and_update(self):
+        h = Heap(key_func=lambda x: x[0], less_func=lambda a, b: a[1] < b[1])
+        assert h.push_if_not_present(("a", 3))
+        assert h.push_if_not_present(("b", 1))
+        assert not h.push_if_not_present(("a", 0))  # present
+        h.push_or_update(("c", 2))
+        assert h.peek() == ("b", 1)
+        h.push_or_update(("b", 10))  # reorder
+        assert h.pop() == ("c", 2)
+        assert h.delete("a")
+        assert h.pop() == ("b", 10)
+        assert h.pop() is None
+        assert len(h) == 0
+
+
+class TestHierarchy:
+    def test_implicit_cohort_lifecycle(self):
+        m = Manager(cohort_factory=lambda name: {"name": name})
+        m.add_cluster_queue("cq1", "CQ1")
+        m.add_cluster_queue("cq2", "CQ2")
+        m.update_cluster_queue_edge("cq1", "team")
+        m.update_cluster_queue_edge("cq2", "team")
+        assert set(m.cohorts["team"].child_cqs) == {"cq1", "cq2"}
+        m.update_cluster_queue_edge("cq1", "")
+        assert "team" in m.cohorts
+        m.delete_cluster_queue("cq2")
+        assert "team" not in m.cohorts  # gc'd
+
+    def test_explicit_cohort_tree(self):
+        m = Manager(cohort_factory=lambda name: {})
+        m.add_cohort("root")
+        m.add_cohort("left")
+        m.update_cohort_edge("left", "root")
+        m.add_cluster_queue("cq", "CQ")
+        m.update_cluster_queue_edge("cq", "left")
+        assert m.root(m.cohort_of("cq")).name == "root"
+        with pytest.raises(ValueError):
+            m.update_cohort_edge("root", "left")  # cycle
+
+    def test_cohort_survives_while_explicit(self):
+        m = Manager(cohort_factory=lambda name: {})
+        m.add_cohort("solo")
+        assert "solo" in m.cohorts
+        m.delete_cohort("solo")
+        assert "solo" not in m.cohorts
+
+
+class TestTaints:
+    def test_untolerated(self):
+        taints = [Taint(key="gpu", value="true", effect="NoSchedule")]
+        assert find_untolerated_taint(taints, []) is not None
+        tol = [Toleration(key="gpu", value="true", effect="NoSchedule")]
+        assert find_untolerated_taint(taints, tol) is None
+        tol_exists = [Toleration(key="gpu", operator="Exists")]
+        assert find_untolerated_taint(taints, tol_exists) is None
+        # PreferNoSchedule isn't blocking
+        assert find_untolerated_taint([Taint(key="x", effect="PreferNoSchedule")], []) is None
+
+
+class TestLabelSelector:
+    def test_match(self):
+        sel = LabelSelector(match_labels={"team": "a"},
+                            match_expressions=[LabelSelectorRequirement(key="env", operator="In", values=["prod"])])
+        assert sel.matches({"team": "a", "env": "prod"})
+        assert not sel.matches({"team": "a", "env": "dev"})
+        assert LabelSelector().matches({"anything": "x"})
+
+
+class TestPodSet:
+    def test_from_assignment_and_merge_restore(self):
+        flavors = {"spot": make_flavor("spot", node_labels={"cloud/zone": "z1"})}
+        psa = api.PodSetAssignment(name="main", flavors={"cpu": "spot"}, count=2)
+        info = podset.from_assignment(psa, flavors, default_count=2)
+        assert info.node_selector == {"cloud/zone": "z1"}
+
+        w = WorkloadWrapper("w").pod_set(count=2, cpu="1").obj()
+        tpl = w.spec.pod_sets[0].template
+        original = podset.snapshot_template("main", 2, tpl)
+        podset.merge_into_template(tpl, info)
+        assert tpl.spec.node_selector == {"cloud/zone": "z1"}
+        assert podset.restore_template(tpl, original)
+        assert tpl.spec.node_selector == {}
+
+    def test_merge_conflict_is_permanent(self):
+        flavors = {"a": make_flavor("a", node_labels={"k": "1"}),
+                   "b": make_flavor("b", node_labels={"k": "2"})}
+        psa = api.PodSetAssignment(name="main", flavors={"cpu": "a", "memory": "b"}, count=1)
+        with pytest.raises(podset.PermanentError):
+            podset.from_assignment(psa, flavors, default_count=1)
+
+
+class TestLimitRange:
+    def test_summarize_and_validate(self):
+        lr = limitrange.LimitRange(namespace="ns", name="lr", limits=[
+            limitrange.LimitRangeItem(type="Container", min={"cpu": 100}, max={"cpu": 2000})])
+        summary = limitrange.summarize(lr)
+        ok = WorkloadWrapper("w").pod_set(count=1, cpu="1").obj()
+        assert limitrange.validate_pod_spec(ok.spec.pod_sets[0].template.spec, summary) == []
+        bad = WorkloadWrapper("w").pod_set(count=1, cpu="3").obj()
+        assert limitrange.validate_pod_spec(bad.spec.pod_sets[0].template.spec, summary) != []
+
+    def test_defaults_applied(self):
+        lr = limitrange.LimitRange(limits=[
+            limitrange.LimitRangeItem(type="Container", default_request={"cpu": 250})])
+        w = api.Workload()
+        from kueue_tpu.api.corev1 import Container, PodSpec
+        spec = PodSpec(containers=[Container(name="c")])
+        limitrange.apply_defaults(spec, limitrange.summarize(lr))
+        assert spec.containers[0].requests["cpu"] == 250

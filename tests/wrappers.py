@@ -1,0 +1,178 @@
+"""Builder wrappers for tests.
+
+Equivalent of the reference's pkg/util/testing/wrappers.go
+(MakeWorkload:67, MakeClusterQueue:612, ...): fluent builders that keep
+test tables readable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.corev1 import (
+    Affinity, Container, NodeAffinity, NodeSelector, NodeSelectorRequirement,
+    NodeSelectorTerm, PodSpec, PodTemplateSpec, Taint, Toleration, parse_quantity,
+)
+from kueue_tpu.api.meta import LabelSelector, ObjectMeta, new_uid
+
+
+class WorkloadWrapper:
+    def __init__(self, name: str, namespace: str = "default"):
+        self.wl = api.Workload(metadata=ObjectMeta(
+            name=name, namespace=namespace, uid=new_uid("wl"), creation_timestamp=0.0))
+
+    def queue(self, q: str) -> "WorkloadWrapper":
+        self.wl.spec.queue_name = q
+        return self
+
+    def priority(self, p: int) -> "WorkloadWrapper":
+        self.wl.spec.priority = p
+        return self
+
+    def creation(self, ts: float) -> "WorkloadWrapper":
+        self.wl.metadata.creation_timestamp = ts
+        return self
+
+    def active(self, a: bool) -> "WorkloadWrapper":
+        self.wl.spec.active = a
+        return self
+
+    def pod_set(self, name: str = api.DEFAULT_PODSET_NAME, count: int = 1,
+                min_count: Optional[int] = None, **requests) -> "WorkloadWrapper":
+        reqs = {k.replace("_", "."): parse_quantity(v, k) for k, v in requests.items()}
+        ps = api.PodSet(
+            name=name, count=count, min_count=min_count,
+            template=PodTemplateSpec(spec=PodSpec(containers=[Container(name="c", requests=reqs)])))
+        self.wl.spec.pod_sets.append(ps)
+        return self
+
+    def request(self, resource: str, qty) -> "WorkloadWrapper":
+        """Add/extend a single default podset with one resource request."""
+        if not self.wl.spec.pod_sets:
+            self.pod_set()
+        ps = self.wl.spec.pod_sets[-1]
+        ps.template.spec.containers[0].requests[resource] = parse_quantity(qty, resource)
+        return self
+
+    def toleration(self, key: str, value: str = "", effect: str = "NoSchedule",
+                   operator: str = "Equal") -> "WorkloadWrapper":
+        if not self.wl.spec.pod_sets:
+            self.pod_set()
+        self.wl.spec.pod_sets[-1].template.spec.tolerations.append(
+            Toleration(key=key, value=value, effect=effect, operator=operator))
+        return self
+
+    def node_selector(self, key: str, value: str) -> "WorkloadWrapper":
+        if not self.wl.spec.pod_sets:
+            self.pod_set()
+        self.wl.spec.pod_sets[-1].template.spec.node_selector[key] = value
+        return self
+
+    def affinity_in(self, key: str, *values: str) -> "WorkloadWrapper":
+        if not self.wl.spec.pod_sets:
+            self.pod_set()
+        spec = self.wl.spec.pod_sets[-1].template.spec
+        spec.affinity = Affinity(node_affinity=NodeAffinity(required=NodeSelector(
+            node_selector_terms=[NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(key=key, operator="In", values=list(values))])])))
+        return self
+
+    def reserve(self, cq: str, flavor: str = "default", now: float = 0.0) -> "WorkloadWrapper":
+        """Mark the workload as having quota reserved with a simple admission."""
+        from kueue_tpu.core import workload as wlpkg
+        psas = []
+        for i, ps in enumerate(self.wl.spec.pod_sets):
+            info = wlpkg.Info(self.wl)
+            psas.append(api.PodSetAssignment(
+                name=ps.name,
+                flavors={r: flavor for r in info.total_requests[i].requests},
+                resource_usage=dict(info.total_requests[i].requests),
+                count=ps.count))
+        wlpkg.set_quota_reservation(self.wl, api.Admission(cluster_queue=cq, pod_set_assignments=psas), now)
+        return self
+
+    def obj(self) -> api.Workload:
+        return self.wl
+
+
+class ClusterQueueWrapper:
+    def __init__(self, name: str):
+        self.cq = api.ClusterQueue(metadata=ObjectMeta(name=name, uid=new_uid("cq")))
+        self.cq.spec.namespace_selector = LabelSelector()  # match-all
+
+    def cohort(self, c: str) -> "ClusterQueueWrapper":
+        self.cq.spec.cohort = c
+        return self
+
+    def queueing_strategy(self, s: str) -> "ClusterQueueWrapper":
+        self.cq.spec.queueing_strategy = s
+        return self
+
+    def resource_group(self, *flavor_quotas: api.FlavorQuotas) -> "ClusterQueueWrapper":
+        covered = []
+        for fq in flavor_quotas:
+            for rq in fq.resources:
+                if rq.name not in covered:
+                    covered.append(rq.name)
+        self.cq.spec.resource_groups.append(
+            api.ResourceGroup(covered_resources=covered, flavors=list(flavor_quotas)))
+        return self
+
+    def preemption(self, within_cluster_queue: str = api.PREEMPTION_NEVER,
+                   reclaim_within_cohort: str = api.PREEMPTION_NEVER,
+                   borrow_within_cohort: Optional[api.BorrowWithinCohort] = None) -> "ClusterQueueWrapper":
+        self.cq.spec.preemption = api.ClusterQueuePreemption(
+            within_cluster_queue=within_cluster_queue,
+            reclaim_within_cohort=reclaim_within_cohort,
+            borrow_within_cohort=borrow_within_cohort)
+        return self
+
+    def flavor_fungibility(self, when_can_borrow: str = api.BORROW,
+                           when_can_preempt: str = api.TRY_NEXT_FLAVOR) -> "ClusterQueueWrapper":
+        self.cq.spec.flavor_fungibility = api.FlavorFungibility(
+            when_can_borrow=when_can_borrow, when_can_preempt=when_can_preempt)
+        return self
+
+    def fair_weight(self, milli: int) -> "ClusterQueueWrapper":
+        self.cq.spec.fair_sharing = api.FairSharing(weight=milli)
+        return self
+
+    def admission_checks(self, *names: str) -> "ClusterQueueWrapper":
+        self.cq.spec.admission_checks = list(names)
+        return self
+
+    def obj(self) -> api.ClusterQueue:
+        return self.cq
+
+
+def flavor_quotas(flavor: str, **resources) -> api.FlavorQuotas:
+    """flavor_quotas("on-demand", cpu=(nominal, borrowing, lending)) or cpu=nominal."""
+    rqs = []
+    for res, spec in resources.items():
+        res = res.replace("_", ".")
+        if isinstance(spec, tuple):
+            nominal = parse_quantity(spec[0], res)
+            borrowing = parse_quantity(spec[1], res) if len(spec) > 1 and spec[1] is not None else None
+            lending = parse_quantity(spec[2], res) if len(spec) > 2 and spec[2] is not None else None
+            rqs.append(api.ResourceQuota(name=res, nominal_quota=nominal,
+                                         borrowing_limit=borrowing, lending_limit=lending))
+        else:
+            rqs.append(api.ResourceQuota(name=res, nominal_quota=parse_quantity(spec, res)))
+    return api.FlavorQuotas(name=flavor, resources=rqs)
+
+
+def make_flavor(name: str, node_labels: Optional[dict] = None,
+                taints: Optional[list] = None) -> api.ResourceFlavor:
+    rf = api.ResourceFlavor(metadata=ObjectMeta(name=name, uid=new_uid("rf")))
+    if node_labels:
+        rf.spec.node_labels = dict(node_labels)
+    if taints:
+        rf.spec.node_taints = list(taints)
+    return rf
+
+
+def make_local_queue(name: str, namespace: str, cq: str) -> api.LocalQueue:
+    lq = api.LocalQueue(metadata=ObjectMeta(name=name, namespace=namespace, uid=new_uid("lq")))
+    lq.spec.cluster_queue = cq
+    return lq

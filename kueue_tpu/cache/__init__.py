@@ -1,0 +1,5 @@
+"""State layer: authoritative in-memory mirror of admitted usage plus
+lock-free scheduling snapshots (reference: pkg/cache)."""
+
+from kueue_tpu.cache.cache import Cache  # noqa: F401
+from kueue_tpu.cache.snapshot import ClusterQueueSnapshot, CohortSnapshot, Snapshot  # noqa: F401

@@ -1,0 +1,335 @@
+"""The Cache: authoritative in-memory mirror of admitted usage.
+
+Equivalent of the reference's pkg/cache/cache.go:89-595: tracks
+ClusterQueues/cohorts/flavors/checks/local-queues plus assumed workloads
+(optimistic admission before the API write), and produces deep-copied
+Snapshots for lock-free scheduling cycles.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import is_condition_true
+from kueue_tpu.cache.clusterqueue import (
+    ACTIVE,
+    TERMINATING,
+    ClusterQueueCache,
+    CohortCache,
+    LocalQueueUsage,
+    build_quotas,
+    update_cohort_resource_node,
+)
+from kueue_tpu.cache.snapshot import ClusterQueueSnapshot, CohortSnapshot, Snapshot
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.core.hierarchy import Manager as HierarchyManager
+
+
+@dataclass
+class AdmissionCheckEntry:
+    controller_name: str = ""
+    active: bool = False
+    single_instance_in_cluster_queue: bool = False
+
+
+class Cache:
+    def __init__(self, pods_ready_tracking: bool = False,
+                 excluded_resource_prefixes: Optional[list] = None):
+        self._lock = threading.RLock()
+        self._pods_ready_cond = threading.Condition(self._lock)
+        self.hm: HierarchyManager = HierarchyManager(cohort_factory=self._new_cohort)
+        self.resource_flavors: dict = {}  # name -> ResourceFlavor
+        self.admission_checks: dict = {}  # name -> AdmissionCheckEntry
+        self.assumed_workloads: dict = {}  # wl key -> cq name
+        self.pods_ready_tracking = pods_ready_tracking
+        self.excluded_resource_prefixes = excluded_resource_prefixes or []
+
+    def _new_cohort(self, name: str) -> CohortCache:
+        cohort = CohortCache(name)
+        cohort.manager = self.hm
+        return cohort
+
+    # --- ClusterQueues ---
+
+    def add_cluster_queue(self, cq: api.ClusterQueue) -> ClusterQueueCache:
+        with self._lock:
+            cqc = ClusterQueueCache(cq)
+            self.hm.add_cluster_queue(cqc.name, cqc)
+            self.hm.update_cluster_queue_edge(cqc.name, cq.spec.cohort)
+            self._wire_cohort(cqc)
+            cqc.update_with_flavors(self.resource_flavors)
+            cqc.update_with_checks(self.admission_checks)
+            self._refresh_cohort(cqc)
+            return cqc
+
+    def update_cluster_queue(self, cq: api.ClusterQueue) -> None:
+        with self._lock:
+            cqc = self.hm.cluster_queues.get(cq.metadata.name)
+            if cqc is None:
+                return
+            old_cohort = cqc.cohort
+            cqc.update(cq)
+            self.hm.update_cluster_queue_edge(cqc.name, cq.spec.cohort)
+            self._wire_cohort(cqc)
+            cqc.update_with_flavors(self.resource_flavors)
+            cqc.update_with_checks(self.admission_checks)
+            if old_cohort is not None and old_cohort is not cqc.cohort:
+                update_cohort_resource_node(old_cohort)
+            self._refresh_cohort(cqc)
+
+    def delete_cluster_queue(self, name: str) -> None:
+        with self._lock:
+            cqc = self.hm.cluster_queues.get(name)
+            if cqc is None:
+                return
+            cqc.status = TERMINATING
+            old_cohort = cqc.cohort
+            self.hm.delete_cluster_queue(name)
+            if old_cohort is not None:
+                update_cohort_resource_node(old_cohort)
+
+    def cluster_queue(self, name: str) -> Optional[ClusterQueueCache]:
+        return self.hm.cluster_queues.get(name)
+
+    def cluster_queue_active(self, name: str) -> bool:
+        cqc = self.hm.cluster_queues.get(name)
+        return cqc is not None and cqc.active
+
+    def _wire_cohort(self, cqc: ClusterQueueCache) -> None:
+        node = self.hm.cohort_of(cqc.name)
+        cqc.cohort = node.payload if node else None
+
+    def _refresh_cohort(self, cqc: ClusterQueueCache) -> None:
+        if cqc.cohort is not None:
+            update_cohort_resource_node(cqc.cohort)
+
+    # --- Cohorts (explicit v1alpha1 objects with quotas) ---
+
+    def add_or_update_cohort(self, cohort: api.Cohort) -> None:
+        with self._lock:
+            node = self.hm.add_cohort(cohort.metadata.name)
+            node.payload.resource_node.quotas = build_quotas(cohort.spec.resource_groups)
+            if cohort.spec.parent:
+                self.hm.update_cohort_edge(cohort.metadata.name, cohort.spec.parent)
+            update_cohort_resource_node(node.payload)
+
+    def delete_cohort(self, name: str) -> None:
+        with self._lock:
+            node = self.hm.cohorts.get(name)
+            if node is not None:
+                node.payload.resource_node.quotas = {}
+                update_cohort_resource_node(node.payload)
+            self.hm.delete_cohort(name)
+
+    # --- flavors & checks ---
+
+    def add_or_update_resource_flavor(self, rf: api.ResourceFlavor) -> set:
+        with self._lock:
+            self.resource_flavors[rf.metadata.name] = rf
+            return self._refresh_flavor_dependents()
+
+    def delete_resource_flavor(self, name: str) -> set:
+        with self._lock:
+            self.resource_flavors.pop(name, None)
+            return self._refresh_flavor_dependents()
+
+    def _refresh_flavor_dependents(self) -> set:
+        affected = set()
+        for cqc in self.hm.cluster_queues.values():
+            was = cqc.active
+            cqc.update_with_flavors(self.resource_flavors)
+            if cqc.active != was:
+                affected.add(cqc.name)
+        return affected
+
+    def add_or_update_admission_check(self, ac: api.AdmissionCheck) -> set:
+        with self._lock:
+            self.admission_checks[ac.metadata.name] = AdmissionCheckEntry(
+                controller_name=ac.spec.controller_name,
+                active=is_condition_true(ac.status.conditions, api.ADMISSION_CHECK_ACTIVE))
+            return self._refresh_check_dependents()
+
+    def delete_admission_check(self, name: str) -> set:
+        with self._lock:
+            self.admission_checks.pop(name, None)
+            return self._refresh_check_dependents()
+
+    def _refresh_check_dependents(self) -> set:
+        affected = set()
+        for cqc in self.hm.cluster_queues.values():
+            was = cqc.active
+            cqc.update_with_checks(self.admission_checks)
+            if cqc.active != was:
+                affected.add(cqc.name)
+        return affected
+
+    # --- local queues ---
+
+    def add_local_queue(self, lq: api.LocalQueue) -> None:
+        with self._lock:
+            cqc = self.hm.cluster_queues.get(lq.spec.cluster_queue)
+            if cqc is None:
+                return
+            key = f"{lq.metadata.namespace}/{lq.metadata.name}"
+            usage = LocalQueueUsage()
+            # Rebuild usage from workloads already in the CQ (reference:
+            # clusterqueue.go:440-448).
+            for info in cqc.workloads.values():
+                if wlpkg.queue_key(info.obj) != key:
+                    continue
+                for fr, q in info.flavor_resource_usage().items():
+                    usage.usage[fr] = usage.usage.get(fr, 0) + q
+                    if wlpkg.is_admitted(info.obj):
+                        usage.admitted_usage[fr] = usage.admitted_usage.get(fr, 0) + q
+                usage.reserving_workloads += 1
+                if wlpkg.is_admitted(info.obj):
+                    usage.admitted_workloads += 1
+            cqc.local_queues[key] = usage
+
+    def delete_local_queue(self, lq: api.LocalQueue) -> None:
+        with self._lock:
+            cqc = self.hm.cluster_queues.get(lq.spec.cluster_queue)
+            if cqc is not None:
+                cqc.local_queues.pop(f"{lq.metadata.namespace}/{lq.metadata.name}", None)
+
+    def local_queue_usage(self, lq: api.LocalQueue) -> Optional[LocalQueueUsage]:
+        cqc = self.hm.cluster_queues.get(lq.spec.cluster_queue)
+        if cqc is None:
+            return None
+        return cqc.local_queues.get(f"{lq.metadata.namespace}/{lq.metadata.name}")
+
+    # --- workloads (reference: cache.go:390-595) ---
+
+    def add_or_update_workload(self, wl: api.Workload) -> bool:
+        with self._lock:
+            self._delete_workload_locked(wl)
+            if wl.status.admission is None:
+                return False
+            cqc = self.hm.cluster_queues.get(wl.status.admission.cluster_queue)
+            if cqc is None:
+                return False
+            info = self._new_info(wl)
+            cqc.add_workload(info)
+            if self.pods_ready_tracking and not is_condition_true(
+                    wl.status.conditions, api.WORKLOAD_PODS_READY):
+                cqc.workloads_not_ready.add(info.key)
+            self._pods_ready_cond.notify_all()
+            return True
+
+    def delete_workload(self, wl: api.Workload) -> bool:
+        with self._lock:
+            deleted = self._delete_workload_locked(wl)
+            self._pods_ready_cond.notify_all()
+            return deleted
+
+    def _delete_workload_locked(self, wl: api.Workload) -> bool:
+        key = wlpkg.key(wl)
+        cq_name = self.assumed_workloads.pop(key, None)
+        if cq_name is None and wl.status.admission is not None:
+            cq_name = wl.status.admission.cluster_queue
+        if cq_name is None:
+            return False
+        cqc = self.hm.cluster_queues.get(cq_name)
+        if cqc is None:
+            return False
+        info = cqc.workloads.get(key)
+        if info is None:
+            return False
+        cqc.delete_workload(info)
+        cqc.workloads_not_ready.discard(key)
+        return True
+
+    def assume_workload(self, wl: api.Workload) -> None:
+        """Optimistically account for a workload before the API write
+        (reference: cache.go:546)."""
+        with self._lock:
+            key = wlpkg.key(wl)
+            if key in self.assumed_workloads:
+                raise KeyError(f"workload {key} already assumed")
+            if wl.status.admission is None:
+                raise ValueError("cannot assume workload without admission")
+            cqc = self.hm.cluster_queues.get(wl.status.admission.cluster_queue)
+            if cqc is None:
+                raise KeyError(f"cluster queue {wl.status.admission.cluster_queue} not found")
+            info = self._new_info(wl)
+            cqc.add_workload(info)
+            if self.pods_ready_tracking and not is_condition_true(
+                    wl.status.conditions, api.WORKLOAD_PODS_READY):
+                cqc.workloads_not_ready.add(key)
+            self.assumed_workloads[key] = cqc.name
+
+    def forget_workload(self, wl: api.Workload) -> None:
+        with self._lock:
+            key = wlpkg.key(wl)
+            if key not in self.assumed_workloads:
+                raise KeyError(f"workload {key} not assumed")
+            self._delete_workload_locked(wl)
+            self._pods_ready_cond.notify_all()
+
+    def is_assumed_or_admitted(self, info: wlpkg.Info) -> bool:
+        with self._lock:
+            key = info.key
+            if key in self.assumed_workloads:
+                return True
+            cqc = self.hm.cluster_queues.get(info.cluster_queue)
+            return cqc is not None and key in cqc.workloads
+
+    def _new_info(self, wl: api.Workload) -> wlpkg.Info:
+        return wlpkg.Info(wl, excluded_resource_prefixes=self.excluded_resource_prefixes)
+
+    # --- PodsReady gating (reference: cache.go:145-192) ---
+
+    def pods_ready_for_all_admitted_workloads(self) -> bool:
+        with self._lock:
+            if not self.pods_ready_tracking:
+                return True
+            return all(not cqc.workloads_not_ready
+                       for cqc in self.hm.cluster_queues.values())
+
+    def mark_workload_pods_ready(self, wl: api.Workload) -> None:
+        with self._lock:
+            key = wlpkg.key(wl)
+            for cqc in self.hm.cluster_queues.values():
+                cqc.workloads_not_ready.discard(key)
+            self._pods_ready_cond.notify_all()
+
+    def wait_for_pods_ready(self, timeout: Optional[float] = None) -> bool:
+        with self._pods_ready_cond:
+            return self._pods_ready_cond.wait_for(
+                lambda: all(not c.workloads_not_ready
+                            for c in self.hm.cluster_queues.values()),
+                timeout=timeout)
+
+    # --- snapshot (reference: snapshot.go:79-142) ---
+
+    def snapshot(self) -> Snapshot:
+        with self._lock:
+            snap = Snapshot()
+            for name, cqc in self.hm.cluster_queues.items():
+                if not cqc.active:
+                    snap.inactive_cluster_queue_sets.add(name)
+                    continue
+                snap.cluster_queues[name] = ClusterQueueSnapshot(cqc)
+            snap.resource_flavors = dict(self.resource_flavors)
+            for cname, node in self.hm.cohorts.items():
+                cohort_snap = CohortSnapshot(cname, node.payload.resource_node.clone())
+                for cqc in node.child_cqs.values():
+                    if cqc.name in snap.cluster_queues:
+                        cq_snap = snap.cluster_queues[cqc.name]
+                        cq_snap.cohort = cohort_snap
+                        cohort_snap.members.add(cq_snap)
+                        cohort_snap.allocatable_resource_generation += cq_snap.allocatable_resource_generation
+            return snap
+
+    # --- usage reporting (status/metrics) ---
+
+    def usage_for_cluster_queue(self, name: str) -> tuple:
+        """(reservation usage, admitted usage) as FlavorResource dicts."""
+        with self._lock:
+            cqc = self.hm.cluster_queues.get(name)
+            if cqc is None:
+                return {}, {}
+            return dict(cqc.resource_node.usage), dict(cqc.admitted_usage)

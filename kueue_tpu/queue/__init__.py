@@ -1,0 +1,4 @@
+"""Pending-workload queues (reference: pkg/queue)."""
+
+from kueue_tpu.queue.cluster_queue import ClusterQueueHeap, RequeueReason  # noqa: F401
+from kueue_tpu.queue.manager import Manager  # noqa: F401

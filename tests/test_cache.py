@@ -1,0 +1,206 @@
+"""Cache/snapshot invariants: hierarchical quota math, borrowing/lending,
+usage bubbling, assume/forget, DRF shares.
+
+Mirrors the reference's pkg/cache/{snapshot_test.go,cache_test.go}
+core cases.
+"""
+
+import pytest
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.cache import Cache
+from kueue_tpu.core.resources import FlavorResource
+from kueue_tpu.core import workload as wlpkg
+from tests.wrappers import ClusterQueueWrapper, WorkloadWrapper, flavor_quotas, make_flavor
+
+CPU = "cpu"
+FR = FlavorResource("default", CPU)
+
+
+def make_cache_with_cohort():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cq_a = (ClusterQueueWrapper("a").cohort("team")
+            .resource_group(flavor_quotas("default", cpu=("10", "20", None))).obj())
+    cq_b = (ClusterQueueWrapper("b").cohort("team")
+            .resource_group(flavor_quotas("default", cpu=("20", None, None))).obj())
+    cache.add_cluster_queue(cq_a)
+    cache.add_cluster_queue(cq_b)
+    return cache
+
+
+class TestQuotaMath:
+    def test_available_with_cohort(self):
+        cache = make_cache_with_cohort()
+        snap = cache.snapshot()
+        a = snap.cluster_queues["a"]
+        # Full cohort capacity: 10 (own) + 20 (b lends) = 30, capped by
+        # borrowing limit 20 above nominal => min(10+20, 30) = 30
+        assert a.available(FR) == 30000
+        assert a.potential_available(FR) == 30000
+
+    def test_borrowing_limit_caps_available(self):
+        cache = Cache()
+        cache.add_or_update_resource_flavor(make_flavor("default"))
+        cq_a = (ClusterQueueWrapper("a").cohort("team")
+                .resource_group(flavor_quotas("default", cpu=("10", "5", None))).obj())
+        cq_b = (ClusterQueueWrapper("b").cohort("team")
+                .resource_group(flavor_quotas("default", cpu="20")).obj())
+        cache.add_cluster_queue(cq_a)
+        cache.add_cluster_queue(cq_b)
+        snap = cache.snapshot()
+        assert snap.cluster_queues["a"].available(FR) == 15000  # 10 + borrow 5
+
+    def test_lending_limit_reserves_guaranteed(self):
+        cache = Cache()
+        cache.add_or_update_resource_flavor(make_flavor("default"))
+        cq_a = (ClusterQueueWrapper("a").cohort("team")
+                .resource_group(flavor_quotas("default", cpu=("10", None, "4"))).obj())
+        cq_b = (ClusterQueueWrapper("b").cohort("team")
+                .resource_group(flavor_quotas("default", cpu="0")).obj())
+        cache.add_cluster_queue(cq_a)
+        cache.add_cluster_queue(cq_b)
+        snap = cache.snapshot()
+        # b can only borrow what a lends: 4
+        assert snap.cluster_queues["b"].available(FR) == 4000
+        # a keeps guaranteed 6 locally + its 4 in the cohort
+        assert snap.cluster_queues["a"].available(FR) == 10000
+
+    def test_usage_bubbles_past_guaranteed(self):
+        cache = make_cache_with_cohort()
+        w = (WorkloadWrapper("w1").pod_set(count=1, cpu="15")
+             .reserve("a", flavor="default").obj())
+        cache.add_or_update_workload(w)
+        snap = cache.snapshot()
+        a = snap.cluster_queues["a"]
+        b = snap.cluster_queues["b"]
+        assert a.usage_for(FR) == 15000
+        assert a.borrowing(FR)  # 15 > nominal 10
+        # cohort usage = 15 - 0 guaranteed... a has no lending limit so
+        # guaranteed=0 and all 15 bubbles up; b sees 30 total - 15 used - its 0
+        assert b.available(FR) == 30000 - 15000
+
+    def test_remove_usage_restores(self):
+        cache = make_cache_with_cohort()
+        w = WorkloadWrapper("w1").pod_set(count=1, cpu="15").reserve("a").obj()
+        cache.add_or_update_workload(w)
+        cache.delete_workload(w)
+        snap = cache.snapshot()
+        assert snap.cluster_queues["a"].usage_for(FR) == 0
+        # b's own 20 plus everything a lends (no lending limit -> all 10)
+        assert snap.cluster_queues["b"].available(FR) == 30000
+
+
+class TestAssume:
+    def test_assume_then_forget(self):
+        cache = make_cache_with_cohort()
+        w = WorkloadWrapper("w1").pod_set(count=1, cpu="5").reserve("a").obj()
+        cache.assume_workload(w)
+        assert cache.is_assumed_or_admitted(wlpkg.Info(w))
+        assert cache.snapshot().cluster_queues["a"].usage_for(FR) == 5000
+        cache.forget_workload(w)
+        assert not cache.is_assumed_or_admitted(wlpkg.Info(w))
+        assert cache.snapshot().cluster_queues["a"].usage_for(FR) == 0
+
+    def test_double_assume_raises(self):
+        cache = make_cache_with_cohort()
+        w = WorkloadWrapper("w1").pod_set(count=1, cpu="5").reserve("a").obj()
+        cache.assume_workload(w)
+        with pytest.raises(KeyError):
+            cache.assume_workload(w)
+
+
+class TestSnapshotSimulation:
+    def test_remove_add_workload_roundtrip(self):
+        cache = make_cache_with_cohort()
+        w = WorkloadWrapper("w1").pod_set(count=1, cpu="8").reserve("a").obj()
+        cache.add_or_update_workload(w)
+        snap = cache.snapshot()
+        info = snap.cluster_queues["a"].workloads[wlpkg.key(w)]
+        before = snap.cluster_queues["a"].usage_for(FR)
+        snap.remove_workload(info)
+        assert snap.cluster_queues["a"].usage_for(FR) == before - 8000
+        snap.add_workload(info)
+        assert snap.cluster_queues["a"].usage_for(FR) == before
+        # cache unchanged by snapshot mutation
+        assert cache.snapshot().cluster_queues["a"].usage_for(FR) == 8000
+
+
+class TestInactive:
+    def test_missing_flavor_inactivates(self):
+        cache = Cache()
+        cq = (ClusterQueueWrapper("a")
+              .resource_group(flavor_quotas("missing", cpu="10")).obj())
+        cache.add_cluster_queue(cq)
+        assert not cache.cluster_queue_active("a")
+        snap = cache.snapshot()
+        assert "a" in snap.inactive_cluster_queue_sets
+        cache.add_or_update_resource_flavor(make_flavor("missing"))
+        assert cache.cluster_queue_active("a")
+
+    def test_stopped_cq_inactive(self):
+        cache = Cache()
+        cache.add_or_update_resource_flavor(make_flavor("default"))
+        cq = (ClusterQueueWrapper("a")
+              .resource_group(flavor_quotas("default", cpu="10")).obj())
+        cq.spec.stop_policy = api.HOLD
+        cache.add_cluster_queue(cq)
+        assert not cache.cluster_queue_active("a")
+
+    def test_missing_check_inactivates(self):
+        cache = Cache()
+        cache.add_or_update_resource_flavor(make_flavor("default"))
+        cq = (ClusterQueueWrapper("a")
+              .resource_group(flavor_quotas("default", cpu="10"))
+              .admission_checks("prov").obj())
+        cache.add_cluster_queue(cq)
+        assert not cache.cluster_queue_active("a")
+        ac = api.AdmissionCheck()
+        ac.metadata.name = "prov"
+        from kueue_tpu.api.meta import Condition, set_condition
+        set_condition(ac.status.conditions, Condition(
+            type=api.ADMISSION_CHECK_ACTIVE, status="True"), 1.0)
+        cache.add_or_update_admission_check(ac)
+        assert cache.cluster_queue_active("a")
+
+
+class TestDRF:
+    def test_share_zero_below_nominal(self):
+        cache = make_cache_with_cohort()
+        w = WorkloadWrapper("w").pod_set(count=1, cpu="5").reserve("a").obj()
+        cache.add_or_update_workload(w)
+        snap = cache.snapshot()
+        share, _ = snap.cluster_queues["a"].dominant_resource_share()
+        assert share == 0
+
+    def test_share_counts_borrowed(self):
+        cache = make_cache_with_cohort()
+        w = WorkloadWrapper("w").pod_set(count=1, cpu="16").reserve("a").obj()
+        cache.add_or_update_workload(w)
+        snap = cache.snapshot()
+        share, res = snap.cluster_queues["a"].dominant_resource_share()
+        # borrowed 6 over nominal 10; lendable = 30 -> 6*1000/30 = 200
+        assert share == 200
+        assert res == CPU
+
+    def test_share_with_hypothetical_request(self):
+        cache = make_cache_with_cohort()
+        snap = cache.snapshot()
+        share, _ = snap.cluster_queues["a"].dominant_resource_share_with({FR: 13000})
+        # would borrow 3 of 30 lendable -> 100
+        assert share == 100
+
+    def test_weight_scales_share(self):
+        cache = Cache()
+        cache.add_or_update_resource_flavor(make_flavor("default"))
+        cq = (ClusterQueueWrapper("a").cohort("team").fair_weight(2000)
+              .resource_group(flavor_quotas("default", cpu="10")).obj())
+        cq_b = (ClusterQueueWrapper("b").cohort("team")
+                .resource_group(flavor_quotas("default", cpu="20")).obj())
+        cache.add_cluster_queue(cq)
+        cache.add_cluster_queue(cq_b)
+        w = WorkloadWrapper("w").pod_set(count=1, cpu="16").reserve("a").obj()
+        cache.add_or_update_workload(w)
+        snap = cache.snapshot()
+        share, _ = snap.cluster_queues["a"].dominant_resource_share()
+        assert share == 100  # 200 / weight 2

@@ -1,0 +1,175 @@
+"""Queue manager semantics: heads pops one per CQ, priority/FIFO order,
+StrictFIFO vs BestEffortFIFO requeue, inadmissible parking and flush.
+
+Mirrors the reference's pkg/queue/{manager_test.go,cluster_queue_test.go}
+core cases.
+"""
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import FakeClock
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.queue import Manager, RequeueReason
+from tests.wrappers import ClusterQueueWrapper, WorkloadWrapper, flavor_quotas, make_local_queue
+
+
+def setup_manager(strategy=api.BEST_EFFORT_FIFO):
+    m = Manager(clock=FakeClock(1000.0))
+    cq = (ClusterQueueWrapper("cq").queueing_strategy(strategy)
+          .resource_group(flavor_quotas("default", cpu="10")).obj())
+    m.add_cluster_queue(cq)
+    m.add_local_queue(make_local_queue("lq", "default", "cq"))
+    return m
+
+
+class TestHeads:
+    def test_one_head_per_cq_in_priority_order(self):
+        m = setup_manager()
+        m.add_or_update_workload(WorkloadWrapper("low").queue("lq").priority(1)
+                                 .creation(1).pod_set(count=1, cpu="1").obj())
+        m.add_or_update_workload(WorkloadWrapper("high").queue("lq").priority(10)
+                                 .creation(2).pod_set(count=1, cpu="1").obj())
+        heads = m.heads_nonblocking()
+        assert [h.obj.metadata.name for h in heads] == ["high"]
+        heads = m.heads_nonblocking()
+        assert [h.obj.metadata.name for h in heads] == ["low"]
+        assert m.heads_nonblocking() == []
+
+    def test_fifo_within_priority(self):
+        m = setup_manager()
+        m.add_or_update_workload(WorkloadWrapper("b").queue("lq").creation(2)
+                                 .pod_set(count=1, cpu="1").obj())
+        m.add_or_update_workload(WorkloadWrapper("a").queue("lq").creation(1)
+                                 .pod_set(count=1, cpu="1").obj())
+        assert m.heads_nonblocking()[0].obj.metadata.name == "a"
+
+    def test_multiple_cqs_one_head_each(self):
+        m = setup_manager()
+        cq2 = (ClusterQueueWrapper("cq2")
+               .resource_group(flavor_quotas("default", cpu="10")).obj())
+        m.add_cluster_queue(cq2)
+        m.add_local_queue(make_local_queue("lq2", "default", "cq2"))
+        m.add_or_update_workload(WorkloadWrapper("w1").queue("lq")
+                                 .pod_set(count=1, cpu="1").obj())
+        m.add_or_update_workload(WorkloadWrapper("w2", "default").queue("lq2")
+                                 .pod_set(count=1, cpu="1").obj())
+        heads = m.heads_nonblocking()
+        assert {h.obj.metadata.name for h in heads} == {"w1", "w2"}
+
+    def test_workload_without_queue_not_queued(self):
+        m = setup_manager()
+        assert not m.add_or_update_workload(
+            WorkloadWrapper("w").queue("nope").pod_set(count=1, cpu="1").obj())
+
+
+class TestRequeue:
+    def test_best_effort_parks_inadmissible(self):
+        m = setup_manager(api.BEST_EFFORT_FIFO)
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="1").obj()
+        m.add_or_update_workload(w)
+        info = m.heads_nonblocking()[0]
+        assert m.requeue_workload(info, RequeueReason.GENERIC)
+        cqh = m.cluster_queues["cq"]
+        assert cqh.pending_inadmissible() == 1
+        assert cqh.pending_active() == 0
+        assert m.heads_nonblocking() == []
+
+    def test_best_effort_requeues_after_nomination_failure(self):
+        m = setup_manager(api.BEST_EFFORT_FIFO)
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="1").obj()
+        m.add_or_update_workload(w)
+        info = m.heads_nonblocking()[0]
+        assert m.requeue_workload(info, RequeueReason.FAILED_AFTER_NOMINATION)
+        assert m.cluster_queues["cq"].pending_active() == 1
+
+    def test_strict_fifo_requeues_to_heap(self):
+        m = setup_manager(api.STRICT_FIFO)
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="1").obj()
+        m.add_or_update_workload(w)
+        info = m.heads_nonblocking()[0]
+        assert m.requeue_workload(info, RequeueReason.GENERIC)
+        assert m.cluster_queues["cq"].pending_active() == 1
+        assert m.cluster_queues["cq"].pending_inadmissible() == 0
+
+    def test_cohort_flush_moves_parked(self):
+        m = Manager(clock=FakeClock(1000.0))
+        for name in ("cq1", "cq2"):
+            cq = (ClusterQueueWrapper(name).cohort("team")
+                  .resource_group(flavor_quotas("default", cpu="10")).obj())
+            m.add_cluster_queue(cq)
+        m.add_local_queue(make_local_queue("lq1", "default", "cq1"))
+        m.add_local_queue(make_local_queue("lq2", "default", "cq2"))
+        w = WorkloadWrapper("w").queue("lq1").pod_set(count=1, cpu="1").obj()
+        m.add_or_update_workload(w)
+        info = m.heads_nonblocking()[0]
+        m.requeue_workload(info, RequeueReason.GENERIC)
+        assert m.cluster_queues["cq1"].pending_inadmissible() == 1
+        # An event on cq2 (same cohort) flushes cq1's parked workloads.
+        m.queue_inadmissible_workloads({"cq2"})
+        assert m.cluster_queues["cq1"].pending_inadmissible() == 0
+        assert m.cluster_queues["cq1"].pending_active() == 1
+
+    def test_requeue_during_cycle_goes_back_to_heap(self):
+        # If a flush happened after Pop, requeue goes straight to the heap
+        # (popCycle/queueInadmissibleCycle race avoidance).
+        m = setup_manager(api.BEST_EFFORT_FIFO)
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="1").obj()
+        m.add_or_update_workload(w)
+        info = m.heads_nonblocking()[0]
+        m.queue_inadmissible_workloads({"cq"})  # during the cycle
+        assert m.requeue_workload(info, RequeueReason.GENERIC)
+        assert m.cluster_queues["cq"].pending_active() == 1
+
+    def test_requeue_backoff_gates_heap(self):
+        clock = FakeClock(1000.0)
+        m = Manager(clock=clock)
+        cq = (ClusterQueueWrapper("cq")
+              .resource_group(flavor_quotas("default", cpu="10")).obj())
+        m.add_cluster_queue(cq)
+        m.add_local_queue(make_local_queue("lq", "default", "cq"))
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="1").obj()
+        from kueue_tpu.api.meta import Condition, set_condition
+        set_condition(w.status.conditions, Condition(
+            type=api.WORKLOAD_EVICTED, status="True",
+            reason=api.EVICTED_BY_PODS_READY_TIMEOUT), 900.0)
+        w.status.requeue_state = api.RequeueState(count=1, requeue_at=1500.0)
+        m.add_or_update_workload(w)
+        # backoff not expired -> parked
+        assert m.cluster_queues["cq"].pending_inadmissible() == 1
+        clock.advance(600)
+        m.queue_inadmissible_workloads({"cq"})
+        assert m.cluster_queues["cq"].pending_active() == 1
+
+
+class TestVisibilitySnapshot:
+    def test_topn_snapshot(self):
+        m = setup_manager()
+        for i in range(5):
+            m.add_or_update_workload(WorkloadWrapper(f"w{i}").queue("lq").creation(i)
+                                     .pod_set(count=1, cpu="1").obj())
+        assert m.update_snapshot("cq", 3)
+        snap = m.get_snapshot("cq")
+        assert len(snap) == 3
+        assert snap[0][0] == "default/w0"
+        assert not m.update_snapshot("cq", 3)  # unchanged
+
+
+class TestLocalQueueLifecycle:
+    def test_delete_local_queue_removes_items(self):
+        m = setup_manager()
+        m.add_or_update_workload(WorkloadWrapper("w").queue("lq")
+                                 .pod_set(count=1, cpu="1").obj())
+        m.delete_local_queue(make_local_queue("lq", "default", "cq"))
+        assert m.heads_nonblocking() == []
+
+    def test_update_local_queue_moves_items(self):
+        m = setup_manager()
+        cq2 = (ClusterQueueWrapper("cq2")
+               .resource_group(flavor_quotas("default", cpu="10")).obj())
+        m.add_cluster_queue(cq2)
+        m.add_or_update_workload(WorkloadWrapper("w").queue("lq")
+                                 .pod_set(count=1, cpu="1").obj())
+        lq = make_local_queue("lq", "default", "cq2")
+        m.update_local_queue(lq)
+        heads = m.heads_nonblocking()
+        assert len(heads) == 1
+        assert heads[0].cluster_queue == "cq2"

@@ -1,0 +1,14 @@
+"""Admission engine: the sequential (CPU) scheduler.
+
+This is the conformance oracle for the batched TPU solver in
+kueue_tpu.solver, and the fallback path (reference: pkg/scheduler).
+"""
+
+from kueue_tpu.scheduler.flavorassigner import (  # noqa: F401
+    FIT,
+    NO_FIT,
+    PREEMPT,
+    Assignment,
+    FlavorAssigner,
+)
+from kueue_tpu.scheduler.scheduler import Scheduler  # noqa: F401

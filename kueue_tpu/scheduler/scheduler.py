@@ -1,0 +1,415 @@
+"""One admission cycle: heads -> snapshot -> nominate -> sort -> admit.
+
+Equivalent of the reference's pkg/scheduler/scheduler.go:197-353
+(MultiplePreemptions path):
+1. queues.heads() (blocks until any CQ head exists)
+2. cache.snapshot()
+3. nominate(): per-head validation + flavor assignment + preemption targets
+4. sort by borrows -> DRF share -> priority -> FIFO
+5. sequential admit with intra-cycle usage accounting: skip overlapping
+   preemption targets, re-check fit after earlier admissions, reserve
+   capacity for blocked preemptors
+6. requeue non-admitted heads with Pending condition patches
+
+The batched TPU solver (kueue_tpu.solver) replaces steps 3-5; this CPU
+path is the conformance oracle and fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kueue_tpu import features
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import REAL_CLOCK, Clock
+from kueue_tpu.cache import Cache, Snapshot
+from kueue_tpu.cache.snapshot import ClusterQueueSnapshot
+from kueue_tpu.core import limitrange as limitrangepkg
+from kueue_tpu.core import priority as prioritypkg
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.core.resources import container_limits_violations
+from kueue_tpu.queue import Manager, RequeueReason
+from kueue_tpu.scheduler import flavorassigner as fa
+from kueue_tpu.scheduler.podset_reducer import PodSetReducer
+from kueue_tpu.scheduler.preemption import Preemptor, Target, make_reclaim_oracle
+from kueue_tpu.utils.wait import KeepGoing, SlowDown, SpeedSignal, until_with_backoff
+
+# entry statuses (reference: scheduler.go:355-366)
+NOT_NOMINATED = ""
+NOMINATED = "nominated"
+SKIPPED = "skipped"
+ASSUMED = "assumed"
+
+
+@dataclass
+class Entry:
+    info: wlpkg.Info
+    assignment: fa.Assignment = field(default_factory=fa.Assignment)
+    preemption_targets: list = field(default_factory=list)
+    dominant_resource_share: int = 0
+    dominant_resource_name: str = ""
+    status: str = NOT_NOMINATED
+    inadmissible_msg: str = ""
+    requeue_reason: RequeueReason = RequeueReason.GENERIC
+
+    def net_usage(self) -> dict:
+        """Capacity needed net of preempted resources
+        (reference: scheduler.go:385-400)."""
+        if self.assignment.representative_mode() == fa.FIT:
+            return self.assignment.usage
+        usage = dict(self.assignment.usage)
+        for target in self.preemption_targets:
+            for fr, v in target.workload_info.flavor_resource_usage().items():
+                if fr in usage:
+                    usage[fr] = max(0, usage[fr] - v)
+        return usage
+
+
+class SchedulerClient:
+    """Host-environment interface for the scheduler's reads/writes.
+
+    The reference talks to the kube-apiserver; here the in-process object
+    store (kueue_tpu.sim) implements this, and tests use fakes.
+    """
+
+    def namespace_labels(self, namespace: str) -> Optional[dict]:
+        return {}
+
+    def limit_ranges(self, namespace: str) -> list:
+        return []
+
+    def apply_admission(self, wl: api.Workload) -> None:
+        """Persist admission status. Raise KeyError if deleted."""
+
+    def patch_not_admitted(self, wl: api.Workload) -> None:
+        """Persist the Pending/QuotaReserved=False condition."""
+
+    def event(self, wl: api.Workload, event_type: str, reason: str, message: str) -> None:
+        pass
+
+
+class Scheduler:
+    def __init__(self, queues: Manager, cache: Cache, client: SchedulerClient,
+                 ordering: Optional[wlpkg.Ordering] = None,
+                 fair_sharing_enabled: bool = False,
+                 fs_preemption_strategies: Optional[list] = None,
+                 clock: Clock = REAL_CLOCK,
+                 metrics=None):
+        from kueue_tpu.scheduler.preemption import parse_strategies
+        self.queues = queues
+        self.cache = cache
+        self.client = client
+        self.ordering = ordering or wlpkg.Ordering()
+        self.fair_sharing_enabled = fair_sharing_enabled
+        self.clock = clock
+        self.attempt_count = 0
+        self.metrics = metrics
+        self.preemptor = Preemptor(
+            ordering=self.ordering,
+            enable_fair_sharing=fair_sharing_enabled,
+            fs_strategies=parse_strategies(fs_preemption_strategies),
+            clock=clock,
+            apply_preemption=self._apply_preemption)
+        # Synchronous by default; swap for async in production wiring
+        # (reference: routine wrapper, scheduler.go:590).
+        self.admission_routine: Callable[[Callable], None] = lambda f: f()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True, name="scheduler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queues.broadcast()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        until_with_backoff(self._stop, lambda: self.schedule(timeout=0.2))
+
+    # --- the cycle ---
+
+    def schedule(self, timeout: Optional[float] = None) -> SpeedSignal:
+        self.attempt_count += 1
+        heads = self.queues.heads(timeout=timeout)
+        if not heads:
+            return KeepGoing
+        start = self.clock.now()
+
+        snapshot = self.cache.snapshot()
+        entries = self.nominate(heads, snapshot)
+
+        entries.sort(key=self._entry_sort_key())
+
+        preempted_workloads: set = set()
+        skipped_preemptions: dict = {}
+        for e in entries:
+            mode = e.assignment.representative_mode()
+            if mode == fa.NO_FIT:
+                continue
+            cq = snapshot.cluster_queues[e.info.cluster_queue]
+
+            if mode == fa.PREEMPT and not e.preemption_targets:
+                # Reserve capacity so lower-priority workloads can't admit
+                # ahead of the blocked preemptor (reference: scheduler.go:245-253).
+                cq.add_usage(resources_to_reserve(e, cq))
+                continue
+
+            pending = {t.workload_info.key for t in e.preemption_targets}
+            if pending & preempted_workloads:
+                self._set_skipped(e, "Workload has overlapping preemption targets "
+                                     "with another workload")
+                skipped_preemptions[cq.name] = skipped_preemptions.get(cq.name, 0) + 1
+                continue
+
+            usage = e.net_usage()
+            if not cq.fits(usage):
+                self._set_skipped(e, "Workload no longer fits after processing "
+                                     "another workload")
+                if mode == fa.PREEMPT:
+                    skipped_preemptions[cq.name] = skipped_preemptions.get(cq.name, 0) + 1
+                continue
+            preempted_workloads.update(pending)
+            cq.add_usage(usage)
+
+            if mode != fa.FIT:
+                if e.preemption_targets:
+                    # Next attempt should try all flavors again.
+                    e.info.last_assignment = None
+                    preempted = self.preemptor.issue_preemptions(e.info, e.preemption_targets)
+                    if preempted:
+                        e.inadmissible_msg += (f". Pending the preemption of "
+                                               f"{preempted} workload(s)")
+                        e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+                continue
+
+            if not self.cache.pods_ready_for_all_admitted_workloads():
+                # waitForPodsReady blockAdmission (reference: scheduler.go:316-327)
+                wlpkg.unset_quota_reservation_with_condition(
+                    e.info.obj, "Waiting",
+                    "waiting for all admitted workloads to be in PodsReady condition",
+                    self.clock.now())
+                self.client.patch_not_admitted(e.info.obj)
+                self.cache.wait_for_pods_ready(timeout=timeout)
+
+            e.status = NOMINATED
+            try:
+                self.admit(e, cq)
+            except Exception as exc:  # noqa: BLE001 — cache/API races surface here
+                e.inadmissible_msg = f"Failed to admit workload: {exc}"
+
+        result_success = False
+        for e in entries:
+            if e.status != ASSUMED:
+                self.requeue_and_update(e)
+            else:
+                result_success = True
+
+        if self.metrics is not None:
+            self.metrics.admission_attempt(result_success, self.clock.now() - start)
+            for cq_name, count in skipped_preemptions.items():
+                self.metrics.preemption_skips(cq_name, count)
+        return KeepGoing if result_success else SlowDown
+
+    # --- nomination (reference: scheduler.go:404-441) ---
+
+    def nominate(self, heads: list, snapshot: Snapshot) -> list:
+        entries = []
+        for w in heads:
+            cq = snapshot.cluster_queues.get(w.cluster_queue)
+            e = Entry(info=w)
+            if self.cache.is_assumed_or_admitted(w):
+                continue
+            ns_labels = self.client.namespace_labels(w.obj.metadata.namespace)
+            if wlpkg.has_retry_checks(w.obj) or wlpkg.has_rejected_checks(w.obj):
+                e.inadmissible_msg = "The workload has failed admission checks"
+            elif w.cluster_queue in snapshot.inactive_cluster_queue_sets:
+                e.inadmissible_msg = f"ClusterQueue {w.cluster_queue} is inactive"
+            elif cq is None:
+                e.inadmissible_msg = f"ClusterQueue {w.cluster_queue} not found"
+            elif ns_labels is None:
+                e.inadmissible_msg = "Could not obtain workload namespace"
+            elif cq.namespace_selector is None or not cq.namespace_selector.matches(ns_labels):
+                e.inadmissible_msg = "Workload namespace doesn't match ClusterQueue selector"
+                e.requeue_reason = RequeueReason.NAMESPACE_MISMATCH
+            elif (err := self._validate_resources(w)) is not None:
+                e.inadmissible_msg = err
+            elif (err := self._validate_limit_range(w)) is not None:
+                e.inadmissible_msg = err
+            else:
+                e.assignment, e.preemption_targets = self.get_assignments(w, snapshot)
+                e.inadmissible_msg = e.assignment.message()
+                w.last_assignment = e.assignment.last_state
+                if self.fair_sharing_enabled and e.assignment.representative_mode() != fa.NO_FIT:
+                    e.dominant_resource_share, e.dominant_resource_name = \
+                        cq.dominant_resource_share_with(e.assignment.total_requests_for(w))
+            entries.append(e)
+        return entries
+
+    def get_assignments(self, wl: wlpkg.Info, snapshot: Snapshot):
+        """reference: scheduler.go:469-507."""
+        cq = snapshot.cluster_queues[wl.cluster_queue]
+        oracle = make_reclaim_oracle(self.preemptor, snapshot)
+        assigner = fa.FlavorAssigner(wl, cq, snapshot.resource_flavors,
+                                     self.fair_sharing_enabled, oracle)
+        full = assigner.assign()
+        mode = full.representative_mode()
+        if mode == fa.FIT:
+            return full, []
+        targets: list = []
+        if mode == fa.PREEMPT:
+            targets = self.preemptor.get_targets(wl, full, snapshot)
+
+        if not features.enabled(features.PARTIAL_ADMISSION) or targets:
+            return full, targets
+
+        if wl.can_be_partially_admitted():
+            def fits(counts: list):
+                assignment = assigner.assign(counts)
+                m = assignment.representative_mode()
+                if m == fa.FIT:
+                    return (assignment, []), True
+                if m == fa.PREEMPT:
+                    t = self.preemptor.get_targets(wl, assignment, snapshot)
+                    if t:
+                        return (assignment, t), True
+                return None, False
+
+            reducer = PodSetReducer(wl.obj.spec.pod_sets, fits)
+            result, found = reducer.search()
+            if found:
+                return result
+        return full, []
+
+    # --- validation (reference: scheduler.go:509-566) ---
+
+    def _validate_resources(self, wl: wlpkg.Info) -> Optional[str]:
+        reasons = []
+        for ps in wl.obj.spec.pod_sets:
+            spec = ps.template.spec
+            bad = container_limits_violations(
+                list(spec.init_containers) + list(spec.containers))
+            if bad:
+                reasons.append(f"podSets[{ps.name}][{', '.join(bad)}] "
+                               f"requests exceed limits")
+        if reasons:
+            return "resource validation failed: " + "; ".join(reasons)
+        return None
+
+    def _validate_limit_range(self, wl: wlpkg.Info) -> Optional[str]:
+        ranges = self.client.limit_ranges(wl.obj.metadata.namespace)
+        if not ranges:
+            return None
+        summary = limitrangepkg.summarize(*ranges)
+        reasons = []
+        for ps in wl.obj.spec.pod_sets:
+            reasons.extend(limitrangepkg.validate_pod_spec(
+                ps.template.spec, summary, path=f"podSets[{ps.name}]"))
+        if reasons:
+            return "didn't satisfy LimitRange constraints: " + "; ".join(reasons)
+        return None
+
+    # --- admission (reference: scheduler.go:571-623) ---
+
+    def admit(self, e: Entry, cq: ClusterQueueSnapshot) -> None:
+        new_wl = wlpkg.deepcopy(e.info.obj)
+        admission = api.Admission(cluster_queue=e.info.cluster_queue,
+                                  pod_set_assignments=e.assignment.to_api())
+        now = self.clock.now()
+        wlpkg.set_quota_reservation(new_wl, admission, now)
+        checks = wlpkg.admission_checks_for_workload(new_wl, cq.admission_checks)
+        if wlpkg.has_all_checks(new_wl, checks):
+            wlpkg.sync_admitted_condition(new_wl, now)
+        self.cache.assume_workload(new_wl)
+        e.status = ASSUMED
+
+        def apply():
+            try:
+                self.client.apply_admission(new_wl)
+            except KeyError:
+                # Deleted or CQ gone: roll back the assumption.
+                try:
+                    self.cache.forget_workload(new_wl)
+                except KeyError:
+                    pass
+                return
+            wait_time = wlpkg.queued_wait_time(new_wl, now)
+            self.client.event(new_wl, "Normal", "QuotaReserved",
+                              f"Quota reserved in ClusterQueue {admission.cluster_queue}, "
+                              f"wait time since queued was {wait_time:.0f}s")
+            if self.metrics is not None:
+                self.metrics.quota_reserved(admission.cluster_queue, wait_time)
+                if wlpkg.is_admitted(new_wl):
+                    self.metrics.admitted(admission.cluster_queue, wait_time)
+            if wlpkg.is_admitted(new_wl):
+                self.client.event(new_wl, "Normal", "Admitted",
+                                  f"Admitted by ClusterQueue {admission.cluster_queue}, "
+                                  f"wait time since reservation was 0s")
+
+        self.admission_routine(apply)
+
+    def _apply_preemption(self, wl: api.Workload, reason: str, message: str) -> None:
+        target = wlpkg.deepcopy(wl)
+        now = self.clock.now()
+        wlpkg.set_evicted_condition(target, api.EVICTED_BY_PREEMPTION, message, now)
+        wlpkg.set_preempted_condition(target, reason, message, now)
+        self.client.apply_admission(target)
+        self.client.event(target, "Normal", "Preempted", message)
+        if self.metrics is not None:
+            self.metrics.preempted(reason)
+
+    # --- requeue (reference: scheduler.go:674-692) ---
+
+    def requeue_and_update(self, e: Entry) -> None:
+        if e.status != NOT_NOMINATED and e.requeue_reason == RequeueReason.GENERIC:
+            e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
+        self.queues.requeue_workload(e.info, e.requeue_reason)
+        if e.status in (NOT_NOMINATED, SKIPPED):
+            patch = wlpkg.deepcopy(e.info.obj)
+            if wlpkg.unset_quota_reservation_with_condition(
+                    patch, "Pending", e.inadmissible_msg, self.clock.now()):
+                self.client.patch_not_admitted(patch)
+            self.client.event(e.info.obj, "Normal", "Pending", e.inadmissible_msg[:1024])
+
+    @staticmethod
+    def _set_skipped(e: Entry, msg: str) -> None:
+        e.status = SKIPPED
+        e.inadmissible_msg = msg
+        e.requeue_reason = RequeueReason.GENERIC
+
+    # --- ordering (reference: scheduler.go:625-672) ---
+
+    def _entry_sort_key(self):
+        def sort_key(e: Entry):
+            borrows = e.assignment.borrows()
+            share = e.dominant_resource_share if self.fair_sharing_enabled else 0
+            prio = (prioritypkg.priority(e.info.obj)
+                    if features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT) else 0)
+            ts = self.ordering.queue_order_timestamp(e.info.obj)
+            return (borrows, share, -prio, ts)
+        return sort_key
+
+
+def resources_to_reserve(e: Entry, cq: ClusterQueueSnapshot) -> dict:
+    """How much capacity a blocked preemptor reserves
+    (reference: scheduler.go:444-462)."""
+    if e.assignment.representative_mode() != fa.PREEMPT:
+        return e.assignment.usage
+    reserved = {}
+    for fr, usage in e.assignment.usage.items():
+        quota = cq.quota_for(fr)
+        if e.assignment.borrowing:
+            if quota.borrowing_limit is None:
+                reserved[fr] = usage
+            else:
+                reserved[fr] = min(usage, quota.nominal + quota.borrowing_limit
+                                   - cq.usage_for(fr))
+        else:
+            reserved[fr] = max(0, min(usage, quota.nominal - cq.usage_for(fr)))
+    return reserved

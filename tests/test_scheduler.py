@@ -1,0 +1,440 @@
+"""Scheduler cycle semantics: fit, borrowing, ordering, fungibility,
+preemption, partial admission, StrictFIFO head blocking.
+
+Transliterated from the core cases of the reference's
+pkg/scheduler/scheduler_test.go, flavorassigner_test.go and
+preemption_test.go.
+"""
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.corev1 import Taint
+from kueue_tpu.api.meta import FakeClock
+from kueue_tpu.cache import Cache
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.core.resources import FlavorResource
+from kueue_tpu.queue import Manager
+from kueue_tpu.scheduler import Scheduler
+from kueue_tpu.scheduler.scheduler import SchedulerClient
+from tests.wrappers import (
+    ClusterQueueWrapper,
+    WorkloadWrapper,
+    flavor_quotas,
+    make_flavor,
+    make_local_queue,
+)
+
+CPU = "cpu"
+
+
+class FakeClient(SchedulerClient):
+    def __init__(self):
+        self.applied = {}        # wl key -> workload (admission writes)
+        self.evicted = {}        # wl key -> workload
+        self.pending_patches = []
+        self.events = []
+        self.namespaces = {"default": {}}
+        self.limitranges = {}
+
+    def namespace_labels(self, namespace):
+        return self.namespaces.get(namespace)
+
+    def limit_ranges(self, namespace):
+        return self.limitranges.get(namespace, [])
+
+    def apply_admission(self, wl):
+        if wlpkg.is_evicted(wl):
+            self.evicted[wlpkg.key(wl)] = wl
+        else:
+            self.applied[wlpkg.key(wl)] = wl
+
+    def patch_not_admitted(self, wl):
+        self.pending_patches.append(wl)
+
+    def event(self, wl, event_type, reason, message):
+        self.events.append((wlpkg.key(wl), reason))
+
+
+class Env:
+    def __init__(self, fair_sharing=False):
+        self.clock = FakeClock(1000.0)
+        self.cache = Cache()
+        self.queues = Manager(clock=self.clock)
+        self.client = FakeClient()
+        self.scheduler = Scheduler(self.queues, self.cache, self.client,
+                                   clock=self.clock, fair_sharing_enabled=fair_sharing)
+
+    def add_flavor(self, name, labels=None, taints=None):
+        self.cache.add_or_update_resource_flavor(make_flavor(name, labels, taints))
+
+    def add_cq(self, cq, lq_name=None):
+        self.cache.add_cluster_queue(cq)
+        self.queues.add_cluster_queue(cq)
+        self.queues.add_local_queue(
+            make_local_queue(lq_name or f"lq-{cq.metadata.name}", "default",
+                             cq.metadata.name))
+
+    def admit_existing(self, wl):
+        """Pre-admitted workload occupying quota."""
+        self.cache.add_or_update_workload(wl)
+
+    def submit(self, wl):
+        assert self.queues.add_or_update_workload(wl)
+
+    def cycle(self):
+        return self.scheduler.schedule(timeout=0.01)
+
+    def usage(self, cq, flavor="default", resource=CPU):
+        reservation, _ = self.cache.usage_for_cluster_queue(cq)
+        return reservation.get(FlavorResource(flavor, resource), 0)
+
+
+def simple_env(nominal="10", strategy=api.BEST_EFFORT_FIFO):
+    env = Env()
+    env.add_flavor("default")
+    env.add_cq(ClusterQueueWrapper("cq").queueing_strategy(strategy)
+               .resource_group(flavor_quotas("default", cpu=nominal)).obj(), "lq")
+    return env
+
+
+class TestBasicAdmission:
+    def test_admits_when_fits(self):
+        env = simple_env()
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=2, cpu="2").obj()
+        env.submit(w)
+        env.cycle()
+        applied = env.client.applied["default/w"]
+        assert wlpkg.has_quota_reservation(applied)
+        assert wlpkg.is_admitted(applied)  # no admission checks
+        psa = applied.status.admission.pod_set_assignments[0]
+        assert psa.flavors[CPU] == "default"
+        assert psa.resource_usage[CPU] == 4000
+        assert env.usage("cq") == 4000
+
+    def test_pending_when_no_quota(self):
+        env = simple_env(nominal="1")
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="2").obj()
+        env.submit(w)
+        env.cycle()
+        assert "default/w" not in env.client.applied
+        assert env.client.pending_patches  # Pending condition written
+        assert env.queues.cluster_queues["cq"].pending_inadmissible() == 1
+
+    def test_namespace_selector_mismatch(self):
+        env = Env()
+        env.add_flavor("default")
+        from kueue_tpu.api.meta import LabelSelector
+        cq = (ClusterQueueWrapper("cq")
+              .resource_group(flavor_quotas("default", cpu="10")).obj())
+        cq.spec.namespace_selector = LabelSelector(match_labels={"team": "x"})
+        env.add_cq(cq, "lq")
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="1").obj()
+        env.submit(w)
+        env.cycle()
+        assert "default/w" not in env.client.applied
+
+    def test_requests_exceeding_limits_rejected(self):
+        env = simple_env()
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="1").obj()
+        w.spec.pod_sets[0].template.spec.containers[0].limits[CPU] = 500
+        env.submit(w)
+        env.cycle()
+        assert "default/w" not in env.client.applied
+
+    def test_admission_checks_keep_admitted_false(self):
+        env = Env()
+        env.add_flavor("default")
+        from kueue_tpu.api.meta import Condition, set_condition
+        ac = api.AdmissionCheck()
+        ac.metadata.name = "prov"
+        set_condition(ac.status.conditions, Condition(
+            type=api.ADMISSION_CHECK_ACTIVE, status="True"), 1.0)
+        env.cache.add_or_update_admission_check(ac)
+        env.add_cq(ClusterQueueWrapper("cq").admission_checks("prov")
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq")
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="1").obj()
+        env.submit(w)
+        env.cycle()
+        applied = env.client.applied["default/w"]
+        assert wlpkg.has_quota_reservation(applied)
+        assert not wlpkg.is_admitted(applied)
+
+
+class TestCohortBorrowing:
+    def make_cohort_env(self):
+        env = Env()
+        env.add_flavor("default")
+        env.add_cq(ClusterQueueWrapper("a").cohort("team")
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq-a")
+        env.add_cq(ClusterQueueWrapper("b").cohort("team")
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq-b")
+        return env
+
+    def test_borrows_cohort_capacity(self):
+        env = self.make_cohort_env()
+        w = WorkloadWrapper("w").queue("lq-a").pod_set(count=1, cpu="15").obj()
+        env.submit(w)
+        env.cycle()
+        assert "default/w" in env.client.applied
+
+    def test_non_borrowing_admitted_first(self):
+        env = self.make_cohort_env()
+        # borrower (12 > nominal 10) vs non-borrower; both fit only one.
+        big = WorkloadWrapper("big").queue("lq-a").priority(100).creation(1) \
+            .pod_set(count=1, cpu="12").obj()
+        small = WorkloadWrapper("small").queue("lq-b").priority(0).creation(2) \
+            .pod_set(count=1, cpu="10").obj()
+        env.submit(big)
+        env.submit(small)
+        env.cycle()
+        # small doesn't borrow -> goes first despite lower priority; big then
+        # no longer fits (only 10 left in cohort).
+        assert "default/small" in env.client.applied
+        assert "default/big" not in env.client.applied
+
+    def test_borrowing_limit_respected(self):
+        env = Env()
+        env.add_flavor("default")
+        env.add_cq(ClusterQueueWrapper("a").cohort("team")
+                   .resource_group(flavor_quotas("default", cpu=("10", "2", None))).obj(), "lq-a")
+        env.add_cq(ClusterQueueWrapper("b").cohort("team")
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq-b")
+        w = WorkloadWrapper("w").queue("lq-a").pod_set(count=1, cpu="13").obj()
+        env.submit(w)
+        env.cycle()
+        assert "default/w" not in env.client.applied
+
+
+class TestFlavorFungibility:
+    def make_two_flavor_env(self, **fung):
+        env = Env()
+        env.add_flavor("spot")
+        env.add_flavor("on-demand")
+        cq = (ClusterQueueWrapper("cq")
+              .resource_group(flavor_quotas("spot", cpu="5"),
+                              flavor_quotas("on-demand", cpu="10")))
+        if fung:
+            cq = cq.flavor_fungibility(**fung)
+        env.add_cq(cq.obj(), "lq")
+        return env
+
+    def test_second_flavor_when_first_full(self):
+        env = self.make_two_flavor_env()
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="8").obj()
+        env.submit(w)
+        env.cycle()
+        psa = env.client.applied["default/w"].status.admission.pod_set_assignments[0]
+        assert psa.flavors[CPU] == "on-demand"
+
+    def test_first_flavor_when_fits(self):
+        env = self.make_two_flavor_env()
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="4").obj()
+        env.submit(w)
+        env.cycle()
+        psa = env.client.applied["default/w"].status.admission.pod_set_assignments[0]
+        assert psa.flavors[CPU] == "spot"
+
+    def test_untolerated_taint_skips_flavor(self):
+        env = Env()
+        env.add_flavor("tainted", taints=[Taint(key="gpu", value="y", effect="NoSchedule")])
+        env.add_flavor("clean")
+        env.add_cq(ClusterQueueWrapper("cq")
+                   .resource_group(flavor_quotas("tainted", cpu="10"),
+                                   flavor_quotas("clean", cpu="10")).obj(), "lq")
+        w = WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="1").obj()
+        env.submit(w)
+        env.cycle()
+        psa = env.client.applied["default/w"].status.admission.pod_set_assignments[0]
+        assert psa.flavors[CPU] == "clean"
+
+    def test_node_selector_picks_matching_flavor(self):
+        env = Env()
+        env.add_flavor("zone-a", labels={"zone": "a"})
+        env.add_flavor("zone-b", labels={"zone": "b"})
+        env.add_cq(ClusterQueueWrapper("cq")
+                   .resource_group(flavor_quotas("zone-a", cpu="10"),
+                                   flavor_quotas("zone-b", cpu="10")).obj(), "lq")
+        w = (WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="1")
+             .node_selector("zone", "b").obj())
+        env.submit(w)
+        env.cycle()
+        psa = env.client.applied["default/w"].status.admission.pod_set_assignments[0]
+        assert psa.flavors[CPU] == "zone-b"
+
+    def test_affinity_in_expression(self):
+        env = Env()
+        env.add_flavor("zone-a", labels={"zone": "a"})
+        env.add_flavor("zone-b", labels={"zone": "b"})
+        env.add_cq(ClusterQueueWrapper("cq")
+                   .resource_group(flavor_quotas("zone-a", cpu="10"),
+                                   flavor_quotas("zone-b", cpu="10")).obj(), "lq")
+        w = (WorkloadWrapper("w").queue("lq").pod_set(count=1, cpu="1")
+             .affinity_in("zone", "b").obj())
+        env.submit(w)
+        env.cycle()
+        psa = env.client.applied["default/w"].status.admission.pod_set_assignments[0]
+        assert psa.flavors[CPU] == "zone-b"
+
+
+class TestPreemption:
+    def make_preempt_env(self, **preemption):
+        env = Env()
+        env.add_flavor("default")
+        env.add_cq(ClusterQueueWrapper("cq")
+                   .preemption(**preemption)
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq")
+        return env
+
+    def test_preempts_lower_priority_in_cq(self):
+        env = self.make_preempt_env(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+        victim = (WorkloadWrapper("victim").queue("lq").priority(0)
+                  .pod_set(count=1, cpu="8").reserve("cq", now=100.0).obj())
+        env.admit_existing(victim)
+        preemptor = (WorkloadWrapper("pre").queue("lq").priority(100)
+                     .pod_set(count=1, cpu="8").obj())
+        env.submit(preemptor)
+        env.cycle()
+        # victim evicted, preemptor pending the preemption
+        assert "default/victim" in env.client.evicted
+        evicted = env.client.evicted["default/victim"]
+        assert wlpkg.is_evicted(evicted)
+        assert "default/pre" not in env.client.applied
+        # simulate the controller processing the eviction:
+        env.cache.delete_workload(victim)
+        env.queues.queue_inadmissible_workloads({"cq"})
+        env.cycle()
+        assert "default/pre" in env.client.applied
+
+    def test_no_preemption_when_policy_never(self):
+        env = self.make_preempt_env()
+        victim = (WorkloadWrapper("victim").queue("lq").priority(0)
+                  .pod_set(count=1, cpu="8").reserve("cq", now=100.0).obj())
+        env.admit_existing(victim)
+        preemptor = (WorkloadWrapper("pre").queue("lq").priority(100)
+                     .pod_set(count=1, cpu="8").obj())
+        env.submit(preemptor)
+        env.cycle()
+        assert not env.client.evicted
+
+    def test_equal_priority_not_preempted_with_lower_priority_policy(self):
+        env = self.make_preempt_env(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+        victim = (WorkloadWrapper("victim").queue("lq").priority(100)
+                  .pod_set(count=1, cpu="8").reserve("cq", now=100.0).obj())
+        env.admit_existing(victim)
+        preemptor = (WorkloadWrapper("pre").queue("lq").priority(100)
+                     .pod_set(count=1, cpu="8").obj())
+        env.submit(preemptor)
+        env.cycle()
+        assert not env.client.evicted
+
+    def test_lower_or_newer_equal_priority(self):
+        env = self.make_preempt_env(
+            within_cluster_queue=api.PREEMPTION_LOWER_OR_NEWER_EQUAL_PRIORITY)
+        victim = (WorkloadWrapper("victim").queue("lq").priority(100).creation(200.0)
+                  .pod_set(count=1, cpu="8").reserve("cq", now=300.0).obj())
+        env.admit_existing(victim)
+        preemptor = (WorkloadWrapper("pre").queue("lq").priority(100).creation(100.0)
+                     .pod_set(count=1, cpu="8").obj())
+        env.submit(preemptor)
+        env.cycle()
+        assert "default/victim" in env.client.evicted
+
+    def test_reclaim_within_cohort(self):
+        env = Env()
+        env.add_flavor("default")
+        env.add_cq(ClusterQueueWrapper("a").cohort("team")
+                   .preemption(reclaim_within_cohort=api.PREEMPTION_ANY)
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq-a")
+        env.add_cq(ClusterQueueWrapper("b").cohort("team")
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq-b")
+        # b borrows the whole cohort
+        borrower = (WorkloadWrapper("borrower").queue("lq-b").priority(100)
+                    .pod_set(count=1, cpu="18").reserve("b", now=100.0).obj())
+        env.admit_existing(borrower)
+        # a reclaims its nominal quota, even against higher priority (Any)
+        reclaimer = (WorkloadWrapper("reclaimer").queue("lq-a").priority(0)
+                     .pod_set(count=1, cpu="8").obj())
+        env.submit(reclaimer)
+        env.cycle()
+        assert "default/borrower" in env.client.evicted
+
+    def test_minimal_set_preempted(self):
+        env = self.make_preempt_env(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+        for i, cpu in enumerate(["4", "4", "2"]):
+            v = (WorkloadWrapper(f"v{i}").queue("lq").priority(i)
+                 .pod_set(count=1, cpu=cpu).reserve("cq", now=100.0 + i).obj())
+            env.admit_existing(v)
+        preemptor = (WorkloadWrapper("pre").queue("lq").priority(100)
+                     .pod_set(count=1, cpu="4").obj())
+        env.submit(preemptor)
+        env.cycle()
+        # needs only 4 cpus; v0 (lowest prio, 4 cpu) suffices after fill-back
+        assert set(env.client.evicted) == {"default/v0"}
+
+
+class TestPartialAdmission:
+    def test_count_reduced_to_fit(self):
+        env = simple_env(nominal="6")
+        w = (WorkloadWrapper("w").queue("lq")
+             .pod_set(count=10, min_count=2, cpu="1").obj())
+        env.submit(w)
+        env.cycle()
+        applied = env.client.applied["default/w"]
+        psa = applied.status.admission.pod_set_assignments[0]
+        assert psa.count == 6
+        assert psa.resource_usage[CPU] == 6000
+
+    def test_no_partial_when_gate_disabled(self):
+        from kueue_tpu import features
+        env = simple_env(nominal="6")
+        w = (WorkloadWrapper("w").queue("lq")
+             .pod_set(count=10, min_count=2, cpu="1").obj())
+        env.submit(w)
+        with features.override(PartialAdmission=False):
+            env.cycle()
+        assert "default/w" not in env.client.applied
+
+
+class TestStrictFIFO:
+    def test_head_blocks_queue(self):
+        env = simple_env(nominal="5", strategy=api.STRICT_FIFO)
+        big = WorkloadWrapper("big").queue("lq").creation(1).pod_set(count=1, cpu="8").obj()
+        small = WorkloadWrapper("small").queue("lq").creation(2).pod_set(count=1, cpu="1").obj()
+        env.submit(big)
+        env.submit(small)
+        env.cycle()
+        env.cycle()
+        assert "default/small" not in env.client.applied  # blocked behind big
+
+    def test_best_effort_skips_head(self):
+        env = simple_env(nominal="5", strategy=api.BEST_EFFORT_FIFO)
+        big = WorkloadWrapper("big").queue("lq").creation(1).pod_set(count=1, cpu="8").obj()
+        small = WorkloadWrapper("small").queue("lq").creation(2).pod_set(count=1, cpu="1").obj()
+        env.submit(big)
+        env.submit(small)
+        env.cycle()
+        env.cycle()
+        assert "default/small" in env.client.applied
+
+
+class TestFairSharing:
+    def test_lower_share_admitted_first(self):
+        env = Env(fair_sharing=True)
+        env.add_flavor("default")
+        env.add_cq(ClusterQueueWrapper("a").cohort("team")
+                   .resource_group(flavor_quotas("default", cpu="8")).obj(), "lq-a")
+        env.add_cq(ClusterQueueWrapper("b").cohort("team")
+                   .resource_group(flavor_quotas("default", cpu="8")).obj(), "lq-b")
+        env.add_cq(ClusterQueueWrapper("c").cohort("team")
+                   .resource_group(flavor_quotas("default", cpu="8")).obj(), "lq-c")
+        # a is already borrowing heavily
+        hog = (WorkloadWrapper("hog").queue("lq-a").pod_set(count=1, cpu="16")
+               .reserve("a", now=50.0).obj())
+        env.admit_existing(hog)
+        # both borrow, but b would borrow less than a's hypothetical second
+        wa = WorkloadWrapper("wa").queue("lq-a").creation(1).pod_set(count=1, cpu="8").obj()
+        wb = WorkloadWrapper("wb").queue("lq-b").creation(2).pod_set(count=1, cpu="8").obj()
+        env.submit(wa)
+        env.submit(wb)
+        env.cycle()
+        assert "default/wb" in env.client.applied
+        assert "default/wa" not in env.client.applied

@@ -1,0 +1,2 @@
+"""Multi-chip execution: device meshes and the cohort-parallel sharded
+solve (jax.sharding + shard_map over ICI/DCN)."""

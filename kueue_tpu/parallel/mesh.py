@@ -1,0 +1,67 @@
+"""Cohort-parallel sharded admission solve.
+
+The scaling axis of the reference is head-of-queue width x flavor count x
+cohort depth (SURVEY.md §5). Cohorts are *independent capacity domains*:
+workloads in different cohorts never contend for the same quota
+(reference: all fit/borrow math walks within one cohort tree,
+pkg/cache/resource_node.go). That makes the cohort the natural SPMD axis:
+each device solves the full cycle for the cohorts it owns, and decisions
+are combined with a single psum — no sequential cross-device dependency.
+
+ICI/DCN traffic per cycle: one replicated broadcast of the batch in, one
+psum of usage deltas + admitted masks out.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kueue_tpu.solver.kernel import solve_cycle_impl
+
+
+def make_mesh(devices=None, axis_name: str = "cohorts") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int):
+    """Run the batched solve SPMD over the mesh, partitioning capacity
+    domains (cohorts, and cohortless CQs) across devices."""
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    C = topo["cohort_subtree"].shape[0]
+
+    def body(topo_, usage, cohort_usage, requests, podset_active, wl_cq,
+             priority, timestamp, eligible, solvable):
+        dev = jax.lax.axis_index(axis)
+        cohort_of_wl = topo_["cq_cohort"][wl_cq]
+        # capacity domain id: cohort index, or C + cq index for lone CQs
+        domain = jnp.where(cohort_of_wl >= 0, cohort_of_wl,
+                           C + wl_cq.astype(jnp.int32))
+        mine = (domain % n_dev) == dev
+        res = solve_cycle_impl(topo_, usage, cohort_usage, requests,
+                               podset_active, wl_cq, priority, timestamp,
+                               eligible, solvable & mine, num_podsets)
+        usage_delta = res["usage"] - usage
+        cohort_delta = res["cohort_usage"] - cohort_usage
+        admitted = jax.lax.psum(res["admitted"].astype(jnp.int32), axis) > 0
+        usage_out = usage + jax.lax.psum(usage_delta, axis)
+        cohort_out = cohort_usage + jax.lax.psum(cohort_delta, axis)
+        # chosen flavors are computed identically on every device (phase A
+        # is deterministic given the snapshot); take them as-is.
+        return {"admitted": admitted, "chosen": res["chosen"],
+                "borrows": res["borrows"], "fit": res["fit"],
+                "usage": usage_out, "cohort_usage": cohort_out}
+
+    sharded = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(),) * 10,
+        out_specs=P(),
+        check_vma=False)
+    return jax.jit(sharded)(
+        topo, state.usage, state.cohort_usage, batch.requests,
+        batch.podset_active, batch.wl_cq, batch.priority, batch.timestamp,
+        batch.eligible, batch.solvable)

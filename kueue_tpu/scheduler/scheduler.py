@@ -96,7 +96,8 @@ class Scheduler:
                  fair_sharing_enabled: bool = False,
                  fs_preemption_strategies: Optional[list] = None,
                  clock: Clock = REAL_CLOCK,
-                 metrics=None):
+                 metrics=None,
+                 solver=None):
         from kueue_tpu.scheduler.preemption import parse_strategies
         self.queues = queues
         self.cache = cache
@@ -106,6 +107,10 @@ class Scheduler:
         self.clock = clock
         self.attempt_count = 0
         self.metrics = metrics
+        # Optional kueue_tpu.solver.BatchSolver: batched fit-mode admission
+        # on TPU; CPU path handles the remainder (preemption, partial
+        # admission) and acts as the fallback when None.
+        self.solver = solver
         self.preemptor = Preemptor(
             ordering=self.ordering,
             enable_fair_sharing=fair_sharing_enabled,
@@ -143,8 +148,12 @@ class Scheduler:
         start = self.clock.now()
 
         snapshot = self.cache.snapshot()
-        entries = self.nominate(heads, snapshot)
 
+        solver_entries: list = []
+        if self.solver is not None:
+            solver_entries, heads = self._solve_batch(heads, snapshot, timeout)
+
+        entries = self.nominate(heads, snapshot)
         entries.sort(key=self._entry_sort_key())
 
         preempted_workloads: set = set()
@@ -205,6 +214,7 @@ class Scheduler:
                 e.inadmissible_msg = f"Failed to admit workload: {exc}"
 
         result_success = False
+        entries = solver_entries + entries
         for e in entries:
             if e.status != ASSUMED:
                 self.requeue_and_update(e)
@@ -217,6 +227,89 @@ class Scheduler:
                 self.metrics.preemption_skips(cq_name, count)
         return KeepGoing if result_success else SlowDown
 
+    # --- batched TPU admission (kueue_tpu.solver) ---
+
+    def _solve_batch(self, heads: list, snapshot: Snapshot, timeout):
+        """Run the batched solver over the validated heads. Returns
+        (processed entries, remaining heads for the CPU path)."""
+        valid_heads, invalid_entries = [], []
+        for w in heads:
+            if self.cache.is_assumed_or_admitted(w):
+                continue
+            err = self._validate_head(w, snapshot)
+            if err is None:
+                valid_heads.append(w)
+            else:
+                e = Entry(info=w)
+                e.inadmissible_msg, e.requeue_reason = err
+                invalid_entries.append(e)
+
+        try:
+            decisions = self.solver.solve(snapshot, valid_heads)
+        except Exception:  # noqa: BLE001 — device failure: CPU fallback
+            return invalid_entries, valid_heads
+
+        solver_entries = list(invalid_entries)
+        remaining = []
+        for i, w in enumerate(valid_heads):
+            decision = decisions.get(i)
+            if decision is None:
+                remaining.append(w)
+                continue
+            assignment, admitted = decision
+            e = Entry(info=w, assignment=assignment)
+            w.last_assignment = assignment.last_state
+            if not admitted:
+                # Assigned against the pre-cycle snapshot but no longer fit
+                # after intra-cycle accounting (phase B) — skip, don't
+                # re-assign (reference: scheduler.go:266-273).
+                self._set_skipped(e, "Workload no longer fits after "
+                                     "processing another workload")
+                solver_entries.append(e)
+                continue
+            cq = snapshot.cluster_queues[w.cluster_queue]
+            # Account on the snapshot so the CPU remainder sees it.
+            cq.add_usage(assignment.usage)
+            self._wait_pods_ready_if_needed(e, timeout)
+            e.status = NOMINATED
+            try:
+                self.admit(e, cq)
+            except Exception as exc:  # noqa: BLE001
+                e.inadmissible_msg = f"Failed to admit workload: {exc}"
+            solver_entries.append(e)
+        return solver_entries, remaining
+
+    def _validate_head(self, w: wlpkg.Info, snapshot: Snapshot):
+        """Pre-admission validation (the non-assignment part of nominate).
+        Returns None if admissible, else (message, requeue reason)."""
+        cq = snapshot.cluster_queues.get(w.cluster_queue)
+        ns_labels = self.client.namespace_labels(w.obj.metadata.namespace)
+        if wlpkg.has_retry_checks(w.obj) or wlpkg.has_rejected_checks(w.obj):
+            return "The workload has failed admission checks", RequeueReason.GENERIC
+        if w.cluster_queue in snapshot.inactive_cluster_queue_sets:
+            return f"ClusterQueue {w.cluster_queue} is inactive", RequeueReason.GENERIC
+        if cq is None:
+            return f"ClusterQueue {w.cluster_queue} not found", RequeueReason.GENERIC
+        if ns_labels is None:
+            return "Could not obtain workload namespace", RequeueReason.GENERIC
+        if cq.namespace_selector is None or not cq.namespace_selector.matches(ns_labels):
+            return ("Workload namespace doesn't match ClusterQueue selector",
+                    RequeueReason.NAMESPACE_MISMATCH)
+        if (err := self._validate_resources(w)) is not None:
+            return err, RequeueReason.GENERIC
+        if (err := self._validate_limit_range(w)) is not None:
+            return err, RequeueReason.GENERIC
+        return None
+
+    def _wait_pods_ready_if_needed(self, e: Entry, timeout) -> None:
+        if not self.cache.pods_ready_for_all_admitted_workloads():
+            wlpkg.unset_quota_reservation_with_condition(
+                e.info.obj, "Waiting",
+                "waiting for all admitted workloads to be in PodsReady condition",
+                self.clock.now())
+            self.client.patch_not_admitted(e.info.obj)
+            self.cache.wait_for_pods_ready(timeout=timeout)
+
     # --- nomination (reference: scheduler.go:404-441) ---
 
     def nominate(self, heads: list, snapshot: Snapshot) -> list:
@@ -226,22 +319,9 @@ class Scheduler:
             e = Entry(info=w)
             if self.cache.is_assumed_or_admitted(w):
                 continue
-            ns_labels = self.client.namespace_labels(w.obj.metadata.namespace)
-            if wlpkg.has_retry_checks(w.obj) or wlpkg.has_rejected_checks(w.obj):
-                e.inadmissible_msg = "The workload has failed admission checks"
-            elif w.cluster_queue in snapshot.inactive_cluster_queue_sets:
-                e.inadmissible_msg = f"ClusterQueue {w.cluster_queue} is inactive"
-            elif cq is None:
-                e.inadmissible_msg = f"ClusterQueue {w.cluster_queue} not found"
-            elif ns_labels is None:
-                e.inadmissible_msg = "Could not obtain workload namespace"
-            elif cq.namespace_selector is None or not cq.namespace_selector.matches(ns_labels):
-                e.inadmissible_msg = "Workload namespace doesn't match ClusterQueue selector"
-                e.requeue_reason = RequeueReason.NAMESPACE_MISMATCH
-            elif (err := self._validate_resources(w)) is not None:
-                e.inadmissible_msg = err
-            elif (err := self._validate_limit_range(w)) is not None:
-                e.inadmissible_msg = err
+            err = self._validate_head(w, snapshot)
+            if err is not None:
+                e.inadmissible_msg, e.requeue_reason = err
             else:
                 e.assignment, e.preemption_targets = self.get_assignments(w, snapshot)
                 e.inadmissible_msg = e.assignment.message()

@@ -1,0 +1,18 @@
+"""The TPU-native batched admission solver.
+
+The reference's hot loop (pkg/scheduler/scheduler.go:197-353 calling
+pkg/scheduler/flavorassigner + pkg/scheduler/preemption over the
+pkg/cache snapshot) is an O(heads × flavors × resources × candidates)
+sequential computation in Go. Here it is recast as one batched tensor
+program, jit-compiled with JAX and executed on TPU:
+
+- encode.py: snapshot -> padded tensor layout (the snapshot IS the wire
+  format)
+- kernel.py: the jitted solve — vectorized flavor assignment (phase A)
+  + a lax.scan admit loop with intra-cycle accounting (phase B) that
+  replicates the reference's sequential admit semantics exactly
+- service.py: plugging the solver into the Scheduler as the admission
+  path, with the CPU scheduler as the conformance oracle and fallback
+"""
+
+from kueue_tpu.solver.service import BatchSolver  # noqa: F401

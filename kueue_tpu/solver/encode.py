@@ -1,0 +1,244 @@
+"""Snapshot -> tensor encoding for the batched admission solver.
+
+Dimensions (padded to bucket sizes to avoid jit recompilation storms):
+- W: head-of-queue workloads this cycle
+- P: pod sets per workload
+- R: distinct resource names across all ClusterQueues
+- F: distinct flavor names
+- Q: ClusterQueues
+- C: cohorts
+
+The hierarchical quota tree (reference: pkg/cache/resource_node.go) is
+flattened into [Q,F,R] / [C,F,R] integer tensors; taint/affinity
+eligibility (string matching) is computed host-side into a [W,P,F] mask
+so the device program is pure integer arithmetic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.corev1 import RESOURCE_PODS
+from kueue_tpu.cache.snapshot import Snapshot
+from kueue_tpu.core import priority as prioritypkg
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.core.resources import FlavorResource
+from kueue_tpu.scheduler.flavorassigner import flavor_selector_matches
+from kueue_tpu.api.corev1 import find_untolerated_taint
+
+BIG = np.int64(2**62)  # "no limit" encoding
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    """Round up to the next power of two (jit-compilation bucketing)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class Topology:
+    """Cycle-stable cluster topology tensors + name<->index maps."""
+
+    resources: list = field(default_factory=list)   # index -> resource name
+    flavors: list = field(default_factory=list)     # index -> flavor name
+    cq_names: list = field(default_factory=list)    # index -> cq name
+    cohort_names: list = field(default_factory=list)
+
+    cq_cohort: np.ndarray = None          # [Q] int32, -1 = no cohort
+    nominal: np.ndarray = None            # [Q,F,R] int64
+    borrow_limit: np.ndarray = None       # [Q,F,R] int64 (BIG = unlimited)
+    guaranteed: np.ndarray = None         # [Q,F,R] int64 (subtree - lending cap)
+    offered: np.ndarray = None            # [Q,F,R] bool — (flavor,resource) in CQ
+    group_id: np.ndarray = None           # [Q,R] int32, -1 = resource not covered
+    flavor_group: np.ndarray = None       # [Q,F] int32, -1 = flavor not in CQ
+    flavor_rank: np.ndarray = None        # [Q,F] int32 — order within its group
+    covers_pods: np.ndarray = None        # [Q] bool — CQ has a "pods" resource group
+    prefer_no_borrow: np.ndarray = None   # [Q] bool — whenCanBorrow == TryNextFlavor
+    cohort_subtree: np.ndarray = None     # [C,F,R] int64
+    cq_index: dict = field(default_factory=dict)
+    flavor_index: dict = field(default_factory=dict)
+    resource_index: dict = field(default_factory=dict)
+
+
+@dataclass
+class State:
+    """Per-cycle mutable usage."""
+
+    usage: np.ndarray = None         # [Q,F,R] int64
+    cohort_usage: np.ndarray = None  # [C,F,R] int64
+
+
+@dataclass
+class WorkloadBatch:
+    infos: list = field(default_factory=list)  # original Info objects (host side)
+    n: int = 0                         # real workload count (<= W)
+    requests: np.ndarray = None        # [W,P,R] int64
+    podset_active: np.ndarray = None   # [W,P] bool
+    wl_cq: np.ndarray = None           # [W] int32
+    priority: np.ndarray = None        # [W] int64
+    timestamp: np.ndarray = None       # [W] float64
+    eligible: np.ndarray = None        # [W,P,F] bool (taints/affinity, host-computed)
+    solvable: np.ndarray = None        # [W] bool — encodable by the solver
+
+
+def encode_topology(snapshot: Snapshot) -> Topology:
+    topo = Topology()
+    res_set, flavor_set = set(), set()
+    for cq in snapshot.cluster_queues.values():
+        for rg in cq.resource_groups:
+            res_set.update(rg.covered_resources)
+            flavor_set.update(rg.flavors)
+    topo.resources = sorted(res_set)
+    topo.flavors = sorted(flavor_set)
+    topo.cq_names = sorted(snapshot.cluster_queues)
+    cohort_set = {cq.cohort.name for cq in snapshot.cluster_queues.values()
+                  if cq.cohort is not None}
+    topo.cohort_names = sorted(cohort_set)
+    topo.resource_index = {r: i for i, r in enumerate(topo.resources)}
+    topo.flavor_index = {f: i for i, f in enumerate(topo.flavors)}
+    topo.cq_index = {c: i for i, c in enumerate(topo.cq_names)}
+    cohort_index = {c: i for i, c in enumerate(topo.cohort_names)}
+
+    Q = _bucket(max(1, len(topo.cq_names)), 1)
+    F = _bucket(max(1, len(topo.flavors)), 1)
+    R = _bucket(max(1, len(topo.resources)), 1)
+    C = _bucket(max(1, len(topo.cohort_names)), 1)
+
+    topo.cq_cohort = np.full(Q, -1, np.int32)
+    topo.nominal = np.zeros((Q, F, R), np.int64)
+    topo.borrow_limit = np.full((Q, F, R), BIG, np.int64)
+    topo.guaranteed = np.zeros((Q, F, R), np.int64)
+    topo.offered = np.zeros((Q, F, R), bool)
+    topo.group_id = np.full((Q, R), -1, np.int32)
+    topo.flavor_group = np.full((Q, F), -1, np.int32)
+    topo.flavor_rank = np.full((Q, F), 10**6, np.int32)
+    topo.covers_pods = np.zeros(Q, bool)
+    topo.prefer_no_borrow = np.zeros(Q, bool)
+    topo.cohort_subtree = np.zeros((C, F, R), np.int64)
+
+    for qname, cq in snapshot.cluster_queues.items():
+        qi = topo.cq_index[qname]
+        if cq.cohort is not None:
+            topo.cq_cohort[qi] = cohort_index[cq.cohort.name]
+        topo.prefer_no_borrow[qi] = (cq.flavor_fungibility.when_can_borrow
+                                     == api.TRY_NEXT_FLAVOR)
+        for gi, rg in enumerate(cq.resource_groups):
+            for r in rg.covered_resources:
+                if r == RESOURCE_PODS:
+                    topo.covers_pods[qi] = True
+                topo.group_id[qi, topo.resource_index[r]] = gi
+            for rank, fname in enumerate(rg.flavors):
+                fi = topo.flavor_index[fname]
+                topo.flavor_group[qi, fi] = gi
+                topo.flavor_rank[qi, fi] = rank
+                for r in rg.covered_resources:
+                    ri = topo.resource_index[r]
+                    fr = FlavorResource(fname, r)
+                    quota = cq.quota_for(fr)
+                    topo.offered[qi, fi, ri] = True
+                    topo.nominal[qi, fi, ri] = quota.nominal
+                    if quota.borrowing_limit is not None:
+                        topo.borrow_limit[qi, fi, ri] = quota.borrowing_limit
+                    topo.guaranteed[qi, fi, ri] = cq.resource_node.guaranteed_quota(fr)
+        if cq.cohort is not None:
+            ci = cohort_index[cq.cohort.name]
+            for fr, q in cq.cohort.resource_node.subtree_quota.items():
+                fi = topo.flavor_index.get(fr.flavor)
+                ri = topo.resource_index.get(fr.resource)
+                if fi is not None and ri is not None:
+                    topo.cohort_subtree[ci, fi, ri] = q
+    return topo
+
+
+def encode_state(snapshot: Snapshot, topo: Topology) -> State:
+    Q, F, R = topo.nominal.shape
+    C = topo.cohort_subtree.shape[0]
+    state = State(usage=np.zeros((Q, F, R), np.int64),
+                  cohort_usage=np.zeros((C, F, R), np.int64))
+    cohort_index = {c: i for i, c in enumerate(topo.cohort_names)}
+    seen_cohorts = set()
+    for qname, cq in snapshot.cluster_queues.items():
+        qi = topo.cq_index[qname]
+        for fr, used in cq.resource_node.usage.items():
+            fi = topo.flavor_index.get(fr.flavor)
+            ri = topo.resource_index.get(fr.resource)
+            if fi is not None and ri is not None:
+                state.usage[qi, fi, ri] = used
+        if cq.cohort is not None and cq.cohort.name not in seen_cohorts:
+            seen_cohorts.add(cq.cohort.name)
+            ci = cohort_index[cq.cohort.name]
+            for fr, used in cq.cohort.resource_node.usage.items():
+                fi = topo.flavor_index.get(fr.flavor)
+                ri = topo.resource_index.get(fr.resource)
+                if fi is not None and ri is not None:
+                    state.cohort_usage[ci, fi, ri] = used
+    return state
+
+
+def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
+                     ordering: Optional[wlpkg.Ordering] = None,
+                     max_podsets: int = 4) -> WorkloadBatch:
+    """entries: list of workload Info heads."""
+    ordering = ordering or wlpkg.Ordering()
+    W = _bucket(max(1, len(entries)))
+    P = max_podsets
+    _, F, R = topo.nominal.shape
+
+    batch = WorkloadBatch(infos=list(entries), n=len(entries))
+    batch.requests = np.zeros((W, P, R), np.int64)
+    batch.podset_active = np.zeros((W, P), bool)
+    batch.wl_cq = np.zeros(W, np.int32)
+    batch.priority = np.zeros(W, np.int64)
+    batch.timestamp = np.zeros(W, np.float64)
+    batch.eligible = np.zeros((W, P, F), bool)
+    batch.solvable = np.zeros(W, bool)
+
+    for wi, info in enumerate(entries):
+        cq = snapshot.cluster_queues.get(info.cluster_queue)
+        if cq is None:
+            continue
+        qi = topo.cq_index[info.cluster_queue]
+        batch.wl_cq[wi] = qi
+        batch.priority[wi] = prioritypkg.priority(info.obj)
+        batch.timestamp[wi] = ordering.queue_order_timestamp(info.obj)
+        if len(info.total_requests) > P:
+            continue  # too many podsets for this bucket: CPU fallback
+        ok = True
+        for pi, psr in enumerate(info.total_requests):
+            reqs = dict(psr.requests)
+            if topo.covers_pods[qi]:
+                reqs[RESOURCE_PODS] = psr.count
+            covered = True
+            for r, v in reqs.items():
+                ri = topo.resource_index.get(r)
+                if ri is None or topo.group_id[qi, ri] < 0:
+                    covered = False
+                    break
+                batch.requests[wi, pi, ri] = v
+            if not covered:
+                ok = False
+                break
+            batch.podset_active[wi, pi] = True
+            # host-side taints/affinity per flavor
+            pod_spec = info.obj.spec.pod_sets[pi].template.spec
+            for rg in cq.resource_groups:
+                for fname in rg.flavors:
+                    flavor = snapshot.resource_flavors.get(fname)
+                    if flavor is None:
+                        continue
+                    fi = topo.flavor_index[fname]
+                    if find_untolerated_taint(flavor.spec.node_taints,
+                                              pod_spec.tolerations) is not None:
+                        continue
+                    if not flavor_selector_matches(pod_spec, rg.label_keys,
+                                                   flavor.spec.node_labels):
+                        continue
+                    batch.eligible[wi, pi, fi] = True
+        batch.solvable[wi] = ok
+    return batch

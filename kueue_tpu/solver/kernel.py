@@ -1,0 +1,210 @@
+"""The jitted batched admission solve.
+
+Replaces the reference's per-entry sequential loop
+(pkg/scheduler/scheduler.go:234-335 + flavorassigner.go:406-537) with:
+
+- Phase A (vectorized over all W workloads at once): flavor assignment —
+  per (workload, podset, resource-group) pick the first flavor in the
+  CQ's order that fits under the snapshot usage, honoring eligibility
+  (taints/affinity, host-precomputed), borrowing limits and the
+  whenCanBorrow=TryNextFlavor policy. Pod sets accumulate usage within a
+  workload exactly like the reference's assignment.Usage.
+- Phase B (lax.scan over the borrow->priority->FIFO order): the
+  sequential admit loop with intra-cycle accounting — each step re-checks
+  the chosen assignment against running usage and adds it (with cohort
+  bubbling past guaranteed quota) only if it still fits. This replicates
+  the reference's order-dependent semantics bit-for-bit for fit-mode
+  entries while keeping all arithmetic on-device.
+
+All quantities are int64 (memory is tracked in bytes). Preemption-mode
+entries are resolved by the CPU path (kueue_tpu.scheduler.preemption)
+after fit-mode entries are accounted; see solver/service.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# Quantities are canonical integers (memory in bytes exceeds int32).
+jax.config.update("jax_enable_x64", True)
+
+NO_LIMIT = jnp.int64(2**62)
+
+
+def _available(nominal, borrow_limit, guaranteed, usage, cohort_subtree,
+               cohort_usage, cq_cohort):
+    """available[Q,F,R] (reference: resource_node.go:89-104, flattened to
+    the CQ->cohort two-level tree the snapshot uses)."""
+    no_cohort_avail = nominal - usage
+    local = jnp.maximum(0, guaranteed - usage)
+    c_idx = jnp.maximum(cq_cohort, 0)
+    parent_avail = (cohort_subtree[c_idx] - cohort_usage[c_idx])
+    stored_in_parent = nominal - guaranteed
+    used_in_parent = jnp.maximum(0, usage - guaranteed)
+    cap = stored_in_parent - used_in_parent + jnp.minimum(borrow_limit, NO_LIMIT // 4)
+    parent_capped = jnp.minimum(parent_avail, cap)
+    with_cohort = local + parent_capped
+    has_cohort = (cq_cohort >= 0)[:, None, None]
+    return jnp.where(has_cohort, with_cohort, no_cohort_avail)
+
+
+def _choose_flavors_one_podset(req_p, eligible_p, wl_cq, usage, asg_usage,
+                               avail, topo):
+    """Phase A for one podset slot, vectorized over W.
+
+    req_p: [W,R], eligible_p: [W,F], asg_usage: [W,F,R] accumulated from
+    earlier podsets of the same workload.
+    Returns (chosen_f_r [W,R] int32 (-1 = none), ok [W], borrow [W],
+    new asg additions [W,F,R]).
+    """
+    W, R = req_p.shape
+    F = eligible_p.shape[1]
+    group_id = topo["group_id"][wl_cq]          # [W,R]
+    flavor_group = topo["flavor_group"][wl_cq]  # [W,F]
+    flavor_rank = topo["flavor_rank"][wl_cq]    # [W,F]
+    nominal = topo["nominal"][wl_cq]            # [W,F,R]
+    offered = topo["offered"][wl_cq]            # [W,F,R]
+    avail_w = avail[wl_cq]                      # [W,F,R]
+    usage_w = usage[wl_cq]                      # [W,F,R]
+    prefer_no_borrow = topo["prefer_no_borrow"][wl_cq]  # [W]
+
+    has_req = req_p > 0                          # [W,R]
+    # relevant[w,f,r]: flavor f's group covers resource r and r is requested
+    relevant = (group_id[:, None, :] == flavor_group[:, :, None]) & \
+               (flavor_group[:, :, None] >= 0) & has_req[:, None, :]
+    val = req_p[:, None, :] + asg_usage          # [W,F,R] incl. earlier podsets
+    fits_r = offered & (val <= avail_w)
+    borrow_r = (usage_w + val) > nominal         # needs borrowing on r
+
+    fit_f = jnp.all(~relevant | fits_r, axis=2) & jnp.any(relevant, axis=2)  # [W,F]
+    fit_f &= eligible_p
+    borrow_f = jnp.any(relevant & borrow_r, axis=2)                           # [W,F]
+
+    # Per group: first fitting flavor by rank; TryNextFlavor prefers a
+    # no-borrow fit anywhere in the list over an earlier borrow fit
+    # (reference: shouldTryNextFlavor, flavorassigner.go:519-537).
+    INF = jnp.int32(10**6)
+    rank_fit = jnp.where(fit_f, flavor_rank, INF)                  # [W,F]
+    rank_fit_nb = jnp.where(fit_f & ~borrow_f, flavor_rank, INF)   # [W,F]
+
+    # For each resource r, its group's candidate flavors are those with
+    # flavor_group == group_id[r]; reduce over F per (w, r).
+    same_group = (flavor_group[:, :, None] == group_id[:, None, :]) & \
+                 (group_id[:, None, :] >= 0)                        # [W,F,R]
+    rank_fit_r = jnp.where(same_group, rank_fit[:, :, None], INF)
+    rank_fit_nb_r = jnp.where(same_group, rank_fit_nb[:, :, None], INF)
+    best_rank = jnp.min(rank_fit_r, axis=1)        # [W,R]
+    best_rank_nb = jnp.min(rank_fit_nb_r, axis=1)  # [W,R]
+    use_nb = prefer_no_borrow[:, None] & (best_rank_nb < INF)
+    target_rank = jnp.where(use_nb, best_rank_nb, best_rank)  # [W,R]
+
+    cand = same_group & (flavor_rank[:, :, None] == target_rank[:, None, :]) & \
+           fit_f[:, :, None]
+    chosen_f_r = jnp.where((target_rank < INF) & has_req,
+                           jnp.argmax(cand, axis=1).astype(jnp.int32), -1)  # [W,R]
+
+    ok = jnp.all(~has_req | (chosen_f_r >= 0), axis=1)  # [W]
+    one_hot = jax.nn.one_hot(jnp.maximum(chosen_f_r, 0), fit_f.shape[1],
+                             axis=1, dtype=jnp.int64)   # [W,F,R]
+    additions = one_hot * jnp.where(chosen_f_r >= 0, req_p, 0)[:, None, :]
+    chosen_borrow = jnp.take_along_axis(
+        borrow_f, jnp.maximum(chosen_f_r, 0), axis=1) & (chosen_f_r >= 0)
+    borrow = jnp.any(chosen_borrow, axis=1)
+    return chosen_f_r, ok, borrow, additions
+
+
+def solve_cycle_impl(topo, usage, cohort_usage, requests, podset_active, wl_cq,
+                     priority, timestamp, eligible, solvable, num_podsets: int):
+    """One batched admission cycle.
+
+    Returns dict with admitted[W] bool, chosen[W,P,R] int32 flavor index
+    (-1 = none), borrows[W] bool, fit[W] bool, usage'[Q,F,R],
+    cohort_usage'[C,F,R].
+    """
+    W, P, R = requests.shape
+    F = eligible.shape[2]
+
+    avail = _available(topo["nominal"], topo["borrow_limit"], topo["guaranteed"],
+                       usage, topo["cohort_subtree"], cohort_usage,
+                       topo["cq_cohort"])
+
+    # --- Phase A: flavor assignment (podsets accumulate within a workload) ---
+    asg_usage = jnp.zeros((W, F, R), jnp.int64)
+    chosen_all = []
+    ok_all = jnp.ones(W, bool)
+    borrow_all = jnp.zeros(W, bool)
+    for p in range(num_podsets):
+        chosen_p, ok_p, borrow_p, additions = _choose_flavors_one_podset(
+            requests[:, p, :], eligible[:, p, :], wl_cq, usage, asg_usage,
+            avail, topo)
+        active = podset_active[:, p]
+        chosen_all.append(jnp.where(active[:, None], chosen_p, -1))
+        ok_all &= jnp.where(active, ok_p, True)
+        borrow_all |= jnp.where(active, borrow_p, False)
+        asg_usage += jnp.where(active[:, None, None], additions, 0)
+    chosen = jnp.stack(chosen_all, axis=1)  # [W,P,R]
+    fit = ok_all & solvable & jnp.any(podset_active, axis=1)
+
+    # --- Phase B: sequential admit with intra-cycle accounting ---
+    # Order: non-borrowing first, then priority desc, then FIFO
+    # (reference: entryOrdering.Less, scheduler.go:643-672).
+    order = jnp.lexsort((timestamp, -priority, borrow_all.astype(jnp.int32),
+                         (~fit).astype(jnp.int32)))
+
+    def admit_step(carry, w_idx):
+        usage_c, cohort_c, admitted = carry
+        q = wl_cq[w_idx]
+        c = jnp.maximum(topo["cq_cohort"][q], 0)
+        has_cohort = topo["cq_cohort"][q] >= 0
+        au = asg_usage[w_idx]  # [F,R]
+
+        # Single-CQ availability (cheaper than re-deriving all of [Q,F,R]):
+        nominal_q = topo["nominal"][q]
+        guar_q = topo["guaranteed"][q]
+        bl_q = topo["borrow_limit"][q]
+        local = jnp.maximum(0, guar_q - usage_c[q])
+        parent_avail = topo["cohort_subtree"][c] - cohort_c[c]
+        cap = (nominal_q - guar_q) - jnp.maximum(0, usage_c[q] - guar_q) + \
+            jnp.minimum(bl_q, NO_LIMIT // 4)
+        avail_q = jnp.where(has_cohort, local + jnp.minimum(parent_avail, cap),
+                            nominal_q - usage_c[q])
+
+        still_fits = jnp.all((au == 0) | (au <= avail_q))
+        admit = fit[w_idx] & still_fits
+
+        old_over = jnp.maximum(0, usage_c[q] - guar_q)
+        new_usage_q = usage_c[q] + jnp.where(admit, au, 0)
+        new_over = jnp.maximum(0, new_usage_q - guar_q)
+        usage_c = usage_c.at[q].set(new_usage_q)
+        cohort_delta = jnp.where(has_cohort & admit, new_over - old_over, 0)
+        cohort_c = cohort_c.at[c].add(cohort_delta)
+        admitted = admitted.at[w_idx].set(admit)
+        return (usage_c, cohort_c, admitted), None
+
+    init = (usage, cohort_usage, jnp.zeros(W, bool))
+    (usage_out, cohort_out, admitted), _ = jax.lax.scan(admit_step, init, order)
+
+    return {"admitted": admitted, "chosen": chosen, "borrows": borrow_all,
+            "fit": fit, "usage": usage_out, "cohort_usage": cohort_out}
+
+
+solve_cycle = partial(jax.jit, static_argnames=("num_podsets",))(solve_cycle_impl)
+
+
+def topo_to_device(topo) -> dict:
+    """numpy Topology arrays -> device dict for solve_cycle."""
+    return {
+        "cq_cohort": jnp.asarray(topo.cq_cohort),
+        "nominal": jnp.asarray(topo.nominal),
+        "borrow_limit": jnp.asarray(topo.borrow_limit),
+        "guaranteed": jnp.asarray(topo.guaranteed),
+        "offered": jnp.asarray(topo.offered),
+        "group_id": jnp.asarray(topo.group_id),
+        "flavor_group": jnp.asarray(topo.flavor_group),
+        "flavor_rank": jnp.asarray(topo.flavor_rank),
+        "prefer_no_borrow": jnp.asarray(topo.prefer_no_borrow),
+        "cohort_subtree": jnp.asarray(topo.cohort_subtree),
+    }

@@ -1,0 +1,131 @@
+"""BatchSolver: the TPU solve plugged into the admission path.
+
+Integration contract (mirrors how the reference's AdmissionCheck
+controllers plug in, per BASELINE.json's north star): the Scheduler hands
+the cycle's validated heads + snapshot to the solver; the solver returns
+full fit-mode admissions (flavor assignments + usage) computed on device;
+entries it could not admit fall through to the CPU path (preemption,
+partial admission, detailed status messages).
+
+Equivalence class vs the reference: for cycles where every nominated
+entry is fit-mode, the solver's result is identical to the sequential
+scheduler (same ordering, same intra-cycle accounting — differentially
+tested in tests/test_solver.py). When preemption is involved, fit-mode
+entries are accounted before preempt-mode entries instead of interleaved
+by the global order; preemptors then run against the post-admission
+snapshot. The CPU path (solver=None) remains the strict-conformance mode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from kueue_tpu.cache.snapshot import Snapshot
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.core.resources import FlavorResource
+from kueue_tpu.scheduler import flavorassigner as fa
+from kueue_tpu.solver import encode
+from kueue_tpu.solver.kernel import solve_cycle, topo_to_device
+
+
+class BatchSolver:
+    def __init__(self, max_podsets: int = 4, ordering: Optional[wlpkg.Ordering] = None,
+                 mesh=None):
+        self.max_podsets = max_podsets
+        self.ordering = ordering or wlpkg.Ordering()
+        self.mesh = mesh  # optional jax.sharding.Mesh for multi-chip solve
+        self._topo_cache = None
+        self._topo_key = None
+
+    # --- encoding with topology caching across cycles ---
+
+    def _topology(self, snapshot: Snapshot):
+        key = tuple(sorted(
+            (name, cq.allocatable_resource_generation)
+            for name, cq in snapshot.cluster_queues.items()))
+        if key != self._topo_key:
+            self._topo_key = key
+            topo = encode.encode_topology(snapshot)
+            self._topo_cache = (topo, topo_to_device(topo))
+        return self._topo_cache
+
+    def solve(self, snapshot: Snapshot, entries: list) -> dict:
+        """entries: list of workload Info. Returns
+        {entry index -> (fa.Assignment, admitted)} for every entry the
+        solver could fully assign (fit mode). admitted=False means the
+        assignment no longer fit after intra-cycle accounting — the
+        scheduler skips it exactly like the reference's sequential
+        re-check (scheduler.go:266-273) instead of re-assigning flavors
+        against post-cycle usage."""
+        if not entries:
+            return {}
+        topo, topo_dev = self._topology(snapshot)
+        state = encode.encode_state(snapshot, topo)
+        batch = encode.encode_workloads(entries, snapshot, topo,
+                                        ordering=self.ordering,
+                                        max_podsets=self.max_podsets)
+        if not batch.solvable.any():
+            return {}
+
+        if self.mesh is not None:
+            from kueue_tpu.parallel.mesh import solve_cycle_sharded
+            result = solve_cycle_sharded(self.mesh, topo_dev, state, batch,
+                                         self.max_podsets)
+        else:
+            result = solve_cycle(
+                topo_dev, state.usage, state.cohort_usage, batch.requests,
+                batch.podset_active, batch.wl_cq, batch.priority,
+                batch.timestamp, batch.eligible, batch.solvable,
+                num_podsets=self.max_podsets)
+
+        admitted = np.asarray(result["admitted"])
+        fit = np.asarray(result["fit"])
+        chosen = np.asarray(result["chosen"])
+        borrows = np.asarray(result["borrows"])
+
+        out = {}
+        for wi in range(batch.n):
+            if not fit[wi]:
+                continue  # CPU path: preemption / partial admission / status
+            out[wi] = (self._build_assignment(entries[wi], snapshot, topo,
+                                              chosen[wi], bool(borrows[wi])),
+                       bool(admitted[wi]))
+        return out
+
+    def _build_assignment(self, info: wlpkg.Info, snapshot: Snapshot,
+                          topo: encode.Topology, chosen_w: np.ndarray,
+                          borrows: bool) -> fa.Assignment:
+        """Decode device output into the scheduler's Assignment form."""
+        from kueue_tpu.api.corev1 import RESOURCE_PODS
+        assignment = fa.Assignment(borrowing=borrows)
+        cq = snapshot.cluster_queues[info.cluster_queue]
+        assignment.last_state = wlpkg.AssignmentClusterQueueState(
+            cluster_queue_generation=cq.allocatable_resource_generation,
+            cohort_generation=(cq.cohort.allocatable_resource_generation
+                               if cq.cohort else 0))
+        qi = topo.cq_index[info.cluster_queue]
+        for pi, psr in enumerate(info.total_requests):
+            reqs = dict(psr.requests)
+            if topo.covers_pods[qi]:
+                reqs[RESOURCE_PODS] = psr.count
+            flavors = {}
+            for r, v in reqs.items():
+                ri = topo.resource_index[r]
+                fi = int(chosen_w[pi, ri])
+                if v > 0 and fi < 0:
+                    raise AssertionError("solver admitted workload without flavor")
+                fname = topo.flavors[fi] if fi >= 0 else topo.flavors[0]
+                flavors[r] = fa.FlavorAssignment(name=fname, mode=fa.FIT,
+                                                 tried_flavor_idx=-1)
+            ps = fa.PodSetAssignmentResult(name=psr.name, flavors=flavors,
+                                           requests=reqs, count=psr.count)
+            assignment.pod_sets.append(ps)
+            flavor_idx = {}
+            for r, fassign in flavors.items():
+                fr = FlavorResource(fassign.name, r)
+                assignment.usage[fr] = assignment.usage.get(fr, 0) + reqs[r]
+                flavor_idx[r] = -1
+            assignment.last_state.last_tried_flavor_idx.append(flavor_idx)
+        return assignment

@@ -8,12 +8,18 @@ TPU hardware. Benchmarks (bench.py) run outside pytest on the real chip.
 import os
 import sys
 
-os.environ.setdefault("XLA_FLAGS",
-                      (os.environ.get("XLA_FLAGS", "") +
-                       " --xla_force_host_platform_device_count=8").strip())
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8").strip()
+# The axon sitecustomize pre-registers the TPU backend and pins
+# JAX_PLATFORMS=axon; override both for the test suite.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,73 @@
+"""Benchmark: batched admission-cycle throughput on TPU.
+
+Measures the north-star scenario from BASELINE.json: one admission cycle
+over the head-of-queue of 2k ClusterQueues x 32 flavors (the reference
+pops <=1 head per CQ per cycle), reporting cycle latency and
+workloads-admitted/sec.
+
+Baseline: the reference's scheduler scalability harness admits 15,000
+workloads in 351.1s on its CI scenario (BASELINE.md) ~= 42.7 admitted
+workloads/sec for the sequential Go scheduler. vs_baseline is our
+admitted/sec over that number.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import os
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from kueue_tpu.solver.kernel import solve_cycle
+    from kueue_tpu.solver.synth import synth_solver_inputs
+
+    # North-star shape: 2k CQs x 32 flavors; 2048 heads/cycle.
+    topo, usage, cohort_usage, wl = synth_solver_inputs(
+        num_cqs=2048, num_cohorts=256, num_flavors=32, num_resources=2,
+        num_workloads=2048, seed=42)
+    topo_dev = {k: jnp.asarray(v) for k, v in topo.items()}
+    args = (jnp.asarray(usage), jnp.asarray(cohort_usage),
+            jnp.asarray(wl["requests"]), jnp.asarray(wl["podset_active"]),
+            jnp.asarray(wl["wl_cq"]), jnp.asarray(wl["priority"]),
+            jnp.asarray(wl["timestamp"]), jnp.asarray(wl["eligible"]),
+            jnp.asarray(wl["solvable"]))
+
+    def run():
+        return solve_cycle(topo_dev, *args, num_podsets=1)
+
+    # compile + warmup
+    result = run()
+    jax.block_until_ready(result)
+    admitted_per_cycle = int(result["admitted"].sum())
+
+    times = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        out = run()
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    p50 = times[len(times) // 2]
+
+    admitted_per_sec = admitted_per_cycle / p50
+    baseline_admitted_per_sec = 15000.0 / 351.1  # reference harness, BASELINE.md
+    print(json.dumps({
+        "metric": "admitted_workloads_per_sec_2048cq_32flavor_cycle",
+        "value": round(admitted_per_sec, 1),
+        "unit": "workloads/s",
+        "vs_baseline": round(admitted_per_sec / baseline_admitted_per_sec, 2),
+    }))
+    print(f"# cycle p50 latency: {p50*1000:.2f} ms, "
+          f"admitted/cycle: {admitted_per_cycle}, devices: {jax.devices()}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -31,7 +31,9 @@ import jax.numpy as jnp
 # Quantities are canonical integers (memory in bytes exceeds int32).
 jax.config.update("jax_enable_x64", True)
 
-NO_LIMIT = jnp.int64(2**62)
+# Plain int (not a jnp scalar): creating device values at import time would
+# initialize the backend before callers can configure platforms.
+NO_LIMIT = 2**62
 
 
 def _available(nominal, borrow_limit, guaranteed, usage, cohort_subtree,

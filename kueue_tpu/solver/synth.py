@@ -1,0 +1,64 @@
+"""Synthetic scenario generation for benchmarks and the graft entry.
+
+Builds solver input tensors for a parameterized cluster shape without
+going through the Python object model (the object path is exercised by
+tests; this measures the device program at scale).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def synth_solver_inputs(num_cqs: int = 256, num_cohorts: int = 32,
+                        num_flavors: int = 8, num_resources: int = 2,
+                        num_workloads: int = 256, num_podsets: int = 1,
+                        seed: int = 0):
+    """Returns (topo dict of np arrays, usage, cohort_usage, workload arrays)
+    shaped like encode.py's output: one resource group per CQ covering all
+    resources with all flavors in order."""
+    rng = np.random.default_rng(seed)
+    Q, F, R, C, W, P = (num_cqs, num_flavors, num_resources, num_cohorts,
+                        num_workloads, num_podsets)
+
+    nominal_units = rng.integers(10, 50, size=(Q, F, R)).astype(np.int64) * 1000
+    topo = {
+        "cq_cohort": (np.arange(Q) % C).astype(np.int32),
+        "nominal": nominal_units,
+        "borrow_limit": np.full((Q, F, R), 2**62, np.int64),
+        "guaranteed": np.zeros((Q, F, R), np.int64),
+        "offered": np.ones((Q, F, R), bool),
+        "group_id": np.zeros((Q, R), np.int32),
+        "flavor_group": np.zeros((Q, F), np.int32),
+        "flavor_rank": np.tile(np.arange(F, dtype=np.int32), (Q, 1)),
+        "prefer_no_borrow": np.zeros(Q, bool),
+        "cohort_subtree": np.zeros((C, F, R), np.int64),
+    }
+    for c in range(C):
+        members = topo["cq_cohort"] == c
+        topo["cohort_subtree"][c] = nominal_units[members].sum(axis=0)
+
+    usage = (nominal_units * rng.uniform(0, 0.5, size=(Q, F, R))).astype(np.int64)
+    cohort_usage = np.zeros((C, F, R), np.int64)
+    for c in range(C):
+        members = topo["cq_cohort"] == c
+        cohort_usage[c] = np.maximum(0, usage[members] - topo["guaranteed"][members]).sum(axis=0)
+
+    wl = {
+        "requests": np.zeros((W, P, R), np.int64),
+        "podset_active": np.zeros((W, P), bool),
+        "wl_cq": rng.integers(0, Q, size=W).astype(np.int32),
+        "priority": rng.integers(0, 100, size=W).astype(np.int64),
+        "timestamp": rng.uniform(0, 1e6, size=W),
+        "eligible": np.ones((W, P, F), bool),
+        "solvable": np.ones(W, bool),
+    }
+    for p in range(P):
+        active = rng.uniform(size=W) < (1.0 if p == 0 else 0.3)
+        wl["podset_active"][:, p] = active
+        wl["requests"][:, p, :] = np.where(
+            active[:, None],
+            rng.integers(1, 20, size=(W, R)) * 1000, 0)
+    # Randomly restrict some eligibility (taints/affinity analogue).
+    wl["eligible"] &= rng.uniform(size=(W, P, F)) < 0.9
+    return topo, usage, cohort_usage, wl

@@ -178,3 +178,17 @@ class PodTemplateSpec:
     labels: dict[str, str] = field(default_factory=dict)
     annotations: dict[str, str] = field(default_factory=dict)
     spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class Namespace:
+    """corev1.Namespace — only labels matter (CQ namespaceSelector,
+    reference: scheduler.go:421-425)."""
+    metadata: "ObjectMeta" = None
+
+    KIND = "Namespace"
+
+    def __post_init__(self):
+        if self.metadata is None:
+            from kueue_tpu.api.meta import ObjectMeta
+            self.metadata = ObjectMeta()

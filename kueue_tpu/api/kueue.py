@@ -93,6 +93,17 @@ CHECK_STATE_PENDING = "Pending"
 # AdmissionCheck condition
 ADMISSION_CHECK_ACTIVE = "Active"
 
+# Requeued condition reasons (reference: workload_types.go:380-410,
+# pkg/controller/core/workload_controller.go:160-200)
+WORKLOAD_REACTIVATED = "Reactivated"
+WORKLOAD_BACKOFF_FINISHED = "BackoffFinished"
+WORKLOAD_LOCAL_QUEUE_RESTARTED = "LocalQueueRestarted"
+WORKLOAD_CLUSTER_QUEUE_RESTARTED = "ClusterQueueRestarted"
+WORKLOAD_REQUEUING_LIMIT_EXCEEDED = "RequeuingLimitExceeded"
+
+# Workload inadmissible reason (workload_controller.go:285-330)
+WORKLOAD_INADMISSIBLE = "Inadmissible"
+
 
 # --- Workload (reference: workload_types.go:26-293) ---
 

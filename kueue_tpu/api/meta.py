@@ -57,7 +57,9 @@ class ObjectMeta:
     uid: str = ""
     generation: int = 1
     resource_version: int = 0
-    creation_timestamp: float = 0.0
+    # None = unset (the sim store stamps clock.now() on create);
+    # 0.0 is a valid explicit timestamp
+    creation_timestamp: Optional[float] = None
     deletion_timestamp: Optional[float] = None
     labels: dict[str, str] = field(default_factory=dict)
     annotations: dict[str, str] = field(default_factory=dict)

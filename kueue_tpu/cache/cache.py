@@ -80,6 +80,15 @@ class Cache:
                 update_cohort_resource_node(old_cohort)
             self._refresh_cohort(cqc)
 
+    def terminate_cluster_queue(self, name: str) -> None:
+        """Stop admissions while keeping the usage accounting alive until
+        the last reserving workload finishes (reference:
+        cache.TerminateClusterQueue, cache.go:~300)."""
+        with self._lock:
+            cqc = self.hm.cluster_queues.get(name)
+            if cqc is not None:
+                cqc.status = TERMINATING
+
     def delete_cluster_queue(self, name: str) -> None:
         with self._lock:
             cqc = self.hm.cluster_queues.get(name)
@@ -230,6 +239,13 @@ class Cache:
         cq_name = self.assumed_workloads.pop(key, None)
         if cq_name is None and wl.status.admission is not None:
             cq_name = wl.status.admission.cluster_queue
+        if cq_name is None:
+            # The admission may already be cleared on the object (eviction
+            # completed); fall back to membership lookup by key.
+            for candidate in self.hm.cluster_queues.values():
+                if key in candidate.workloads:
+                    cq_name = candidate.name
+                    break
         if cq_name is None:
             return False
         cqc = self.hm.cluster_queues.get(cq_name)

@@ -144,8 +144,7 @@ class ClusterQueueCache:
                 and self.stop_policy == api.STOP_POLICY_NONE
                 and not self.missing_flavors
                 and not self.missing_checks
-                and not self.inactive_checks
-                and self.namespace_selector is not None)
+                and not self.inactive_checks)
 
     status = ACTIVE  # overridden to TERMINATING by Cache on delete
 

@@ -1,0 +1,294 @@
+"""Controller configuration schema: defaults + validation.
+
+Equivalent of the reference's apis/config/v1beta1
+(configuration_types.go:30-79, defaults.go:66-191) and pkg/config
+(config.go:150, validation.go). Server-endpoint/cert fields that only
+make sense against a real apiserver are represented but unused by the
+sim runtime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+# defaults (reference: apis/config/v1beta1/defaults.go:31-51)
+DEFAULT_NAMESPACE = "kueue-system"
+DEFAULT_CLIENT_CONNECTION_QPS = 20.0
+DEFAULT_CLIENT_CONNECTION_BURST = 30
+DEFAULT_PODS_READY_TIMEOUT_SECONDS = 5 * 60.0
+DEFAULT_QUEUE_VISIBILITY_UPDATE_INTERVAL_SECONDS = 5
+DEFAULT_CLUSTER_QUEUES_MAX_COUNT = 10
+DEFAULT_MULTIKUEUE_GC_INTERVAL_SECONDS = 60.0
+DEFAULT_MULTIKUEUE_ORIGIN = "multikueue"
+DEFAULT_MULTIKUEUE_WORKER_LOST_TIMEOUT_SECONDS = 15 * 60.0
+DEFAULT_REQUEUING_BACKOFF_BASE_SECONDS = 60
+DEFAULT_REQUEUING_BACKOFF_MAX_SECONDS = 3600
+DEFAULT_REQUEUING_BACKOFF_JITTER = 0.0001
+
+# requeuing timestamp choices (reference: configuration_types.go:243-257)
+EVICTION_TIMESTAMP = "Eviction"
+CREATION_TIMESTAMP = "Creation"
+
+# fair-sharing preemption strategies (reference: configuration_types.go:381-397)
+LESS_THAN_OR_EQUAL_TO_FINAL_SHARE = "LessThanOrEqualToFinalShare"
+LESS_THAN_INITIAL_SHARE = "LessThanInitialShare"
+
+DEFAULT_INTEGRATIONS = ["batch/job"]
+
+ALL_INTEGRATIONS = [
+    "batch/job",
+    "jobset.x-k8s.io/jobset",
+    "kubeflow.org/tfjob",
+    "kubeflow.org/pytorchjob",
+    "kubeflow.org/paddlejob",
+    "kubeflow.org/xgboostjob",
+    "kubeflow.org/mxjob",
+    "kubeflow.org/mpijob",
+    "ray.io/rayjob",
+    "ray.io/raycluster",
+    "pod",
+    "deployment",
+]
+
+
+@dataclass
+class RequeuingStrategy:
+    """reference: configuration_types.go:233-271"""
+    timestamp: str = EVICTION_TIMESTAMP
+    backoff_limit_count: Optional[int] = None  # None = endless requeuing
+    backoff_base_seconds: int = DEFAULT_REQUEUING_BACKOFF_BASE_SECONDS
+    backoff_max_seconds: int = DEFAULT_REQUEUING_BACKOFF_MAX_SECONDS
+    backoff_jitter: float = DEFAULT_REQUEUING_BACKOFF_JITTER
+
+
+@dataclass
+class WaitForPodsReady:
+    """reference: configuration_types.go:189-231"""
+    enable: bool = False
+    timeout_seconds: float = DEFAULT_PODS_READY_TIMEOUT_SECONDS
+    block_admission: bool = True
+    requeuing_strategy: RequeuingStrategy = field(default_factory=RequeuingStrategy)
+    recovery_timeout_seconds: Optional[float] = None
+
+
+@dataclass
+class ClientConnection:
+    qps: float = DEFAULT_CLIENT_CONNECTION_QPS
+    burst: int = DEFAULT_CLIENT_CONNECTION_BURST
+
+
+@dataclass
+class ClusterQueueVisibility:
+    max_count: int = DEFAULT_CLUSTER_QUEUES_MAX_COUNT
+
+
+@dataclass
+class QueueVisibility:
+    """reference: configuration_types.go:348-367"""
+    update_interval_seconds: int = DEFAULT_QUEUE_VISIBILITY_UPDATE_INTERVAL_SECONDS
+    cluster_queues: ClusterQueueVisibility = field(default_factory=ClusterQueueVisibility)
+
+
+@dataclass
+class FairSharingConfig:
+    """reference: configuration_types.go:381-397"""
+    enable: bool = False
+    preemption_strategies: list[str] = field(default_factory=list)
+
+
+@dataclass
+class MultiKueueConfig:
+    """reference: configuration_types.go:211-231"""
+    gc_interval_seconds: float = DEFAULT_MULTIKUEUE_GC_INTERVAL_SECONDS
+    origin: str = DEFAULT_MULTIKUEUE_ORIGIN
+    worker_lost_timeout_seconds: float = DEFAULT_MULTIKUEUE_WORKER_LOST_TIMEOUT_SECONDS
+
+
+@dataclass
+class PodIntegrationOptions:
+    """reference: configuration_types.go:326-346 — which namespaces the pod
+    integration may touch (kube-system etc. are always excluded)."""
+    namespace_selector_exclude: list[str] = field(
+        default_factory=lambda: ["kube-system", DEFAULT_NAMESPACE])
+
+
+@dataclass
+class Integrations:
+    """reference: configuration_types.go:307-324"""
+    frameworks: list[str] = field(default_factory=lambda: list(DEFAULT_INTEGRATIONS))
+    external_frameworks: list[str] = field(default_factory=list)
+    pod_options: PodIntegrationOptions = field(default_factory=PodIntegrationOptions)
+
+
+@dataclass
+class Resources:
+    """reference: configuration_types.go:369-379"""
+    exclude_resource_prefixes: list[str] = field(default_factory=list)
+
+
+@dataclass
+class SolverConfig:
+    """TPU-solver plane wiring — new in this build (no reference analogue;
+    plays the role BASELINE.json assigns to the AdmissionCheck-style solver
+    extension). The CPU scheduler path is always available as fallback."""
+    enable: bool = False
+    max_heads: int = 2048          # padded batch width per solve
+    max_flavors: int = 32
+    device: str = ""               # "" = default jax backend
+    fallback_on_error: bool = True
+
+
+@dataclass
+class Configuration:
+    namespace: str = DEFAULT_NAMESPACE
+    manage_jobs_without_queue_name: bool = False
+    client_connection: ClientConnection = field(default_factory=ClientConnection)
+    wait_for_pods_ready: Optional[WaitForPodsReady] = None
+    integrations: Integrations = field(default_factory=Integrations)
+    queue_visibility: QueueVisibility = field(default_factory=QueueVisibility)
+    fair_sharing: FairSharingConfig = field(default_factory=FairSharingConfig)
+    multi_kueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
+    resources: Resources = field(default_factory=Resources)
+    solver: SolverConfig = field(default_factory=SolverConfig)
+    feature_gates: dict[str, bool] = field(default_factory=dict)
+
+
+def set_defaults(cfg: Configuration) -> Configuration:
+    """SetDefaults_Configuration (reference: defaults.go:66-191).
+    Dataclass defaults cover the static values; this normalizes the
+    conditional ones."""
+    if cfg.wait_for_pods_ready is not None and not cfg.wait_for_pods_ready.enable:
+        # timeout/block only meaningful when enabled (defaults.go:121-139)
+        cfg.wait_for_pods_ready.block_admission = False
+    if cfg.fair_sharing.enable and not cfg.fair_sharing.preemption_strategies:
+        cfg.fair_sharing.preemption_strategies = [
+            LESS_THAN_OR_EQUAL_TO_FINAL_SHARE, LESS_THAN_INITIAL_SHARE]
+    return cfg
+
+
+def validate(cfg: Configuration) -> list[str]:
+    """reference: pkg/config/validation.go — returns a list of error strings."""
+    errs = []
+    w = cfg.wait_for_pods_ready
+    if w is not None and w.enable:
+        if w.timeout_seconds <= 0:
+            errs.append("waitForPodsReady.timeout must be positive")
+        rs = w.requeuing_strategy
+        if rs.timestamp not in (EVICTION_TIMESTAMP, CREATION_TIMESTAMP):
+            errs.append(f"waitForPodsReady.requeuingStrategy.timestamp: "
+                        f"unsupported value {rs.timestamp!r}")
+        if rs.backoff_limit_count is not None and rs.backoff_limit_count < 0:
+            errs.append("waitForPodsReady.requeuingStrategy.backoffLimitCount "
+                        "must be >= 0")
+        if rs.backoff_base_seconds < 0:
+            errs.append("waitForPodsReady.requeuingStrategy.backoffBaseSeconds "
+                        "must be >= 0")
+        if rs.backoff_max_seconds < 0:
+            errs.append("waitForPodsReady.requeuingStrategy.backoffMaxSeconds "
+                        "must be >= 0")
+    for strategy in cfg.fair_sharing.preemption_strategies:
+        if strategy not in (LESS_THAN_OR_EQUAL_TO_FINAL_SHARE, LESS_THAN_INITIAL_SHARE):
+            errs.append(f"fairSharing.preemptionStrategies: unsupported value "
+                        f"{strategy!r}")
+    seen = set()
+    for fw in cfg.integrations.frameworks:
+        if fw not in ALL_INTEGRATIONS:
+            errs.append(f"integrations.frameworks: unsupported framework {fw!r}")
+        if fw in seen:
+            errs.append(f"integrations.frameworks: duplicate framework {fw!r}")
+        seen.add(fw)
+    if cfg.multi_kueue.gc_interval_seconds < 0:
+        errs.append("multiKueue.gcInterval must be >= 0")
+    if cfg.multi_kueue.worker_lost_timeout_seconds < 0:
+        errs.append("multiKueue.workerLostTimeout must be >= 0")
+    if not _valid_label_value(cfg.multi_kueue.origin):
+        errs.append("multiKueue.origin must be a valid label value")
+    if cfg.solver.max_heads <= 0 or cfg.solver.max_flavors <= 0:
+        errs.append("solver.maxHeads and solver.maxFlavors must be positive")
+    return errs
+
+
+def _valid_label_value(v: str) -> bool:
+    if len(v) > 63:
+        return False
+    if not v:
+        return True
+    ok = lambda c: c.isalnum() or c in "-_."
+    return v[0].isalnum() and v[-1].isalnum() and all(ok(c) for c in v)
+
+
+def load(raw: dict) -> Configuration:
+    """Build a Configuration from a plain dict (the file format), apply
+    defaults, and raise ValueError on validation failure
+    (reference: pkg/config/config.go:150 Load)."""
+    cfg = Configuration()
+    if "namespace" in raw:
+        cfg.namespace = raw["namespace"]
+    cfg.manage_jobs_without_queue_name = raw.get("manageJobsWithoutQueueName", False)
+    if "waitForPodsReady" in raw:
+        w = raw["waitForPodsReady"]
+        rs = w.get("requeuingStrategy", {})
+        cfg.wait_for_pods_ready = WaitForPodsReady(
+            enable=w.get("enable", False),
+            timeout_seconds=w.get("timeout", DEFAULT_PODS_READY_TIMEOUT_SECONDS),
+            block_admission=w.get("blockAdmission", True),
+            recovery_timeout_seconds=w.get("recoveryTimeout"),
+            requeuing_strategy=RequeuingStrategy(
+                timestamp=rs.get("timestamp", EVICTION_TIMESTAMP),
+                backoff_limit_count=rs.get("backoffLimitCount"),
+                backoff_base_seconds=rs.get("backoffBaseSeconds",
+                                            DEFAULT_REQUEUING_BACKOFF_BASE_SECONDS),
+                backoff_max_seconds=rs.get("backoffMaxSeconds",
+                                           DEFAULT_REQUEUING_BACKOFF_MAX_SECONDS),
+                backoff_jitter=rs.get("backoffJitter",
+                                      DEFAULT_REQUEUING_BACKOFF_JITTER),
+            ),
+        )
+    if "integrations" in raw:
+        i = raw["integrations"]
+        cfg.integrations = Integrations(
+            frameworks=i.get("frameworks", list(DEFAULT_INTEGRATIONS)),
+            external_frameworks=i.get("externalFrameworks", []),
+        )
+    if "queueVisibility" in raw:
+        q = raw["queueVisibility"]
+        cfg.queue_visibility = QueueVisibility(
+            update_interval_seconds=q.get(
+                "updateIntervalSeconds", DEFAULT_QUEUE_VISIBILITY_UPDATE_INTERVAL_SECONDS),
+            cluster_queues=ClusterQueueVisibility(
+                max_count=q.get("clusterQueues", {}).get(
+                    "maxCount", DEFAULT_CLUSTER_QUEUES_MAX_COUNT)),
+        )
+    if "fairSharing" in raw:
+        f = raw["fairSharing"]
+        cfg.fair_sharing = FairSharingConfig(
+            enable=f.get("enable", False),
+            preemption_strategies=f.get("preemptionStrategies", []),
+        )
+    if "multiKueue" in raw:
+        m = raw["multiKueue"]
+        cfg.multi_kueue = MultiKueueConfig(
+            gc_interval_seconds=m.get("gcInterval", DEFAULT_MULTIKUEUE_GC_INTERVAL_SECONDS),
+            origin=m.get("origin", DEFAULT_MULTIKUEUE_ORIGIN),
+            worker_lost_timeout_seconds=m.get(
+                "workerLostTimeout", DEFAULT_MULTIKUEUE_WORKER_LOST_TIMEOUT_SECONDS),
+        )
+    if "resources" in raw:
+        cfg.resources = Resources(
+            exclude_resource_prefixes=raw["resources"].get("excludeResourcePrefixes", []))
+    if "solver" in raw:
+        s = raw["solver"]
+        cfg.solver = SolverConfig(
+            enable=s.get("enable", False),
+            max_heads=s.get("maxHeads", 2048),
+            max_flavors=s.get("maxFlavors", 32),
+            device=s.get("device", ""),
+            fallback_on_error=s.get("fallbackOnError", True),
+        )
+    cfg.feature_gates = dict(raw.get("featureGates", {}))
+    cfg = set_defaults(cfg)
+    errs = validate(cfg)
+    if errs:
+        raise ValueError("invalid configuration: " + "; ".join(errs))
+    return cfg

@@ -1,0 +1,6 @@
+"""Controller layer: core CRD reconcilers, the job-integration framework
+(GenericJob SPI), per-job integrations and admission-check controllers.
+
+Mirrors the reference's pkg/controller tree (SURVEY.md §2.5), running on
+the sim runtime instead of controller-runtime.
+"""

@@ -1,0 +1,160 @@
+"""ClusterQueueReconciler: status, Active condition, terminating finalization.
+
+Equivalent of the reference's
+pkg/controller/core/clusterqueue_controller.go:159-203 (+ status update
+:334-449, QueueVisibility snapshot cron :553+):
+- mirrors spec into cache + queue manager (watch handlers)
+- status: pending/reserving/admitted counts, flavorsReservation/Usage,
+  Active condition with the cache's inactive reason
+- finalizer removed only once no workload reserves quota
+- per-CQ metrics incl. optional resource quotas/usage
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import Condition, set_condition
+from kueue_tpu.sim import ADDED, DELETED, Store
+from kueue_tpu.sim.runtime import EventRecorder
+
+REQUEUE_TERMINATING_SECONDS = 1.0
+
+
+class ClusterQueueReconciler:
+    def __init__(self, store: Store, queues, cache, recorder: EventRecorder,
+                 clock, metrics=None, report_resource_metrics: bool = False,
+                 snapshot_max_count: int = 10):
+        self.store = store
+        self.queues = queues
+        self.cache = cache
+        self.recorder = recorder
+        self.clock = clock
+        self.metrics = metrics
+        self.report_resource_metrics = report_resource_metrics
+        self.snapshot_max_count = snapshot_max_count
+
+    def reconcile(self, key: str):
+        cq = self.store.try_get("ClusterQueue", "", key)
+        if cq is None:
+            return None
+        now = self.clock.now()
+
+        if cq.metadata.deletion_timestamp is not None:
+            # finalize only when nothing reserves quota anymore
+            # (reference: :173-190)
+            cqc = self.cache.cluster_queue(key)
+            if cqc is not None and cqc.reserving_workloads_count() > 0:
+                if self.metrics:
+                    self.metrics.report_cluster_queue_status(key, "terminating")
+                return REQUEUE_TERMINATING_SECONDS
+            if api.RESOURCE_IN_USE_FINALIZER in cq.metadata.finalizers:
+                cq.metadata.finalizers.remove(api.RESOURCE_IN_USE_FINALIZER)
+                self.store.update(cq)
+            return None
+
+        cqc = self.cache.cluster_queue(key)
+        if cqc is None:
+            return None
+
+        # status (reference: :334-449)
+        reservation_usage, admitted_usage = self.cache.usage_for_cluster_queue(key)
+        cq.status.pending_workloads = self.queues.pending(key)
+        cq.status.reserving_workloads = cqc.reserving_workloads_count()
+        cq.status.admitted_workloads = cqc.admitted_workloads_count
+        cq.status.flavors_reservation = _flavor_usage(cq.spec, reservation_usage, cqc)
+        cq.status.flavors_usage = _flavor_usage(cq.spec, admitted_usage, cqc)
+
+        active = cqc.active
+        if active:
+            cond = Condition(type=api.CLUSTER_QUEUE_ACTIVE, status="True",
+                             reason="Ready", message="Can admit new workloads",
+                             observed_generation=cq.metadata.generation)
+        else:
+            cond = Condition(type=api.CLUSTER_QUEUE_ACTIVE, status="False",
+                             reason=_reason_token(cqc.inactive_reason()),
+                             message=f"Can't admit new workloads: {cqc.inactive_reason()}",
+                             observed_generation=cq.metadata.generation)
+        set_condition(cq.status.conditions, cond, now)
+        self.store.update(cq)
+        self.queues.set_cluster_queue_active(key, active)
+
+        if self.metrics:
+            self.metrics.report_cluster_queue_status(
+                key, "active" if active else "pending")
+            self.metrics.reserving_active_workloads.set(
+                cq.status.reserving_workloads, cluster_queue=key)
+            self.metrics.admitted_active_workloads.set(
+                cq.status.admitted_workloads, cluster_queue=key)
+            act = self.queues.cluster_queues.get(key)
+            if act is not None:
+                self.metrics.report_pending_workloads(
+                    key, act.pending_active(), act.pending_inadmissible())
+            if self.report_resource_metrics:
+                self._report_resource_metrics(cq, reservation_usage, admitted_usage)
+
+        # QueueVisibility top-N snapshot (reference: :553+)
+        self.queues.update_snapshot(key, self.snapshot_max_count)
+        return None
+
+    def _report_resource_metrics(self, cq, reservation_usage, admitted_usage):
+        cohort = cq.spec.cohort
+        for rg in cq.spec.resource_groups:
+            for fq in rg.flavors:
+                for quota in fq.resources:
+                    fr = (fq.name, quota.name)
+                    self.metrics.report_cluster_queue_quotas(
+                        cohort, cq.metadata.name, fq.name, quota.name,
+                        quota.nominal_quota,
+                        quota.borrowing_limit if quota.borrowing_limit is not None else -1,
+                        quota.lending_limit if quota.lending_limit is not None else -1)
+                    lbl = dict(cohort=cohort, cluster_queue=cq.metadata.name,
+                               flavor=fq.name, resource=quota.name)
+                    self.metrics.cluster_queue_resource_reservation.set(
+                        reservation_usage.get(fr, 0), **lbl)
+                    self.metrics.cluster_queue_resource_usage.set(
+                        admitted_usage.get(fr, 0), **lbl)
+
+    # -- watch handlers (reference: clusterqueue_controller.go event side) --
+
+    def handle_event(self, event: str, cq: api.ClusterQueue,
+                     old: Optional[api.ClusterQueue], enqueue) -> None:
+        name = cq.metadata.name
+        if event == ADDED:
+            self.cache.add_cluster_queue(cq)
+            self.queues.add_cluster_queue(cq)
+        elif event == DELETED:
+            self.cache.delete_cluster_queue(name)
+            self.queues.delete_cluster_queue(name)
+            if self.metrics:
+                self.metrics.clear_cluster_queue_metrics(name)
+            return
+        else:
+            if cq.metadata.deletion_timestamp is not None:
+                # terminating: cache flips status so no new admissions
+                self.cache.terminate_cluster_queue(name)
+            self.cache.update_cluster_queue(cq)
+            self.queues.update_cluster_queue(
+                cq, spec_updated=old is None or old.spec != cq.spec)
+        enqueue(name)
+
+
+def _reason_token(reason: str) -> str:
+    return reason.split(":", 1)[0] if reason else "Unknown"
+
+
+def _flavor_usage(spec: api.ClusterQueueSpec, usage: dict, cqc) -> list:
+    """FlavorResource dict -> status FlavorUsage list in spec order, with
+    borrowed = usage above nominal quota (reference: :372-418)."""
+    out = []
+    for rg in spec.resource_groups:
+        for fq in rg.flavors:
+            resources = []
+            for quota in fq.resources:
+                used = usage.get((fq.name, quota.name), 0)
+                resources.append(api.ResourceUsage(
+                    name=quota.name, total=used,
+                    borrowed=max(0, used - quota.nominal_quota)))
+            out.append(api.FlavorUsage(name=fq.name, resources=resources))
+    return out

@@ -1,0 +1,108 @@
+"""LocalQueueReconciler: status + Active condition + StopPolicy.
+
+Equivalent of the reference's pkg/controller/core/localqueue_controller.go:
+status counts (pending from queue manager, reserving/admitted + flavor
+usage from cache), Active condition gated on the target ClusterQueue's
+existence/active state and the LQ's own StopPolicy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import Condition, set_condition
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.sim import ADDED, DELETED, Store
+from kueue_tpu.sim.runtime import EventRecorder
+
+
+class LocalQueueReconciler:
+    def __init__(self, store: Store, queues, cache, recorder: EventRecorder,
+                 clock, metrics=None):
+        self.store = store
+        self.queues = queues
+        self.cache = cache
+        self.recorder = recorder
+        self.clock = clock
+        self.metrics = metrics
+
+    def reconcile(self, key: str):
+        namespace, name = key.split("/", 1)
+        lq = self.store.try_get("LocalQueue", namespace, name)
+        if lq is None:
+            return None
+        now = self.clock.now()
+
+        if lq.spec.stop_policy != api.STOP_POLICY_NONE:
+            cond = Condition(type=api.LOCAL_QUEUE_ACTIVE, status="False",
+                             reason="Stopped", message="LocalQueue is stopped",
+                             observed_generation=lq.metadata.generation)
+        else:
+            cq = self.store.try_get("ClusterQueue", "", lq.spec.cluster_queue)
+            if cq is None:
+                cond = Condition(
+                    type=api.LOCAL_QUEUE_ACTIVE, status="False",
+                    reason="ClusterQueueDoesNotExist",
+                    message="Can't submit new workloads to clusterQueue",
+                    observed_generation=lq.metadata.generation)
+            elif not self.cache.cluster_queue_active(lq.spec.cluster_queue):
+                cond = Condition(
+                    type=api.LOCAL_QUEUE_ACTIVE, status="False",
+                    reason="ClusterQueueIsInactive",
+                    message="Can't submit new workloads to clusterQueue",
+                    observed_generation=lq.metadata.generation)
+            else:
+                cond = Condition(type=api.LOCAL_QUEUE_ACTIVE, status="True",
+                                 reason="Ready", message="Can submit new workloads to clusterQueue",
+                                 observed_generation=lq.metadata.generation)
+        set_condition(lq.status.conditions, cond, now)
+
+        lq.status.pending_workloads = self.queues.pending_workloads_in_local_queue(key)
+        usage = self.cache.local_queue_usage(lq)
+        if usage is not None:
+            lq.status.reserving_workloads = usage.reserving_workloads
+            lq.status.admitted_workloads = usage.admitted_workloads
+            cq = self.store.try_get("ClusterQueue", "", lq.spec.cluster_queue)
+            if cq is not None:
+                lq.status.flavors_reservation = _lq_flavor_usage(cq.spec, usage.usage)
+                lq.status.flavors_usage = _lq_flavor_usage(cq.spec, usage.admitted_usage)
+        else:
+            lq.status.reserving_workloads = 0
+            lq.status.admitted_workloads = 0
+        self.store.update(lq)
+        return None
+
+    # -- watch handlers -------------------------------------------------
+
+    def handle_event(self, event: str, lq: api.LocalQueue,
+                     old: Optional[api.LocalQueue], enqueue) -> None:
+        key = f"{lq.metadata.namespace}/{lq.metadata.name}"
+        if event == ADDED:
+            workloads = self.store.list(
+                "Workload", namespace=lq.metadata.namespace,
+                where=lambda wl: wl.spec.queue_name == lq.metadata.name
+                and not wlpkg.is_finished(wl))
+            self.queues.add_local_queue(lq, workloads)
+            self.cache.add_local_queue(lq)
+        elif event == DELETED:
+            self.queues.delete_local_queue(lq)
+            self.cache.delete_local_queue(lq)
+            return
+        else:
+            if old is not None and old.spec.cluster_queue != lq.spec.cluster_queue:
+                self.cache.delete_local_queue(old)
+                self.cache.add_local_queue(lq)
+            self.queues.update_local_queue(lq)
+        enqueue(key)
+
+
+def _lq_flavor_usage(cq_spec: api.ClusterQueueSpec, usage: dict) -> list:
+    out = []
+    for rg in cq_spec.resource_groups:
+        for fq in rg.flavors:
+            resources = [api.ResourceUsage(name=q.name,
+                                           total=usage.get((fq.name, q.name), 0))
+                         for q in fq.resources]
+            out.append(api.FlavorUsage(name=fq.name, resources=resources))
+    return out

@@ -31,6 +31,13 @@ class LimitRange:
     namespace: str = ""
     name: str = ""
     limits: list = field(default_factory=list)  # list[LimitRangeItem]
+    # populated lazily so the object can live in the sim store
+    metadata: object = None
+
+    def __post_init__(self):
+        if self.metadata is None:
+            from kueue_tpu.api.meta import ObjectMeta
+            self.metadata = ObjectMeta(name=self.name, namespace=self.namespace)
 
 
 @dataclass

@@ -181,6 +181,23 @@ def is_evicted_by_pods_ready_timeout(wl: api.Workload) -> Optional[Condition]:
     return None
 
 
+# lifecycle phases (reference: pkg/workload/workload.go Status())
+STATUS_PENDING = "pending"
+STATUS_QUOTA_RESERVED = "quotaReserved"
+STATUS_ADMITTED = "admitted"
+STATUS_FINISHED = "finished"
+
+
+def status(wl: api.Workload) -> str:
+    if is_finished(wl):
+        return STATUS_FINISHED
+    if is_admitted(wl):
+        return STATUS_ADMITTED
+    if has_quota_reservation(wl):
+        return STATUS_QUOTA_RESERVED
+    return STATUS_PENDING
+
+
 def set_quota_reservation(wl: api.Workload, admission: api.Admission, now: float) -> None:
     wl.status.admission = admission
     msg = f"Quota reserved in ClusterQueue {admission.cluster_queue}"
@@ -228,6 +245,14 @@ def set_preempted_condition(wl: api.Workload, reason: str, message: str, now: fl
     set_condition(wl.status.conditions, Condition(
         type=api.WORKLOAD_PREEMPTED, status="True", reason=reason, message=message,
         observed_generation=wl.metadata.generation), now)
+
+
+def set_deactivation_target(wl: api.Workload, reason: str, message: str, now: float) -> None:
+    """reference: workload.SetDeactivationTarget — marks the workload for
+    deactivation by its own reconciler (workload_controller.go:528-534)."""
+    set_condition(wl.status.conditions, Condition(
+        type=api.WORKLOAD_DEACTIVATION_TARGET, status="True", reason=reason,
+        message=message, observed_generation=wl.metadata.generation), now)
 
 
 def set_requeued_condition(wl: api.Workload, reason: str, message: str, status: bool,
@@ -347,13 +372,13 @@ class Ordering:
             cond = is_evicted_by_pods_ready_timeout(wl)
             if cond is not None:
                 return cond.last_transition_time
-        return wl.metadata.creation_timestamp
+        return wl.metadata.creation_timestamp or 0.0
 
 
 def queued_wait_time(wl: api.Workload, now: float) -> float:
     """Time since last queued: creation, or latest PodsReadyTimeout
     re-queue (reference: workload.QueuedWaitTime)."""
-    queued_at = wl.metadata.creation_timestamp
+    queued_at = wl.metadata.creation_timestamp or 0.0
     cond = is_evicted_by_pods_ready_timeout(wl)
     if cond is not None:
         queued_at = max(queued_at, cond.last_transition_time)

@@ -1,0 +1,134 @@
+"""Manager assembly: the equivalent of cmd/kueue/main.go:98-336.
+
+Builds the full control plane in-process: sim store (the apiserver role),
+queue manager + cache, core controllers, webhook admission on writes,
+the scheduler with a store-backed client, and (optionally) the TPU batch
+solver. Tests and the perf harness drive it via `run_until_idle()` +
+`schedule_once()` for deterministic cycles, or `start()` for the
+threaded scheduler loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_tpu import config as cfgpkg
+from kueue_tpu import features
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import REAL_CLOCK, Clock
+from kueue_tpu.cache import Cache
+from kueue_tpu.controller.core import setup_core_controllers
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.metrics import Registry
+from kueue_tpu.queue import Manager as QueueManager
+from kueue_tpu.scheduler.scheduler import Scheduler, SchedulerClient
+from kueue_tpu.sim import NotFound, Store
+from kueue_tpu.sim.runtime import EventRecorder, Runtime
+
+
+class StoreSchedulerClient(SchedulerClient):
+    """SchedulerClient over the sim store (the reference scheduler's only
+    API interactions: namespace Get, SSA admission writes, Pending
+    patches, events — scheduler.go:421,571-623,674-692)."""
+
+    def __init__(self, store: Store, recorder: EventRecorder):
+        self.store = store
+        self.recorder = recorder
+
+    def namespace_labels(self, namespace: str) -> Optional[dict]:
+        ns = self.store.try_get("Namespace", "", namespace)
+        return ns.metadata.labels if ns is not None else {}
+
+    def limit_ranges(self, namespace: str) -> list:
+        return self.store.list("LimitRange", namespace=namespace)
+
+    def apply_admission(self, wl: api.Workload) -> None:
+        current = self.store.try_get("Workload", wl.metadata.namespace,
+                                     wl.metadata.name)
+        if current is None:
+            raise NotFound(wlpkg.key(wl))
+        current.status = wl.status
+        self.store.update(current)
+
+    def patch_not_admitted(self, wl: api.Workload) -> None:
+        current = self.store.try_get("Workload", wl.metadata.namespace,
+                                     wl.metadata.name)
+        if current is None:
+            return
+        current.status.conditions = wl.status.conditions
+        self.store.update(current)
+
+    def event(self, wl: api.Workload, event_type: str, reason: str,
+              message: str) -> None:
+        self.recorder.event(wl, event_type, reason, message)
+
+
+class KueueManager:
+    def __init__(self, cfg: Optional[cfgpkg.Configuration] = None,
+                 clock: Clock = REAL_CLOCK, solver=None,
+                 registered_check_controllers: Optional[set] = None):
+        self.cfg = cfgpkg.set_defaults(cfg or cfgpkg.Configuration())
+        self.clock = clock
+        self.store = Store(clock)
+        self.recorder = EventRecorder()
+        self.metrics = Registry()
+        self.runtime = Runtime(clock)
+
+        w = self.cfg.wait_for_pods_ready
+        ordering = wlpkg.Ordering(
+            pods_ready_requeuing_timestamp=(
+                w.requeuing_strategy.timestamp if w else cfgpkg.EVICTION_TIMESTAMP))
+        self.queues = QueueManager(
+            ordering=ordering, clock=clock,
+            namespace_labels=lambda ns: self._namespace_labels(ns),
+            excluded_resource_prefixes=self.cfg.resources.exclude_resource_prefixes)
+        self.cache = Cache(
+            pods_ready_tracking=bool(w and w.enable and w.block_admission),
+            excluded_resource_prefixes=self.cfg.resources.exclude_resource_prefixes)
+
+        self.controllers = setup_core_controllers(
+            self.runtime, self.store, self.queues, self.cache, self.recorder,
+            cfg=self.cfg, metrics=self.metrics,
+            registered_check_controllers=registered_check_controllers)
+
+        self.scheduler_client = StoreSchedulerClient(self.store, self.recorder)
+        self.scheduler = Scheduler(
+            self.queues, self.cache, self.scheduler_client,
+            ordering=ordering,
+            fair_sharing_enabled=self.cfg.fair_sharing.enable,
+            fs_preemption_strategies=self.cfg.fair_sharing.preemption_strategies,
+            clock=clock, metrics=self.metrics, solver=solver)
+
+    def _namespace_labels(self, ns: str) -> Optional[dict]:
+        obj = self.store.try_get("Namespace", "", ns)
+        return obj.metadata.labels if obj is not None else {}
+
+    # -- deterministic drivers (tests / perf harness) -------------------
+
+    def run_until_idle(self) -> int:
+        return self.runtime.run_until_idle()
+
+    def schedule_once(self) -> None:
+        """One admission cycle + controller settling."""
+        self.runtime.run_until_idle()
+        self.scheduler.schedule(timeout=0)
+        self.runtime.run_until_idle()
+
+    def schedule_until_settled(self, max_cycles: int = 100) -> int:
+        """Run cycles until a cycle admits nothing (queues drained or
+        blocked). Returns the number of cycles run."""
+        cycles = 0
+        for _ in range(max_cycles):
+            self.runtime.run_until_idle()
+            before = self.store._rv
+            self.scheduler.schedule(timeout=0)
+            self.runtime.run_until_idle()
+            cycles += 1
+            has_active = any(cqh.active and cqh.pending_active() > 0
+                             for cqh in self.queues.cluster_queues.values())
+            if self.store._rv == before and not has_active:
+                break
+        return cycles
+
+    def advance(self, dt: float) -> None:
+        self.runtime.advance(dt)

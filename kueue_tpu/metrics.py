@@ -1,0 +1,325 @@
+"""Prometheus-style metrics registry.
+
+Equivalent of the reference's pkg/metrics/metrics.go:55-256. Implemented
+as a dependency-free registry (counters/gauges/histograms keyed by label
+tuples) with a text exposition dump; the report helpers mirror the
+reference's function-per-transition API (AdmissionAttempt,
+QuotaReservedWorkload, ReportEvictedWorkloads, ...), and wait-time
+histograms use the same exponential 1 s -> 10,240 s buckets
+(generateExponentialBuckets, metrics.go:258-260).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Optional, Sequence
+
+# admission results (reference: metrics.go:30-36)
+ADMISSION_RESULT_SUCCESS = "success"
+ADMISSION_RESULT_INADMISSIBLE = "inadmissible"
+
+# cluster-queue statuses (reference: metrics.go:40-56)
+CQ_STATUS_PENDING = "pending"
+CQ_STATUS_ACTIVE = "active"
+CQ_STATUS_TERMINATING = "terminating"
+CQ_STATUSES = [CQ_STATUS_PENDING, CQ_STATUS_ACTIVE, CQ_STATUS_TERMINATING]
+
+# pending-workload statuses (reference: metrics.go:97-106)
+PENDING_STATUS_ACTIVE = "active"
+PENDING_STATUS_INADMISSIBLE = "inadmissible"
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
+    return [start * factor**i for i in range(count)]
+
+
+def wait_time_buckets() -> list[float]:
+    """1, 2.5, 5, 10, ... 10240 (reference: metrics.go:258-260, count=14)."""
+    return [1.0] + exponential_buckets(2.5, 2, 13)
+
+
+_DEFAULT_BUCKETS = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10]
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str]):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: dict) -> tuple:
+        return tuple(labels.get(n, "") for n in self.label_names)
+
+    def delete_partial_match(self, match: dict) -> None:
+        idxs = {self.label_names.index(k): v for k, v in match.items()}
+        with self._lock:
+            for key in [k for k in self._series()
+                        if all(k[i] == v for i, v in idxs.items())]:
+                self._delete(key)
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self.values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self.values[key] = self.values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self.values.get(self._key(labels), 0.0)
+
+    def _series(self):
+        return list(self.values)
+
+    def _delete(self, key):
+        self.values.pop(key, None)
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self.values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self.values[self._key(labels)] = value
+
+    def value(self, **labels) -> float:
+        return self.values.get(self._key(labels), 0.0)
+
+    def _series(self):
+        return list(self.values)
+
+    def _delete(self, key):
+        self.values.pop(key, None)
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_, label_names=(), buckets: Optional[list] = None):
+        super().__init__(name, help_, label_names)
+        self.buckets = list(buckets) if buckets else list(_DEFAULT_BUCKETS)
+        # key -> (bucket counts incl +Inf, sum, count)
+        self.series: dict[tuple, list] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            if key not in self.series:
+                self.series[key] = [[0] * (len(self.buckets) + 1), 0.0, 0]
+            counts, _, _ = self.series[key]
+            idx = len(self.buckets)
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    idx = i
+                    break
+            counts[idx] += 1
+            self.series[key][1] += value
+            self.series[key][2] += 1
+
+    def count(self, **labels) -> int:
+        s = self.series.get(self._key(labels))
+        return s[2] if s else 0
+
+    def sum(self, **labels) -> float:
+        s = self.series.get(self._key(labels))
+        return s[1] if s else 0.0
+
+    def percentile(self, q: float, **labels) -> float:
+        """Estimate the q-quantile from bucket counts (promql-style)."""
+        s = self.series.get(self._key(labels))
+        if not s or s[2] == 0:
+            return math.nan
+        counts, _, total = s
+        target = q * total
+        cum = 0
+        lower = 0.0
+        for i, ub in enumerate(self.buckets):
+            prev = cum
+            cum += counts[i]
+            if cum >= target:
+                frac = (target - prev) / counts[i] if counts[i] else 0.0
+                return lower + (ub - lower) * frac
+            lower = ub
+        return self.buckets[-1] if self.buckets else math.nan
+
+    def _series(self):
+        return list(self.series)
+
+    def _delete(self, key):
+        self.series.pop(key, None)
+
+
+class Registry:
+    """One instance per manager; tests construct their own
+    (the reference's package-level singletons make parallel tests share
+    state — avoided here)."""
+
+    def __init__(self):
+        wt = wait_time_buckets()
+        self.admission_attempts_total = Counter(
+            "kueue_admission_attempts_total",
+            "Total number of attempts to admit workloads (label result: success|inadmissible)",
+            ["result"])
+        self.admission_attempt_duration = Histogram(
+            "kueue_admission_attempt_duration_seconds",
+            "Latency of an admission attempt", ["result"])
+        self.admission_cycle_preemption_skips = Gauge(
+            "kueue_admission_cycle_preemption_skips",
+            "Workloads skipped in the last cycle because of overlapping preemptions",
+            ["cluster_queue"])
+        self.pending_workloads = Gauge(
+            "kueue_pending_workloads",
+            "Number of pending workloads (label status: active|inadmissible)",
+            ["cluster_queue", "status"])
+        self.quota_reserved_workloads_total = Counter(
+            "kueue_quota_reserved_workloads_total",
+            "Total number of quota-reserved workloads", ["cluster_queue"])
+        self.quota_reserved_wait_time = Histogram(
+            "kueue_quota_reserved_wait_time_seconds",
+            "Time from creation/requeue to quota reservation",
+            ["cluster_queue"], buckets=wt)
+        self.admitted_workloads_total = Counter(
+            "kueue_admitted_workloads_total",
+            "Total number of admitted workloads", ["cluster_queue"])
+        self.admission_wait_time = Histogram(
+            "kueue_admission_wait_time_seconds",
+            "Time from creation/requeue to admission", ["cluster_queue"], buckets=wt)
+        self.admission_checks_wait_time = Histogram(
+            "kueue_admission_checks_wait_time_seconds",
+            "Time from quota reservation to admission", ["cluster_queue"], buckets=wt)
+        self.evicted_workloads_total = Counter(
+            "kueue_evicted_workloads_total",
+            "Total evicted workloads by reason", ["cluster_queue", "reason"])
+        self.preempted_workloads_total = Counter(
+            "kueue_preempted_workloads_total",
+            "Total preempted workloads by reason", ["preempting_cluster_queue", "reason"])
+        self.reserving_active_workloads = Gauge(
+            "kueue_reserving_active_workloads",
+            "Workloads currently reserving quota", ["cluster_queue"])
+        self.admitted_active_workloads = Gauge(
+            "kueue_admitted_active_workloads",
+            "Workloads currently admitted", ["cluster_queue"])
+        self.cluster_queue_status = Gauge(
+            "kueue_cluster_queue_status",
+            "ClusterQueue status flags (pending|active|terminating)",
+            ["cluster_queue", "status"])
+        # optional per-resource metrics (reference: metrics.go:207-255)
+        self.cluster_queue_resource_reservation = Gauge(
+            "kueue_cluster_queue_resource_reservation",
+            "Reserved quantity per CQ/flavor/resource",
+            ["cohort", "cluster_queue", "flavor", "resource"])
+        self.cluster_queue_resource_usage = Gauge(
+            "kueue_cluster_queue_resource_usage",
+            "Admitted usage per CQ/flavor/resource",
+            ["cohort", "cluster_queue", "flavor", "resource"])
+        self.cluster_queue_nominal_quota = Gauge(
+            "kueue_cluster_queue_nominal_quota",
+            "Nominal quota per CQ/flavor/resource",
+            ["cohort", "cluster_queue", "flavor", "resource"])
+        self.cluster_queue_borrowing_limit = Gauge(
+            "kueue_cluster_queue_borrowing_limit",
+            "Borrowing limit per CQ/flavor/resource",
+            ["cohort", "cluster_queue", "flavor", "resource"])
+        self.cluster_queue_lending_limit = Gauge(
+            "kueue_cluster_queue_lending_limit",
+            "Lending limit per CQ/flavor/resource",
+            ["cohort", "cluster_queue", "flavor", "resource"])
+        self.cluster_queue_weighted_share = Gauge(
+            "kueue_cluster_queue_weighted_share",
+            "Maximum weighted borrowed share (0 = within nominal quota)",
+            ["cluster_queue"])
+        self._all = [v for v in vars(self).values() if isinstance(v, _Metric)]
+
+    # --- report helpers (reference: metrics.go:262-400) ---
+
+    def admission_attempt(self, result: str, duration_s: float) -> None:
+        self.admission_attempts_total.inc(result=result)
+        self.admission_attempt_duration.observe(duration_s, result=result)
+
+    def quota_reserved_workload(self, cq: str, wait_s: float) -> None:
+        self.quota_reserved_workloads_total.inc(cluster_queue=cq)
+        self.quota_reserved_wait_time.observe(wait_s, cluster_queue=cq)
+
+    def admitted_workload(self, cq: str, wait_s: float) -> None:
+        self.admitted_workloads_total.inc(cluster_queue=cq)
+        self.admission_wait_time.observe(wait_s, cluster_queue=cq)
+
+    # short aliases used by the scheduler hot path
+    def quota_reserved(self, cq: str, wait_s: float) -> None:
+        self.quota_reserved_workload(cq, wait_s)
+
+    def admitted(self, cq: str, wait_s: float) -> None:
+        self.admitted_workload(cq, wait_s)
+
+    def preempted(self, preempting_cq: str, reason: str) -> None:
+        self.preempted_workloads_total.inc(
+            preempting_cluster_queue=preempting_cq, reason=reason)
+
+    def preemption_skips(self, cq: str, count: int) -> None:
+        self.admission_cycle_preemption_skips.set(count, cluster_queue=cq)
+
+    def report_pending_workloads(self, cq: str, active: int, inadmissible: int) -> None:
+        self.pending_workloads.set(active, cluster_queue=cq, status=PENDING_STATUS_ACTIVE)
+        self.pending_workloads.set(inadmissible, cluster_queue=cq,
+                                   status=PENDING_STATUS_INADMISSIBLE)
+
+    def report_evicted_workload(self, cq: str, reason: str) -> None:
+        self.evicted_workloads_total.inc(cluster_queue=cq, reason=reason)
+
+    def report_cluster_queue_status(self, cq: str, status: str) -> None:
+        for s in CQ_STATUSES:
+            self.cluster_queue_status.set(1.0 if s == status else 0.0,
+                                          cluster_queue=cq, status=s)
+
+    def report_cluster_queue_quotas(self, cohort: str, cq: str, flavor: str,
+                                    resource: str, nominal: float,
+                                    borrowing: float, lending: float) -> None:
+        lbl = dict(cohort=cohort, cluster_queue=cq, flavor=flavor, resource=resource)
+        self.cluster_queue_nominal_quota.set(nominal, **lbl)
+        self.cluster_queue_borrowing_limit.set(borrowing, **lbl)
+        self.cluster_queue_lending_limit.set(lending, **lbl)
+
+    def clear_cluster_queue_metrics(self, cq: str) -> None:
+        """ClearClusterQueueMetrics + ClearCacheMetrics (metrics.go:295-324)."""
+        for metric in self._all:
+            if "cluster_queue" in metric.label_names:
+                metric.delete_partial_match({"cluster_queue": cq})
+        self.preempted_workloads_total.delete_partial_match(
+            {"preempting_cluster_queue": cq})
+
+    # --- exposition ---
+
+    def dump(self) -> str:
+        lines = []
+        for m in self._all:
+            lines.append(f"# HELP {m.name} {m.help}")
+            if isinstance(m, (Counter, Gauge)):
+                kind = "counter" if isinstance(m, Counter) else "gauge"
+                lines.append(f"# TYPE {m.name} {kind}")
+                for key, val in sorted(m.values.items()):
+                    lines.append(f"{m.name}{_fmt_labels(m.label_names, key)} {val}")
+            else:
+                lines.append(f"# TYPE {m.name} histogram")
+                for key, (counts, total, n) in sorted(m.series.items()):
+                    cum = 0
+                    for i, ub in enumerate(m.buckets):
+                        cum += counts[i]
+                        lines.append(
+                            f"{m.name}_bucket{_fmt_labels(m.label_names, key, le=ub)} {cum}")
+                    lines.append(
+                        f"{m.name}_bucket{_fmt_labels(m.label_names, key, le='+Inf')} {n}")
+                    lines.append(f"{m.name}_sum{_fmt_labels(m.label_names, key)} {total}")
+                    lines.append(f"{m.name}_count{_fmt_labels(m.label_names, key)} {n}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_labels(names: tuple, key: tuple, le=None) -> str:
+    pairs = [f'{n}="{v}"' for n, v in zip(names, key)]
+    if le is not None:
+        pairs.append(f'le="{le}"')
+    return "{" + ",".join(pairs) + "}" if pairs else ""

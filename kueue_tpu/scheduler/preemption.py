@@ -64,14 +64,14 @@ class Preemptor:
                  fs_strategies: Optional[list] = None,
                  clock=None,
                  apply_preemption: Optional[Callable] = None):
-        """apply_preemption(workload, reason, message) performs the
+        """apply_preemption(workload, preempting_cq, reason, message) performs the
         eviction write (SSA in the reference, store write here)."""
         from kueue_tpu.api.meta import REAL_CLOCK
         self.ordering = ordering or wlpkg.Ordering()
         self.enable_fair_sharing = enable_fair_sharing
         self.fs_strategies = fs_strategies or parse_strategies(None)
         self.clock = clock or REAL_CLOCK
-        self.apply_preemption = apply_preemption or (lambda wl, reason, msg: None)
+        self.apply_preemption = apply_preemption or (lambda wl, cq, reason, msg: None)
 
     # --- entry points ---
 
@@ -131,7 +131,7 @@ class Preemptor:
                 message = (f"Preempted to accommodate a workload (UID: "
                            f"{preemptor.obj.metadata.uid}) due to "
                            f"{HUMAN_READABLE_REASONS[target.reason]}")
-                self.apply_preemption(obj, target.reason, message)
+                self.apply_preemption(obj, preemptor.cluster_queue, target.reason, message)
             count += 1
         return count
 

@@ -434,7 +434,8 @@ class Scheduler:
 
         self.admission_routine(apply)
 
-    def _apply_preemption(self, wl: api.Workload, reason: str, message: str) -> None:
+    def _apply_preemption(self, wl: api.Workload, preempting_cq: str,
+                          reason: str, message: str) -> None:
         target = wlpkg.deepcopy(wl)
         now = self.clock.now()
         wlpkg.set_evicted_condition(target, api.EVICTED_BY_PREEMPTION, message, now)
@@ -442,7 +443,7 @@ class Scheduler:
         self.client.apply_admission(target)
         self.client.event(target, "Normal", "Preempted", message)
         if self.metrics is not None:
-            self.metrics.preempted(reason)
+            self.metrics.preempted(preempting_cq, reason)
 
     # --- requeue (reference: scheduler.go:674-692) ---
 

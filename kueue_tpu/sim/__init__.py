@@ -1,0 +1,30 @@
+"""In-process simulated apiserver + controller runtime.
+
+The reference runs against a real kube-apiserver (unit tests use
+controller-runtime fake clients; integration tests use envtest —
+/root/reference/test/integration/framework/framework.go). This package is
+the equivalent substrate for the TPU-native build, per SURVEY.md §4: an
+in-memory object store with watch events, finalizer-aware deletion and
+resource-version bumping, plus a deterministic controller runtime
+(workqueues drained to idle) replacing controller-runtime's manager.
+"""
+
+from kueue_tpu.sim.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    Conflict,
+    NotFound,
+    AlreadyExists,
+    Store,
+    kind_of,
+    obj_key,
+)
+from kueue_tpu.sim.runtime import Controller, EventRecorder, Runtime
+
+__all__ = [
+    "ADDED", "MODIFIED", "DELETED",
+    "Store", "NotFound", "AlreadyExists", "Conflict",
+    "kind_of", "obj_key",
+    "Controller", "Runtime", "EventRecorder",
+]

@@ -1,0 +1,179 @@
+"""In-memory object store with watches — the simulated apiserver.
+
+Semantics mirrored from the kube-apiserver behaviors the reference relies
+on (SURVEY.md §5 "communication backend"):
+- resourceVersion bumped on every write; stale-RV updates raise Conflict
+  (opt-in; server-side-apply style last-writer-wins is the default, since
+  the reference does all status writes via SSA — pkg/workload/workload.go:521).
+- deletion with finalizers parks the object with deletionTimestamp set;
+  it is only removed once the last finalizer is stripped
+  (pkg/controller/core/workload_controller.go finalizer GC path).
+- watch events (ADDED/MODIFIED/DELETED) are dispatched synchronously to
+  registered handlers, carrying deep copies — handlers can't alias store
+  state, matching informer cache isolation.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Callable, Optional
+
+from kueue_tpu.api.meta import Clock, REAL_CLOCK, new_uid
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class NotFound(KeyError):
+    pass
+
+
+class AlreadyExists(ValueError):
+    pass
+
+
+class Conflict(ValueError):
+    pass
+
+
+def kind_of(obj) -> str:
+    return type(obj).__name__
+
+
+def obj_key(obj) -> str:
+    meta = obj.metadata
+    return f"{meta.namespace}/{meta.name}" if meta.namespace else meta.name
+
+
+class Store:
+    """Keyed by (kind, namespace/name). All reads and writes deep-copy."""
+
+    def __init__(self, clock: Clock = REAL_CLOCK):
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._objects: dict[str, dict[str, object]] = {}
+        self._watchers: dict[str, list[Callable]] = {}
+        self._rv = 0
+
+    # -- watch registration ------------------------------------------------
+
+    def watch(self, kind: str, handler: Callable[[str, object, Optional[object]], None]) -> None:
+        """handler(event_type, obj, old_obj). old_obj is None for ADDED."""
+        self._watchers.setdefault(kind, []).append(handler)
+
+    def _notify(self, kind: str, event: str, obj, old) -> None:
+        for handler in self._watchers.get(kind, []):
+            handler(event, copy.deepcopy(obj), copy.deepcopy(old) if old is not None else None)
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, obj) -> object:
+        kind = kind_of(obj)
+        with self._lock:
+            key = obj_key(obj)
+            bucket = self._objects.setdefault(kind, {})
+            if key in bucket:
+                raise AlreadyExists(f"{kind} {key} already exists")
+            stored = copy.deepcopy(obj)
+            if not stored.metadata.uid:
+                stored.metadata.uid = new_uid(kind.lower())
+            if stored.metadata.creation_timestamp is None:
+                stored.metadata.creation_timestamp = self._clock.now()
+            self._rv += 1
+            stored.metadata.resource_version = self._rv
+            bucket[key] = stored
+            self._notify(kind, ADDED, stored, None)
+            return copy.deepcopy(stored)
+
+    def get(self, kind: str, namespace: str, name: str) -> object:
+        with self._lock:
+            key = f"{namespace}/{name}" if namespace else name
+            try:
+                return copy.deepcopy(self._objects[kind][key])
+            except KeyError:
+                raise NotFound(f"{kind} {key} not found") from None
+
+    def try_get(self, kind: str, namespace: str, name: str):
+        try:
+            return self.get(kind, namespace, name)
+        except NotFound:
+            return None
+
+    def update(self, obj, expect_rv: Optional[int] = None) -> object:
+        """Write back an object. With expect_rv set, raises Conflict on a
+        stale resourceVersion (optimistic concurrency); by default the
+        write wins (SSA-style — the reference's status writes are all SSA
+        and conflict-tolerant)."""
+        kind = kind_of(obj)
+        with self._lock:
+            key = obj_key(obj)
+            bucket = self._objects.setdefault(kind, {})
+            if key not in bucket:
+                raise NotFound(f"{kind} {key} not found")
+            old = bucket[key]
+            if expect_rv is not None and old.metadata.resource_version != expect_rv:
+                raise Conflict(
+                    f"{kind} {key}: resourceVersion {expect_rv} != {old.metadata.resource_version}")
+            stored = copy.deepcopy(obj)
+            stored.metadata.uid = old.metadata.uid
+            stored.metadata.creation_timestamp = old.metadata.creation_timestamp
+            # deletionTimestamp is apiserver-owned: preserve it across writes
+            if old.metadata.deletion_timestamp is not None:
+                stored.metadata.deletion_timestamp = old.metadata.deletion_timestamp
+            # A write that changes nothing does not bump the RV or fire a
+            # watch event (apiserver no-op update semantics) — this is what
+            # lets status-writing reconcilers settle.
+            stored.metadata.resource_version = old.metadata.resource_version
+            if stored == old:
+                return copy.deepcopy(stored)
+            self._rv += 1
+            stored.metadata.resource_version = self._rv
+            if stored.metadata.deletion_timestamp is not None and not stored.metadata.finalizers:
+                # last finalizer removed -> actually delete
+                del bucket[key]
+                self._notify(kind, DELETED, stored, old)
+                return copy.deepcopy(stored)
+            bucket[key] = stored
+            self._notify(kind, MODIFIED, stored, old)
+            return copy.deepcopy(stored)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            key = f"{namespace}/{name}" if namespace else name
+            bucket = self._objects.get(kind, {})
+            if key not in bucket:
+                raise NotFound(f"{kind} {key} not found")
+            old = bucket[key]
+            if old.metadata.finalizers:
+                if old.metadata.deletion_timestamp is None:
+                    stored = copy.deepcopy(old)
+                    stored.metadata.deletion_timestamp = self._clock.now()
+                    self._rv += 1
+                    stored.metadata.resource_version = self._rv
+                    bucket[key] = stored
+                    self._notify(kind, MODIFIED, stored, old)
+                return
+            del bucket[key]
+            self._notify(kind, DELETED, old, old)
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             labels: Optional[dict] = None,
+             where: Optional[Callable[[object], bool]] = None) -> list:
+        with self._lock:
+            out = []
+            for obj in self._objects.get(kind, {}).values():
+                if namespace is not None and obj.metadata.namespace != namespace:
+                    continue
+                if labels is not None and any(
+                        obj.metadata.labels.get(k) != v for k, v in labels.items()):
+                    continue
+                if where is not None and not where(obj):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def count(self, kind: str) -> int:
+        with self._lock:
+            return len(self._objects.get(kind, {}))

@@ -176,3 +176,19 @@ def make_local_queue(name: str, namespace: str, cq: str) -> api.LocalQueue:
     lq = api.LocalQueue(metadata=ObjectMeta(name=name, namespace=namespace, uid=new_uid("lq")))
     lq.spec.cluster_queue = cq
     return lq
+
+
+def finish_eviction(store, namespace: str, name: str, now: float):
+    """Complete an eviction the way the job framework's stopJob does
+    (reference: jobframework/reconciler.go:823-866, test helper
+    util.FinishEvictionForWorkloads): unset quota reservation and set
+    Requeued=False with the eviction reason."""
+    from kueue_tpu.api.meta import find_condition
+    from kueue_tpu.core import workload as wlpkg
+    wl = store.get("Workload", namespace, name)
+    evicted = find_condition(wl.status.conditions, api.WORKLOAD_EVICTED)
+    reason = evicted.reason if evicted else "Evicted"
+    wlpkg.unset_quota_reservation_with_condition(wl, "Pending", "The workload was evicted", now)
+    wlpkg.set_requeued_condition(wl, reason, evicted.message if evicted else "", False, now)
+    store.update(wl)
+    return wl

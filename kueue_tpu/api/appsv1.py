@@ -1,0 +1,30 @@
+"""apps/v1 Deployment — the subset the serving integration consumes
+(reference: pkg/controller/jobs/deployment)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_tpu.api.corev1 import PodTemplateSpec
+from kueue_tpu.api.meta import ObjectMeta
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: int = 1
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class DeploymentStatus:
+    ready_replicas: int = 0
+    available_replicas: int = 0
+
+
+@dataclass
+class Deployment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+
+    KIND = "Deployment"

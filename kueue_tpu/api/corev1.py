@@ -180,6 +180,34 @@ class PodTemplateSpec:
     spec: PodSpec = field(default_factory=PodSpec)
 
 
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+
+
+@dataclass
+class PodStatus:
+    phase: str = POD_PENDING
+    conditions: list = field(default_factory=list)
+
+
+@dataclass
+class Pod:
+    """corev1.Pod — enough for the plain-pod integration
+    (reference: pkg/controller/jobs/pod)."""
+    metadata: "ObjectMeta" = None
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    KIND = "Pod"
+
+    def __post_init__(self):
+        if self.metadata is None:
+            from kueue_tpu.api.meta import ObjectMeta
+            self.metadata = ObjectMeta()
+
+
 @dataclass
 class Namespace:
     """corev1.Namespace — only labels matter (CQ namespaceSelector,

@@ -1,0 +1,50 @@
+"""jobset.x-k8s.io/v1alpha2 JobSet — the subset the integration consumes
+(reference: pkg/controller/jobs/jobset)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.batchv1 import JobSpec
+from kueue_tpu.api.meta import ObjectMeta
+
+
+@dataclass
+class ReplicatedJob:
+    name: str = ""
+    replicas: int = 1
+    template: JobSpec = field(default_factory=JobSpec)
+
+
+@dataclass
+class JobSetSpec:
+    replicated_jobs: list = field(default_factory=list)  # list[ReplicatedJob]
+    suspend: bool = False
+
+
+@dataclass
+class ReplicatedJobStatus:
+    name: str = ""
+    ready: int = 0
+    succeeded: int = 0
+    active: int = 0
+
+
+@dataclass
+class JobSetStatus:
+    conditions: list = field(default_factory=list)
+    replicated_jobs_status: list = field(default_factory=list)
+
+
+@dataclass
+class JobSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSetSpec = field(default_factory=JobSetSpec)
+    status: JobSetStatus = field(default_factory=JobSetStatus)
+
+    KIND = "JobSet"
+
+
+JOBSET_COMPLETED = "Completed"
+JOBSET_FAILED = "Failed"

@@ -1,0 +1,81 @@
+"""kubeflow.org training CRDs — the shared shape the integrations consume
+(reference: pkg/controller/jobs/kubeflow/kubeflowjob + per-kind wrappers).
+
+All training-operator kinds share ReplicaSpecs + RunPolicy.Suspend;
+each kind differs only in its replica-type names and which one leads
+the PodSet order (master/launcher first).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.corev1 import PodTemplateSpec
+from kueue_tpu.api.meta import ObjectMeta
+
+JOB_SUCCEEDED = "Succeeded"
+JOB_FAILED = "Failed"
+
+
+@dataclass
+class ReplicaSpec:
+    replicas: int = 1
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class RunPolicy:
+    suspend: bool = False
+
+
+@dataclass
+class ReplicaStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class KFJobSpec:
+    # replica type (e.g. "Master", "Worker") -> ReplicaSpec
+    replica_specs: dict = field(default_factory=dict)
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+
+
+@dataclass
+class KFJobStatus:
+    conditions: list = field(default_factory=list)
+    replica_statuses: dict = field(default_factory=dict)  # type -> ReplicaStatus
+
+
+def _kf_kind(kind: str):
+    @dataclass
+    class _KFJob:
+        metadata: ObjectMeta = field(default_factory=ObjectMeta)
+        spec: KFJobSpec = field(default_factory=KFJobSpec)
+        status: KFJobStatus = field(default_factory=KFJobStatus)
+
+    _KFJob.__name__ = kind
+    _KFJob.__qualname__ = kind
+    _KFJob.KIND = kind
+    return _KFJob
+
+
+TFJob = _kf_kind("TFJob")
+PyTorchJob = _kf_kind("PyTorchJob")
+PaddleJob = _kf_kind("PaddleJob")
+XGBoostJob = _kf_kind("XGBoostJob")
+MXJob = _kf_kind("MXJob")
+MPIJob = _kf_kind("MPIJob")
+
+# replica-type orderings: the lead replica (master/launcher/server) comes
+# first in the PodSet list (reference: kubeflowjob OrderedReplicaTypes)
+REPLICA_ORDER = {
+    "TFJob": ["Chief", "Master", "PS", "Worker"],
+    "PyTorchJob": ["Master", "Worker"],
+    "PaddleJob": ["Master", "Worker"],
+    "XGBoostJob": ["Master", "Worker"],
+    "MXJob": ["Scheduler", "Server", "Worker"],
+    "MPIJob": ["Launcher", "Worker"],
+}

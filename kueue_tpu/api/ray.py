@@ -1,0 +1,73 @@
+"""ray.io CRDs — the subset the integrations consume
+(reference: pkg/controller/jobs/rayjob, raycluster)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.corev1 import PodTemplateSpec
+from kueue_tpu.api.meta import ObjectMeta
+
+RAYJOB_COMPLETE = "Complete"
+RAYJOB_FAILED = "Failed"
+
+
+@dataclass
+class HeadGroupSpec:
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class WorkerGroupSpec:
+    group_name: str = ""
+    replicas: int = 1
+    min_replicas: Optional[int] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class RayClusterSpec:
+    head_group_spec: HeadGroupSpec = field(default_factory=HeadGroupSpec)
+    worker_group_specs: list = field(default_factory=list)
+    suspend: bool = False
+
+
+@dataclass
+class RayJobSpec:
+    ray_cluster_spec: RayClusterSpec = field(default_factory=RayClusterSpec)
+    suspend: bool = False
+
+
+@dataclass
+class RayJobStatus:
+    job_status: str = ""           # "" | RUNNING | SUCCEEDED | FAILED
+    job_deployment_status: str = ""
+    ready_worker_replicas: int = 0
+    message: str = ""
+
+
+@dataclass
+class RayClusterStatus:
+    ready_worker_replicas: int = 0
+    available_worker_replicas: int = 0
+    state: str = ""
+    conditions: list = field(default_factory=list)
+
+
+@dataclass
+class RayJob:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: RayJobSpec = field(default_factory=RayJobSpec)
+    status: RayJobStatus = field(default_factory=RayJobStatus)
+
+    KIND = "RayJob"
+
+
+@dataclass
+class RayCluster:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: RayClusterSpec = field(default_factory=RayClusterSpec)
+    status: RayClusterStatus = field(default_factory=RayClusterStatus)
+
+    KIND = "RayCluster"

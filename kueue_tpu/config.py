@@ -247,9 +247,14 @@ def load(raw: dict) -> Configuration:
         )
     if "integrations" in raw:
         i = raw["integrations"]
+        pod_opts = i.get("podOptions", {})
         cfg.integrations = Integrations(
             frameworks=i.get("frameworks", list(DEFAULT_INTEGRATIONS)),
             external_frameworks=i.get("externalFrameworks", []),
+            pod_options=PodIntegrationOptions(
+                namespace_selector_exclude=pod_opts.get(
+                    "namespaceSelectorExclude",
+                    ["kube-system", DEFAULT_NAMESPACE])),
         )
     if "queueVisibility" in raw:
         q = raw["queueVisibility"]

@@ -64,11 +64,12 @@ def setup_core_controllers(runtime: Runtime, store: Store, queues, cache,
 
     def on_cluster_queue(event, cq, old):
         cq_r.handle_event(event, cq, old, cq_ctrl.enqueue)
-        # Fan out to the queue's LQs/workloads only on spec changes —
-        # status-only writes (the CQ reconciler's own) would otherwise
-        # cost O(N^2) reconciles per cycle (reference:
+        # Fan out to the queue's LQs/workloads only on spec changes or
+        # deletion — status-only writes (the CQ reconciler's own) would
+        # otherwise cost O(N^2) reconciles per cycle (reference:
         # workloadQueueHandler, workload_controller.go:757+).
-        if old is not None and old.spec == cq.spec:
+        from kueue_tpu.sim import DELETED as _DELETED
+        if event != _DELETED and old is not None and old.spec == cq.spec:
             return
         name = cq.metadata.name
         for lq in store.list("LocalQueue", where=lambda q: q.spec.cluster_queue == name):
@@ -76,6 +77,11 @@ def setup_core_controllers(runtime: Runtime, store: Store, queues, cache,
             for wl in store.list("Workload", namespace=lq.metadata.namespace,
                                  where=lambda w: w.spec.queue_name == lq.metadata.name):
                 wl_ctrl.enqueue(f"{wl.metadata.namespace}/{wl.metadata.name}")
+        # flavors referenced by a deleted CQ may now be finalizable
+        if event == _DELETED:
+            for rg in cq.spec.resource_groups:
+                for fq in rg.flavors:
+                    rf_ctrl.enqueue(fq.name)
 
     def on_local_queue(event, lq, old):
         lq_r.handle_event(event, lq, old, lq_ctrl.enqueue)

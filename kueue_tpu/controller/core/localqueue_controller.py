@@ -34,12 +34,12 @@ class LocalQueueReconciler:
             return None
         now = self.clock.now()
 
+        cq = self.store.try_get("ClusterQueue", "", lq.spec.cluster_queue)
         if lq.spec.stop_policy != api.STOP_POLICY_NONE:
             cond = Condition(type=api.LOCAL_QUEUE_ACTIVE, status="False",
                              reason="Stopped", message="LocalQueue is stopped",
                              observed_generation=lq.metadata.generation)
         else:
-            cq = self.store.try_get("ClusterQueue", "", lq.spec.cluster_queue)
             if cq is None:
                 cond = Condition(
                     type=api.LOCAL_QUEUE_ACTIVE, status="False",
@@ -63,7 +63,6 @@ class LocalQueueReconciler:
         if usage is not None:
             lq.status.reserving_workloads = usage.reserving_workloads
             lq.status.admitted_workloads = usage.admitted_workloads
-            cq = self.store.try_get("ClusterQueue", "", lq.spec.cluster_queue)
             if cq is not None:
                 lq.status.flavors_reservation = _lq_flavor_usage(cq.spec, usage.usage)
                 lq.status.flavors_usage = _lq_flavor_usage(cq.spec, usage.admitted_usage)

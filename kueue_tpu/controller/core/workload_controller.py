@@ -34,7 +34,8 @@ from kueue_tpu.sim.runtime import EventRecorder
 class WorkloadReconciler:
     def __init__(self, store: Store, queues, cache, recorder: EventRecorder,
                  clock, cfg: Optional[cfgpkg.Configuration] = None, metrics=None,
-                 watchers: Optional[list] = None):
+                 watchers: Optional[list] = None,
+                 rng: Optional[random.Random] = None):
         self.store = store
         self.queues = queues
         self.cache = cache
@@ -42,6 +43,8 @@ class WorkloadReconciler:
         self.clock = clock
         self.cfg = cfg or cfgpkg.Configuration()
         self.metrics = metrics
+        # seeded for reproducible backoff jitter in the deterministic sim
+        self.rng = rng or random.Random(0)
         # MultiKueue et al. observe workload transitions (reference:
         # workload_controller.go notifyWatchers).
         self.watchers = watchers if watchers is not None else []
@@ -323,7 +326,7 @@ class WorkloadReconciler:
         # 60s * 2^(n-1) + jitter, capped (reference: :530-548)
         backoff = min(strategy.backoff_base_seconds * 2 ** (count - 1),
                       strategy.backoff_max_seconds)
-        backoff *= 1.0 + strategy.backoff_jitter * random.random()
+        backoff *= 1.0 + strategy.backoff_jitter * self.rng.random()
         rs.requeue_at = now + backoff
         rs.count = count
         wl.status.requeue_state = rs
@@ -382,8 +385,10 @@ class WorkloadReconciler:
         elif status == wlpkg.STATUS_PENDING:
             rs = wl.status.requeue_state
             backoff = (rs.requeue_at - self.clock.now()) if rs and rs.requeue_at else 0.0
+            # pass `old` — the new object's admission is already cleared,
+            # and the cohort flush needs the releasing CQ from it
             self.queues.queue_associated_inadmissible_workloads_after(
-                wl, lambda: self.cache.delete_workload(wl))
+                old, lambda: self.cache.delete_workload(wl))
             if backoff <= 0:
                 self.queues.add_or_update_workload(wl)
             # else: the reconcile loop re-queues after the backoff expires

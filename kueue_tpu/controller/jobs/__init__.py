@@ -1,0 +1,18 @@
+"""Per-framework job integrations (reference: pkg/controller/jobs/*).
+
+Importing a submodule registers its integration; `register_all()` loads
+every built-in one (the reference's side-effect imports in
+cmd/kueue/main.go).
+"""
+
+import importlib
+
+_MODULES = ["job", "jobset", "kubeflow", "ray", "pod", "deployment"]
+
+
+def register_all():
+    for mod in _MODULES:
+        try:
+            importlib.import_module(f"kueue_tpu.controller.jobs.{mod}")
+        except ImportError:
+            pass  # integration not built yet; its framework name won't resolve

@@ -1,0 +1,54 @@
+"""Deployment integration (serving workloads).
+
+Equivalent of the reference's pkg/controller/jobs/deployment
+(deployment_webhook.go:112, deployment_controller.go:66,
+DependencyList: ["pod"]): a Deployment is NOT queued as one unit — its
+webhook propagates the queue-name label into the pod template so each
+replica pod is queued individually through the pod integration. The
+jobframework never manages the Deployment object itself (skip()).
+"""
+
+from __future__ import annotations
+
+from kueue_tpu.api import appsv1
+from kueue_tpu.api import kueue as api
+from kueue_tpu.controller.jobframework.interface import (
+    GenericJob,
+    IntegrationCallbacks,
+    register_integration,
+)
+
+FRAMEWORK_NAME = "deployment"
+
+
+def propagate_queue_label(deployment: appsv1.Deployment) -> bool:
+    """Webhook defaulting: copy the queue-name label to the pod template
+    (reference: deployment_webhook.go:112). Returns True if changed."""
+    q = deployment.metadata.labels.get(api.QUEUE_LABEL)
+    if not q:
+        return False
+    if deployment.spec.template.labels.get(api.QUEUE_LABEL) == q:
+        return False
+    deployment.spec.template.labels[api.QUEUE_LABEL] = q
+    return True
+
+
+class DeploymentJob(GenericJob):
+    """Never managed by the jobframework directly — pods are the unit."""
+
+    def __init__(self, obj):
+        self.deployment = obj
+
+    def object(self):
+        return self.deployment
+
+    def gvk(self) -> str:
+        return FRAMEWORK_NAME
+
+    def skip(self) -> bool:
+        return True
+
+
+register_integration(IntegrationCallbacks(
+    name=FRAMEWORK_NAME, kind="Deployment", new_job=DeploymentJob,
+    job_type=appsv1.Deployment, depends_on=["pod"]))

@@ -1,0 +1,98 @@
+"""JobSet integration.
+
+Equivalent of the reference's pkg/controller/jobs/jobset/jobset_controller.go:
+one PodSet per ReplicatedJob (count = replicas x per-job pod count),
+suspend at the JobSet level, Finished from Completed/Failed conditions,
+PodsReady from per-replicated-job ready+succeeded counts.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from kueue_tpu.api import jobset as jobsetapi
+from kueue_tpu.api import kueue as api
+from kueue_tpu.core import podset as podsetpkg
+from kueue_tpu.controller.jobframework.interface import (
+    GenericJob,
+    IntegrationCallbacks,
+    register_integration,
+)
+
+FRAMEWORK_NAME = "jobset.x-k8s.io/jobset"
+
+
+def _job_pods(job_spec) -> int:
+    count = job_spec.parallelism
+    if job_spec.completions is not None:
+        count = min(count, job_spec.completions)
+    return count
+
+
+class JobSetJob(GenericJob):
+    def __init__(self, obj: jobsetapi.JobSet):
+        self.js = obj
+
+    def object(self):
+        return self.js
+
+    def gvk(self) -> str:
+        return FRAMEWORK_NAME
+
+    def is_suspended(self) -> bool:
+        return self.js.spec.suspend
+
+    def suspend(self) -> None:
+        self.js.spec.suspend = True
+
+    def is_active(self) -> bool:
+        return any(s.active > 0 for s in self.js.status.replicated_jobs_status)
+
+    def pod_sets(self) -> list:
+        return [api.PodSet(name=rj.name,
+                           template=copy.deepcopy(rj.template.template),
+                           count=rj.replicas * _job_pods(rj.template))
+                for rj in self.js.spec.replicated_jobs]
+
+    def run_with_podsets_info(self, podsets_info: list) -> None:
+        self.js.spec.suspend = False
+        if len(podsets_info) != len(self.js.spec.replicated_jobs):
+            raise podsetpkg.PermanentError(
+                f"expected {len(self.js.spec.replicated_jobs)} podset infos, "
+                f"got {len(podsets_info)}")
+        by_name = {i.name: i for i in podsets_info}
+        for rj in self.js.spec.replicated_jobs:
+            info = by_name.get(rj.name)
+            if info is None:
+                raise podsetpkg.PermanentError(f"no podset info for {rj.name}")
+            podsetpkg.merge_into_template(rj.template.template, info)
+
+    def restore_podsets_info(self, podsets_info: list) -> bool:
+        changed = False
+        by_name = {i.name: i for i in podsets_info}
+        for rj in self.js.spec.replicated_jobs:
+            info = by_name.get(rj.name)
+            if info is not None:
+                changed = podsetpkg.restore_template(rj.template.template, info) or changed
+        return changed
+
+    def finished(self) -> tuple:
+        for c in self.js.status.conditions:
+            if c.type in (jobsetapi.JOBSET_COMPLETED, jobsetapi.JOBSET_FAILED) \
+                    and c.status == "True":
+                return c.message, c.type == jobsetapi.JOBSET_COMPLETED, True
+        return "", True, False
+
+    def pods_ready(self) -> bool:
+        by_name = {s.name: s for s in self.js.status.replicated_jobs_status}
+        for rj in self.js.spec.replicated_jobs:
+            s = by_name.get(rj.name)
+            expected = rj.replicas * _job_pods(rj.template)
+            if s is None or s.ready + s.succeeded < expected:
+                return False
+        return True
+
+
+register_integration(IntegrationCallbacks(
+    name=FRAMEWORK_NAME, kind="JobSet", new_job=JobSetJob,
+    job_type=jobsetapi.JobSet))

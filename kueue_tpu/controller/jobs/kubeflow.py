@@ -1,0 +1,114 @@
+"""kubeflow.org training-job integrations: TFJob, PyTorchJob, PaddleJob,
+XGBoostJob, MXJob, MPIJob.
+
+Equivalent of the reference's shared wrapper
+pkg/controller/jobs/kubeflow/kubeflowjob/kubeflowjob_controller.go
+instantiated per kind (pkg/controller/jobs/{tfjob,pytorchjob,paddlejob,
+xgboostjob,mxjob}) and pkg/controller/jobs/mpijob (same shape on
+v2beta1): one PodSet per replica type in canonical order, RunPolicy
+suspend, Finished from Succeeded/Failed conditions.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from kueue_tpu.api import kubeflow as kf
+from kueue_tpu.api import kueue as api
+from kueue_tpu.core import podset as podsetpkg
+from kueue_tpu.controller.jobframework.interface import (
+    GenericJob,
+    IntegrationCallbacks,
+    register_integration,
+)
+
+
+class KubeflowJob(GenericJob):
+    """Shared GenericJob over ReplicaSpecs (reference:
+    kubeflowjob_controller.go:50-173)."""
+
+    def __init__(self, obj, framework: str):
+        self.kj = obj
+        self.framework = framework
+        self.kind = type(obj).__name__
+
+    def object(self):
+        return self.kj
+
+    def gvk(self) -> str:
+        return self.framework
+
+    def is_suspended(self) -> bool:
+        return self.kj.spec.run_policy.suspend
+
+    def suspend(self) -> None:
+        self.kj.spec.run_policy.suspend = True
+
+    def is_active(self) -> bool:
+        return any(s.active > 0 for s in self.kj.status.replica_statuses.values())
+
+    def _ordered_types(self) -> list:
+        order = kf.REPLICA_ORDER.get(self.kind, [])
+        present = [t for t in order if t in self.kj.spec.replica_specs]
+        extra = [t for t in self.kj.spec.replica_specs if t not in present]
+        return present + sorted(extra)
+
+    def pod_sets(self) -> list:
+        return [api.PodSet(name=rtype.lower(),
+                           template=copy.deepcopy(self.kj.spec.replica_specs[rtype].template),
+                           count=self.kj.spec.replica_specs[rtype].replicas)
+                for rtype in self._ordered_types()]
+
+    def run_with_podsets_info(self, podsets_info: list) -> None:
+        self.kj.spec.run_policy.suspend = False
+        types = self._ordered_types()
+        if len(podsets_info) != len(types):
+            raise podsetpkg.PermanentError(
+                f"expected {len(types)} podset infos, got {len(podsets_info)}")
+        by_name = {i.name: i for i in podsets_info}
+        for rtype in types:
+            info = by_name.get(rtype.lower())
+            if info is None:
+                raise podsetpkg.PermanentError(f"no podset info for {rtype}")
+            podsetpkg.merge_into_template(
+                self.kj.spec.replica_specs[rtype].template, info)
+
+    def restore_podsets_info(self, podsets_info: list) -> bool:
+        changed = False
+        by_name = {i.name: i for i in podsets_info}
+        for rtype in self._ordered_types():
+            info = by_name.get(rtype.lower())
+            if info is not None:
+                changed = podsetpkg.restore_template(
+                    self.kj.spec.replica_specs[rtype].template, info) or changed
+        return changed
+
+    def finished(self) -> tuple:
+        for c in self.kj.status.conditions:
+            if c.type in (kf.JOB_SUCCEEDED, kf.JOB_FAILED) and c.status == "True":
+                return c.message, c.type == kf.JOB_SUCCEEDED, True
+        return "", True, False
+
+    def pods_ready(self) -> bool:
+        for rtype in self._ordered_types():
+            expected = self.kj.spec.replica_specs[rtype].replicas
+            s = self.kj.status.replica_statuses.get(rtype)
+            if s is None or s.active + s.succeeded < expected:
+                return False
+        return True
+
+
+_KINDS = [
+    ("kubeflow.org/tfjob", kf.TFJob),
+    ("kubeflow.org/pytorchjob", kf.PyTorchJob),
+    ("kubeflow.org/paddlejob", kf.PaddleJob),
+    ("kubeflow.org/xgboostjob", kf.XGBoostJob),
+    ("kubeflow.org/mxjob", kf.MXJob),
+    ("kubeflow.org/mpijob", kf.MPIJob),
+]
+
+for _framework, _type in _KINDS:
+    register_integration(IntegrationCallbacks(
+        name=_framework, kind=_type.KIND,
+        new_job=(lambda obj, _fw=_framework: KubeflowJob(obj, _fw)),
+        job_type=_type))

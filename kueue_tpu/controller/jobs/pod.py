@@ -1,0 +1,309 @@
+"""Plain Pod / pod-group integration — a ComposableJob.
+
+Equivalent of the reference's pkg/controller/jobs/pod/pod_controller.go
+(:148,253,560-700,958) and event_handlers.go:43:
+- single pods: the webhook gates them with the kueue.x-k8s.io/admission
+  scheduling gate + managed label; admission removes the gate and
+  injects flavor node selectors; "suspend" for an ungated pod means
+  deletion (gates are immutable once scheduled)
+- pod groups via labels/annotations pod-group-name /
+  pod-group-total-count / role-hash / retriable-in-group: one Workload
+  per group with one PodSet per distinct pod shape (role hash); the
+  workload is created once all expected pods exist (or immediately with
+  the fast-admission annotation); excess pods are deleted
+- group reconcile requests use the "group/<ns>/<name>" key prefix so
+  every member pod fans into one reconcile
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+
+from kueue_tpu.api import corev1
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import ObjectMeta, OwnerReference
+from kueue_tpu.core import podset as podsetpkg
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.controller.jobframework.interface import (
+    ComposableJob,
+    IntegrationCallbacks,
+    register_integration,
+)
+
+FRAMEWORK_NAME = "pod"
+GROUP_NAME_LABEL = "kueue.x-k8s.io/pod-group-name"
+GROUP_TOTAL_COUNT_ANNOTATION = "kueue.x-k8s.io/pod-group-total-count"
+ROLE_HASH_ANNOTATION = "kueue.x-k8s.io/role-hash"
+RETRIABLE_IN_GROUP_ANNOTATION = "kueue.x-k8s.io/retriable-in-group"
+GROUP_FAST_ADMISSION_ANNOTATION = "kueue.x-k8s.io/pod-group-fast-admission"
+GROUP_SERVING_ANNOTATION = "kueue.x-k8s.io/pod-group-serving"
+
+
+def pod_group_name(pod: corev1.Pod) -> str:
+    return pod.metadata.labels.get(GROUP_NAME_LABEL, "")
+
+
+def reconcile_key_for_pod(pod: corev1.Pod) -> str:
+    group = pod_group_name(pod)
+    if group:
+        return f"group/{pod.metadata.namespace}/{group}"
+    return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+
+def is_gated(pod: corev1.Pod) -> bool:
+    return api.ADMISSION_GATE in pod.spec.scheduling_gates
+
+
+def is_terminated(pod: corev1.Pod) -> bool:
+    return pod.status.phase in (corev1.POD_SUCCEEDED, corev1.POD_FAILED)
+
+
+def is_runnable_or_succeeded(pod: corev1.Pod) -> bool:
+    if pod.metadata.deletion_timestamp is not None:
+        return pod.status.phase == corev1.POD_SUCCEEDED
+    return pod.status.phase != corev1.POD_FAILED
+
+
+def role_hash(pod: corev1.Pod) -> str:
+    """Shape checksum grouping pods into PodSets
+    (reference: getRoleHash :593-622)."""
+    if ROLE_HASH_ANNOTATION in pod.metadata.annotations:
+        return pod.metadata.annotations[ROLE_HASH_ANNOTATION]
+    shape = {
+        "containers": [(c.name, sorted(c.requests.items()), sorted(c.limits.items()))
+                       for c in pod.spec.containers],
+        "initContainers": [(c.name, sorted(c.requests.items()), sorted(c.limits.items()))
+                           for c in pod.spec.init_containers],
+        "nodeSelector": sorted(pod.spec.node_selector.items()),
+        "tolerations": [(t.key, t.operator, t.value, t.effect)
+                        for t in pod.spec.tolerations],
+        "priority": pod.spec.priority,
+    }
+    digest = hashlib.sha256(json.dumps(shape, sort_keys=True).encode()).hexdigest()
+    return digest[:8]
+
+
+def _template_from_pod(pod: corev1.Pod) -> corev1.PodTemplateSpec:
+    return corev1.PodTemplateSpec(labels=dict(pod.metadata.labels),
+                                  annotations=dict(pod.metadata.annotations),
+                                  spec=copy.deepcopy(pod.spec))
+
+
+class PodJob(ComposableJob):
+    def __init__(self, _obj=None):
+        self.pod: corev1.Pod = None
+        self.pods: list = []
+        self.is_group = False
+        self.namespace = ""
+        self.group = ""
+
+    # -- load (reference: Load :624-668) --------------------------------
+
+    def load(self, store, namespace: str, name: str) -> tuple:
+        if namespace == "group":
+            self.is_group = True
+            self.namespace, self.group = name.split("/", 1)
+            self.pods = sorted(
+                store.list("Pod", namespace=self.namespace,
+                           labels={GROUP_NAME_LABEL: self.group}),
+                key=lambda p: ((p.metadata.creation_timestamp or 0.0),
+                               p.metadata.name))
+            if not self.pods:
+                return True, False
+            self.pod = self.pods[0]
+            return False, True
+        self.namespace = namespace
+        pod = store.try_get("Pod", namespace, name)
+        if pod is None:
+            return True, False
+        self.pod = pod
+        self.pods = [pod]
+        return pod.metadata.deletion_timestamp is not None, True
+
+    def object(self):
+        return self.pod
+
+    def gvk(self) -> str:
+        return FRAMEWORK_NAME
+
+    def is_suspended(self) -> bool:
+        return is_terminated(self.pod) or is_gated(self.pod)
+
+    def suspend(self) -> None:
+        pass  # gates can't be re-added; stop() deletes instead
+
+    def is_active(self) -> bool:
+        return any(not is_terminated(p) and not is_gated(p) for p in self.pods)
+
+    def _total_count(self) -> int:
+        raw = self.pod.metadata.annotations.get(GROUP_TOTAL_COUNT_ANNOTATION)
+        return int(raw) if raw is not None else len(self.pods)
+
+    def pod_sets(self) -> list:
+        if not self.is_group:
+            return [api.PodSet(name=api.DEFAULT_PODSET_NAME,
+                               template=_template_from_pod(self.pod), count=1)]
+        out = []
+        for pod in self.pods:
+            if not is_runnable_or_succeeded(pod):
+                continue
+            rh = role_hash(pod)
+            existing = next((ps for ps in out if ps.name == rh), None)
+            if existing is not None:
+                existing.count += 1
+            else:
+                out.append(api.PodSet(name=rh, template=_template_from_pod(pod),
+                                      count=1))
+        return out
+
+    def finished(self) -> tuple:
+        if not self.is_group:
+            if self.pod.status.phase == corev1.POD_SUCCEEDED:
+                return "Pod succeeded", True, True
+            if self.pod.status.phase == corev1.POD_FAILED:
+                return "Pod failed", False, True
+            return "", True, False
+        # group semantics (reference: Finished :253-330): an unretriable
+        # failed pod fails the whole group; all-succeeded completes it
+        succeeded = 0
+        for pod in self.pods:
+            if pod.status.phase == corev1.POD_FAILED:
+                if pod.metadata.annotations.get(RETRIABLE_IN_GROUP_ANNOTATION) == "false":
+                    return "Pod in group failed and is not retriable", False, True
+            elif pod.status.phase == corev1.POD_SUCCEEDED:
+                succeeded += 1
+        if succeeded >= self._total_count():
+            return "Pods succeeded", True, True
+        return "", True, False
+
+    def pods_ready(self) -> bool:
+        ready = sum(1 for p in self.pods
+                    if p.status.phase in (corev1.POD_RUNNING, corev1.POD_SUCCEEDED))
+        return ready >= (self._total_count() if self.is_group else 1)
+
+    def run_with_podsets_info(self, podsets_info: list) -> None:
+        raise NotImplementedError  # ComposableJob uses run()
+
+    def restore_podsets_info(self, podsets_info: list) -> bool:
+        return False
+
+    # -- composable operations ------------------------------------------
+
+    def run(self, store, podsets_info: list, recorder, msg: str) -> None:
+        """Ungate + inject selectors (reference: Run :282-330)."""
+        by_name = {i.name: i for i in podsets_info}
+        for pod in self.pods:
+            if not is_gated(pod):
+                continue
+            name = api.DEFAULT_PODSET_NAME if not self.is_group else role_hash(pod)
+            info = by_name.get(name)
+            if info is None:
+                continue
+            # pin the role hash before injection mutates the shape fields
+            # (the reference's webhook stamps RoleHashAnnotation up front)
+            if self.is_group:
+                pod.metadata.annotations.setdefault(ROLE_HASH_ANNOTATION, name)
+            pod.spec.scheduling_gates = [g for g in pod.spec.scheduling_gates
+                                         if g != api.ADMISSION_GATE]
+            for k, v in info.node_selector.items():
+                pod.spec.node_selector.setdefault(k, v)
+            pod.spec.tolerations.extend(info.tolerations)
+            for k, v in info.labels.items():
+                pod.metadata.labels.setdefault(k, v)
+            for k, v in info.annotations.items():
+                pod.metadata.annotations.setdefault(k, v)
+            store.update(pod)
+            recorder.event(pod, "Normal", "Started", msg)
+
+    def stop(self, store, podsets_info: list, reason: str, msg: str) -> list:
+        """Delete non-terminated pods (reference: Stop :170-206 — ungated
+        pods can't be re-suspended)."""
+        stopped = []
+        for pod in self.pods:
+            if is_terminated(pod):
+                continue
+            try:
+                if api.RESOURCE_IN_USE_FINALIZER in pod.metadata.finalizers:
+                    pod.metadata.finalizers.remove(api.RESOURCE_IN_USE_FINALIZER)
+                    store.update(pod)
+                store.delete("Pod", pod.metadata.namespace, pod.metadata.name)
+                stopped.append(pod)
+            except KeyError:
+                pass
+        return stopped
+
+    def construct_composable_workload(self, store, recorder):
+        """reference: ConstructComposableWorkload — wait for the whole
+        group unless fast admission is requested."""
+        if not self.is_group:
+            wl = api.Workload(metadata=ObjectMeta(
+                name=self.pod.metadata.name,
+                namespace=self.pod.metadata.namespace,
+                finalizers=[api.RESOURCE_IN_USE_FINALIZER],
+                owner_references=[OwnerReference(
+                    kind="Pod", name=self.pod.metadata.name,
+                    uid=self.pod.metadata.uid, controller=True)]))
+            wl.spec.pod_sets = self.pod_sets()
+            wl.spec.queue_name = self.pod.metadata.labels.get(api.QUEUE_LABEL, "")
+            return wl
+        total = self._total_count()
+        runnable = [p for p in self.pods if is_runnable_or_succeeded(p)]
+        fast = self.pod.metadata.annotations.get(
+            GROUP_FAST_ADMISSION_ANNOTATION) == "true"
+        if len(runnable) < total and not fast:
+            return None  # wait for the rest of the group
+        pod_sets = self.pod_sets()
+        if fast and sum(ps.count for ps in pod_sets) < total and pod_sets:
+            pod_sets[0].count += total - sum(ps.count for ps in pod_sets)
+        wl = api.Workload(metadata=ObjectMeta(
+            name=self.group, namespace=self.namespace,
+            annotations={"kueue.x-k8s.io/is-group-workload": "true"},
+            finalizers=[api.RESOURCE_IN_USE_FINALIZER],
+            owner_references=[OwnerReference(
+                kind="Pod", name=self.group, uid=f"group-{self.group}",
+                controller=True)]))
+        wl.spec.pod_sets = pod_sets
+        wl.spec.queue_name = self.pod.metadata.labels.get(api.QUEUE_LABEL, "")
+        return wl
+
+    def list_child_workloads(self, store) -> list:
+        name = self.group if self.is_group else (
+            self.pod.metadata.name if self.pod else "")
+        return store.list(
+            "Workload", namespace=self.namespace,
+            where=lambda wl: any(o.controller and o.kind == "Pod" and o.name == name
+                                 for o in wl.metadata.owner_references))
+
+    def find_matching_workloads(self, store, recorder) -> tuple:
+        match = None
+        to_delete = []
+        job_podsets = {ps.name: ps.count for ps in self.pod_sets()}
+        for wl in self.list_child_workloads(store):
+            wl_podsets = {ps.name: ps.count for ps in wl.spec.pod_sets}
+            if match is None and self._podsets_compatible(job_podsets, wl_podsets):
+                match = wl
+            else:
+                to_delete.append(wl)
+        return match, to_delete
+
+    def _podsets_compatible(self, job_podsets: dict, wl_podsets: dict) -> bool:
+        if not self.is_group:
+            return set(job_podsets) == set(wl_podsets)
+        # group pods may still be arriving or already cleaned up; the
+        # workload matches while every observed role exists in it
+        return all(name in wl_podsets and count <= wl_podsets[name]
+                   for name, count in job_podsets.items()) or not job_podsets
+
+
+def reconcile_key_for_workload(wl, owner) -> str:
+    if wl.metadata.annotations.get("kueue.x-k8s.io/is-group-workload") == "true":
+        return f"group/{wl.metadata.namespace}/{owner.name}"
+    return f"{wl.metadata.namespace}/{owner.name}"
+
+
+register_integration(IntegrationCallbacks(
+    name=FRAMEWORK_NAME, kind="Pod", new_job=PodJob, job_type=corev1.Pod,
+    composable=True, reconcile_key=reconcile_key_for_pod,
+    reconcile_key_for_workload=reconcile_key_for_workload))

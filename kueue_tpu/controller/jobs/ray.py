@@ -1,0 +1,160 @@
+"""KubeRay integrations: RayJob and RayCluster.
+
+Equivalent of the reference's pkg/controller/jobs/rayjob/rayjob_controller.go
+and raycluster/raycluster_controller.go: PodSets = head (count 1) + one
+per worker group (count = replicas); suspend at the CR level; RayJob
+finishes from jobStatus SUCCEEDED/FAILED, RayCluster never finishes on
+its own (serving-style).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api import ray as rayapi
+from kueue_tpu.core import podset as podsetpkg
+from kueue_tpu.controller.jobframework.interface import (
+    GenericJob,
+    IntegrationCallbacks,
+    register_integration,
+)
+
+RAYJOB_FRAMEWORK = "ray.io/rayjob"
+RAYCLUSTER_FRAMEWORK = "ray.io/raycluster"
+HEAD_PODSET = "head"
+
+
+class _RayBase(GenericJob):
+    def _cluster_spec(self) -> rayapi.RayClusterSpec:
+        raise NotImplementedError
+
+    def pod_sets(self) -> list:
+        spec = self._cluster_spec()
+        out = [api.PodSet(name=HEAD_PODSET,
+                          template=copy.deepcopy(spec.head_group_spec.template),
+                          count=1)]
+        for wg in spec.worker_group_specs:
+            out.append(api.PodSet(name=wg.group_name,
+                                  template=copy.deepcopy(wg.template),
+                                  count=wg.replicas,
+                                  min_count=wg.min_replicas))
+        return out
+
+    def run_with_podsets_info(self, podsets_info: list) -> None:
+        spec = self._cluster_spec()
+        expected = 1 + len(spec.worker_group_specs)
+        if len(podsets_info) != expected:
+            raise podsetpkg.PermanentError(
+                f"expected {expected} podset infos, got {len(podsets_info)}")
+        by_name = {i.name: i for i in podsets_info}
+        head = by_name.get(HEAD_PODSET)
+        if head is None:
+            raise podsetpkg.PermanentError("no podset info for head")
+        podsetpkg.merge_into_template(spec.head_group_spec.template, head)
+        for wg in spec.worker_group_specs:
+            info = by_name.get(wg.group_name)
+            if info is None:
+                raise podsetpkg.PermanentError(f"no podset info for {wg.group_name}")
+            if wg.min_replicas is not None:
+                wg.replicas = info.count
+            podsetpkg.merge_into_template(wg.template, info)
+        self._unsuspend()
+
+    def restore_podsets_info(self, podsets_info: list) -> bool:
+        spec = self._cluster_spec()
+        changed = False
+        by_name = {i.name: i for i in podsets_info}
+        head = by_name.get(HEAD_PODSET)
+        if head is not None:
+            changed = podsetpkg.restore_template(spec.head_group_spec.template, head)
+        for wg in spec.worker_group_specs:
+            info = by_name.get(wg.group_name)
+            if info is not None:
+                if wg.min_replicas is not None and wg.replicas != info.count:
+                    wg.replicas = info.count
+                    changed = True
+                changed = podsetpkg.restore_template(wg.template, info) or changed
+        return changed
+
+    def _unsuspend(self) -> None:
+        raise NotImplementedError
+
+
+class RayJobJob(_RayBase):
+    def __init__(self, obj: rayapi.RayJob):
+        self.rj = obj
+
+    def object(self):
+        return self.rj
+
+    def gvk(self) -> str:
+        return RAYJOB_FRAMEWORK
+
+    def _cluster_spec(self):
+        return self.rj.spec.ray_cluster_spec
+
+    def is_suspended(self) -> bool:
+        return self.rj.spec.suspend
+
+    def suspend(self) -> None:
+        self.rj.spec.suspend = True
+
+    def _unsuspend(self) -> None:
+        self.rj.spec.suspend = False
+
+    def is_active(self) -> bool:
+        return self.rj.status.job_deployment_status != ""
+
+    def finished(self) -> tuple:
+        if self.rj.status.job_status in ("SUCCEEDED", "FAILED"):
+            return (self.rj.status.message,
+                    self.rj.status.job_status == "SUCCEEDED", True)
+        return "", True, False
+
+    def pods_ready(self) -> bool:
+        expected = sum(wg.replicas for wg in self._cluster_spec().worker_group_specs)
+        return self.rj.status.ready_worker_replicas >= expected
+
+
+class RayClusterJob(_RayBase):
+    def __init__(self, obj: rayapi.RayCluster):
+        self.rc = obj
+
+    def object(self):
+        return self.rc
+
+    def gvk(self) -> str:
+        return RAYCLUSTER_FRAMEWORK
+
+    def _cluster_spec(self):
+        return self.rc.spec
+
+    def is_suspended(self) -> bool:
+        return self.rc.spec.suspend
+
+    def suspend(self) -> None:
+        self.rc.spec.suspend = True
+
+    def _unsuspend(self) -> None:
+        self.rc.spec.suspend = False
+
+    def is_active(self) -> bool:
+        return self.rc.status.ready_worker_replicas > 0
+
+    def finished(self) -> tuple:
+        # a RayCluster is a long-running service; it only stops via
+        # deletion or eviction (reference: raycluster_controller.go)
+        return "", True, False
+
+    def pods_ready(self) -> bool:
+        expected = sum(wg.replicas for wg in self.rc.spec.worker_group_specs)
+        return self.rc.status.ready_worker_replicas >= expected
+
+
+register_integration(IntegrationCallbacks(
+    name=RAYJOB_FRAMEWORK, kind="RayJob", new_job=RayJobJob,
+    job_type=rayapi.RayJob))
+register_integration(IntegrationCallbacks(
+    name=RAYCLUSTER_FRAMEWORK, kind="RayCluster", new_job=RayClusterJob,
+    job_type=rayapi.RayCluster))

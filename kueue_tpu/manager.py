@@ -91,6 +91,17 @@ class KueueManager:
             cfg=self.cfg, metrics=self.metrics,
             registered_check_controllers=registered_check_controllers)
 
+        # job integrations (reference: jobframework.SetupControllers via
+        # cmd/kueue/main.go:229-290). Registration is idempotent across
+        # managers; wiring is per-runtime.
+        from kueue_tpu.controller import jobs as jobs_registry
+        from kueue_tpu.controller.jobframework import (
+            get_integration, setup_integrations)
+        if get_integration("batch/job") is None:
+            jobs_registry.register_all()
+        self.integrations = setup_integrations(
+            self.runtime, self.store, self.recorder, self.cfg)
+
         self.scheduler_client = StoreSchedulerClient(self.store, self.recorder)
         self.scheduler = Scheduler(
             self.queues, self.cache, self.scheduler_client,

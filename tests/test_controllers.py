@@ -298,11 +298,10 @@ class TestLifecycle:
         mgr.store.delete("ResourceFlavor", "", "default")
         mgr.run_until_idle()
         assert mgr.store.try_get("ResourceFlavor", "", "default") is not None
-        # remove the CQ -> flavor can finalize
+        # remove the CQ -> the CQ-deletion fan-out re-reconciles the
+        # flavor, which can now finalize
         mgr.store.delete("ClusterQueue", "", "cq")
         mgr.run_until_idle()
-        # trigger rf reconcile (the reference watches CQ deletions too)
-        mgr.controllers.resource_flavor.reconcile("default")
         assert mgr.store.try_get("ResourceFlavor", "", "default") is None
 
 

@@ -1,0 +1,359 @@
+"""Job-integration tests: jobframework state machine + per-kind wrappers.
+
+Plays the role of the reference's test/integration/controller/jobs/*
+suites (SURVEY.md §4 tier 2).
+"""
+
+import pytest
+
+from kueue_tpu import config as cfgpkg
+from kueue_tpu.api import appsv1, batchv1, corev1, jobset as jobsetapi
+from kueue_tpu.api import kubeflow as kf
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api import ray as rayapi
+from kueue_tpu.api.corev1 import Container, PodSpec, PodTemplateSpec
+from kueue_tpu.api.meta import Condition, FakeClock, ObjectMeta, find_condition
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.controller.jobs.pod import (
+    GROUP_NAME_LABEL,
+    GROUP_TOTAL_COUNT_ANNOTATION,
+)
+from kueue_tpu.manager import KueueManager
+
+from tests.wrappers import (
+    ClusterQueueWrapper,
+    flavor_quotas,
+    make_flavor,
+    make_local_queue,
+)
+
+ALL_FRAMEWORKS_CFG = cfgpkg.Configuration(
+    integrations=cfgpkg.Integrations(frameworks=list(cfgpkg.ALL_INTEGRATIONS)))
+
+
+@pytest.fixture
+def clock():
+    return FakeClock(1000.0)
+
+
+@pytest.fixture
+def mgr(clock):
+    m = KueueManager(cfg=ALL_FRAMEWORKS_CFG, clock=clock)
+    m.store.create(make_flavor("default", node_labels={"zone": "a"}))
+    m.store.create(ClusterQueueWrapper("cq").resource_group(
+        flavor_quotas("default", cpu=4)).obj())
+    m.store.create(make_local_queue("lq", "default", "cq"))
+    m.run_until_idle()
+    return m
+
+
+def template(cpu="1"):
+    return PodTemplateSpec(spec=PodSpec(containers=[
+        Container(name="c", requests={"cpu": corev1.parse_quantity(cpu, "cpu")})]))
+
+
+def make_job(name="j", queue="lq", parallelism=1, cpu="1", **annotations):
+    job = batchv1.Job(metadata=ObjectMeta(
+        name=name, namespace="default",
+        labels={api.QUEUE_LABEL: queue} if queue else {},
+        annotations=dict(annotations)))
+    job.spec.suspend = True
+    job.spec.parallelism = parallelism
+    job.spec.template = template(cpu)
+    return job
+
+
+class TestBatchJob:
+    def test_full_lifecycle(self, mgr, clock):
+        mgr.store.create(make_job(parallelism=2))
+        mgr.schedule_until_settled()
+        wls = mgr.store.list("Workload")
+        assert len(wls) == 1 and wlpkg.is_admitted(wls[0])
+        assert wls[0].spec.pod_sets[0].count == 2
+        job = mgr.store.get("Job", "default", "j")
+        assert not job.spec.suspend
+        assert job.spec.template.spec.node_selector == {"zone": "a"}
+        # finish
+        job.status.conditions.append(Condition(
+            type=batchv1.JOB_COMPLETE, status="True", message="done"))
+        mgr.store.update(job)
+        mgr.run_until_idle()
+        wl = mgr.store.list("Workload")[0]
+        assert wlpkg.is_finished(wl)
+        assert not wl.metadata.finalizers
+        # delete job -> workload GC'd (sim plays the k8s GC role)
+        mgr.store.delete("Job", "default", "j")
+        mgr.run_until_idle()
+        assert mgr.store.list("Workload") == []
+
+    def test_job_without_queue_name_ignored(self, mgr):
+        mgr.store.create(make_job(queue=None))
+        mgr.schedule_until_settled()
+        assert mgr.store.list("Workload") == []
+
+    def test_manage_without_queue_name(self, clock):
+        cfg = cfgpkg.Configuration(
+            manage_jobs_without_queue_name=True,
+            integrations=cfgpkg.Integrations(frameworks=["batch/job"]))
+        m = KueueManager(cfg=cfg, clock=clock)
+        m.store.create(make_flavor("default"))
+        m.store.create(ClusterQueueWrapper("cq").resource_group(
+            flavor_quotas("default", cpu=4)).obj())
+        m.store.create(make_local_queue("lq", "default", "cq"))
+        m.run_until_idle()
+        m.store.create(make_job(queue=None))
+        m.run_until_idle()
+        # a workload is created even without the label (queue is empty ->
+        # stays pending as inadmissible)
+        assert len(m.store.list("Workload")) == 1
+
+    def test_partial_admission_scales_parallelism(self, mgr):
+        job = make_job(parallelism=6, **{
+            "kueue.x-k8s.io/job-min-parallelism": "2"})
+        mgr.store.create(job)
+        mgr.schedule_until_settled()
+        wl = mgr.store.list("Workload")[0]
+        assert wlpkg.is_admitted(wl)
+        # only 4 cpus -> scaled down to 4
+        assert wl.status.admission.pod_set_assignments[0].count == 4
+        job = mgr.store.get("Job", "default", "j")
+        assert job.spec.parallelism == 4
+        assert job.metadata.annotations["kueue.x-k8s.io/original-parallelism"] == "6"
+
+    def test_eviction_stops_job_and_clears_reservation(self, mgr, clock):
+        mgr.store.create(make_job(parallelism=1))
+        mgr.schedule_until_settled()
+        job = mgr.store.get("Job", "default", "j")
+        assert not job.spec.suspend
+        # evict via CQ drain
+        cq = mgr.store.get("ClusterQueue", "", "cq")
+        cq.spec.stop_policy = api.HOLD_AND_DRAIN
+        mgr.store.update(cq)
+        mgr.run_until_idle()
+        job = mgr.store.get("Job", "default", "j")
+        assert job.spec.suspend
+        assert job.spec.template.spec.node_selector == {}  # restored
+        wl = mgr.store.list("Workload")[0]
+        assert not wlpkg.has_quota_reservation(wl)
+        req = find_condition(wl.status.conditions, api.WORKLOAD_REQUEUED)
+        assert req is not None and req.status == "False"
+        assert req.reason == api.EVICTED_BY_CLUSTER_QUEUE_STOPPED
+
+    def test_preemption_requeues_immediately(self, mgr, clock):
+        cq = mgr.store.get("ClusterQueue", "", "cq")
+        cq.spec.preemption = api.ClusterQueuePreemption(
+            within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+        mgr.store.update(cq)
+        mgr.run_until_idle()
+        mgr.store.create(api.WorkloadPriorityClass(
+            metadata=ObjectMeta(name="high"), value=100))
+        mgr.store.create(make_job(name="low", parallelism=4, cpu="1"))
+        mgr.schedule_until_settled()
+        assert not mgr.store.get("Job", "default", "low").spec.suspend
+        high = make_job(name="high", parallelism=4, cpu="1")
+        high.metadata.labels[api.PRIORITY_CLASS_LABEL] = "high"
+        mgr.store.create(high)
+        mgr.schedule_until_settled()
+        low_wl = next(w for w in mgr.store.list("Workload")
+                      if w.metadata.name.startswith("job-low"))
+        high_wl = next(w for w in mgr.store.list("Workload")
+                       if w.metadata.name.startswith("job-high"))
+        assert high_wl.spec.priority == 100
+        assert wlpkg.is_admitted(high_wl)
+        assert mgr.store.get("Job", "default", "low").spec.suspend
+        req = find_condition(low_wl.status.conditions, api.WORKLOAD_REQUEUED)
+        # preemption evictions requeue immediately (Requeued=True)
+        assert req is not None and req.status == "True"
+
+    def test_reclaimable_pods_propagate(self, mgr):
+        mgr.store.create(make_job(parallelism=3))
+        mgr.schedule_until_settled()
+        job = mgr.store.get("Job", "default", "j")
+        job.status.succeeded = 2
+        mgr.store.update(job)
+        mgr.run_until_idle()
+        wl = mgr.store.list("Workload")[0]
+        assert wl.status.reclaimable_pods == [
+            api.ReclaimablePod(name="main", count=2)]
+
+    def test_prebuilt_workload(self, mgr):
+        wl = api.Workload(metadata=ObjectMeta(name="prebuilt", namespace="default"))
+        wl.spec.queue_name = "lq"
+        wl.spec.pod_sets = [api.PodSet(name="main", count=1, template=template())]
+        mgr.store.create(wl)
+        job = make_job()
+        job.metadata.labels[api.PREBUILT_WORKLOAD_LABEL] = "prebuilt"
+        mgr.store.create(job)
+        mgr.schedule_until_settled()
+        wls = mgr.store.list("Workload")
+        assert len(wls) == 1 and wls[0].metadata.name == "prebuilt"
+        assert wlpkg.is_admitted(wls[0])
+        assert not mgr.store.get("Job", "default", "j").spec.suspend
+
+
+class TestJobSet:
+    def test_multi_replicated_jobs(self, mgr):
+        js = jobsetapi.JobSet(metadata=ObjectMeta(
+            name="js", namespace="default", labels={api.QUEUE_LABEL: "lq"}))
+        js.spec.suspend = True
+        js.spec.replicated_jobs = [
+            jobsetapi.ReplicatedJob(name="leader", replicas=1,
+                                    template=batchv1.JobSpec(parallelism=1,
+                                                             template=template())),
+            jobsetapi.ReplicatedJob(name="workers", replicas=1,
+                                    template=batchv1.JobSpec(parallelism=2,
+                                                             template=template())),
+        ]
+        mgr.store.create(js)
+        mgr.schedule_until_settled()
+        wl = mgr.store.list("Workload")[0]
+        assert [(ps.name, ps.count) for ps in wl.spec.pod_sets] == [
+            ("leader", 1), ("workers", 2)]
+        assert wlpkg.is_admitted(wl)
+        js = mgr.store.get("JobSet", "", "js") if False else \
+            mgr.store.get("JobSet", "default", "js")
+        assert not js.spec.suspend
+        for rj in js.spec.replicated_jobs:
+            assert rj.template.template.spec.node_selector == {"zone": "a"}
+
+
+class TestKubeflow:
+    def test_pytorch_master_worker(self, mgr):
+        pj = kf.PyTorchJob(metadata=ObjectMeta(
+            name="pt", namespace="default", labels={api.QUEUE_LABEL: "lq"}))
+        pj.spec.run_policy.suspend = True
+        pj.spec.replica_specs = {
+            "Worker": kf.ReplicaSpec(replicas=2, template=template()),
+            "Master": kf.ReplicaSpec(replicas=1, template=template()),
+        }
+        mgr.store.create(pj)
+        mgr.schedule_until_settled()
+        wl = mgr.store.list("Workload")[0]
+        # master ordered first
+        assert [ps.name for ps in wl.spec.pod_sets] == ["master", "worker"]
+        assert wlpkg.is_admitted(wl)
+        pj = mgr.store.get("PyTorchJob", "default", "pt")
+        assert not pj.spec.run_policy.suspend
+
+    def test_mpijob_finishes(self, mgr, clock):
+        mj = kf.MPIJob(metadata=ObjectMeta(
+            name="mpi", namespace="default", labels={api.QUEUE_LABEL: "lq"}))
+        mj.spec.run_policy.suspend = True
+        mj.spec.replica_specs = {
+            "Launcher": kf.ReplicaSpec(replicas=1, template=template()),
+            "Worker": kf.ReplicaSpec(replicas=2, template=template()),
+        }
+        mgr.store.create(mj)
+        mgr.schedule_until_settled()
+        wl = mgr.store.list("Workload")[0]
+        assert [ps.name for ps in wl.spec.pod_sets] == ["launcher", "worker"]
+        mj = mgr.store.get("MPIJob", "default", "mpi")
+        mj.status.conditions.append(Condition(
+            type=kf.JOB_SUCCEEDED, status="True", message="done"))
+        mgr.store.update(mj)
+        mgr.run_until_idle()
+        assert wlpkg.is_finished(mgr.store.list("Workload")[0])
+
+
+class TestRay:
+    def test_rayjob_head_and_workers(self, mgr):
+        rj = rayapi.RayJob(metadata=ObjectMeta(
+            name="ray", namespace="default", labels={api.QUEUE_LABEL: "lq"}))
+        rj.spec.suspend = True
+        rj.spec.ray_cluster_spec = rayapi.RayClusterSpec(
+            head_group_spec=rayapi.HeadGroupSpec(template=template()),
+            worker_group_specs=[rayapi.WorkerGroupSpec(
+                group_name="gpu-group", replicas=2, template=template())])
+        mgr.store.create(rj)
+        mgr.schedule_until_settled()
+        wl = mgr.store.list("Workload")[0]
+        assert [(ps.name, ps.count) for ps in wl.spec.pod_sets] == [
+            ("head", 1), ("gpu-group", 2)]
+        assert wlpkg.is_admitted(wl)
+        assert not mgr.store.get("RayJob", "default", "ray").spec.suspend
+
+
+class TestPodIntegration:
+    def make_pod(self, name, group=None, cpu="1", gated=True, total=None):
+        pod = corev1.Pod(metadata=ObjectMeta(
+            name=name, namespace="default",
+            labels={api.QUEUE_LABEL: "lq", api.MANAGED_LABEL: "true"}))
+        pod.spec = PodSpec(containers=[Container(
+            name="c", requests={"cpu": corev1.parse_quantity(cpu, "cpu")})])
+        if gated:
+            pod.spec.scheduling_gates = [api.ADMISSION_GATE]
+        if group:
+            pod.metadata.labels[GROUP_NAME_LABEL] = group
+            pod.metadata.annotations[GROUP_TOTAL_COUNT_ANNOTATION] = str(total)
+        return pod
+
+    def test_single_pod_gated_then_admitted(self, mgr):
+        mgr.store.create(self.make_pod("p1"))
+        mgr.schedule_until_settled()
+        wl = mgr.store.list("Workload")[0]
+        assert wl.metadata.name == "p1"
+        assert wlpkg.is_admitted(wl)
+        pod = mgr.store.get("Pod", "default", "p1")
+        assert api.ADMISSION_GATE not in pod.spec.scheduling_gates
+        assert pod.spec.node_selector == {"zone": "a"}
+
+    def test_pod_group_waits_for_all_members(self, mgr):
+        mgr.store.create(self.make_pod("g1-a", group="g1", total=2))
+        mgr.schedule_until_settled()
+        assert mgr.store.list("Workload") == []  # incomplete group
+        mgr.store.create(self.make_pod("g1-b", group="g1", total=2))
+        mgr.schedule_until_settled()
+        wls = mgr.store.list("Workload")
+        assert len(wls) == 1 and wls[0].metadata.name == "g1"
+        assert sum(ps.count for ps in wls[0].spec.pod_sets) == 2
+        assert wlpkg.is_admitted(wls[0])
+        for name in ("g1-a", "g1-b"):
+            pod = mgr.store.get("Pod", "default", name)
+            assert api.ADMISSION_GATE not in pod.spec.scheduling_gates
+
+    def test_pod_group_two_roles(self, mgr):
+        mgr.store.create(self.make_pod("g2-driver", group="g2", cpu="2", total=3))
+        mgr.store.create(self.make_pod("g2-w0", group="g2", cpu="1", total=3))
+        mgr.store.create(self.make_pod("g2-w1", group="g2", cpu="1", total=3))
+        mgr.schedule_until_settled()
+        wl = mgr.store.list("Workload")[0]
+        counts = sorted(ps.count for ps in wl.spec.pod_sets)
+        assert counts == [1, 2]  # driver role + worker role
+        assert wlpkg.is_admitted(wl)
+
+    def test_pod_group_finishes(self, mgr):
+        mgr.store.create(self.make_pod("g3-a", group="g3", total=2))
+        mgr.store.create(self.make_pod("g3-b", group="g3", total=2))
+        mgr.schedule_until_settled()
+        for name in ("g3-a", "g3-b"):
+            pod = mgr.store.get("Pod", "default", name)
+            pod.status.phase = corev1.POD_SUCCEEDED
+            mgr.store.update(pod)
+        mgr.run_until_idle()
+        assert wlpkg.is_finished(mgr.store.list("Workload")[0])
+
+
+class TestDeployment:
+    def test_queue_label_propagates_and_pods_queue(self, mgr):
+        from kueue_tpu.controller.jobs.deployment import propagate_queue_label
+        dep = appsv1.Deployment(metadata=ObjectMeta(
+            name="serve", namespace="default", labels={api.QUEUE_LABEL: "lq"}))
+        dep.spec.replicas = 2
+        dep.spec.template = template()
+        assert propagate_queue_label(dep)
+        assert dep.spec.template.labels[api.QUEUE_LABEL] == "lq"
+        mgr.store.create(dep)
+        # the platform (replicaset controller) creates pods from the
+        # template; the pod webhook gates them
+        for i in range(2):
+            pod = corev1.Pod(metadata=ObjectMeta(
+                name=f"serve-{i}", namespace="default",
+                labels=dict(dep.spec.template.labels,
+                            **{api.MANAGED_LABEL: "true"})))
+            pod.spec = dep.spec.template.spec
+            pod.spec.scheduling_gates = [api.ADMISSION_GATE]
+            mgr.store.create(pod)
+        mgr.schedule_until_settled()
+        wls = mgr.store.list("Workload")
+        assert len(wls) == 2  # one workload per serving pod
+        assert all(wlpkg.is_admitted(w) for w in wls)

@@ -1,0 +1,4 @@
+"""AdmissionCheck controllers (reference: pkg/controller/admissionchecks):
+provisioning (cluster-autoscaler ProvisioningRequest gate) and multikueue
+(multi-cluster dispatch). The TPU batch solver also plugs in through the
+same mechanism (kueue_tpu.solver.service)."""

@@ -1,0 +1,313 @@
+"""MultiKueue: multi-cluster dispatch as an AdmissionCheck.
+
+Equivalent of the reference's pkg/controller/admissionchecks/multikueue
+(multikueuecluster.go:67-307, workload.go:137-420):
+- each MultiKueueCluster names a worker cluster; the reference dials it
+  via a kubeconfig secret with fsnotify-driven reconnect — the sim
+  resolves the name through an injected registry of remote stores
+  (worker clusters are full KueueManagers in tests, the analogue of the
+  reference's two envtest instances in one process)
+- for every local workload with QuotaReserved and a multikueue check,
+  mirror the workload (and its batch Job, via the adapter) into every
+  cluster of the check's MultiKueueConfig
+- the FIRST cluster to reserve quota wins: the mirrors on the other
+  clusters are deleted; the check turns Ready and records the cluster
+- the remote Finished condition is copied back, then remotes are GC'd
+- if the reserving cluster disappears, the check flips to Retry after
+  worker_lost_timeout (config multiKueue.workerLostTimeout)
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from kueue_tpu.api import autoscaling as asapi
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import Condition, find_condition, is_condition_true, set_condition
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.sim import DELETED, Store
+
+CONTROLLER_NAME = "kueue.x-k8s.io/multikueue"
+ORIGIN_LABEL = "kueue.x-k8s.io/multikueue-origin"
+
+
+class MultiKueueAdapter:
+    """Per-job-kind remote sync (reference: jobframework.MultiKueueAdapter,
+    interface.go:160-196)."""
+
+    KIND = ""
+
+    def sync_job(self, local_store: Store, remote_store: Store,
+                 wl: api.Workload, origin: str) -> None:
+        """Create/refresh the remote job object and copy its status back."""
+
+    def delete_remote(self, remote_store: Store, namespace: str, name: str) -> None:
+        pass
+
+    def keep_admission_check_pending(self) -> bool:
+        """reference: KeepAdmissionCheckPending — batch Jobs run remotely
+        while the local check stays Pending (managedBy gate absent)."""
+        return False
+
+
+class BatchJobAdapter(MultiKueueAdapter):
+    KIND = "Job"
+
+    def sync_job(self, local_store, remote_store, wl, origin):
+        owner = next((o for o in wl.metadata.owner_references
+                      if o.controller and o.kind == "Job"), None)
+        if owner is None:
+            return
+        local_job = local_store.try_get("Job", wl.metadata.namespace, owner.name)
+        if local_job is None:
+            return
+        remote_job = remote_store.try_get("Job", wl.metadata.namespace, owner.name)
+        if remote_job is None:
+            clone = copy.deepcopy(local_job)
+            clone.metadata.resource_version = 0
+            clone.metadata.uid = ""
+            clone.metadata.labels[ORIGIN_LABEL] = origin
+            # bind the remote job to the mirrored Workload so the worker's
+            # jobframework doesn't construct a duplicate (reference:
+            # job_multikueue_adapter.go sets the prebuilt-workload label)
+            clone.metadata.labels[api.PREBUILT_WORKLOAD_LABEL] = wl.metadata.name
+            remote_store.create(clone)
+            return
+        # copy remote status back to the local job (reference:
+        # job_multikueue_adapter.go SyncJob)
+        if remote_job.status != local_job.status:
+            local_job.status = remote_job.status
+            local_store.update(local_job)
+
+    def delete_remote(self, remote_store, namespace, name):
+        try:
+            remote_store.delete("Job", namespace, name)
+        except KeyError:
+            pass
+
+
+ADAPTERS = {"Job": BatchJobAdapter()}
+
+
+class MultiKueueController:
+    def __init__(self, store: Store, recorder, clock,
+                 remote_clusters: Optional[dict] = None,
+                 origin: str = "multikueue",
+                 worker_lost_timeout: float = 15 * 60.0):
+        self.store = store
+        self.recorder = recorder
+        self.clock = clock
+        # cluster name -> remote Store (or KueueManager, resolved below)
+        self.remote_clusters = remote_clusters if remote_clusters is not None else {}
+        self.origin = origin
+        self.worker_lost_timeout = worker_lost_timeout
+        self._lost_since: dict = {}  # wl key -> first-noticed-lost time
+
+    def _remote_store(self, cluster_name: str) -> Optional[Store]:
+        remote = self.remote_clusters.get(cluster_name)
+        if remote is None:
+            return None
+        return remote.store if hasattr(remote, "store") else remote
+
+    def cluster_active(self, cluster_name: str) -> bool:
+        return self._remote_store(cluster_name) is not None \
+            and self.store.try_get("MultiKueueCluster", "", cluster_name) is not None
+
+    # -- check/config resolution ----------------------------------------
+
+    def _check_for(self, wl: api.Workload) -> Optional[str]:
+        for state in wl.status.admission_checks:
+            ac = self.store.try_get("AdmissionCheck", "", state.name)
+            if ac is not None and ac.spec.controller_name == CONTROLLER_NAME:
+                return state.name
+        return None
+
+    def _clusters_for_check(self, check_name: str) -> list:
+        ac = self.store.try_get("AdmissionCheck", "", check_name)
+        if ac is None or ac.spec.parameters is None:
+            return []
+        config = self.store.try_get("MultiKueueConfig", "", ac.spec.parameters.name)
+        if config is None:
+            return []
+        return [c for c in config.spec.clusters if self.cluster_active(c)]
+
+    # -- reconcile ------------------------------------------------------
+
+    def reconcile(self, key: str):
+        namespace, name = key.split("/", 1)
+        wl = self.store.try_get("Workload", namespace, name)
+        if wl is None:
+            self._gc_remotes(namespace, name)
+            return None
+        check_name = self._check_for(wl)
+        if check_name is None:
+            return None
+        now = self.clock.now()
+        state = wlpkg.find_admission_check(wl, check_name)
+
+        if wlpkg.is_finished(wl):
+            self._gc_remotes(namespace, name)
+            return None
+        if not wlpkg.has_quota_reservation(wl):
+            self._gc_remotes(namespace, name)
+            return None
+
+        clusters = self._clusters_for_check(check_name)
+        reserving = None
+        for cluster in clusters:
+            remote = self._remote_store(cluster)
+            remote_wl = remote.try_get("Workload", namespace, name)
+            if remote_wl is not None and wlpkg.has_quota_reservation(remote_wl):
+                reserving = cluster
+                break
+
+        if reserving is None and state is not None \
+                and state.state == api.CHECK_STATE_READY:
+            # the reserving worker vanished (reference: wlReconciler
+            # workerLostTimeout, workload.go:380-420)
+            first = self._lost_since.setdefault(wlpkg.key(wl), now)
+            remaining = self.worker_lost_timeout - (now - first)
+            if remaining > 0:
+                return float(remaining)
+            self._lost_since.pop(wlpkg.key(wl), None)
+            wlpkg.set_admission_check_state(
+                wl.status.admission_checks,
+                api.AdmissionCheckState(
+                    name=check_name, state=api.CHECK_STATE_RETRY,
+                    message="Reserving remote lost"), now)
+            self.store.update(wl)
+            return None
+        self._lost_since.pop(wlpkg.key(wl), None)
+
+        if reserving is not None:
+            # first reservation wins: drop the other mirrors and their jobs
+            adapter = self._adapter_for(wl)
+            owner = next((o for o in wl.metadata.owner_references
+                          if o.controller), None)
+            for cluster in clusters:
+                if cluster != reserving:
+                    self._delete_mirror(cluster, namespace, name)
+                    if adapter is not None and owner is not None:
+                        adapter.delete_remote(self._remote_store(cluster),
+                                              namespace, owner.name)
+            remote = self._remote_store(reserving)
+            remote_wl = remote.try_get("Workload", namespace, name)
+            # copy the remote Finished condition back
+            if remote_wl is not None and wlpkg.is_finished(remote_wl):
+                fin = find_condition(remote_wl.status.conditions,
+                                     api.WORKLOAD_FINISHED)
+                set_condition(wl.status.conditions, copy.deepcopy(fin), now)
+                self.store.update(wl)
+                return None
+            if adapter is not None:
+                adapter.sync_job(self.store, remote, wl, self.origin)
+            if state is not None and state.state != api.CHECK_STATE_READY:
+                wlpkg.set_admission_check_state(
+                    wl.status.admission_checks,
+                    api.AdmissionCheckState(
+                        name=check_name, state=api.CHECK_STATE_READY,
+                        message=f'The workload got reservation on "{reserving}"'),
+                    now)
+                self.store.update(wl)
+            return None
+
+        # no remote reservation yet: mirror to every cluster
+        for cluster in clusters:
+            remote = self._remote_store(cluster)
+            if remote.try_get("Workload", namespace, name) is None:
+                from kueue_tpu.sim import AlreadyExists
+                clone = self._clone_for_remote(wl)
+                try:
+                    remote.create(clone)
+                except AlreadyExists:
+                    pass
+            adapter = self._adapter_for(wl)
+            if adapter is not None:
+                adapter.sync_job(self.store, remote, wl, self.origin)
+        return None
+
+    def _adapter_for(self, wl: api.Workload) -> Optional[MultiKueueAdapter]:
+        owner = next((o for o in wl.metadata.owner_references if o.controller), None)
+        if owner is None:
+            return None
+        return ADAPTERS.get(owner.kind)
+
+    def _clone_for_remote(self, wl: api.Workload) -> api.Workload:
+        clone = copy.deepcopy(wl)
+        clone.metadata.resource_version = 0
+        clone.metadata.uid = ""
+        clone.metadata.labels[ORIGIN_LABEL] = self.origin
+        clone.metadata.owner_references = []
+        clone.metadata.finalizers = []
+        clone.status = api.WorkloadStatus()
+        return clone
+
+    def _delete_mirror(self, cluster: str, namespace: str, name: str) -> None:
+        remote = self._remote_store(cluster)
+        if remote is None:
+            return
+        remote_wl = remote.try_get("Workload", namespace, name)
+        if remote_wl is None:
+            return
+        if remote_wl.metadata.labels.get(ORIGIN_LABEL) != self.origin:
+            return  # not ours
+        if remote_wl.metadata.finalizers:
+            remote_wl.metadata.finalizers = []
+            remote.update(remote_wl)
+        try:
+            remote.delete("Workload", namespace, name)
+        except KeyError:
+            pass
+
+    def _gc_remotes(self, namespace: str, name: str) -> None:
+        """Remote orphan GC (reference: multikueuecluster.go:255-305)."""
+        for cluster in list(self.remote_clusters):
+            self._delete_mirror(cluster, namespace, name)
+
+    def gc_orphans(self) -> int:
+        """Periodic GC: remote workloads whose local original is gone
+        (reference: GC interval, config multiKueue.gcInterval)."""
+        removed = 0
+        for cluster in list(self.remote_clusters):
+            remote = self._remote_store(cluster)
+            if remote is None:
+                continue
+            for remote_wl in remote.list(
+                    "Workload",
+                    where=lambda w: w.metadata.labels.get(ORIGIN_LABEL) == self.origin):
+                local = self.store.try_get(
+                    "Workload", remote_wl.metadata.namespace, remote_wl.metadata.name)
+                if local is None:
+                    self._delete_mirror(cluster, remote_wl.metadata.namespace,
+                                        remote_wl.metadata.name)
+                    removed += 1
+        return removed
+
+
+def setup_multikueue_controller(runtime, store: Store, recorder,
+                                remote_clusters: Optional[dict] = None,
+                                **kwargs) -> MultiKueueController:
+    controller = MultiKueueController(store, recorder, runtime.clock,
+                                      remote_clusters=remote_clusters, **kwargs)
+    ctrl = runtime.controller("multikueue", controller.reconcile)
+
+    def on_workload(event, wl, old):
+        ctrl.enqueue(wlpkg.key(wl))
+
+    store.watch("Workload", on_workload)
+
+    # remote workload/job transitions re-trigger the local reconcile
+    # (reference: watch fan-in channels, multikueuecluster.go:187-253)
+    def watch_remote(cluster_name: str) -> None:
+        remote = controller._remote_store(cluster_name)
+        if remote is None:
+            return
+        def on_remote(event, obj, old):
+            ctrl.enqueue(f"{obj.metadata.namespace}/{obj.metadata.name}")
+        remote.watch("Workload", on_remote)
+
+    controller.watch_remote = watch_remote
+    for cluster_name in (remote_clusters or {}):
+        watch_remote(cluster_name)
+    return controller

@@ -393,10 +393,16 @@ class JobReconciler:
         else:
             wl = self._construct_workload(job, obj)
         self._prepare_workload(job, wl)
+        from kueue_tpu.sim import AlreadyExists, Invalid
         try:
             self.store.create(wl)
-        except ValueError:
-            return True  # AlreadyExists -> immediate retry
+        except AlreadyExists:
+            return True  # lost a race -> immediate retry
+        except Invalid as exc:
+            # webhook rejection: retrying won't change the outcome
+            # (reference: unretryable error handling, reconciler.go:384-395)
+            self.recorder.event(obj, "Warning", "FailedCreateWorkload", str(exc))
+            return None
         self.recorder.event(obj, "Normal", "CreatedWorkload",
                             f"Created Workload: {wlpkg.key(wl)}")
         return None

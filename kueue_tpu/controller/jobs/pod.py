@@ -98,6 +98,7 @@ class PodJob(ComposableJob):
         self.is_group = False
         self.namespace = ""
         self.group = ""
+        self.name = ""  # single-pod name, kept even when the pod is gone
 
     # -- load (reference: Load :624-668) --------------------------------
 
@@ -115,6 +116,7 @@ class PodJob(ComposableJob):
             self.pod = self.pods[0]
             return False, True
         self.namespace = namespace
+        self.name = name
         pod = store.try_get("Pod", namespace, name)
         if pod is None:
             return True, False
@@ -269,8 +271,7 @@ class PodJob(ComposableJob):
         return wl
 
     def list_child_workloads(self, store) -> list:
-        name = self.group if self.is_group else (
-            self.pod.metadata.name if self.pod else "")
+        name = self.group if self.is_group else self.name
         return store.list(
             "Workload", namespace=self.namespace,
             where=lambda wl: any(o.controller and o.kind == "Pod" and o.name == name

@@ -66,7 +66,8 @@ class StoreSchedulerClient(SchedulerClient):
 class KueueManager:
     def __init__(self, cfg: Optional[cfgpkg.Configuration] = None,
                  clock: Clock = REAL_CLOCK, solver=None,
-                 registered_check_controllers: Optional[set] = None):
+                 registered_check_controllers: Optional[set] = None,
+                 remote_clusters: Optional[dict] = None):
         self.cfg = cfgpkg.set_defaults(cfg or cfgpkg.Configuration())
         self.clock = clock
         self.store = Store(clock)
@@ -86,10 +87,25 @@ class KueueManager:
             pods_ready_tracking=bool(w and w.enable and w.block_admission),
             excluded_resource_prefixes=self.cfg.resources.exclude_resource_prefixes)
 
+        # built-in admission-check controllers are always registered
+        # (reference: cmd/kueue/main.go:240-263)
+        from kueue_tpu.controller.admissionchecks import multikueue as mkpkg
+        from kueue_tpu.controller.admissionchecks import provisioning as provpkg
+        check_controllers = set(registered_check_controllers or set())
+        check_controllers |= {provpkg.CONTROLLER_NAME, mkpkg.CONTROLLER_NAME}
+
         self.controllers = setup_core_controllers(
             self.runtime, self.store, self.queues, self.cache, self.recorder,
             cfg=self.cfg, metrics=self.metrics,
-            registered_check_controllers=registered_check_controllers)
+            registered_check_controllers=check_controllers)
+
+        self.provisioning = provpkg.setup_provisioning_controller(
+            self.runtime, self.store, self.recorder)
+        self.multikueue = mkpkg.setup_multikueue_controller(
+            self.runtime, self.store, self.recorder,
+            remote_clusters=remote_clusters,
+            origin=self.cfg.multi_kueue.origin,
+            worker_lost_timeout=self.cfg.multi_kueue.worker_lost_timeout_seconds)
 
         # job integrations (reference: jobframework.SetupControllers via
         # cmd/kueue/main.go:229-290). Registration is idempotent across
@@ -101,6 +117,11 @@ class KueueManager:
             jobs_registry.register_all()
         self.integrations = setup_integrations(
             self.runtime, self.store, self.recorder, self.cfg)
+
+        # admission webhooks on the sim store (reference:
+        # webhooks.Setup, cmd/kueue/main.go:265-268)
+        from kueue_tpu.webhooks import setup_webhooks
+        setup_webhooks(self.store, self.cfg)
 
         self.scheduler_client = StoreSchedulerClient(self.store, self.recorder)
         self.scheduler = Scheduler(

@@ -10,6 +10,7 @@ resource-version bumping, plus a deterministic controller runtime
 """
 
 from kueue_tpu.sim.store import (
+    Invalid,
     ADDED,
     DELETED,
     MODIFIED,
@@ -24,7 +25,7 @@ from kueue_tpu.sim.runtime import Controller, EventRecorder, Runtime
 
 __all__ = [
     "ADDED", "MODIFIED", "DELETED",
-    "Store", "NotFound", "AlreadyExists", "Conflict",
+    "Store", "NotFound", "AlreadyExists", "Conflict", "Invalid",
     "kind_of", "obj_key",
     "Controller", "Runtime", "EventRecorder",
 ]

@@ -38,6 +38,10 @@ class Conflict(ValueError):
     pass
 
 
+class Invalid(ValueError):
+    """Admission-webhook rejection (the apiserver's 422)."""
+
+
 def kind_of(obj) -> str:
     return type(obj).__name__
 
@@ -55,7 +59,21 @@ class Store:
         self._lock = threading.RLock()
         self._objects: dict[str, dict[str, object]] = {}
         self._watchers: dict[str, list[Callable]] = {}
+        self._admission_hooks: dict[str, list[Callable]] = {}
         self._rv = 0
+
+    # -- admission webhooks -------------------------------------------------
+
+    def add_admission_hook(self, kind: str,
+                           hook: Callable[[str, object, Optional[object]], None]) -> None:
+        """hook(op, obj, old) runs before a create ("CREATE") or update
+        ("UPDATE") is persisted — the webhook role. It may mutate obj
+        (defaulting) or raise Invalid (validation)."""
+        self._admission_hooks.setdefault(kind, []).append(hook)
+
+    def _admit(self, op: str, obj, old) -> None:
+        for hook in self._admission_hooks.get(kind_of(obj), []):
+            hook(op, obj, old)
 
     # -- watch registration ------------------------------------------------
 
@@ -77,6 +95,7 @@ class Store:
             if key in bucket:
                 raise AlreadyExists(f"{kind} {key} already exists")
             stored = copy.deepcopy(obj)
+            self._admit("CREATE", stored, None)
             if not stored.metadata.uid:
                 stored.metadata.uid = new_uid(kind.lower())
             if stored.metadata.creation_timestamp is None:
@@ -117,6 +136,8 @@ class Store:
                 raise Conflict(
                     f"{kind} {key}: resourceVersion {expect_rv} != {old.metadata.resource_version}")
             stored = copy.deepcopy(obj)
+            if self._admission_hooks.get(kind):
+                self._admit("UPDATE", stored, copy.deepcopy(old))
             stored.metadata.uid = old.metadata.uid
             stored.metadata.creation_timestamp = old.metadata.creation_timestamp
             # deletionTimestamp is apiserver-owned: preserve it across writes

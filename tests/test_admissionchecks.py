@@ -1,0 +1,252 @@
+"""AdmissionCheck controller tests: provisioning + MultiKueue.
+
+Plays the role of the reference's
+test/integration/controller/admissionchecks and
+test/integration/multikueue suites (two envtest instances in one
+process -> two KueueManagers in one process, SURVEY.md §4).
+"""
+
+import pytest
+
+from kueue_tpu import config as cfgpkg
+from kueue_tpu.api import autoscaling as asapi
+from kueue_tpu.api import batchv1, kueue as api
+from kueue_tpu.api.corev1 import Container, PodSpec, PodTemplateSpec
+from kueue_tpu.api.meta import Condition, FakeClock, ObjectMeta, find_condition, set_condition
+from kueue_tpu.controller.admissionchecks.multikueue import (
+    CONTROLLER_NAME as MK_CONTROLLER,
+    ORIGIN_LABEL,
+)
+from kueue_tpu.controller.admissionchecks.provisioning import (
+    CONTROLLER_NAME as PROV_CONTROLLER,
+    CONSUME_ANNOTATION,
+)
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.manager import KueueManager
+
+from tests.wrappers import (
+    ClusterQueueWrapper,
+    WorkloadWrapper,
+    flavor_quotas,
+    make_flavor,
+    make_local_queue,
+)
+
+
+@pytest.fixture
+def clock():
+    return FakeClock(1000.0)
+
+
+def setup_cluster(mgr, check_names=()):
+    mgr.store.create(make_flavor("default"))
+    cq = ClusterQueueWrapper("cq").resource_group(flavor_quotas("default", cpu=8))
+    if check_names:
+        cq = cq.admission_checks(*check_names)
+    mgr.store.create(cq.obj())
+    mgr.store.create(make_local_queue("lq", "default", "cq"))
+    mgr.run_until_idle()
+
+
+class TestProvisioning:
+    def make_mgr(self, clock):
+        mgr = KueueManager(clock=clock)
+        mgr.store.create(asapi.ProvisioningRequestConfig(
+            metadata=ObjectMeta(name="prov-config"),
+            spec=asapi.ProvisioningRequestConfigSpec(
+                provisioning_class_name="queued-provisioning.gke.io")))
+        ac = api.AdmissionCheck(metadata=ObjectMeta(name="prov-check"))
+        ac.spec.controller_name = PROV_CONTROLLER
+        ac.spec.parameters = api.AdmissionCheckParametersReference(
+            kind="ProvisioningRequestConfig", name="prov-config")
+        mgr.store.create(ac)
+        setup_cluster(mgr, ["prov-check"])
+        return mgr
+
+    def submit(self, mgr):
+        mgr.store.create(
+            WorkloadWrapper("w").queue("lq").request("cpu", "2").obj())
+        mgr.schedule_until_settled()
+        return mgr.store.get("Workload", "default", "w")
+
+    def test_request_created_after_quota_reservation(self, clock):
+        mgr = self.make_mgr(clock)
+        wl = self.submit(mgr)
+        assert wlpkg.has_quota_reservation(wl)
+        assert not wlpkg.is_admitted(wl)  # gated on the check
+        pr = mgr.store.get("ProvisioningRequest", "default", "w-prov-check")
+        assert pr.spec.provisioning_class_name == "queued-provisioning.gke.io"
+        assert pr.spec.pod_sets[0].count == 1
+        # pod template object exists
+        assert mgr.store.get("PodTemplate", "default",
+                             "ppt-w-prov-check-main") is not None
+
+    def test_provisioned_flips_check_ready_with_podset_updates(self, clock):
+        mgr = self.make_mgr(clock)
+        self.submit(mgr)
+        pr = mgr.store.get("ProvisioningRequest", "default", "w-prov-check")
+        set_condition(pr.status.conditions, Condition(
+            type=asapi.PROVISIONED, status="True", reason="Provisioned"),
+            clock.now())
+        mgr.store.update(pr)
+        mgr.schedule_until_settled()
+        wl = mgr.store.get("Workload", "default", "w")
+        state = wlpkg.find_admission_check(wl, "prov-check")
+        assert state.state == api.CHECK_STATE_READY
+        assert state.pod_set_updates[0].annotations[CONSUME_ANNOTATION] == \
+            "w-prov-check"
+        assert wlpkg.is_admitted(wl)
+
+    def test_failed_retries_with_backoff_then_rejects(self, clock):
+        mgr = self.make_mgr(clock)
+        self.submit(mgr)
+
+        def fail_current(name):
+            pr = mgr.store.get("ProvisioningRequest", "default", name)
+            set_condition(pr.status.conditions, Condition(
+                type=asapi.FAILED, status="True", reason="NotEnoughCapacity",
+                message="no capacity"), clock.now())
+            mgr.store.update(pr)
+            mgr.run_until_idle()
+
+        fail_current("w-prov-check")
+        # in backoff: no second attempt yet
+        assert mgr.store.try_get("ProvisioningRequest", "default",
+                                 "w-prov-check-attempt2") is None
+        mgr.advance(61.0)  # backoff 60s for attempt 1
+        assert mgr.store.try_get("ProvisioningRequest", "default",
+                                 "w-prov-check-attempt2") is not None
+        fail_current("w-prov-check-attempt2")
+        mgr.advance(121.0)
+        fail_current("w-prov-check-attempt3")
+        mgr.advance(241.0)
+        # 3 retries exhausted after the 4th attempt fails -> Rejected ->
+        # workload deactivated by the check-based eviction
+        fail_current("w-prov-check-attempt4")
+        mgr.run_until_idle()
+        wl = mgr.store.get("Workload", "default", "w")
+        assert not wl.spec.active
+
+
+class TestMultiKueue:
+    def make_clusters(self, clock):
+        worker1 = KueueManager(clock=clock)
+        worker2 = KueueManager(clock=clock)
+        setup_cluster(worker1)
+        setup_cluster(worker2)
+        manager = KueueManager(clock=clock, remote_clusters={
+            "worker1": worker1, "worker2": worker2})
+        for name in ("worker1", "worker2"):
+            manager.store.create(asapi.MultiKueueCluster(
+                metadata=ObjectMeta(name=name)))
+        manager.store.create(asapi.MultiKueueConfig(
+            metadata=ObjectMeta(name="mk-config"),
+            spec=asapi.MultiKueueConfigSpec(clusters=["worker1", "worker2"])))
+        ac = api.AdmissionCheck(metadata=ObjectMeta(name="mk-check"))
+        ac.spec.controller_name = MK_CONTROLLER
+        ac.spec.parameters = api.AdmissionCheckParametersReference(
+            kind="MultiKueueConfig", name="mk-config")
+        manager.store.create(ac)
+        setup_cluster(manager, ["mk-check"])
+        return manager, worker1, worker2
+
+    def run_all(self, manager, worker1, worker2, cycles=3):
+        for _ in range(cycles):
+            manager.schedule_until_settled()
+            worker1.schedule_until_settled()
+            worker2.schedule_until_settled()
+            manager.run_until_idle()
+
+    def test_first_reserving_cluster_wins(self, clock):
+        manager, worker1, worker2 = self.make_clusters(clock)
+        manager.store.create(
+            WorkloadWrapper("w").queue("lq").request("cpu", "2").obj())
+        manager.schedule_until_settled()
+        # mirrored to both workers
+        assert worker1.store.try_get("Workload", "default", "w") is not None
+        assert worker2.store.try_get("Workload", "default", "w") is not None
+        mirrored = worker1.store.get("Workload", "default", "w")
+        assert mirrored.metadata.labels[ORIGIN_LABEL] == "multikueue"
+        # workers schedule; one reserves; the other mirror is deleted
+        self.run_all(manager, worker1, worker2)
+        wl = manager.store.get("Workload", "default", "w")
+        state = wlpkg.find_admission_check(wl, "mk-check")
+        assert state.state == api.CHECK_STATE_READY
+        assert "got reservation on" in state.message
+        assert wlpkg.is_admitted(wl)
+        remaining = [w for w in (worker1, worker2)
+                     if w.store.try_get("Workload", "default", "w") is not None]
+        assert len(remaining) == 1
+
+    def test_remote_finish_copied_back(self, clock):
+        manager, worker1, worker2 = self.make_clusters(clock)
+        manager.store.create(
+            WorkloadWrapper("w").queue("lq").request("cpu", "2").obj())
+        manager.schedule_until_settled()
+        self.run_all(manager, worker1, worker2)
+        winner = next(w for w in (worker1, worker2)
+                      if w.store.try_get("Workload", "default", "w") is not None)
+        remote_wl = winner.store.get("Workload", "default", "w")
+        set_condition(remote_wl.status.conditions, Condition(
+            type=api.WORKLOAD_FINISHED, status="True", reason="Succeeded",
+            message="remote done"), clock.now())
+        winner.store.update(remote_wl)
+        manager.run_until_idle()
+        wl = manager.store.get("Workload", "default", "w")
+        assert wlpkg.is_finished(wl)
+        fin = find_condition(wl.status.conditions, api.WORKLOAD_FINISHED)
+        assert fin.message == "remote done"
+
+    def test_worker_lost_triggers_retry_after_timeout(self, clock):
+        manager, worker1, worker2 = self.make_clusters(clock)
+        manager.store.create(
+            WorkloadWrapper("w").queue("lq").request("cpu", "2").obj())
+        manager.schedule_until_settled()
+        self.run_all(manager, worker1, worker2)
+        winner = next(w for w in (worker1, worker2)
+                      if w.store.try_get("Workload", "default", "w") is not None)
+        # the worker loses the workload entirely
+        wl = winner.store.get("Workload", "default", "w")
+        wl.metadata.finalizers = []
+        winner.store.update(wl)
+        winner.store.delete("Workload", "default", "w")
+        manager.run_until_idle()
+        # before the timeout the check stays Ready
+        state = wlpkg.find_admission_check(
+            manager.store.get("Workload", "default", "w"), "mk-check")
+        assert state.state == api.CHECK_STATE_READY
+        manager.advance(15 * 60.0 + 1)
+        state = wlpkg.find_admission_check(
+            manager.store.get("Workload", "default", "w"), "mk-check")
+        assert state.state == api.CHECK_STATE_RETRY
+
+    def test_batch_job_synced_to_remote(self, clock):
+        manager, worker1, worker2 = self.make_clusters(clock)
+        job = batchv1.Job(metadata=ObjectMeta(
+            name="train", namespace="default",
+            labels={api.QUEUE_LABEL: "lq"}))
+        job.spec.parallelism = 1
+        job.spec.template = PodTemplateSpec(spec=PodSpec(
+            containers=[Container(requests={"cpu": 1000})]))
+        manager.store.create(job)
+        manager.schedule_until_settled()
+        self.run_all(manager, worker1, worker2)
+        winner = next(w for w in (worker1, worker2)
+                      if w.store.try_get("Workload", "default",
+                                         manager.store.list("Workload")[0].metadata.name))
+        remote_job = winner.store.try_get("Job", "default", "train")
+        assert remote_job is not None
+        assert remote_job.metadata.labels[ORIGIN_LABEL] == "multikueue"
+
+    def test_gc_orphans(self, clock):
+        manager, worker1, worker2 = self.make_clusters(clock)
+        manager.store.create(
+            WorkloadWrapper("w").queue("lq").request("cpu", "2").obj())
+        manager.schedule_until_settled()
+        # delete the local workload; remote mirrors are orphaned
+        manager.store.delete("Workload", "default", "w")
+        manager.run_until_idle()
+        removed = manager.multikueue.gc_orphans()
+        assert removed >= 0
+        assert worker1.store.try_get("Workload", "default", "w") is None
+        assert worker2.store.try_get("Workload", "default", "w") is None

@@ -210,8 +210,7 @@ class TestJobSet:
         assert [(ps.name, ps.count) for ps in wl.spec.pod_sets] == [
             ("leader", 1), ("workers", 2)]
         assert wlpkg.is_admitted(wl)
-        js = mgr.store.get("JobSet", "", "js") if False else \
-            mgr.store.get("JobSet", "default", "js")
+        js = mgr.store.get("JobSet", "default", "js")
         assert not js.spec.suspend
         for rj in js.spec.replicated_jobs:
             assert rj.template.template.spec.node_selector == {"zone": "a"}

@@ -1,0 +1,187 @@
+"""Webhook defaulting/validation tests (reference: pkg/webhooks/*_test.go
+and per-job webhook suites, SURVEY.md §2.5/L6)."""
+
+import pytest
+
+from kueue_tpu.api import batchv1, corev1, kueue as api
+from kueue_tpu.api.corev1 import Container, PodSpec, PodTemplateSpec
+from kueue_tpu.api.meta import FakeClock, ObjectMeta
+from kueue_tpu.manager import KueueManager
+from kueue_tpu.sim import Invalid
+from kueue_tpu import webhooks
+
+from tests.wrappers import (
+    ClusterQueueWrapper,
+    WorkloadWrapper,
+    flavor_quotas,
+    make_flavor,
+    make_local_queue,
+)
+
+
+@pytest.fixture
+def mgr():
+    return KueueManager(clock=FakeClock(1000.0))
+
+
+def cq_with_quota(name="cq", cohort="", **kwargs):
+    cq = ClusterQueueWrapper(name).resource_group(
+        flavor_quotas("default", cpu=4)).obj()
+    cq.spec.cohort = cohort
+    return cq
+
+
+class TestClusterQueueValidation:
+    def test_valid_cq_accepted(self, mgr):
+        mgr.store.create(cq_with_quota())
+
+    def test_borrowing_limit_requires_cohort(self, mgr):
+        cq = ClusterQueueWrapper("cq").resource_group(
+            flavor_quotas("default", cpu=(4, 2))).obj()
+        with pytest.raises(Invalid, match="borrowingLimit.*cohort"):
+            mgr.store.create(cq)
+
+    def test_lending_limit_above_nominal_rejected(self, mgr):
+        cq = ClusterQueueWrapper("cq").cohort("team").resource_group(
+            flavor_quotas("default", cpu=(4, None, 8))).obj()
+        with pytest.raises(Invalid, match="lendingLimit"):
+            mgr.store.create(cq)
+
+    def test_duplicate_flavor_across_groups_rejected(self, mgr):
+        cq = (ClusterQueueWrapper("cq")
+              .resource_group(flavor_quotas("default", cpu=4))
+              .resource_group(flavor_quotas("default", memory="1Gi")).obj())
+        with pytest.raises(Invalid, match="already used"):
+            mgr.store.create(cq)
+
+    def test_checks_xor_strategy(self, mgr):
+        cq = cq_with_quota()
+        cq.spec.admission_checks = ["a"]
+        cq.spec.admission_checks_strategy = [
+            api.AdmissionCheckStrategyRule(name="b")]
+        with pytest.raises(Invalid, match="either admissionChecks or"):
+            mgr.store.create(cq)
+
+    def test_reclaim_never_with_borrow_within_cohort(self, mgr):
+        cq = cq_with_quota(cohort="team")
+        cq.spec.preemption = api.ClusterQueuePreemption(
+            reclaim_within_cohort=api.PREEMPTION_NEVER,
+            borrow_within_cohort=api.BorrowWithinCohort(
+                policy=api.BORROW_WITHIN_COHORT_LOWER_PRIORITY))
+        with pytest.raises(Invalid, match="reclaimWithinCohort=Never"):
+            mgr.store.create(cq)
+
+    def test_flavor_resources_must_match_covered(self, mgr):
+        cq = api.ClusterQueue(metadata=ObjectMeta(name="cq"))
+        cq.spec.namespace_selector = api.LabelSelector()
+        cq.spec.resource_groups = [api.ResourceGroup(
+            covered_resources=["cpu", "memory"],
+            flavors=[api.FlavorQuotas(name="f", resources=[
+                api.ResourceQuota(name="cpu", nominal_quota=1)])])]
+        with pytest.raises(Invalid, match="must match coveredResources"):
+            mgr.store.create(cq)
+
+
+class TestWorkloadValidation:
+    def test_single_podset_defaulted_to_main(self, mgr):
+        wl = api.Workload(metadata=ObjectMeta(name="w", namespace="default"))
+        wl.spec.queue_name = "lq"
+        wl.spec.pod_sets = [api.PodSet(name="", count=1)]
+        created = mgr.store.create(wl)
+        assert created.spec.pod_sets[0].name == "main"
+
+    def test_multiple_min_count_rejected(self, mgr):
+        wl = WorkloadWrapper("w").queue("lq") \
+            .pod_set(name="a", count=2, min_count=1) \
+            .pod_set(name="b", count=2, min_count=1).obj()
+        with pytest.raises(Invalid, match="at most one podSet"):
+            mgr.store.create(wl)
+
+    def test_pods_resource_reserved(self, mgr):
+        wl = WorkloadWrapper("w").queue("lq").request("pods", 1).obj()
+        with pytest.raises(Invalid, match="reserved"):
+            mgr.store.create(wl)
+
+    def test_podsets_immutable_after_reservation(self, mgr):
+        mgr.store.create(make_flavor("default"))
+        mgr.store.create(cq_with_quota())
+        mgr.store.create(make_local_queue("lq", "default", "cq"))
+        mgr.store.create(WorkloadWrapper("w").queue("lq").request("cpu", "1").obj())
+        mgr.schedule_until_settled()
+        got = mgr.store.get("Workload", "default", "w")
+        got.spec.pod_sets[0].count = 5
+        with pytest.raises(Invalid, match="immutable"):
+            mgr.store.update(got)
+
+    def test_admission_fields_immutable(self, mgr):
+        mgr.store.create(make_flavor("default"))
+        mgr.store.create(cq_with_quota())
+        mgr.store.create(make_local_queue("lq", "default", "cq"))
+        mgr.store.create(WorkloadWrapper("w").queue("lq").request("cpu", "1").obj())
+        mgr.schedule_until_settled()
+        got = mgr.store.get("Workload", "default", "w")
+        got.status.admission.cluster_queue = "other"
+        with pytest.raises(Invalid, match="admission"):
+            mgr.store.update(got)
+
+    def test_reclaimable_cannot_decrease(self, mgr):
+        mgr.store.create(make_flavor("default"))
+        mgr.store.create(cq_with_quota())
+        mgr.store.create(make_local_queue("lq", "default", "cq"))
+        mgr.store.create(
+            WorkloadWrapper("w").queue("lq").pod_set(count=3)
+            .request("cpu", "1").obj())
+        mgr.schedule_until_settled()
+        got = mgr.store.get("Workload", "default", "w")
+        got.status.reclaimable_pods = [api.ReclaimablePod(name="main", count=2)]
+        mgr.store.update(got)
+        got = mgr.store.get("Workload", "default", "w")
+        got.status.reclaimable_pods = [api.ReclaimablePod(name="main", count=1)]
+        with pytest.raises(Invalid, match="cannot be less"):
+            mgr.store.update(got)
+
+
+class TestJobAndPodWebhooks:
+    def test_queued_job_created_suspended(self, mgr):
+        job = batchv1.Job(metadata=ObjectMeta(
+            name="j", namespace="default", labels={api.QUEUE_LABEL: "lq"}))
+        job.spec.suspend = False  # user forgot; webhook enforces
+        job.spec.template = PodTemplateSpec(spec=PodSpec(
+            containers=[Container(requests={"cpu": 1000})]))
+        created = mgr.store.create(job)
+        assert created.spec.suspend
+
+    def test_queue_change_rejected_while_running(self, mgr):
+        job = batchv1.Job(metadata=ObjectMeta(name="j", namespace="default"))
+        job.spec.suspend = False
+        mgr.store.create(job)
+        got = mgr.store.get("Job", "default", "j")
+        got.metadata.labels[api.QUEUE_LABEL] = "lq2"
+        with pytest.raises(Invalid, match="must not be changed"):
+            mgr.store.update(got)
+
+    def test_pod_gets_gated_on_create(self, mgr):
+        pod = corev1.Pod(metadata=ObjectMeta(
+            name="p", namespace="default", labels={api.QUEUE_LABEL: "lq"}))
+        created = mgr.store.create(pod)
+        assert api.ADMISSION_GATE in created.spec.scheduling_gates
+        assert created.metadata.labels[api.MANAGED_LABEL] == "true"
+
+    def test_pod_in_excluded_namespace_not_gated(self, mgr):
+        pod = corev1.Pod(metadata=ObjectMeta(
+            name="p", namespace="kube-system", labels={api.QUEUE_LABEL: "lq"}))
+        created = mgr.store.create(pod)
+        assert created.spec.scheduling_gates == []
+
+    def test_local_queue_cq_immutable(self, mgr):
+        mgr.store.create(make_local_queue("lq", "default", "cq"))
+        got = mgr.store.get("LocalQueue", "default", "lq")
+        got.spec.cluster_queue = "other"
+        with pytest.raises(Invalid, match="immutable"):
+            mgr.store.update(got)
+
+    def test_resource_flavor_bad_taint_rejected(self, mgr):
+        from kueue_tpu.api.corev1 import Taint
+        rf = make_flavor("f", taints=[Taint(key="", effect="Bogus")])
+        with pytest.raises(Invalid):
+            mgr.store.create(rf)

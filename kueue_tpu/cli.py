@@ -1,0 +1,218 @@
+"""kueuectl — the operator CLI.
+
+Equivalent of the reference's cmd/kueuectl (app/cmd.go:79-90):
+create {clusterqueue,localqueue,resourceflavor}, list {clusterqueue,
+localqueue,workload,resourceflavor}, stop/resume {workload,clusterqueue,
+localqueue} (via spec.active / stopPolicy), version. The command core is
+the `Kueuectl` class over a manager's store (tests drive it directly);
+`main()` wraps it in argparse against a demo manager.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from kueue_tpu import version as versionpkg
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import ObjectMeta
+from kueue_tpu.core import workload as wlpkg
+
+
+class Kueuectl:
+    def __init__(self, manager, out=None):
+        self.manager = manager
+        self.store = manager.store
+        self.out = out or sys.stdout
+
+    def _print(self, *cols):
+        print("\t".join(str(c) for c in cols), file=self.out)
+
+    # -- create (reference: app/create/) --------------------------------
+
+    def create_cluster_queue(self, name: str, cohort: str = "",
+                             queueing_strategy: str = api.BEST_EFFORT_FIFO,
+                             nominal_quota: Optional[dict] = None,
+                             flavor: str = "default") -> api.ClusterQueue:
+        cq = api.ClusterQueue(metadata=ObjectMeta(name=name))
+        cq.spec.cohort = cohort
+        cq.spec.queueing_strategy = queueing_strategy
+        cq.spec.namespace_selector = api.LabelSelector()
+        if nominal_quota:
+            cq.spec.resource_groups = [api.ResourceGroup(
+                covered_resources=list(nominal_quota),
+                flavors=[api.FlavorQuotas(name=flavor, resources=[
+                    api.ResourceQuota(name=res, nominal_quota=qty)
+                    for res, qty in nominal_quota.items()])])]
+        return self.store.create(cq)
+
+    def create_local_queue(self, name: str, namespace: str,
+                           cluster_queue: str) -> api.LocalQueue:
+        lq = api.LocalQueue(metadata=ObjectMeta(name=name, namespace=namespace))
+        lq.spec.cluster_queue = cluster_queue
+        return self.store.create(lq)
+
+    def create_resource_flavor(self, name: str,
+                               node_labels: Optional[dict] = None) -> api.ResourceFlavor:
+        rf = api.ResourceFlavor(metadata=ObjectMeta(name=name))
+        if node_labels:
+            rf.spec.node_labels = dict(node_labels)
+        return self.store.create(rf)
+
+    # -- list (reference: app/list/) ------------------------------------
+
+    def list_cluster_queues(self) -> list:
+        out = self.store.list("ClusterQueue")
+        self._print("NAME", "COHORT", "STRATEGY", "PENDING", "ADMITTED", "ACTIVE")
+        for cq in sorted(out, key=lambda c: c.metadata.name):
+            from kueue_tpu.api.meta import is_condition_true
+            self._print(cq.metadata.name, cq.spec.cohort,
+                        cq.spec.queueing_strategy,
+                        cq.status.pending_workloads,
+                        cq.status.admitted_workloads,
+                        is_condition_true(cq.status.conditions,
+                                          api.CLUSTER_QUEUE_ACTIVE))
+        return out
+
+    def list_local_queues(self, namespace: Optional[str] = None) -> list:
+        out = self.store.list("LocalQueue", namespace=namespace)
+        self._print("NAMESPACE", "NAME", "CLUSTERQUEUE", "PENDING", "ADMITTED")
+        for lq in sorted(out, key=lambda q: (q.metadata.namespace, q.metadata.name)):
+            self._print(lq.metadata.namespace, lq.metadata.name,
+                        lq.spec.cluster_queue, lq.status.pending_workloads,
+                        lq.status.admitted_workloads)
+        return out
+
+    def list_workloads(self, namespace: Optional[str] = None) -> list:
+        out = self.store.list("Workload", namespace=namespace)
+        self._print("NAMESPACE", "NAME", "QUEUE", "STATUS", "PRIORITY")
+        for wl in sorted(out, key=lambda w: (w.metadata.namespace, w.metadata.name)):
+            self._print(wl.metadata.namespace, wl.metadata.name,
+                        wl.spec.queue_name, wlpkg.status(wl),
+                        wl.spec.priority if wl.spec.priority is not None else 0)
+        return out
+
+    def list_resource_flavors(self) -> list:
+        out = self.store.list("ResourceFlavor")
+        self._print("NAME", "NODELABELS")
+        for rf in sorted(out, key=lambda r: r.metadata.name):
+            self._print(rf.metadata.name, rf.spec.node_labels)
+        return out
+
+    # -- stop / resume (reference: app/stop, app/resume) ----------------
+
+    def stop_workload(self, namespace: str, name: str) -> None:
+        wl = self.store.get("Workload", namespace, name)
+        wl.spec.active = False
+        self.store.update(wl)
+
+    def resume_workload(self, namespace: str, name: str) -> None:
+        wl = self.store.get("Workload", namespace, name)
+        wl.spec.active = True
+        self.store.update(wl)
+
+    def stop_cluster_queue(self, name: str, drain: bool = True) -> None:
+        cq = self.store.get("ClusterQueue", "", name)
+        cq.spec.stop_policy = api.HOLD_AND_DRAIN if drain else api.HOLD
+        self.store.update(cq)
+
+    def resume_cluster_queue(self, name: str) -> None:
+        cq = self.store.get("ClusterQueue", "", name)
+        cq.spec.stop_policy = api.STOP_POLICY_NONE
+        self.store.update(cq)
+
+    def stop_local_queue(self, namespace: str, name: str,
+                         drain: bool = True) -> None:
+        lq = self.store.get("LocalQueue", namespace, name)
+        lq.spec.stop_policy = api.HOLD_AND_DRAIN if drain else api.HOLD
+        self.store.update(lq)
+
+    def resume_local_queue(self, namespace: str, name: str) -> None:
+        lq = self.store.get("LocalQueue", namespace, name)
+        lq.spec.stop_policy = api.STOP_POLICY_NONE
+        self.store.update(lq)
+
+    def version(self) -> str:
+        v = f"kueuectl (kueue_tpu) {versionpkg.VERSION}"
+        self._print(v)
+        return v
+
+
+def main(argv: Optional[list] = None, manager=None) -> int:
+    parser = argparse.ArgumentParser(prog="kueuectl")
+    sub = parser.add_subparsers(dest="command", required=True)
+    for verb in ("create", "list", "stop", "resume"):
+        p = sub.add_parser(verb)
+        p.add_argument("kind", choices=["clusterqueue", "localqueue",
+                                        "workload", "resourceflavor"])
+        p.add_argument("name", nargs="?")
+        p.add_argument("-n", "--namespace", default="default")
+        p.add_argument("--cohort", default="")
+        p.add_argument("--clusterqueue", default="")
+    sub.add_parser("version")
+    args = parser.parse_args(argv)
+
+    if manager is None:
+        from kueue_tpu.manager import KueueManager
+        manager = KueueManager()
+    ctl = Kueuectl(manager)
+
+    if args.command in ("create", "stop", "resume") and not args.name:
+        print(f"error: {args.command} {args.kind} requires a name",
+              file=sys.stderr)
+        return 1
+    if (args.command == "create" and args.kind == "localqueue"
+            and not args.clusterqueue):
+        print("error: create localqueue requires --clusterqueue",
+              file=sys.stderr)
+        return 1
+
+    from kueue_tpu.sim import AlreadyExists, Invalid, NotFound
+    try:
+        return _dispatch(ctl, args)
+    except (Invalid, AlreadyExists, NotFound) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+
+
+def _dispatch(ctl: Kueuectl, args) -> int:
+    if args.command == "version":
+        ctl.version()
+        return 0
+    kind = args.kind
+    if args.command == "list":
+        {"clusterqueue": ctl.list_cluster_queues,
+         "localqueue": ctl.list_local_queues,
+         "workload": ctl.list_workloads,
+         "resourceflavor": ctl.list_resource_flavors}[kind]()
+        return 0
+    if args.command == "create":
+        if kind == "clusterqueue":
+            ctl.create_cluster_queue(args.name, cohort=args.cohort)
+        elif kind == "localqueue":
+            ctl.create_local_queue(args.name, args.namespace, args.clusterqueue)
+        elif kind == "resourceflavor":
+            ctl.create_resource_flavor(args.name)
+        return 0
+    if args.command == "stop":
+        if kind == "workload":
+            ctl.stop_workload(args.namespace, args.name)
+        elif kind == "clusterqueue":
+            ctl.stop_cluster_queue(args.name)
+        elif kind == "localqueue":
+            ctl.stop_local_queue(args.namespace, args.name)
+        return 0
+    if args.command == "resume":
+        if kind == "workload":
+            ctl.resume_workload(args.namespace, args.name)
+        elif kind == "clusterqueue":
+            ctl.resume_cluster_queue(args.name)
+        elif kind == "localqueue":
+            ctl.resume_local_queue(args.namespace, args.name)
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -135,6 +135,9 @@ class SolverConfig:
     enable: bool = False
     max_heads: int = 2048          # padded batch width per solve
     max_flavors: int = 32
+    # narrower cycles than this skip the accelerator (dispatch overhead
+    # exceeds the win); 0 forces the solver for every cycle
+    min_heads: int = 64
     device: str = ""               # "" = default jax backend
     fallback_on_error: bool = True
 
@@ -288,6 +291,7 @@ def load(raw: dict) -> Configuration:
             enable=s.get("enable", False),
             max_heads=s.get("maxHeads", 2048),
             max_flavors=s.get("maxFlavors", 32),
+            min_heads=s.get("minHeads", 64),
             device=s.get("device", ""),
             fallback_on_error=s.get("fallbackOnError", True),
         )

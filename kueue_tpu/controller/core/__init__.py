@@ -20,7 +20,7 @@ from kueue_tpu.controller.core.admissioncheck_controller import (
 from kueue_tpu.controller.core.clusterqueue_controller import ClusterQueueReconciler
 from kueue_tpu.controller.core.localqueue_controller import LocalQueueReconciler
 from kueue_tpu.controller.core.workload_controller import WorkloadReconciler
-from kueue_tpu.sim import Store
+from kueue_tpu.sim import DELETED, Store
 from kueue_tpu.sim.runtime import EventRecorder, Runtime
 
 
@@ -68,8 +68,7 @@ def setup_core_controllers(runtime: Runtime, store: Store, queues, cache,
         # deletion — status-only writes (the CQ reconciler's own) would
         # otherwise cost O(N^2) reconciles per cycle (reference:
         # workloadQueueHandler, workload_controller.go:757+).
-        from kueue_tpu.sim import DELETED as _DELETED
-        if event != _DELETED and old is not None and old.spec == cq.spec:
+        if event != DELETED and old is not None and old.spec == cq.spec:
             return
         name = cq.metadata.name
         for lq in store.list("LocalQueue", where=lambda q: q.spec.cluster_queue == name):
@@ -78,13 +77,17 @@ def setup_core_controllers(runtime: Runtime, store: Store, queues, cache,
                                  where=lambda w: w.spec.queue_name == lq.metadata.name):
                 wl_ctrl.enqueue(f"{wl.metadata.namespace}/{wl.metadata.name}")
         # flavors referenced by a deleted CQ may now be finalizable
-        if event == _DELETED:
+        if event == DELETED:
             for rg in cq.spec.resource_groups:
                 for fq in rg.flavors:
                     rf_ctrl.enqueue(fq.name)
 
     def on_local_queue(event, lq, old):
         lq_r.handle_event(event, lq, old, lq_ctrl.enqueue)
+        # status-only writes (pending counts) don't re-enqueue the
+        # queue's workloads — that would cost O(N^2) per admission cycle
+        if event != DELETED and old is not None and old.spec == lq.spec:
+            return
         if lq.spec.cluster_queue:
             cq_ctrl.enqueue(lq.spec.cluster_queue)
         for wl in store.list("Workload", namespace=lq.metadata.namespace,

@@ -1,0 +1,50 @@
+"""State dumper: the SIGUSR2 debugging hook.
+
+Equivalent of the reference's pkg/debugger/debugger.go:34-56: on demand
+(or on SIGUSR2), log the full cache usage state and every queue's
+pending dump.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+
+from kueue_tpu.core import workload as wlpkg
+
+
+class Dumper:
+    def __init__(self, cache, queues, out=None):
+        self.cache = cache
+        self.queues = queues
+        self.out = out or sys.stderr
+
+    def dump(self) -> str:
+        lines = ["=== kueue_tpu state dump ==="]
+        lines.append("-- cache (admitted/reserving usage) --")
+        for name, cqc in sorted(self.cache.hm.cluster_queues.items()):
+            usage = {f"{fr[0]}/{fr[1]}": q
+                     for fr, q in sorted(cqc.resource_node.usage.items())}
+            lines.append(f"cq {name}: cohort={cqc.cohort.name if cqc.cohort else ''} "
+                         f"reserving={cqc.reserving_workloads_count()} "
+                         f"admitted={cqc.admitted_workloads_count} usage={usage}")
+            for key in sorted(cqc.workloads):
+                lines.append(f"  workload {key}")
+        lines.append("-- queues (pending heads) --")
+        for name, cqh in sorted(self.queues.cluster_queues.items()):
+            lines.append(f"cq {name}: strategy={cqh.queueing_strategy} "
+                         f"active={cqh.pending_active()} "
+                         f"inadmissible={cqh.pending_inadmissible()}")
+            for info in cqh.snapshot_sorted():
+                lines.append(f"  pending {info.key}")
+        lines.append("-- assumed workloads --")
+        for key, cq in sorted(self.cache.assumed_workloads.items()):
+            lines.append(f"  {key} -> {cq}")
+        return "\n".join(lines)
+
+    def write(self) -> None:
+        print(self.dump(), file=self.out, flush=True)
+
+    def listen_for_signal(self, signum: int = signal.SIGUSR2) -> None:
+        """reference: debugger.go ListenForSignal."""
+        signal.signal(signum, lambda s, f: self.write())

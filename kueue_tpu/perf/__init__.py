@@ -1,0 +1,25 @@
+"""Scheduler scalability harness.
+
+Equivalent of the reference's test/performance/scheduler
+(runner/generator/recorder/checker + minimalkueue): generate cohorts,
+ClusterQueues and timed workload arrivals from a class spec, fake
+workload execution on a virtual clock, record per-class time-to-admission
+statistics, and check them against a rangespec.
+"""
+
+from kueue_tpu.perf.generator import (
+    CohortClass,
+    QueueClass,
+    WorkloadClass,
+    WorkloadSet,
+    default_generator_config,
+    generate,
+)
+from kueue_tpu.perf.runner import RunResult, Runner
+from kueue_tpu.perf.checker import RangeSpec, check
+
+__all__ = [
+    "CohortClass", "QueueClass", "WorkloadClass", "WorkloadSet",
+    "default_generator_config", "generate",
+    "Runner", "RunResult", "RangeSpec", "check",
+]

@@ -1,0 +1,58 @@
+"""Rangespec checker.
+
+Equivalent of the reference's test/performance/scheduler/checker
+(checker_test.go over default_rangespec.yaml:1-30): assert the recorded
+statistics stay inside accepted bounds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_tpu.perf.runner import RunResult
+
+
+@dataclass
+class RangeSpec:
+    max_wall_s: float = 0.0   # 0 = unchecked (hardware-dependent)
+    # workload class -> max average time-to-admission (seconds)
+    wl_class_max_avg_tta_s: dict = field(default_factory=dict)
+    # cq class -> min average usage pct
+    cq_class_min_usage_pct: dict = field(default_factory=dict)
+    min_admitted: int = 0
+
+
+def default_rangespec() -> RangeSpec:
+    """The reference's accepted bounds (default_rangespec.yaml:8-30).
+    Wall-time/CPU/RSS bounds are hardware-specific and unchecked here;
+    the queueing-dynamics bounds carry over because the virtual clock
+    reproduces the reference's arrival/runtime schedule."""
+    return RangeSpec(
+        wl_class_max_avg_tta_s={"large": 11.0, "medium": 90.0, "small": 233.0},
+        cq_class_min_usage_pct={"cq": 55.0},
+    )
+
+
+def check(result: RunResult, spec: RangeSpec) -> list:
+    violations = []
+    if spec.max_wall_s and result.wall_s > spec.max_wall_s:
+        violations.append(
+            f"wall time {result.wall_s:.1f}s exceeds {spec.max_wall_s:.1f}s")
+    if result.admitted < spec.min_admitted:
+        violations.append(
+            f"admitted {result.admitted} below minimum {spec.min_admitted}")
+    for cls, bound in spec.wl_class_max_avg_tta_s.items():
+        stats = result.class_stats.get(cls)
+        if stats is None:
+            violations.append(f"no stats recorded for workload class {cls!r}")
+            continue
+        if stats.avg > bound:
+            violations.append(
+                f"class {cls!r} avg time-to-admission {stats.avg:.1f}s "
+                f"exceeds {bound:.1f}s")
+    for cls, bound in spec.cq_class_min_usage_pct.items():
+        usage = result.cq_class_avg_usage_pct.get(cls, 0.0)
+        if usage < bound:
+            violations.append(
+                f"cq class {cls!r} avg usage {usage:.1f}% below {bound:.1f}%")
+    return violations

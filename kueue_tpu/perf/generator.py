@@ -1,0 +1,141 @@
+"""Workload/queue generator for the perf harness.
+
+Equivalent of the reference's test/performance/scheduler/generator
+driven by default_generator_config.yaml:1-28: a class spec tree
+(cohorts x queue sets x workload sets) expands into ResourceFlavor/
+ClusterQueue/LocalQueue objects plus a time-ordered arrival schedule.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import ObjectMeta
+
+RESOURCE = "cpu"  # abstract units (the reference uses 1-unit requests)
+FLAVOR = "default"
+
+
+@dataclass
+class WorkloadClass:
+    class_name: str
+    runtime_ms: int
+    priority: int
+    request: int
+
+
+@dataclass
+class WorkloadSet:
+    count: int
+    creation_interval_ms: int
+    workloads: list = field(default_factory=list)  # list[WorkloadClass]
+
+
+@dataclass
+class QueueClass:
+    class_name: str
+    count: int
+    nominal_quota: int
+    borrowing_limit: Optional[int] = None
+    reclaim_within_cohort: str = api.PREEMPTION_ANY
+    within_cluster_queue: str = api.PREEMPTION_LOWER_PRIORITY
+    workloads_sets: list = field(default_factory=list)  # list[WorkloadSet]
+
+
+@dataclass
+class CohortClass:
+    class_name: str
+    count: int
+    queues_sets: list = field(default_factory=list)  # list[QueueClass]
+
+
+def default_generator_config() -> list:
+    """The reference's default config: 5 cohorts x 6 CQs, per CQ
+    350 small + 100 medium + 50 large => 15,000 workloads / 30 CQs
+    (default_generator_config.yaml:1-28)."""
+    return [CohortClass(class_name="cohort", count=5, queues_sets=[
+        QueueClass(
+            class_name="cq", count=6, nominal_quota=20, borrowing_limit=100,
+            workloads_sets=[
+                WorkloadSet(count=350, creation_interval_ms=100, workloads=[
+                    WorkloadClass("small", runtime_ms=200, priority=50, request=1)]),
+                WorkloadSet(count=100, creation_interval_ms=500, workloads=[
+                    WorkloadClass("medium", runtime_ms=500, priority=100, request=5)]),
+                WorkloadSet(count=50, creation_interval_ms=1200, workloads=[
+                    WorkloadClass("large", runtime_ms=1000, priority=200, request=20)]),
+            ])])]
+
+
+@dataclass
+class Arrival:
+    at_s: float
+    namespace: str
+    name: str
+    queue_name: str
+    class_name: str
+    priority: int
+    request: int
+    runtime_s: float
+
+
+@dataclass
+class GeneratedLoad:
+    flavors: list = field(default_factory=list)
+    cluster_queues: list = field(default_factory=list)
+    local_queues: list = field(default_factory=list)
+    namespaces: list = field(default_factory=list)
+    arrivals: list = field(default_factory=list)  # sorted by at_s
+    cq_class: dict = field(default_factory=dict)  # cq name -> class name
+
+
+def generate(config: list, scale: float = 1.0) -> GeneratedLoad:
+    """Expand the class spec. `scale` multiplies workload counts (the
+    harness's knob for the 50k-pending scenarios)."""
+    load = GeneratedLoad()
+    rf = api.ResourceFlavor(metadata=ObjectMeta(name=FLAVOR))
+    load.flavors.append(rf)
+
+    for cohort_class in config:
+        for ci in range(cohort_class.count):
+            cohort_name = f"{cohort_class.class_name}-{ci}"
+            for queue_class in cohort_class.queues_sets:
+                for qi in range(queue_class.count):
+                    cq_name = f"{cohort_name}-{queue_class.class_name}-{qi}"
+                    namespace = cq_name
+                    cq = api.ClusterQueue(metadata=ObjectMeta(name=cq_name))
+                    cq.spec.cohort = cohort_name
+                    cq.spec.namespace_selector = api.LabelSelector()
+                    cq.spec.preemption = api.ClusterQueuePreemption(
+                        reclaim_within_cohort=queue_class.reclaim_within_cohort,
+                        within_cluster_queue=queue_class.within_cluster_queue)
+                    cq.spec.resource_groups = [api.ResourceGroup(
+                        covered_resources=[RESOURCE],
+                        flavors=[api.FlavorQuotas(name=FLAVOR, resources=[
+                            api.ResourceQuota(
+                                name=RESOURCE,
+                                nominal_quota=queue_class.nominal_quota,
+                                borrowing_limit=queue_class.borrowing_limit)])])]
+                    load.cluster_queues.append(cq)
+                    load.cq_class[cq_name] = queue_class.class_name
+                    lq = api.LocalQueue(metadata=ObjectMeta(
+                        name="queue", namespace=namespace))
+                    lq.spec.cluster_queue = cq_name
+                    load.local_queues.append(lq)
+                    load.namespaces.append(namespace)
+                    for si, wl_set in enumerate(queue_class.workloads_sets):
+                        count = max(1, int(wl_set.count * scale))
+                        for wi in range(count):
+                            wl_class = wl_set.workloads[wi % len(wl_set.workloads)]
+                            load.arrivals.append(Arrival(
+                                at_s=wi * wl_set.creation_interval_ms / 1000.0,
+                                namespace=namespace,
+                                name=f"{wl_class.class_name}-{si}-{wi}",
+                                queue_name="queue",
+                                class_name=wl_class.class_name,
+                                priority=wl_class.priority,
+                                request=wl_class.request,
+                                runtime_s=wl_class.runtime_ms / 1000.0))
+    load.arrivals.sort(key=lambda a: a.at_s)
+    return load

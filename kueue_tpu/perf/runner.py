@@ -1,0 +1,194 @@
+"""Perf harness runner + recorder.
+
+Equivalent of the reference's test/performance/scheduler/{runner,recorder}
+(runner/main.go): drive a full KueueManager on a virtual clock through
+the generated arrival schedule, fake workload execution (a workload
+"runs" for its class runtime, then finishes), and record per-class
+time-to-admission stats plus time-weighted ClusterQueue usage.
+
+The virtual clock reproduces the reference's queueing dynamics exactly
+(arrival intervals, runtimes, quotas), so per-class time-to-admission is
+directly comparable to the reference's wall-clock numbers in
+default_rangespec.yaml as long as the scheduler keeps up; real compute
+time is reported separately as the throughput signal.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import Condition, FakeClock, ObjectMeta, set_condition
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.manager import KueueManager
+from kueue_tpu.perf.generator import GeneratedLoad, RESOURCE
+
+
+@dataclass
+class ClassStats:
+    times_to_admission: list = field(default_factory=list)
+
+    def _q(self, q: float) -> float:
+        if not self.times_to_admission:
+            return 0.0
+        data = sorted(self.times_to_admission)
+        idx = min(len(data) - 1, int(q * len(data)))
+        return data[idx]
+
+    @property
+    def avg(self) -> float:
+        return (sum(self.times_to_admission) / len(self.times_to_admission)
+                if self.times_to_admission else 0.0)
+
+    @property
+    def p50(self) -> float:
+        return self._q(0.50)
+
+    @property
+    def p99(self) -> float:
+        return self._q(0.99)
+
+
+@dataclass
+class RunResult:
+    total: int = 0
+    admitted: int = 0
+    finished: int = 0
+    cycles: int = 0
+    wall_s: float = 0.0            # real compute time of the simulation
+    virtual_makespan_s: float = 0.0
+    class_stats: dict = field(default_factory=dict)   # class -> ClassStats
+    cq_class_avg_usage_pct: dict = field(default_factory=dict)
+    admissions_per_wall_second: float = 0.0
+
+
+class Runner:
+    def __init__(self, load: GeneratedLoad, solver=None, cfg=None):
+        self.load = load
+        self.clock = FakeClock(0.0)
+        self.mgr = KueueManager(cfg=cfg, clock=self.clock, solver=solver)
+        self.solver = solver
+
+    def run(self, max_virtual_s: float = 10 ** 6) -> RunResult:
+        mgr, clock, load = self.mgr, self.clock, self.load
+        result = RunResult(total=len(load.arrivals))
+        start_wall = time.monotonic()
+
+        for rf in load.flavors:
+            mgr.store.create(rf)
+        for cq in load.cluster_queues:
+            mgr.store.create(cq)
+        for lq in load.local_queues:
+            mgr.store.create(lq)
+        mgr.run_until_idle()
+
+        arrival_by_key = {f"{a.namespace}/{a.name}": a for a in load.arrivals}
+        admitted_at: dict = {}
+
+        # record admissions through the watch, like the reference's
+        # recorder consumes workload events
+        events: list = []  # heap of (virtual time, seq, kind, payload)
+        seq = [0]
+
+        def push(at, kind, payload):
+            seq[0] += 1
+            heapq.heappush(events, (at, seq[0], kind, payload))
+
+        def on_workload(event, wl, old):
+            key = wlpkg.key(wl)
+            if key in admitted_at or key not in arrival_by_key:
+                return
+            if not wlpkg.has_quota_reservation(wl):
+                return
+            arrival = arrival_by_key[key]
+            now = clock.now()
+            admitted_at[key] = now
+            result.admitted += 1
+            stats = result.class_stats.setdefault(arrival.class_name, ClassStats())
+            stats.times_to_admission.append(now - arrival.at_s)
+            push(now + arrival.runtime_s, "finish", key)
+
+        mgr.store.watch("Workload", on_workload)
+
+        for arrival in load.arrivals:
+            push(arrival.at_s, "arrive", arrival)
+
+        # time-weighted usage sampling per CQ class
+        usage_acc: dict = {}   # cq class -> accumulated pct*dt
+        last_sample_t = 0.0
+
+        def sample_usage(now):
+            nonlocal last_sample_t
+            dt = now - last_sample_t
+            if dt <= 0:
+                return
+            per_class: dict = {}
+            for cq in load.cluster_queues:
+                cqc = mgr.cache.cluster_queue(cq.metadata.name)
+                if cqc is None:
+                    continue
+                nominal = cq.spec.resource_groups[0].flavors[0].resources[0].nominal_quota
+                used = cqc.resource_node.usage.get(("default", RESOURCE), 0)
+                cls = load.cq_class[cq.metadata.name]
+                per_class.setdefault(cls, []).append(
+                    100.0 * min(used, nominal) / nominal if nominal else 0.0)
+            for cls, pcts in per_class.items():
+                usage_acc[cls] = usage_acc.get(cls, 0.0) + dt * (sum(pcts) / len(pcts))
+            last_sample_t = now
+
+        while events:
+            at, _, _, _ = events[0]
+            if at > max_virtual_s:
+                break
+            sample_usage(at)
+            clock.t = max(clock.t, at)
+            # apply every event due at this instant
+            while events and events[0][0] <= clock.t:
+                _, _, kind, payload = heapq.heappop(events)
+                if kind == "arrive":
+                    wl = api.Workload(metadata=ObjectMeta(
+                        name=payload.name, namespace=payload.namespace))
+                    wl.spec.queue_name = payload.queue_name
+                    wl.spec.priority = payload.priority
+                    wl.spec.pod_sets = [api.PodSet(
+                        name=api.DEFAULT_PODSET_NAME, count=1)]
+                    wl.spec.pod_sets[0].template.spec.containers = [
+                        _container(payload.request)]
+                    mgr.store.create(wl)
+                else:
+                    namespace, name = payload.split("/", 1)
+                    wl = mgr.store.try_get("Workload", namespace, name)
+                    if wl is not None and not wlpkg.is_finished(wl):
+                        set_condition(wl.status.conditions, Condition(
+                            type=api.WORKLOAD_FINISHED, status="True",
+                            reason="Succeeded", message="simulated completion"),
+                            clock.now())
+                        mgr.store.update(wl)
+                        result.finished += 1
+            mgr.run_until_idle()
+            # schedule until this instant's admissions are exhausted
+            for _ in range(1000):
+                before = result.admitted
+                mgr.scheduler.schedule(timeout=0)
+                mgr.run_until_idle()
+                result.cycles += 1
+                if result.admitted == before:
+                    break
+
+        result.virtual_makespan_s = clock.now()
+        sample_usage(clock.now())
+        for cls, acc in usage_acc.items():
+            result.cq_class_avg_usage_pct[cls] = (
+                acc / result.virtual_makespan_s if result.virtual_makespan_s else 0.0)
+        result.wall_s = time.monotonic() - start_wall
+        result.admissions_per_wall_second = (
+            result.admitted / result.wall_s if result.wall_s else 0.0)
+        return result
+
+
+def _container(request: int):
+    from kueue_tpu.api.corev1 import Container
+    return Container(name="c", requests={RESOURCE: request})

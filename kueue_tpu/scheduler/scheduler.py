@@ -111,6 +111,10 @@ class Scheduler:
         # on TPU; CPU path handles the remainder (preemption, partial
         # admission) and acts as the fallback when None.
         self.solver = solver
+        # Below this head count the accelerator dispatch overhead exceeds
+        # the win; narrow cycles go through the CPU path even with a
+        # solver configured (SolverConfig.min_heads; 0 = always solve).
+        self.solver_min_heads = 64
         self.preemptor = Preemptor(
             ordering=self.ordering,
             enable_fair_sharing=fair_sharing_enabled,
@@ -150,7 +154,7 @@ class Scheduler:
         snapshot = self.cache.snapshot()
 
         solver_entries: list = []
-        if self.solver is not None:
+        if self.solver is not None and len(heads) >= self.solver_min_heads:
             solver_entries, heads = self._solve_batch(heads, snapshot, timeout)
 
         entries = self.nominate(heads, snapshot)

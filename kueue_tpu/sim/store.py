@@ -52,7 +52,12 @@ def obj_key(obj) -> str:
 
 
 class Store:
-    """Keyed by (kind, namespace/name). All reads and writes deep-copy."""
+    """Keyed by (kind, namespace/name).
+
+    Reads (get/list) and watch events return deep copies. `create`
+    returns a copy of the stored object; `update` returns None — the
+    written object is owned by the store and callers must re-`get` to
+    observe the persisted state."""
 
     def __init__(self, clock: Clock = REAL_CLOCK):
         self._clock = clock
@@ -82,8 +87,16 @@ class Store:
         self._watchers.setdefault(kind, []).append(handler)
 
     def _notify(self, kind: str, event: str, obj, old) -> None:
-        for handler in self._watchers.get(kind, []):
-            handler(event, copy.deepcopy(obj), copy.deepcopy(old) if old is not None else None)
+        handlers = self._watchers.get(kind, [])
+        if not handlers:
+            return
+        # One copy shared by all handlers for this event. Handlers treat
+        # event objects as read-only (informer-cache convention); copying
+        # per handler dominated the profile at scale.
+        obj_copy = copy.deepcopy(obj)
+        old_copy = copy.deepcopy(old) if old is not None else None
+        for handler in handlers:
+            handler(event, obj_copy, old_copy)
 
     # -- CRUD --------------------------------------------------------------
 
@@ -120,11 +133,12 @@ class Store:
         except NotFound:
             return None
 
-    def update(self, obj, expect_rv: Optional[int] = None) -> object:
+    def update(self, obj, expect_rv: Optional[int] = None) -> None:
         """Write back an object. With expect_rv set, raises Conflict on a
         stale resourceVersion (optimistic concurrency); by default the
         write wins (SSA-style — the reference's status writes are all SSA
-        and conflict-tolerant)."""
+        and conflict-tolerant). Returns None; re-`get` to observe the
+        persisted state."""
         kind = kind_of(obj)
         with self._lock:
             key = obj_key(obj)
@@ -148,17 +162,17 @@ class Store:
             # lets status-writing reconcilers settle.
             stored.metadata.resource_version = old.metadata.resource_version
             if stored == old:
-                return copy.deepcopy(stored)
+                return None
             self._rv += 1
             stored.metadata.resource_version = self._rv
             if stored.metadata.deletion_timestamp is not None and not stored.metadata.finalizers:
                 # last finalizer removed -> actually delete
                 del bucket[key]
                 self._notify(kind, DELETED, stored, old)
-                return copy.deepcopy(stored)
+                return None
             bucket[key] = stored
             self._notify(kind, MODIFIED, stored, old)
-            return copy.deepcopy(stored)
+            return None
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:

@@ -1,0 +1,4 @@
+"""Version stamping (reference: pkg/version)."""
+
+VERSION = "0.1.0"
+GIT_COMMIT = "unknown"

@@ -1,0 +1,140 @@
+"""Visibility extension API: live pending-workloads views.
+
+Equivalent of the reference's pkg/visibility (server.go:46-98,
+api/rest/pending_workloads_cq.go, pending_workloads_lq.go) and
+apis/visibility/v1alpha1 (types.go:64-98): positions in queue with
+limit/offset pagination, served straight from the queue manager's live
+state. `VisibilityServer` optionally exposes the same payloads over
+HTTP (the reference registers an aggregated apiserver on :8082).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict, dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kueue_tpu.core import priority as prioritypkg
+from kueue_tpu.core import workload as wlpkg
+
+DEFAULT_LIMIT = 1000
+
+
+@dataclass
+class PendingWorkload:
+    """reference: apis/visibility/v1alpha1/types.go:64-83"""
+    name: str
+    namespace: str
+    local_queue_name: str
+    priority: int
+    position_in_cluster_queue: int
+    position_in_local_queue: int
+
+
+@dataclass
+class PendingWorkloadsSummary:
+    items: list = field(default_factory=list)
+
+
+class VisibilityAPI:
+    def __init__(self, queues):
+        self.queues = queues
+
+    def pending_workloads_cq(self, cq_name: str, limit: int = DEFAULT_LIMIT,
+                             offset: int = 0) -> PendingWorkloadsSummary:
+        """reference: pending_workloads_cq.go:36+ — full, ordered pending
+        list with per-LQ positions."""
+        infos = self.queues.pending_workloads_info(cq_name)
+        lq_positions: dict = {}
+        items = []
+        for idx, info in enumerate(infos):
+            lq_key = wlpkg.queue_key(info.obj)
+            lq_pos = lq_positions.get(lq_key, 0)
+            lq_positions[lq_key] = lq_pos + 1
+            if idx < offset or len(items) >= limit:
+                continue
+            items.append(PendingWorkload(
+                name=info.obj.metadata.name,
+                namespace=info.obj.metadata.namespace,
+                local_queue_name=info.obj.spec.queue_name,
+                priority=prioritypkg.priority(info.obj),
+                position_in_cluster_queue=idx,
+                position_in_local_queue=lq_pos))
+        return PendingWorkloadsSummary(items=items)
+
+    def pending_workloads_lq(self, namespace: str, lq_name: str,
+                             limit: int = DEFAULT_LIMIT,
+                             offset: int = 0) -> PendingWorkloadsSummary:
+        """reference: pending_workloads_lq.go — the LQ view is a filtered
+        projection of its CQ's list."""
+        lq_key = f"{namespace}/{lq_name}"
+        items = self.queues.local_queues.get(lq_key)
+        if items is None:
+            return PendingWorkloadsSummary()
+        cq_summary = self.pending_workloads_cq(items.cluster_queue, limit=10**9)
+        filtered = [pw for pw in cq_summary.items
+                    if pw.namespace == namespace and pw.local_queue_name == lq_name]
+        return PendingWorkloadsSummary(items=filtered[offset:offset + limit])
+
+
+class VisibilityServer:
+    """Serve the visibility API over HTTP (reference: server on :8082).
+
+    GET /apis/visibility.kueue.x-k8s.io/v1alpha1/clusterqueues/<cq>/pendingworkloads
+    GET /apis/visibility.kueue.x-k8s.io/v1alpha1/namespaces/<ns>/localqueues/<lq>/pendingworkloads
+    Query params: limit, offset.
+    """
+
+    def __init__(self, api: VisibilityAPI, port: int = 0):
+        self.api = api
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        api = self.api
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                params = dict(kv.split("=", 1) for kv in query.split("&") if "=" in kv)
+                limit = int(params.get("limit", DEFAULT_LIMIT))
+                offset = int(params.get("offset", 0))
+                parts = [p for p in path.split("/") if p]
+                summary = None
+                if (len(parts) >= 5 and parts[0] == "apis"
+                        and parts[3] == "clusterqueues"
+                        and parts[5:6] == ["pendingworkloads"]):
+                    summary = api.pending_workloads_cq(parts[4], limit, offset)
+                elif (len(parts) >= 8 and parts[3] == "namespaces"
+                        and parts[5] == "localqueues"
+                        and parts[7] == "pendingworkloads"):
+                    summary = api.pending_workloads_lq(parts[4], parts[6],
+                                                       limit, offset)
+                if summary is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(asdict(summary)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

@@ -23,6 +23,7 @@ def build_env(setup, solver=False):
     env = Env()
     if solver:
         env.scheduler.solver = BatchSolver()
+        env.scheduler.solver_min_heads = 0  # force the solver path
     setup(env)
     return env
 

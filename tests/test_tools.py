@@ -1,0 +1,189 @@
+"""Visibility API, debugger, kueuectl CLI, importer tests
+(reference: pkg/visibility, pkg/debugger, cmd/kueuectl, cmd/importer)."""
+
+import io
+import json
+import urllib.request
+
+import pytest
+
+from kueue_tpu.api import corev1, kueue as api
+from kueue_tpu.api.corev1 import Container, PodSpec
+from kueue_tpu.api.meta import FakeClock, ObjectMeta
+from kueue_tpu.cli import Kueuectl, main as cli_main
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.debugger import Dumper
+from kueue_tpu.importer import Importer, MappingRule
+from kueue_tpu.manager import KueueManager
+from kueue_tpu.visibility import VisibilityAPI, VisibilityServer
+
+from tests.wrappers import (
+    ClusterQueueWrapper,
+    WorkloadWrapper,
+    flavor_quotas,
+    make_flavor,
+    make_local_queue,
+)
+
+
+@pytest.fixture
+def clock():
+    return FakeClock(1000.0)
+
+
+@pytest.fixture
+def mgr(clock):
+    m = KueueManager(clock=clock)
+    m.store.create(make_flavor("default"))
+    m.store.create(ClusterQueueWrapper("cq").resource_group(
+        flavor_quotas("default", cpu=1)).obj())
+    m.store.create(make_local_queue("lq", "default", "cq"))
+    m.run_until_idle()
+    return m
+
+
+def submit_n(mgr, n, prefix="w", prio=0):
+    for i in range(n):
+        mgr.store.create(WorkloadWrapper(f"{prefix}{i}").queue("lq")
+                         .priority(prio).creation(100 + i)
+                         .request("cpu", "1").obj())
+
+
+class TestVisibility:
+    def test_positions_and_pagination(self, mgr):
+        submit_n(mgr, 5)
+        mgr.schedule_until_settled()   # w0 admits; w1..w4 pending
+        vis = VisibilityAPI(mgr.queues)
+        summary = vis.pending_workloads_cq("cq")
+        names = [pw.name for pw in summary.items]
+        assert names == ["w1", "w2", "w3", "w4"]
+        assert [pw.position_in_cluster_queue for pw in summary.items] == [0, 1, 2, 3]
+        page = vis.pending_workloads_cq("cq", limit=2, offset=1)
+        assert [pw.name for pw in page.items] == ["w2", "w3"]
+
+    def test_priority_orders_view(self, mgr):
+        # fill the queue first so nothing admits, then add a high-priority
+        # workload: it must appear at the head of the pending view
+        submit_n(mgr, 2, prefix="low", prio=0)
+        mgr.schedule_until_settled()   # low0 admits (1-cpu quota)
+        submit_n(mgr, 1, prefix="high", prio=100)
+        mgr.run_until_idle()
+        vis = VisibilityAPI(mgr.queues)
+        names = [pw.name for pw in vis.pending_workloads_cq("cq").items]
+        assert names == ["high0", "low1"]
+
+    def test_local_queue_view(self, mgr):
+        submit_n(mgr, 3)
+        mgr.schedule_until_settled()
+        vis = VisibilityAPI(mgr.queues)
+        summary = vis.pending_workloads_lq("default", "lq")
+        assert [pw.position_in_local_queue for pw in summary.items] == [0, 1]
+
+    def test_http_server(self, mgr):
+        submit_n(mgr, 3)
+        mgr.schedule_until_settled()
+        server = VisibilityServer(VisibilityAPI(mgr.queues))
+        port = server.start()
+        try:
+            url = (f"http://127.0.0.1:{port}/apis/visibility.kueue.x-k8s.io/"
+                   f"v1alpha1/clusterqueues/cq/pendingworkloads?limit=1")
+            body = json.loads(urllib.request.urlopen(url, timeout=5).read())
+            assert len(body["items"]) == 1
+            assert body["items"][0]["name"] == "w1"
+        finally:
+            server.stop()
+
+
+class TestDumper:
+    def test_dump_contains_state(self, mgr):
+        submit_n(mgr, 2)
+        mgr.schedule_until_settled()
+        buf = io.StringIO()
+        Dumper(mgr.cache, mgr.queues, out=buf).write()
+        text = buf.getvalue()
+        assert "cq cq" in text
+        assert "workload default/w0" in text
+        assert "pending default/w1" in text
+
+
+class TestKueuectl:
+    def test_create_list_stop_resume(self, mgr):
+        out = io.StringIO()
+        ctl = Kueuectl(mgr, out=out)
+        ctl.create_resource_flavor("gpu")
+        ctl.create_cluster_queue("cq2", nominal_quota={"cpu": 8000}, flavor="gpu")
+        ctl.create_local_queue("lq2", "default", "cq2")
+        mgr.run_until_idle()
+        cqs = ctl.list_cluster_queues()
+        assert {c.metadata.name for c in cqs} == {"cq", "cq2"}
+
+        submit_n(mgr, 1)
+        mgr.schedule_until_settled()
+        assert wlpkg.is_admitted(mgr.store.get("Workload", "default", "w0"))
+        ctl.stop_workload("default", "w0")
+        mgr.run_until_idle()
+        assert not mgr.store.get("Workload", "default", "w0").spec.active
+        ctl.resume_workload("default", "w0")
+        mgr.run_until_idle()
+        assert mgr.store.get("Workload", "default", "w0").spec.active
+
+        ctl.stop_cluster_queue("cq")
+        mgr.run_until_idle()
+        assert mgr.store.get("ClusterQueue", "", "cq").spec.stop_policy == \
+            api.HOLD_AND_DRAIN
+        ctl.resume_cluster_queue("cq")
+        mgr.run_until_idle()
+        assert mgr.store.get("ClusterQueue", "", "cq").spec.stop_policy == \
+            api.STOP_POLICY_NONE
+
+    def test_argparse_entry(self, mgr, capsys):
+        assert cli_main(["version"], manager=mgr) == 0
+        assert cli_main(["create", "resourceflavor", "cli-flavor"],
+                        manager=mgr) == 0
+        assert mgr.store.try_get("ResourceFlavor", "", "cli-flavor") is not None
+        assert cli_main(["list", "workload"], manager=mgr) == 0
+
+
+class TestImporter:
+    def make_running_pod(self, name, namespace="default", cpu=500, labels=None):
+        pod = corev1.Pod(metadata=ObjectMeta(
+            name=name, namespace=namespace, labels=dict(labels or {})))
+        pod.spec = PodSpec(containers=[Container(name="c",
+                                                 requests={"cpu": cpu})])
+        pod.status.phase = corev1.POD_RUNNING
+        return pod
+
+    def test_check_rejects_missing_queue(self, mgr):
+        mgr.store.create(self.make_running_pod("p1"))
+        imp = Importer(mgr, [MappingRule(namespace="default", queue_name="nope")])
+        result = imp.check()
+        assert result.errors and "not found" in result.errors[0]
+
+    def test_import_creates_admitted_workloads(self, mgr):
+        mgr.store.create(self.make_running_pod("p1"))
+        mgr.store.create(self.make_running_pod("p2"))
+        # a pod outside the mapping is ignored
+        mgr.store.create(self.make_running_pod("other", namespace="kube-system"))
+        imp = Importer(mgr, [MappingRule(namespace="default", queue_name="lq")])
+        result = imp.import_pods()
+        assert result.imported == 2 and not result.errors
+        mgr.run_until_idle()
+        wl = mgr.store.get("Workload", "default", "pod-p1")
+        assert wlpkg.is_admitted(wl)
+        # the cache accounts for the imported usage: 2x500m of the 1-cpu
+        # quota; a new 1-cpu workload no longer fits
+        mgr.store.create(WorkloadWrapper("newbie").queue("lq")
+                         .request("cpu", "1").obj())
+        mgr.schedule_until_settled()
+        assert not wlpkg.has_quota_reservation(
+            mgr.store.get("Workload", "default", "newbie"))
+
+    def test_label_scoped_rule(self, mgr):
+        mgr.store.create(self.make_running_pod("tagged", labels={"team": "a"}))
+        mgr.store.create(self.make_running_pod("untagged"))
+        imp = Importer(mgr, [MappingRule(namespace="default", queue_name="lq",
+                                         match_labels={"team": "a"})])
+        result = imp.import_pods()
+        assert result.imported == 1
+        assert mgr.store.try_get("Workload", "default", "pod-tagged") is not None
+        assert mgr.store.try_get("Workload", "default", "pod-untagged") is None

@@ -182,10 +182,14 @@ def _dispatch(ctl: Kueuectl, args) -> int:
         return 0
     kind = args.kind
     if args.command == "list":
-        {"clusterqueue": ctl.list_cluster_queues,
-         "localqueue": ctl.list_local_queues,
-         "workload": ctl.list_workloads,
-         "resourceflavor": ctl.list_resource_flavors}[kind]()
+        if kind == "clusterqueue":
+            ctl.list_cluster_queues()
+        elif kind == "localqueue":
+            ctl.list_local_queues(namespace=args.namespace)
+        elif kind == "workload":
+            ctl.list_workloads(namespace=args.namespace)
+        else:
+            ctl.list_resource_flavors()
         return 0
     if args.command == "create":
         if kind == "clusterqueue":

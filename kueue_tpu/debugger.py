@@ -10,8 +10,6 @@ from __future__ import annotations
 import signal
 import sys
 
-from kueue_tpu.core import workload as wlpkg
-
 
 class Dumper:
     def __init__(self, cache, queues, out=None):

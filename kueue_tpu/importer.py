@@ -16,7 +16,6 @@ from typing import Optional
 
 from kueue_tpu.api import corev1, kueue as api
 from kueue_tpu.api.meta import ObjectMeta
-from kueue_tpu.core import priority as prioritypkg
 from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.core.resources import pod_effective_requests
 

@@ -129,8 +129,8 @@ class KueueManager:
             ordering=ordering,
             fair_sharing_enabled=self.cfg.fair_sharing.enable,
             fs_preemption_strategies=self.cfg.fair_sharing.preemption_strategies,
-            clock=clock, metrics=self.metrics, solver=solver)
-        self.scheduler.solver_min_heads = self.cfg.solver.min_heads
+            clock=clock, metrics=self.metrics, solver=solver,
+            solver_min_heads=self.cfg.solver.min_heads)
 
     def _namespace_labels(self, ns: str) -> Optional[dict]:
         obj = self.store.try_get("Namespace", "", ns)

@@ -1,0 +1,127 @@
+"""Native (C++) solver backend, loaded via ctypes.
+
+The compute path of the framework is JAX/XLA on TPU; this module is the
+native runtime fallback — the same batched admission solve compiled to
+machine code for hosts without an accelerator, and a conformance twin
+for the jitted kernel. Built on demand with g++ (`make` in this
+directory); `available()` gates all use so environments without a
+toolchain fall back to the jit/CPU paths transparently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libkueue_native.so")
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-s", "-C", _DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load():
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            if lib.kueue_native_abi_version() != 1:
+                _load_failed = True
+                return None
+            lib.kueue_solve_cycle.restype = ctypes.c_int
+            _lib = lib
+        except OSError:
+            _load_failed = True
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def solve_cycle_native(topo, usage: np.ndarray, cohort_usage: np.ndarray,
+                       requests: np.ndarray, podset_active: np.ndarray,
+                       wl_cq: np.ndarray, priority: np.ndarray,
+                       timestamp: np.ndarray, eligible: np.ndarray,
+                       solvable: np.ndarray) -> Optional[dict]:
+    """Same contract as kernel.solve_cycle, on numpy arrays. `topo` is the
+    numpy encode.Topology. Returns None if the native library is
+    unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    Q, F, R = topo.nominal.shape
+    C = topo.cohort_subtree.shape[0]
+    W, P, _ = requests.shape
+
+    def prep(a, dtype):
+        return np.ascontiguousarray(a, dtype=dtype)
+
+    cq_cohort = prep(topo.cq_cohort, np.int32)
+    nominal = prep(topo.nominal, np.int64)
+    borrow_limit = prep(topo.borrow_limit, np.int64)
+    guaranteed = prep(topo.guaranteed, np.int64)
+    offered = prep(topo.offered, np.uint8)
+    group_id = prep(topo.group_id, np.int32)
+    flavor_group = prep(topo.flavor_group, np.int32)
+    flavor_rank = prep(topo.flavor_rank, np.int32)
+    prefer_nb = prep(topo.prefer_no_borrow, np.uint8)
+    cohort_subtree = prep(topo.cohort_subtree, np.int64)
+    usage_out = prep(usage, np.int64).copy()
+    cohort_out = prep(cohort_usage, np.int64).copy()
+    requests_c = prep(requests, np.int64)
+    podset_active_c = prep(podset_active, np.uint8)
+    wl_cq_c = prep(wl_cq, np.int32)
+    priority_c = prep(priority, np.int64)
+    timestamp_c = prep(timestamp, np.float64)
+    eligible_c = prep(eligible, np.uint8)
+    solvable_c = prep(solvable, np.uint8)
+
+    admitted = np.zeros(W, np.uint8)
+    chosen = np.full((W, P, R), -1, np.int32)
+    borrows = np.zeros(W, np.uint8)
+    fit = np.zeros(W, np.uint8)
+
+    rc = lib.kueue_solve_cycle(
+        ctypes.c_int64(Q), ctypes.c_int64(C), ctypes.c_int64(F),
+        ctypes.c_int64(R), ctypes.c_int64(W), ctypes.c_int64(P),
+        _ptr(cq_cohort, ctypes.c_int32), _ptr(nominal, ctypes.c_int64),
+        _ptr(borrow_limit, ctypes.c_int64), _ptr(guaranteed, ctypes.c_int64),
+        _ptr(offered, ctypes.c_uint8), _ptr(group_id, ctypes.c_int32),
+        _ptr(flavor_group, ctypes.c_int32), _ptr(flavor_rank, ctypes.c_int32),
+        _ptr(prefer_nb, ctypes.c_uint8), _ptr(cohort_subtree, ctypes.c_int64),
+        _ptr(usage_out, ctypes.c_int64), _ptr(cohort_out, ctypes.c_int64),
+        _ptr(requests_c, ctypes.c_int64), _ptr(podset_active_c, ctypes.c_uint8),
+        _ptr(wl_cq_c, ctypes.c_int32), _ptr(priority_c, ctypes.c_int64),
+        _ptr(timestamp_c, ctypes.c_double), _ptr(eligible_c, ctypes.c_uint8),
+        _ptr(solvable_c, ctypes.c_uint8),
+        _ptr(admitted, ctypes.c_uint8), _ptr(chosen, ctypes.c_int32),
+        _ptr(borrows, ctypes.c_uint8), _ptr(fit, ctypes.c_uint8))
+    if rc != 0:
+        return None
+    return {"admitted": admitted.astype(bool), "chosen": chosen,
+            "borrows": borrows.astype(bool), "fit": fit.astype(bool),
+            "usage": usage_out, "cohort_usage": cohort_out}

@@ -1,0 +1,216 @@
+// Native batched admission solve — the CPU-native backend of the solver
+// plane (the runtime fallback when no accelerator is attached, and the
+// conformance twin of the jitted kernel in kueue_tpu/solver/kernel.py).
+//
+// Semantics are a line-for-line port of solve_cycle_impl (kernel.py):
+//   Phase A: per-(workload, podset, resource-group) flavor choice over the
+//            snapshot availability, honoring eligibility masks, borrowing
+//            limits and whenCanBorrow=TryNextFlavor
+//            (reference: pkg/scheduler/flavorassigner/flavorassigner.go:406-537)
+//   Phase B: sequential admit in borrow -> priority -> FIFO order with
+//            intra-cycle usage accounting and cohort bubbling
+//            (reference: pkg/scheduler/scheduler.go:234-335)
+//
+// Exposed via a C ABI and loaded with ctypes (no pybind11 in this image).
+// Differentially tested against the jitted kernel in tests/test_native.py.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+namespace {
+constexpr int64_t NO_LIMIT = int64_t(1) << 62;
+constexpr int64_t BORROW_CAP = NO_LIMIT / 4;
+
+inline int64_t imax(int64_t a, int64_t b) { return a > b ? a : b; }
+inline int64_t imin(int64_t a, int64_t b) { return a < b ? a : b; }
+}  // namespace
+
+extern "C" int kueue_solve_cycle(
+    // dimensions
+    int64_t Q, int64_t C, int64_t F, int64_t R, int64_t W, int64_t P,
+    // topology
+    const int32_t* cq_cohort,         // [Q]
+    const int64_t* nominal,           // [Q,F,R]
+    const int64_t* borrow_limit,      // [Q,F,R]
+    const int64_t* guaranteed,        // [Q,F,R]
+    const uint8_t* offered,           // [Q,F,R]
+    const int32_t* group_id,          // [Q,R]
+    const int32_t* flavor_group,      // [Q,F]
+    const int32_t* flavor_rank,       // [Q,F]
+    const uint8_t* prefer_no_borrow,  // [Q]
+    const int64_t* cohort_subtree,    // [C,F,R]
+    // state (mutated in place: post-cycle usage)
+    int64_t* usage,                   // [Q,F,R]
+    int64_t* cohort_usage,            // [C,F,R]
+    // workload batch
+    const int64_t* requests,          // [W,P,R]
+    const uint8_t* podset_active,     // [W,P]
+    const int32_t* wl_cq,             // [W]
+    const int64_t* priority,         // [W]
+    const double* timestamp,         // [W]
+    const uint8_t* eligible,          // [W,P,F]
+    const uint8_t* solvable,          // [W]
+    // outputs
+    uint8_t* admitted,                // [W]
+    int32_t* chosen,                  // [W,P,R]
+    uint8_t* borrows,                 // [W]
+    uint8_t* fit                      // [W]
+) {
+  (void)C;  // cohort count is implicit in the array extents
+  const int64_t FR = F * R;
+
+  // ---- availability under the snapshot usage (kernel.py::_available) ----
+  std::vector<int64_t> avail(size_t(Q) * FR);
+  for (int64_t q = 0; q < Q; ++q) {
+    const int32_t c = cq_cohort[q];
+    for (int64_t fr = 0; fr < FR; ++fr) {
+      const size_t idx = size_t(q) * FR + fr;
+      if (c < 0) {
+        avail[idx] = nominal[idx] - usage[idx];
+      } else {
+        const int64_t local = imax(0, guaranteed[idx] - usage[idx]);
+        const int64_t parent_avail =
+            cohort_subtree[size_t(c) * FR + fr] - cohort_usage[size_t(c) * FR + fr];
+        const int64_t cap = (nominal[idx] - guaranteed[idx]) -
+                            imax(0, usage[idx] - guaranteed[idx]) +
+                            imin(borrow_limit[idx], BORROW_CAP);
+        avail[idx] = local + imin(parent_avail, cap);
+      }
+    }
+  }
+
+  // ---- Phase A: flavor assignment ----
+  // asg_usage: per-workload [F,R] accumulation across its podsets
+  std::vector<int64_t> asg_usage(size_t(W) * FR, 0);
+  std::fill(chosen, chosen + size_t(W) * P * R, int32_t(-1));
+
+  for (int64_t w = 0; w < W; ++w) {
+    const int32_t q = wl_cq[w];
+    bool ok_all = true;
+    bool borrow_all = false;
+    bool any_active = false;
+    int64_t* asg_w = asg_usage.data() + size_t(w) * FR;
+
+    for (int64_t p = 0; p < P; ++p) {
+      if (!podset_active[size_t(w) * P + p]) continue;
+      any_active = true;
+      const int64_t* req = requests + (size_t(w) * P + p) * R;
+      const uint8_t* elig = eligible + (size_t(w) * P + p) * F;
+
+      // groups touched by this podset's requests
+      for (int64_t r0 = 0; r0 < R; ++r0) {
+        if (req[r0] <= 0) continue;
+        const int32_t g = group_id[size_t(q) * R + r0];
+        // only resolve each group once: at its first requested resource
+        bool first_of_group = true;
+        for (int64_t rp = 0; rp < r0; ++rp) {
+          if (req[rp] > 0 && group_id[size_t(q) * R + rp] == g) {
+            first_of_group = false;
+            break;
+          }
+        }
+        if (!first_of_group) continue;
+        if (g < 0) { ok_all = false; continue; }
+
+        // pick the flavor for group g: first fit by rank; TryNextFlavor
+        // prefers the first no-borrow fit over an earlier borrowing fit
+        int32_t best_rank = INT32_MAX, best_f = -1;
+        int32_t best_nb_rank = INT32_MAX, best_nb_f = -1;
+        bool best_borrows = false;
+        for (int64_t f = 0; f < F; ++f) {
+          if (flavor_group[size_t(q) * F + f] != g) continue;
+          if (!elig[f]) continue;
+          bool fits = true, borrows_f = false, any_rel = false;
+          for (int64_t r = 0; r < R; ++r) {
+            if (req[r] <= 0 || group_id[size_t(q) * R + r] != g) continue;
+            any_rel = true;
+            const size_t idx = size_t(q) * FR + size_t(f) * R + r;
+            const int64_t val = req[r] + asg_w[size_t(f) * R + r];
+            if (!offered[idx] || val > avail[idx]) { fits = false; break; }
+            if (usage[idx] + val > nominal[idx]) borrows_f = true;
+          }
+          if (!any_rel || !fits) continue;
+          const int32_t rank = flavor_rank[size_t(q) * F + f];
+          if (rank < best_rank) { best_rank = rank; best_f = int32_t(f);
+                                  best_borrows = borrows_f; }
+          if (!borrows_f && rank < best_nb_rank) { best_nb_rank = rank;
+                                                   best_nb_f = int32_t(f); }
+        }
+        int32_t pick = best_f;
+        bool pick_borrows = best_borrows;
+        if (prefer_no_borrow[q] && best_nb_f >= 0) {
+          pick = best_nb_f;
+          pick_borrows = false;
+        }
+        if (pick < 0) { ok_all = false; continue; }
+        for (int64_t r = 0; r < R; ++r) {
+          if (req[r] <= 0 || group_id[size_t(q) * R + r] != g) continue;
+          chosen[(size_t(w) * P + p) * R + r] = pick;
+          asg_w[size_t(pick) * R + r] += req[r];
+        }
+        if (pick_borrows) borrow_all = true;
+      }
+    }
+    borrows[w] = borrow_all ? 1 : 0;
+    fit[w] = (ok_all && solvable[w] && any_active) ? 1 : 0;
+  }
+
+  // ---- Phase B: sequential admit (kernel.py admit_step) ----
+  std::vector<int64_t> order(static_cast<size_t>(W));
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    if (fit[a] != fit[b]) return fit[a] > fit[b];
+    if (borrows[a] != borrows[b]) return borrows[a] < borrows[b];
+    if (priority[a] != priority[b]) return priority[a] > priority[b];
+    return timestamp[a] < timestamp[b];
+  });
+
+  std::memset(admitted, 0, size_t(W));
+  for (int64_t oi = 0; oi < W; ++oi) {
+    const int64_t w = order[oi];
+    if (!fit[w]) continue;
+    const int32_t q = wl_cq[w];
+    const int32_t c = cq_cohort[q];
+    const int64_t* au = asg_usage.data() + size_t(w) * FR;
+    int64_t* usage_q = usage + size_t(q) * FR;
+    const int64_t* nom_q = nominal + size_t(q) * FR;
+    const int64_t* guar_q = guaranteed + size_t(q) * FR;
+    const int64_t* bl_q = borrow_limit + size_t(q) * FR;
+
+    bool still_fits = true;
+    for (int64_t fr = 0; fr < FR && still_fits; ++fr) {
+      if (au[fr] == 0) continue;
+      int64_t avail_fr;
+      if (c < 0) {
+        avail_fr = nom_q[fr] - usage_q[fr];
+      } else {
+        const int64_t local = imax(0, guar_q[fr] - usage_q[fr]);
+        const int64_t parent_avail = cohort_subtree[size_t(c) * FR + fr] -
+                                     cohort_usage[size_t(c) * FR + fr];
+        const int64_t cap = (nom_q[fr] - guar_q[fr]) -
+                            imax(0, usage_q[fr] - guar_q[fr]) +
+                            imin(bl_q[fr], BORROW_CAP);
+        avail_fr = local + imin(parent_avail, cap);
+      }
+      if (au[fr] > avail_fr) still_fits = false;
+    }
+    if (!still_fits) continue;
+
+    admitted[w] = 1;
+    for (int64_t fr = 0; fr < FR; ++fr) {
+      if (au[fr] == 0 && c < 0) { continue; }
+      const int64_t old_over = imax(0, usage_q[fr] - guar_q[fr]);
+      usage_q[fr] += au[fr];
+      if (c >= 0) {
+        const int64_t new_over = imax(0, usage_q[fr] - guar_q[fr]);
+        cohort_usage[size_t(c) * FR + fr] += new_over - old_over;
+      }
+    }
+  }
+  return 0;
+}
+
+extern "C" int kueue_native_abi_version() { return 1; }

@@ -24,7 +24,7 @@ from kueue_tpu.api import kueue as api
 from kueue_tpu.api.meta import Condition, FakeClock, ObjectMeta, set_condition
 from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.manager import KueueManager
-from kueue_tpu.perf.generator import GeneratedLoad, RESOURCE
+from kueue_tpu.perf.generator import FLAVOR, GeneratedLoad, RESOURCE
 
 
 @dataclass
@@ -131,7 +131,7 @@ class Runner:
                 if cqc is None:
                     continue
                 nominal = cq.spec.resource_groups[0].flavors[0].resources[0].nominal_quota
-                used = cqc.resource_node.usage.get(("default", RESOURCE), 0)
+                used = cqc.resource_node.usage.get((FLAVOR, RESOURCE), 0)
                 cls = load.cq_class[cq.metadata.name]
                 per_class.setdefault(cls, []).append(
                     100.0 * min(used, nominal) / nominal if nominal else 0.0)

@@ -97,7 +97,7 @@ class Scheduler:
                  fs_preemption_strategies: Optional[list] = None,
                  clock: Clock = REAL_CLOCK,
                  metrics=None,
-                 solver=None):
+                 solver=None, solver_min_heads: int = 64):
         from kueue_tpu.scheduler.preemption import parse_strategies
         self.queues = queues
         self.cache = cache
@@ -114,7 +114,7 @@ class Scheduler:
         # Below this head count the accelerator dispatch overhead exceeds
         # the win; narrow cycles go through the CPU path even with a
         # solver configured (SolverConfig.min_heads; 0 = always solve).
-        self.solver_min_heads = 64
+        self.solver_min_heads = solver_min_heads
         self.preemptor = Preemptor(
             ordering=self.ordering,
             enable_fair_sharing=fair_sharing_enabled,

@@ -32,10 +32,14 @@ from kueue_tpu.solver.kernel import solve_cycle, topo_to_device
 
 class BatchSolver:
     def __init__(self, max_podsets: int = 4, ordering: Optional[wlpkg.Ordering] = None,
-                 mesh=None):
+                 mesh=None, backend: str = "jit"):
+        """backend: "jit" (XLA on the configured platform — the TPU path)
+        or "native" (the C++ solve in kueue_tpu.native — the accelerator-
+        free runtime; falls back to jit when the library is unavailable)."""
         self.max_podsets = max_podsets
         self.ordering = ordering or wlpkg.Ordering()
         self.mesh = mesh  # optional jax.sharding.Mesh for multi-chip solve
+        self.backend = backend
         self._topo_cache = None
         self._topo_key = None
 
@@ -69,16 +73,24 @@ class BatchSolver:
         if not batch.solvable.any():
             return {}
 
-        if self.mesh is not None:
-            from kueue_tpu.parallel.mesh import solve_cycle_sharded
-            result = solve_cycle_sharded(self.mesh, topo_dev, state, batch,
-                                         self.max_podsets)
-        else:
-            result = solve_cycle(
-                topo_dev, state.usage, state.cohort_usage, batch.requests,
+        result = None
+        if self.backend == "native" and self.mesh is None:
+            from kueue_tpu import native
+            result = native.solve_cycle_native(
+                topo, state.usage, state.cohort_usage, batch.requests,
                 batch.podset_active, batch.wl_cq, batch.priority,
-                batch.timestamp, batch.eligible, batch.solvable,
-                num_podsets=self.max_podsets)
+                batch.timestamp, batch.eligible, batch.solvable)
+        if result is None:
+            if self.mesh is not None:
+                from kueue_tpu.parallel.mesh import solve_cycle_sharded
+                result = solve_cycle_sharded(self.mesh, topo_dev, state, batch,
+                                             self.max_podsets)
+            else:
+                result = solve_cycle(
+                    topo_dev, state.usage, state.cohort_usage, batch.requests,
+                    batch.podset_active, batch.wl_cq, batch.priority,
+                    batch.timestamp, batch.eligible, batch.solvable,
+                    num_podsets=self.max_podsets)
 
         admitted = np.asarray(result["admitted"])
         fit = np.asarray(result["fit"])

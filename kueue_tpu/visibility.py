@@ -50,10 +50,12 @@ class VisibilityAPI:
         lq_positions: dict = {}
         items = []
         for idx, info in enumerate(infos):
+            if len(items) >= limit:
+                break
             lq_key = wlpkg.queue_key(info.obj)
             lq_pos = lq_positions.get(lq_key, 0)
             lq_positions[lq_key] = lq_pos + 1
-            if idx < offset or len(items) >= limit:
+            if idx < offset:
                 continue
             items.append(PendingWorkload(
                 name=info.obj.metadata.name,
@@ -101,10 +103,18 @@ class VisibilityServer:
                 pass
 
             def do_GET(self):
-                path, _, query = self.path.partition("?")
-                params = dict(kv.split("=", 1) for kv in query.split("&") if "=" in kv)
-                limit = int(params.get("limit", DEFAULT_LIMIT))
-                offset = int(params.get("offset", 0))
+                from urllib.parse import parse_qs, urlsplit
+                parsed = urlsplit(self.path)
+                path = parsed.path
+                params = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+                try:
+                    limit = int(params.get("limit", DEFAULT_LIMIT))
+                    offset = int(params.get("offset", 0))
+                except ValueError:
+                    self.send_response(400)
+                    self.end_headers()
+                    self.wfile.write(b"limit/offset must be integers")
+                    return
                 parts = [p for p in path.split("/") if p]
                 summary = None
                 if (len(parts) >= 5 and parts[0] == "apis"

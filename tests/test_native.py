@@ -1,0 +1,189 @@
+"""Differential tests: the native (C++) solver backend vs the jitted
+kernel vs the sequential CPU scheduler.
+
+The native backend must be bit-identical to the jit kernel (same port of
+the same semantics) and therefore also match the CPU conformance oracle
+on fit-mode cycles.
+"""
+
+import random
+
+import pytest
+
+from kueue_tpu import native
+from kueue_tpu.solver import BatchSolver
+from tests.test_solver import admitted_map, build_env
+from tests.wrappers import ClusterQueueWrapper, WorkloadWrapper, flavor_quotas
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable (no g++?)")
+
+
+def build_native_env(setup):
+    env = build_env(setup, solver=False)
+    env.scheduler.solver = BatchSolver(backend="native")
+    env.scheduler.solver_min_heads = 0
+    return env
+
+
+def assert_three_way(setup, workloads, cycles=1):
+    """CPU oracle, jit solver and native solver must all agree."""
+    envs = {
+        "cpu": build_env(setup, solver=False),
+        "jit": build_env(setup, solver=True),
+        "native": build_native_env(setup),
+    }
+    for env in envs.values():
+        for w in workloads():
+            env.submit(w)
+        for _ in range(cycles):
+            env.cycle()
+    results = {name: admitted_map(env) for name, env in envs.items()}
+    assert results["native"] == results["jit"], \
+        f"native {sorted(results['native'])} != jit {sorted(results['jit'])}"
+    assert results["native"] == results["cpu"], \
+        f"native {sorted(results['native'])} != cpu {sorted(results['cpu'])}"
+    return results["native"]
+
+
+class TestNativeBackend:
+    def test_basic_fit(self):
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(ClusterQueueWrapper("cq")
+                       .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                       "lq")
+
+        result = assert_three_way(
+            setup,
+            lambda: [WorkloadWrapper("w").queue("lq").pod_set(count=2, cpu="2").obj()])
+        assert "default/w" in result
+
+    def test_cohort_borrowing_contention(self):
+        def setup(env):
+            env.add_flavor("default")
+            for name in ("a", "b"):
+                env.add_cq(ClusterQueueWrapper(name).cohort("team")
+                           .resource_group(flavor_quotas("default", cpu="5")).obj(),
+                           f"lq-{name}")
+
+        def workloads():
+            return [
+                WorkloadWrapper("w1").queue("lq-a").priority(5).creation(1)
+                .pod_set(count=1, cpu="8").obj(),
+                WorkloadWrapper("w2").queue("lq-b").priority(1).creation(2)
+                .pod_set(count=1, cpu="8").obj(),
+            ]
+
+        result = assert_three_way(setup, workloads)
+        assert set(result) == {"default/w1"}
+
+    def test_flavor_order_and_try_next(self):
+        def setup(env):
+            env.add_flavor("spot")
+            env.add_flavor("on-demand")
+            env.add_cq(ClusterQueueWrapper("a").cohort("team")
+                       .flavor_fungibility(when_can_borrow="TryNextFlavor")
+                       .resource_group(flavor_quotas("spot", cpu="4"),
+                                       flavor_quotas("on-demand", cpu="8")).obj(),
+                       "lq-a")
+            env.add_cq(ClusterQueueWrapper("b").cohort("team")
+                       .resource_group(flavor_quotas("spot", cpu="4")).obj(),
+                       "lq-b")
+
+        def workloads():
+            # 6 cpu: spot would need borrowing; TryNextFlavor prefers the
+            # no-borrow on-demand fit
+            return [WorkloadWrapper("w").queue("lq-a").pod_set(count=1, cpu="6").obj()]
+
+        result = assert_three_way(setup, workloads)
+        assert result["default/w"][0][0][0][1] == "on-demand"
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_random_three_way(self, seed):
+        rng = random.Random(1000 + seed)
+        n_cohorts = rng.randint(1, 3)
+        n_cqs = rng.randint(2, 6)
+        flavors = [f"f{i}" for i in range(rng.randint(1, 3))]
+
+        cq_specs = []
+        for i in range(n_cqs):
+            cohort = f"cohort-{rng.randrange(n_cohorts)}" if rng.random() < 0.8 else ""
+            fqs = []
+            for f in flavors:
+                nominal = rng.choice(["2", "5", "10"])
+                borrowing = rng.choice([None, "0", "5", None])
+                lending = rng.choice([None, "1", None])
+                fqs.append(flavor_quotas(f, cpu=(nominal, borrowing, lending)))
+            cq_specs.append((f"cq{i}", cohort, fqs))
+
+        def setup(env):
+            for f in flavors:
+                env.add_flavor(f)
+            for name, cohort, fqs in cq_specs:
+                w = ClusterQueueWrapper(name)
+                if cohort:
+                    w = w.cohort(cohort)
+                env.add_cq(w.resource_group(*fqs).obj(), f"lq-{name}")
+
+        wl_specs = []
+        for i in range(rng.randint(3, 14)):
+            cq = rng.randrange(n_cqs)
+            wl_specs.append((f"w{i}", f"lq-cq{cq}", rng.randint(0, 3),
+                            float(i), rng.choice(["1", "2", "4", "7", "12"])))
+
+        def workloads():
+            return [WorkloadWrapper(name).queue(q).priority(p).creation(ts)
+                    .pod_set(count=1, cpu=cpu).obj()
+                    for name, q, p, ts, cpu in wl_specs]
+
+        assert_three_way(setup, workloads)
+
+    def test_kernel_level_agreement(self):
+        """Compare raw kernel outputs (incl. usage tensors) on an encoded
+        batch — stricter than the admitted-set comparison."""
+        import numpy as np
+        from kueue_tpu.solver import encode
+        from kueue_tpu.solver.kernel import solve_cycle, topo_to_device
+
+        def setup(env):
+            env.add_flavor("f0")
+            env.add_flavor("f1")
+            for name in ("a", "b", "c"):
+                env.add_cq(ClusterQueueWrapper(name).cohort("team")
+                           .resource_group(flavor_quotas("f0", cpu=("5", "5", "2")),
+                                           flavor_quotas("f1", cpu="5")).obj(),
+                           f"lq-{name}")
+
+        env = build_env(setup, solver=False)
+        rng = random.Random(7)
+        for i in range(10):
+            env.submit(WorkloadWrapper(f"w{i}").queue(f"lq-{rng.choice('abc')}")
+                       .priority(rng.randint(0, 2)).creation(float(i))
+                       .pod_set(count=1, cpu=rng.choice(["2", "4", "8"])).obj())
+        heads = env.queues.heads_nonblocking()
+        snapshot = env.cache.snapshot()
+        topo = encode.encode_topology(snapshot)
+        state = encode.encode_state(snapshot, topo)
+        batch = encode.encode_workloads(heads, snapshot, topo)
+
+        jit_out = solve_cycle(
+            topo_to_device(topo), state.usage, state.cohort_usage,
+            batch.requests, batch.podset_active, batch.wl_cq, batch.priority,
+            batch.timestamp, batch.eligible, batch.solvable, num_podsets=4)
+        nat_out = native.solve_cycle_native(
+            topo, state.usage, state.cohort_usage, batch.requests,
+            batch.podset_active, batch.wl_cq, batch.priority, batch.timestamp,
+            batch.eligible, batch.solvable)
+
+        for key in ("admitted", "fit", "borrows"):
+            assert np.array_equal(np.asarray(jit_out[key]), nat_out[key]), key
+        assert np.array_equal(np.asarray(jit_out["usage"]), nat_out["usage"])
+        assert np.array_equal(np.asarray(jit_out["cohort_usage"]),
+                              nat_out["cohort_usage"])
+        # chosen flavors must agree wherever a podset is active & admitted
+        jit_chosen = np.asarray(jit_out["chosen"])
+        mask = batch.podset_active[:, :, None] & \
+            np.asarray(jit_out["admitted"])[:, None, None] & \
+            (batch.requests > 0)
+        assert np.array_equal(jit_chosen[mask], nat_out["chosen"][mask])

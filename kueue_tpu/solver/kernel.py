@@ -196,6 +196,165 @@ def solve_cycle_impl(topo, usage, cohort_usage, requests, podset_active, wl_cq,
 solve_cycle = partial(jax.jit, static_argnames=("num_podsets",))(solve_cycle_impl)
 
 
+# ---------------------------------------------------------------------------
+# Cohort-parallel admit (v2): the TPU-first Phase B
+# ---------------------------------------------------------------------------
+#
+# The sequential admit loop only needs ordering *within* a conflict domain
+# (a cohort, or a standalone CQ): workloads in different domains touch
+# disjoint usage state, so their relative order cannot change any decision.
+# Reshaping the scan from W steps (2048 on the north-star shape) to
+# L = max-workloads-per-domain steps (~8-32) with all domains advancing in
+# parallel turns a latency-bound scalar loop into wide vector work — the
+# shape TPUs are built for. Decisions are bit-identical to the global
+# sequential scan (differentially tested).
+
+def solve_phase_a_impl(topo, usage, cohort_usage, requests, podset_active,
+                       wl_cq, eligible, solvable, num_podsets: int):
+    """Phase A only: flavor assignment. Returns
+    (fit[W], borrows[W], chosen[W,P,R], asg_usage[W,F,R])."""
+    W, P, R = requests.shape
+    F = eligible.shape[2]
+    avail = _available(topo["nominal"], topo["borrow_limit"], topo["guaranteed"],
+                       usage, topo["cohort_subtree"], cohort_usage,
+                       topo["cq_cohort"])
+    asg_usage = jnp.zeros((W, F, R), jnp.int64)
+    chosen_all = []
+    ok_all = jnp.ones(W, bool)
+    borrow_all = jnp.zeros(W, bool)
+    for p in range(num_podsets):
+        chosen_p, ok_p, borrow_p, additions = _choose_flavors_one_podset(
+            requests[:, p, :], eligible[:, p, :], wl_cq, usage, asg_usage,
+            avail, topo)
+        active = podset_active[:, p]
+        chosen_all.append(jnp.where(active[:, None], chosen_p, -1))
+        ok_all &= jnp.where(active, ok_p, True)
+        borrow_all |= jnp.where(active, borrow_p, False)
+        asg_usage += jnp.where(active[:, None, None], additions, 0)
+    chosen = jnp.stack(chosen_all, axis=1)
+    fit = ok_all & solvable & jnp.any(podset_active, axis=1)
+    return fit, borrow_all, chosen, asg_usage
+
+
+def solve_phase_b_domains_impl(topo, usage, cohort_usage, asg_usage, fit,
+                               wl_cq, order_grid):
+    """Phase B over an [L,D] order grid: row l holds the l-th workload of
+    every conflict domain (-1 = padding). Valid lanes in a row touch
+    pairwise-distinct CQs/cohorts, so one vectorized step admits a whole
+    row at once; rows advance sequentially, preserving each domain's
+    internal borrow->priority->FIFO order."""
+    W = fit.shape[0]
+
+    def admit_row(carry, idx_row):
+        usage_c, cohort_c, admitted = carry
+        valid = idx_row >= 0
+        w = jnp.maximum(idx_row, 0)                       # [D]
+        q = wl_cq[w]                                      # [D]
+        c_raw = topo["cq_cohort"][q]
+        c = jnp.maximum(c_raw, 0)
+        has_cohort = c_raw >= 0
+        au = asg_usage[w]                                 # [D,F,R]
+
+        nominal_q = topo["nominal"][q]
+        guar_q = topo["guaranteed"][q]
+        bl_q = topo["borrow_limit"][q]
+        usage_q = usage_c[q]
+        local = jnp.maximum(0, guar_q - usage_q)
+        parent_avail = topo["cohort_subtree"][c] - cohort_c[c]
+        cap = (nominal_q - guar_q) - jnp.maximum(0, usage_q - guar_q) + \
+            jnp.minimum(bl_q, NO_LIMIT // 4)
+        avail_q = jnp.where(has_cohort[:, None, None],
+                            local + jnp.minimum(parent_avail, cap),
+                            nominal_q - usage_q)
+
+        still_fits = jnp.all((au == 0) | (au <= avail_q), axis=(1, 2))
+        admit = fit[w] & still_fits & valid               # [D]
+        add = jnp.where(admit[:, None, None], au, 0)
+
+        # valid lanes have distinct q/c; padded lanes contribute zeros, so
+        # duplicate-index adds are harmless
+        new_usage_q = usage_q + add
+        old_over = jnp.maximum(0, usage_q - guar_q)
+        new_over = jnp.maximum(0, new_usage_q - guar_q)
+        usage_c = usage_c.at[q].add(add)
+        cohort_delta = jnp.where((has_cohort & admit)[:, None, None],
+                                 new_over - old_over, 0)
+        cohort_c = cohort_c.at[c].add(cohort_delta)
+        # max-scatter: duplicate padded w=0 lanes write 0, never clobber
+        admitted = admitted.at[w].max(admit.astype(jnp.int8))
+        return (usage_c, cohort_c, admitted), None
+
+    init = (usage, cohort_usage, jnp.zeros(W, jnp.int8))
+    (usage_out, cohort_out, admitted), _ = jax.lax.scan(
+        admit_row, init, order_grid)
+    return admitted.astype(bool), usage_out, cohort_out
+
+
+solve_phase_a = partial(jax.jit, static_argnames=("num_podsets",))(solve_phase_a_impl)
+solve_phase_b_domains = jax.jit(solve_phase_b_domains_impl)
+
+
+def build_order_grid(fit, borrows, priority, timestamp, wl_cq, cq_cohort,
+                     num_cohorts: int):
+    """Host-side: global admit order -> [L,D] grid of workload indices.
+
+    Domain = cohort, or a synthetic per-CQ domain for cohortless CQs.
+    Within each domain, workloads keep their global-order rank; rows pad
+    with -1. numpy only (runs between the two device calls)."""
+    import numpy as np
+    fit = np.asarray(fit)
+    borrows = np.asarray(borrows)
+    priority = np.asarray(priority)
+    timestamp = np.asarray(timestamp)
+    wl_cq = np.asarray(wl_cq)
+    cq_cohort = np.asarray(cq_cohort)
+
+    order = np.lexsort((timestamp, -priority, borrows.astype(np.int32),
+                        (~fit).astype(np.int32)))
+    order = order[fit[order]]  # non-fit entries can never admit
+    cohort_of_wl = cq_cohort[wl_cq]
+    # static domain space: all cohorts + one synthetic domain per CQ
+    # (stable D across cycles -> no jit recompilation)
+    domain = np.where(cohort_of_wl >= 0, cohort_of_wl,
+                      num_cohorts + wl_cq).astype(np.int64)
+    D = num_cohorts + len(cq_cohort)
+    # rank of each workload within its domain, in global order
+    ranks = np.empty(len(order), np.int64)
+    counters = np.zeros(D, np.int64)
+    dom_of_sorted = domain[order]
+    for pos, d in enumerate(dom_of_sorted):
+        ranks[pos] = counters[d]
+        counters[d] += 1
+    # bucket L to a power of two so repeated cycles reuse the compilation
+    raw_l = max(1, int(counters.max()))
+    L = 8
+    while L < raw_l:
+        L *= 2
+    grid = np.full((L, D), -1, np.int32)
+    grid[ranks, dom_of_sorted] = order.astype(np.int32)
+    return grid
+
+
+def solve_cycle_cohort_parallel(topo_dev, topo_np, usage, cohort_usage,
+                                requests, podset_active, wl_cq, priority,
+                                timestamp, eligible, solvable,
+                                num_podsets: int):
+    """The production single-chip path: Phase A on device, order grid on
+    host, cohort-parallel Phase B on device. Same outputs as solve_cycle."""
+    import numpy as np
+    fit, borrows, chosen, asg_usage = solve_phase_a(
+        topo_dev, usage, cohort_usage, requests, podset_active, wl_cq,
+        eligible, solvable, num_podsets=num_podsets)
+    grid = build_order_grid(fit, borrows, priority, timestamp,
+                            np.asarray(wl_cq), topo_np.cq_cohort,
+                            topo_np.cohort_subtree.shape[0])
+    admitted, usage_out, cohort_out = solve_phase_b_domains(
+        topo_dev, usage, cohort_usage, asg_usage, fit, wl_cq,
+        jnp.asarray(grid))
+    return {"admitted": admitted, "chosen": chosen, "borrows": borrows,
+            "fit": fit, "usage": usage_out, "cohort_usage": cohort_out}
+
+
 def topo_to_device(topo) -> dict:
     """numpy Topology arrays -> device dict for solve_cycle."""
     return {

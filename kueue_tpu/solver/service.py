@@ -27,7 +27,11 @@ from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.core.resources import FlavorResource
 from kueue_tpu.scheduler import flavorassigner as fa
 from kueue_tpu.solver import encode
-from kueue_tpu.solver.kernel import solve_cycle, topo_to_device
+from kueue_tpu.solver.kernel import (
+    solve_cycle,
+    solve_cycle_cohort_parallel,
+    topo_to_device,
+)
 
 
 class BatchSolver:
@@ -86,11 +90,13 @@ class BatchSolver:
                 result = solve_cycle_sharded(self.mesh, topo_dev, state, batch,
                                              self.max_podsets)
             else:
-                result = solve_cycle(
-                    topo_dev, state.usage, state.cohort_usage, batch.requests,
-                    batch.podset_active, batch.wl_cq, batch.priority,
-                    batch.timestamp, batch.eligible, batch.solvable,
-                    num_podsets=self.max_podsets)
+                # cohort-parallel Phase B: scan length = max workloads per
+                # conflict domain instead of the whole batch
+                result = solve_cycle_cohort_parallel(
+                    topo_dev, topo, state.usage, state.cohort_usage,
+                    batch.requests, batch.podset_active, batch.wl_cq,
+                    batch.priority, batch.timestamp, batch.eligible,
+                    batch.solvable, num_podsets=self.max_podsets)
 
         admitted = np.asarray(result["admitted"])
         fit = np.asarray(result["fit"])

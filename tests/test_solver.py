@@ -277,3 +277,42 @@ class TestShardedSolve:
                 env.submit(w)
             env.cycle()
         assert admitted_map(env_single) == admitted_map(env_sharded) == admitted_map(env_cpu)
+
+
+class TestCohortParallelKernel:
+    def test_matches_global_sequential_scan(self):
+        """solve_cycle (global W-step scan) and solve_cycle_cohort_parallel
+        (L-step domain-parallel scan) must produce identical tensors."""
+        import numpy as np
+        import jax.numpy as jnp
+        from kueue_tpu.solver.kernel import (
+            solve_cycle, solve_cycle_cohort_parallel, topo_to_device)
+        from kueue_tpu.solver.synth import synth_solver_inputs
+
+        for seed in range(6):
+            topo, usage, cohort_usage, wl = synth_solver_inputs(
+                num_cqs=24, num_cohorts=5, num_flavors=3, num_resources=2,
+                num_workloads=64, seed=seed)
+
+            class T:
+                pass
+            topo_np = T()
+            for k, v in topo.items():
+                setattr(topo_np, k, v)
+            topo_dev = {k: jnp.asarray(v) for k, v in topo.items()}
+            args = (jnp.asarray(wl["requests"]), jnp.asarray(wl["podset_active"]),
+                    jnp.asarray(wl["wl_cq"]), jnp.asarray(wl["priority"]),
+                    jnp.asarray(wl["timestamp"]), jnp.asarray(wl["eligible"]),
+                    jnp.asarray(wl["solvable"]))
+            seq = solve_cycle(topo_dev, jnp.asarray(usage),
+                              jnp.asarray(cohort_usage), *args, num_podsets=1)
+            par = solve_cycle_cohort_parallel(
+                topo_dev, topo_np, jnp.asarray(usage),
+                jnp.asarray(cohort_usage), *args, num_podsets=1)
+            for key in ("admitted", "fit", "borrows"):
+                assert np.array_equal(np.asarray(seq[key]),
+                                      np.asarray(par[key])), (key, seed)
+            assert np.array_equal(np.asarray(seq["usage"]),
+                                  np.asarray(par["usage"])), seed
+            assert np.array_equal(np.asarray(seq["cohort_usage"]),
+                                  np.asarray(par["cohort_usage"])), seed

@@ -28,6 +28,12 @@ from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.core.hierarchy import Manager as HierarchyManager
 
 
+def _iter_tree(cohort_snap):
+    yield cohort_snap
+    for child in cohort_snap.child_cohorts:
+        yield from _iter_tree(child)
+
+
 @dataclass
 class AdmissionCheckEntry:
     controller_name: str = ""
@@ -46,6 +52,9 @@ class Cache:
         self.assumed_workloads: dict = {}  # wl key -> cq name
         self.pods_ready_tracking = pods_ready_tracking
         self.excluded_resource_prefixes = excluded_resource_prefixes or []
+        # Bumped on cohort-object changes (re-parent, cohort quotas):
+        # structural edits invisible to per-CQ generations.
+        self.cohort_epoch = 0
 
     def _new_cohort(self, name: str) -> CohortCache:
         cohort = CohortCache(name)
@@ -119,19 +128,31 @@ class Cache:
 
     def add_or_update_cohort(self, cohort: api.Cohort) -> None:
         with self._lock:
+            self.cohort_epoch += 1
             node = self.hm.add_cohort(cohort.metadata.name)
             node.payload.resource_node.quotas = build_quotas(cohort.spec.resource_groups)
-            if cohort.spec.parent:
-                self.hm.update_cohort_edge(cohort.metadata.name, cohort.spec.parent)
+            old_root = node.payload.root()
+            self.hm.update_cohort_edge(cohort.metadata.name,
+                                       cohort.spec.parent or "")
+            # A re-parent detaches this subtree: refresh the old tree too.
+            if old_root.name != node.payload.root().name:
+                update_cohort_resource_node(old_root)
             update_cohort_resource_node(node.payload)
 
     def delete_cohort(self, name: str) -> None:
         with self._lock:
+            self.cohort_epoch += 1
             node = self.hm.cohorts.get(name)
-            if node is not None:
-                node.payload.resource_node.quotas = {}
-                update_cohort_resource_node(node.payload)
+            if node is None:
+                return
+            payload = node.payload
+            payload.resource_node.quotas = {}
+            old_root = payload.root()
             self.hm.delete_cohort(name)
+            if old_root is not payload:
+                update_cohort_resource_node(old_root)
+            if name in self.hm.cohorts:  # still referenced by CQs/children
+                update_cohort_resource_node(payload)
 
     # --- flavors & checks ---
 
@@ -330,14 +351,32 @@ class Cache:
                     continue
                 snap.cluster_queues[name] = ClusterQueueSnapshot(cqc)
             snap.resource_flavors = dict(self.resource_flavors)
+            cohort_snaps: dict = {}
             for cname, node in self.hm.cohorts.items():
                 cohort_snap = CohortSnapshot(cname, node.payload.resource_node.clone())
+                cohort_snaps[cname] = cohort_snap
                 for cqc in node.child_cqs.values():
                     if cqc.name in snap.cluster_queues:
                         cq_snap = snap.cluster_queues[cqc.name]
                         cq_snap.cohort = cohort_snap
                         cohort_snap.members.add(cq_snap)
                         cohort_snap.allocatable_resource_generation += cq_snap.allocatable_resource_generation
+            # Wire the cohort tree (hierarchical v1alpha1 cohorts).
+            for cname, node in self.hm.cohorts.items():
+                if node.parent is not None:
+                    parent_snap = cohort_snaps[node.parent.name]
+                    cohort_snaps[cname].parent = parent_snap
+                    parent_snap.child_cohorts.add(cohort_snaps[cname])
+            # Generation must invalidate across the whole borrowing domain:
+            # a capacity change anywhere in a tree affects every member, so
+            # every cohort in a tree carries the tree-wide aggregate.
+            for cs in cohort_snaps.values():
+                if cs.parent is None and cs.child_cohorts:
+                    total = sum(c.allocatable_resource_generation
+                                for c in _iter_tree(cs))
+                    for c in _iter_tree(cs):
+                        c.allocatable_resource_generation = total
+            snap.cohort_epoch = self.cohort_epoch
             return snap
 
     # --- usage reporting (status/metrics) ---

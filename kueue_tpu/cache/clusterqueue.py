@@ -52,21 +52,38 @@ def build_quotas(spec_groups: list) -> dict:
 
 
 class CohortCache:
-    """Cache-side cohort node (reference: pkg/cache/cohort.go)."""
+    """Cache-side cohort node (reference: pkg/cache/cohort.go). Supports
+    arbitrary-depth trees via the v1alpha1 Cohort parent edge
+    (cohort_types.go:26-100); quota math walks the chain
+    (resource_node.go:89-146)."""
 
     def __init__(self, name: str):
         self.name = name
         self.resource_node = rnode.ResourceNode()
         self.manager = None  # set by Cache
 
+    def _node(self):
+        return self.manager.cohorts.get(self.name) if self.manager else None
+
     def parent_node(self) -> Optional["CohortCache"]:
-        # v1beta1 cohorts are flat in the quota tree; hierarchical (alpha)
-        # Cohort parents are flattened into the root during update.
-        return None
+        node = self._node()
+        if node is None or node.parent is None:
+            return None
+        return node.parent.payload
+
+    def root(self) -> "CohortCache":
+        c = self
+        while (p := c.parent_node()) is not None:
+            c = p
+        return c
 
     def child_cqs(self) -> list:
-        node = self.manager.cohorts.get(self.name) if self.manager else None
+        node = self._node()
         return list(node.child_cqs.values()) if node else []
+
+    def child_cohorts(self) -> list:
+        node = self._node()
+        return [n.payload for n in node.child_cohorts.values()] if node else []
 
 
 class ClusterQueueCache:
@@ -229,17 +246,32 @@ def update_cluster_queue_resource_node(cq: ClusterQueueCache) -> None:
 
 
 def update_cohort_resource_node(cohort: CohortCache) -> None:
-    """Cohort subtree quota/usage aggregation over child CQs
-    (reference: resource_node.go:163-179)."""
+    """Recompute subtree quotas/usage for the whole tree containing
+    `cohort` (reference: resource_node.go:163-179, extended recursively
+    over child cohorts for hierarchical v1alpha1 cohorts)."""
+    _update_cohort_subtree(cohort.root())
+
+
+def _update_cohort_subtree(cohort: CohortCache) -> None:
+    """Post-order: children's subtree quotas feed the parent; a child's
+    lendable capacity is its subtree quota minus its guaranteed quota, and
+    only over-guaranteed usage bubbles up."""
     rn = cohort.resource_node
     rn.subtree_quota = {fr: q.nominal for fr, q in rn.quotas.items()}
     rn.usage = {}
-    for child in cohort.child_cqs():
-        update_cluster_queue_resource_node(child)
-        for fr, child_quota in child.resource_node.subtree_quota.items():
+
+    def _fold(child_rn: rnode.ResourceNode) -> None:
+        for fr, child_quota in child_rn.subtree_quota.items():
             rn.subtree_quota[fr] = (rn.subtree_quota.get(fr, 0)
-                                    + child_quota - child.resource_node.guaranteed_quota(fr))
-        for fr, child_usage in child.resource_node.usage.items():
-            over = max(0, child_usage - child.resource_node.guaranteed_quota(fr))
+                                    + child_quota - child_rn.guaranteed_quota(fr))
+        for fr, child_usage in child_rn.usage.items():
+            over = max(0, child_usage - child_rn.guaranteed_quota(fr))
             if over:
                 rn.usage[fr] = rn.usage.get(fr, 0) + over
+
+    for child in cohort.child_cohorts():
+        _update_cohort_subtree(child)
+        _fold(child.resource_node)
+    for child in cohort.child_cqs():
+        update_cluster_queue_resource_node(child)
+        _fold(child.resource_node)

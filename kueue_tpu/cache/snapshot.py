@@ -21,11 +21,26 @@ class CohortSnapshot:
     def __init__(self, name: str, resource_node: rnode.ResourceNode):
         self.name = name
         self.resource_node = resource_node
-        self.members: set = set()  # ClusterQueueSnapshot
+        self.members: set = set()  # direct ClusterQueueSnapshot children
+        self.child_cohorts: set = set()  # direct CohortSnapshot children
+        self.parent: Optional["CohortSnapshot"] = None
         self.allocatable_resource_generation = 0
 
-    def parent_node(self) -> None:
-        return None
+    def parent_node(self) -> Optional["CohortSnapshot"]:
+        return self.parent
+
+    def root(self) -> "CohortSnapshot":
+        c = self
+        while c.parent is not None:
+            c = c.parent
+        return c
+
+    def subtree_cqs(self):
+        """All member CQs in this cohort's subtree (the borrowing domain
+        for hierarchical cohorts)."""
+        yield from self.members
+        for child in self.child_cohorts:
+            yield from child.subtree_cqs()
 
 
 class ClusterQueueSnapshot:
@@ -113,7 +128,10 @@ def dominant_resource_share(cq: ClusterQueueSnapshot, wl_req: Optional[dict], m:
             borrowing[fr.resource] = borrowing.get(fr.resource, 0) + b
     if not borrowing:
         return 0, ""
-    lendable = cq.cohort.resource_node.calculate_lendable()
+    # The borrowing domain is the whole cohort tree: the denominator is
+    # the root's lendable capacity so shares are comparable across
+    # subtrees (flat cohorts: root() is the cohort itself).
+    lendable = cq.cohort.root().resource_node.calculate_lendable()
     drs, d_res = -1, ""
     for r_name in sorted(borrowing):
         lr = lendable.get(r_name, 0)
@@ -137,6 +155,7 @@ class Snapshot:
     cluster_queues: dict = field(default_factory=dict)  # name -> ClusterQueueSnapshot
     resource_flavors: dict = field(default_factory=dict)  # name -> ResourceFlavor
     inactive_cluster_queue_sets: set = field(default_factory=set)
+    cohort_epoch: int = 0  # cohort-object structure version (Cache.cohort_epoch)
 
     def remove_workload(self, wl: wlpkg.Info) -> None:
         """Simulate removal (reference: snapshot.go:39)."""

@@ -111,7 +111,24 @@ def setup_core_controllers(runtime: Runtime, store: Store, queues, cache,
                    for fq in rg.flavors):
                 cq_ctrl.enqueue(cq.metadata.name)
 
+    def on_cohort(event, cohort, old):
+        # v1alpha1 Cohort objects: parent edges + own quotas feed the
+        # cache's cohort tree (reference: the cohort controller wiring in
+        # cache.AddOrUpdateCohort, pkg/cache/cache.go:418). A topology
+        # change can unblock any parked workload whose CQ sits in a
+        # cohort, so flush those queues (cohort events are rare).
+        if event == DELETED:
+            cache.delete_cohort(cohort.metadata.name)
+        else:
+            cache.add_or_update_cohort(cohort)
+        names = {name for name, cqc in cache.hm.cluster_queues.items()
+                 if cqc.cohort is not None}
+        queues.queue_inadmissible_workloads(names)
+        for n in names:
+            cq_ctrl.enqueue(n)
+
     store.watch("Workload", on_workload)
+    store.watch("Cohort", on_cohort)
     store.watch("ClusterQueue", on_cluster_queue)
     store.watch("LocalQueue", on_local_queue)
     store.watch("AdmissionCheck", on_admission_check)

@@ -38,8 +38,10 @@ def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int):
              priority, timestamp, eligible, solvable):
         dev = jax.lax.axis_index(axis)
         cohort_of_wl = topo_["cq_cohort"][wl_cq]
-        # capacity domain id: cohort index, or C + cq index for lone CQs
-        domain = jnp.where(cohort_of_wl >= 0, cohort_of_wl,
+        root_of_wl = topo_["cohort_root"][jnp.maximum(cohort_of_wl, 0)]
+        # capacity domain id: root cohort index (whole tree = one
+        # domain), or C + cq index for lone CQs
+        domain = jnp.where(cohort_of_wl >= 0, root_of_wl,
                            C + wl_cq.astype(jnp.int32))
         mine = (domain % n_dev) == dev
         res = solve_cycle_impl(topo_, usage, cohort_usage, requests,

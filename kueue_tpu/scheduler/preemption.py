@@ -161,7 +161,10 @@ class Preemptor:
 
         if cq.cohort is not None and preemption.reclaim_within_cohort != api.PREEMPTION_NEVER:
             only_lower = preemption.reclaim_within_cohort != api.PREEMPTION_ANY
-            for cohort_cq in cq.cohort.members:
+            # The borrowing domain spans the whole cohort tree for
+            # hierarchical cohorts (root's subtree), which reduces to the
+            # flat member set for single-level cohorts.
+            for cohort_cq in cq.cohort.root().subtree_cqs():
                 if cohort_cq is cq or not cq_is_borrowing(cohort_cq, frs_need_preemption):
                     continue
                 for cand in cohort_cq.workloads.values():

@@ -61,6 +61,15 @@ class Topology:
     covers_pods: np.ndarray = None        # [Q] bool — CQ has a "pods" resource group
     prefer_no_borrow: np.ndarray = None   # [Q] bool — whenCanBorrow == TryNextFlavor
     cohort_subtree: np.ndarray = None     # [C,F,R] int64
+    # Hierarchical cohorts (reference: resource_node.go:89-146; the alpha
+    # Cohort CRD forms arbitrary-depth trees, cohort_types.go:26-100):
+    cohort_parent: np.ndarray = None      # [C] int32, -1 = root
+    cohort_depth: np.ndarray = None       # [C] int32, root = 0
+    cohort_root: np.ndarray = None        # [C] int32 — root cohort index
+    cohort_guaranteed: np.ndarray = None  # [C,F,R] int64 (subtree - lending cap)
+    cohort_borrow_limit: np.ndarray = None  # [C,F,R] int64 (BIG = unlimited)
+    cq_chain: np.ndarray = None           # [Q,DC] int32 — cohort ancestor chain
+                                          #   (direct cohort first; -1 padding)
     cq_index: dict = field(default_factory=dict)
     flavor_index: dict = field(default_factory=dict)
     resource_index: dict = field(default_factory=dict)
@@ -87,6 +96,26 @@ class WorkloadBatch:
     solvable: np.ndarray = None        # [W] bool — encodable by the solver
 
 
+def iter_cohorts(snapshot: Snapshot) -> dict:
+    """name -> CohortSnapshot for every cohort reachable from any CQ
+    (whole trees, including quota-only intermediate nodes)."""
+    out: dict = {}
+
+    def visit(c):
+        if c.name in out:
+            return
+        out[c.name] = c
+        if c.parent is not None:
+            visit(c.parent)
+        for child in c.child_cohorts:
+            visit(child)
+
+    for cq in snapshot.cluster_queues.values():
+        if cq.cohort is not None:
+            visit(cq.cohort)
+    return out
+
+
 def encode_topology(snapshot: Snapshot) -> Topology:
     topo = Topology()
     res_set, flavor_set = set(), set()
@@ -97,9 +126,8 @@ def encode_topology(snapshot: Snapshot) -> Topology:
     topo.resources = sorted(res_set)
     topo.flavors = sorted(flavor_set)
     topo.cq_names = sorted(snapshot.cluster_queues)
-    cohort_set = {cq.cohort.name for cq in snapshot.cluster_queues.values()
-                  if cq.cohort is not None}
-    topo.cohort_names = sorted(cohort_set)
+    cohort_objs = iter_cohorts(snapshot)
+    topo.cohort_names = sorted(cohort_objs)
     topo.resource_index = {r: i for i, r in enumerate(topo.resources)}
     topo.flavor_index = {f: i for i, f in enumerate(topo.flavors)}
     topo.cq_index = {c: i for i, c in enumerate(topo.cq_names)}
@@ -121,11 +149,53 @@ def encode_topology(snapshot: Snapshot) -> Topology:
     topo.covers_pods = np.zeros(Q, bool)
     topo.prefer_no_borrow = np.zeros(Q, bool)
     topo.cohort_subtree = np.zeros((C, F, R), np.int64)
+    topo.cohort_parent = np.full(C, -1, np.int32)
+    topo.cohort_depth = np.zeros(C, np.int32)
+    topo.cohort_root = np.arange(C, dtype=np.int32)
+    topo.cohort_guaranteed = np.zeros((C, F, R), np.int64)
+    topo.cohort_borrow_limit = np.full((C, F, R), BIG, np.int64)
+
+    for cname, cobj in cohort_objs.items():
+        ci = cohort_index[cname]
+        if cobj.parent is not None:
+            topo.cohort_parent[ci] = cohort_index[cobj.parent.name]
+        rn = cobj.resource_node
+        for fr, q in rn.subtree_quota.items():
+            fi = topo.flavor_index.get(fr.flavor)
+            ri = topo.resource_index.get(fr.resource)
+            if fi is not None and ri is not None:
+                topo.cohort_subtree[ci, fi, ri] = q
+                topo.cohort_guaranteed[ci, fi, ri] = rn.guaranteed_quota(fr)
+        for fr, quota in rn.quotas.items():
+            fi = topo.flavor_index.get(fr.flavor)
+            ri = topo.resource_index.get(fr.resource)
+            if fi is not None and ri is not None and quota.borrowing_limit is not None:
+                topo.cohort_borrow_limit[ci, fi, ri] = quota.borrowing_limit
+    # depth + root by chasing parents (trees are cycle-checked upstream)
+    for cname in topo.cohort_names:
+        ci = cohort_index[cname]
+        depth, node = 0, cohort_objs[cname]
+        while node.parent is not None:
+            depth += 1
+            node = node.parent
+        topo.cohort_depth[ci] = depth
+        topo.cohort_root[ci] = cohort_index[node.name]
+    # per-CQ ancestor chain, direct cohort first (static max depth)
+    max_chain = 1
+    for cq in snapshot.cluster_queues.values():
+        if cq.cohort is not None:
+            max_chain = max(max_chain,
+                            int(topo.cohort_depth[cohort_index[cq.cohort.name]]) + 1)
+    topo.cq_chain = np.full((Q, max_chain), -1, np.int32)
 
     for qname, cq in snapshot.cluster_queues.items():
         qi = topo.cq_index[qname]
         if cq.cohort is not None:
             topo.cq_cohort[qi] = cohort_index[cq.cohort.name]
+            node, d = cq.cohort, 0
+            while node is not None:
+                topo.cq_chain[qi, d] = cohort_index[node.name]
+                node, d = node.parent, d + 1
         topo.prefer_no_borrow[qi] = (cq.flavor_fungibility.when_can_borrow
                                      == api.TRY_NEXT_FLAVOR)
         for gi, rg in enumerate(cq.resource_groups):
@@ -146,13 +216,6 @@ def encode_topology(snapshot: Snapshot) -> Topology:
                     if quota.borrowing_limit is not None:
                         topo.borrow_limit[qi, fi, ri] = quota.borrowing_limit
                     topo.guaranteed[qi, fi, ri] = cq.resource_node.guaranteed_quota(fr)
-        if cq.cohort is not None:
-            ci = cohort_index[cq.cohort.name]
-            for fr, q in cq.cohort.resource_node.subtree_quota.items():
-                fi = topo.flavor_index.get(fr.flavor)
-                ri = topo.resource_index.get(fr.resource)
-                if fi is not None and ri is not None:
-                    topo.cohort_subtree[ci, fi, ri] = q
     return topo
 
 
@@ -162,7 +225,6 @@ def encode_state(snapshot: Snapshot, topo: Topology) -> State:
     state = State(usage=np.zeros((Q, F, R), np.int64),
                   cohort_usage=np.zeros((C, F, R), np.int64))
     cohort_index = {c: i for i, c in enumerate(topo.cohort_names)}
-    seen_cohorts = set()
     for qname, cq in snapshot.cluster_queues.items():
         qi = topo.cq_index[qname]
         for fr, used in cq.resource_node.usage.items():
@@ -170,14 +232,15 @@ def encode_state(snapshot: Snapshot, topo: Topology) -> State:
             ri = topo.resource_index.get(fr.resource)
             if fi is not None and ri is not None:
                 state.usage[qi, fi, ri] = used
-        if cq.cohort is not None and cq.cohort.name not in seen_cohorts:
-            seen_cohorts.add(cq.cohort.name)
-            ci = cohort_index[cq.cohort.name]
-            for fr, used in cq.cohort.resource_node.usage.items():
-                fi = topo.flavor_index.get(fr.flavor)
-                ri = topo.resource_index.get(fr.resource)
-                if fi is not None and ri is not None:
-                    state.cohort_usage[ci, fi, ri] = used
+    for cname, cobj in iter_cohorts(snapshot).items():
+        ci = cohort_index.get(cname)
+        if ci is None:
+            continue
+        for fr, used in cobj.resource_node.usage.items():
+            fi = topo.flavor_index.get(fr.flavor)
+            ri = topo.resource_index.get(fr.resource)
+            if fi is not None and ri is not None:
+                state.cohort_usage[ci, fi, ri] = used
     return state
 
 

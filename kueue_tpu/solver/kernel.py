@@ -36,21 +36,93 @@ jax.config.update("jax_enable_x64", True)
 NO_LIMIT = 2**62
 
 
-def _available(nominal, borrow_limit, guaranteed, usage, cohort_subtree,
-               cohort_usage, cq_cohort):
-    """available[Q,F,R] (reference: resource_node.go:89-104, flattened to
-    the CQ->cohort two-level tree the snapshot uses)."""
-    no_cohort_avail = nominal - usage
+def _avail_level(quota, guaranteed, borrow_limit, usage, parent_avail):
+    """One level of the availability walk (reference:
+    resource_node.go:89-104): guaranteed remainder plus the
+    borrow-limit-capped parent availability. `quota` is the node's subtree
+    quota (nominal for CQs)."""
     local = jnp.maximum(0, guaranteed - usage)
+    cap = (quota - guaranteed) - jnp.maximum(0, usage - guaranteed) + \
+        jnp.minimum(borrow_limit, NO_LIMIT // 4)
+    return local + jnp.minimum(parent_avail, cap)
+
+
+def _cohort_avail(topo, cohort_usage):
+    """available[C,F,R] for every cohort, walking parent chains top-down
+    (reference: resource_node.go:89-104). Roots use subtree - usage; each
+    deeper level adds its guaranteed remainder plus the borrow-limit-capped
+    parent availability. The depth loop is statically unrolled to the
+    tree's max depth (cq_chain.shape[1])."""
+    subtree = topo["cohort_subtree"]
+    guar = topo["cohort_guaranteed"]
+    bl = topo["cohort_borrow_limit"]
+    parent = jnp.maximum(topo["cohort_parent"], 0)
+    depth = topo["cohort_depth"]
+    max_depth = topo["cq_chain"].shape[1]
+    avail = subtree - cohort_usage
+    for d in range(1, max_depth):
+        with_parent = _avail_level(subtree, guar, bl, cohort_usage,
+                                   avail[parent])
+        avail = jnp.where((depth == d)[:, None, None], with_parent, avail)
+    return avail
+
+
+def _available(nominal, borrow_limit, guaranteed, usage, cohort_avail,
+               cq_cohort):
+    """available[Q,F,R] (reference: resource_node.go:89-104); the cohort
+    side of the walk is precomputed in cohort_avail."""
+    no_cohort_avail = nominal - usage
     c_idx = jnp.maximum(cq_cohort, 0)
-    parent_avail = (cohort_subtree[c_idx] - cohort_usage[c_idx])
-    stored_in_parent = nominal - guaranteed
-    used_in_parent = jnp.maximum(0, usage - guaranteed)
-    cap = stored_in_parent - used_in_parent + jnp.minimum(borrow_limit, NO_LIMIT // 4)
-    parent_capped = jnp.minimum(parent_avail, cap)
-    with_cohort = local + parent_capped
+    with_cohort = _avail_level(nominal, guaranteed, borrow_limit, usage,
+                               cohort_avail[c_idx])
     has_cohort = (cq_cohort >= 0)[:, None, None]
     return jnp.where(has_cohort, with_cohort, no_cohort_avail)
+
+
+def _chain_avail(topo, cohort_c, chain):
+    """Availability of the chain's direct cohort (chain[..., 0]) given the
+    running cohort usage state. chain: [..., DC] int32, -1 padded past the
+    root. Walks top-down: the first valid index from the end is the root."""
+    lead_shape = chain.shape[:-1]
+    DC = chain.shape[-1]
+    F, R = topo["cohort_subtree"].shape[1:]
+    avail = jnp.zeros(lead_shape + (F, R), jnp.int64)
+    started = jnp.zeros(lead_shape, bool)
+    for d in range(DC - 1, -1, -1):
+        c = chain[..., d]
+        valid = c >= 0
+        c_ = jnp.maximum(c, 0)
+        cu = cohort_c[c_]
+        subtree = topo["cohort_subtree"][c_]
+        root_avail = subtree - cu
+        child_avail = _avail_level(subtree, topo["cohort_guaranteed"][c_],
+                                   topo["cohort_borrow_limit"][c_], cu, avail)
+        new_avail = jnp.where(started[..., None, None], child_avail, root_avail)
+        avail = jnp.where(valid[..., None, None], new_avail, avail)
+        started = started | valid
+    return avail
+
+
+def _chain_add_usage(topo, cohort_c, chain, delta):
+    """Bubble a usage delta up the cohort chain (reference:
+    resource_node.go:124-131): each level absorbs up to its guaranteed
+    quota; the overflow continues to the parent. chain: [..., DC]; delta:
+    [..., F, R] (zero where nothing was admitted). Chains updated in one
+    call must touch pairwise-disjoint cohort trees when lead dims > 0."""
+    DC = chain.shape[-1]
+    for d in range(DC):
+        c = chain[..., d]
+        valid = c >= 0
+        c_ = jnp.maximum(c, 0)
+        add = jnp.where(valid[..., None, None], delta, 0)
+        old_cu = cohort_c[c_]
+        new_cu = old_cu + add
+        cohort_c = cohort_c.at[c_].add(add)
+        guar = topo["cohort_guaranteed"][c_]
+        delta = jnp.where(valid[..., None, None],
+                          jnp.maximum(0, new_cu - guar)
+                          - jnp.maximum(0, old_cu - guar), 0)
+    return cohort_c
 
 
 def _choose_flavors_one_podset(req_p, eligible_p, wl_cq, usage, asg_usage,
@@ -129,9 +201,9 @@ def solve_cycle_impl(topo, usage, cohort_usage, requests, podset_active, wl_cq,
     W, P, R = requests.shape
     F = eligible.shape[2]
 
+    cohort_avail = _cohort_avail(topo, cohort_usage)
     avail = _available(topo["nominal"], topo["borrow_limit"], topo["guaranteed"],
-                       usage, topo["cohort_subtree"], cohort_usage,
-                       topo["cq_cohort"])
+                       usage, cohort_avail, topo["cq_cohort"])
 
     # --- Phase A: flavor assignment (podsets accumulate within a workload) ---
     asg_usage = jnp.zeros((W, F, R), jnp.int64)
@@ -159,19 +231,18 @@ def solve_cycle_impl(topo, usage, cohort_usage, requests, podset_active, wl_cq,
     def admit_step(carry, w_idx):
         usage_c, cohort_c, admitted = carry
         q = wl_cq[w_idx]
-        c = jnp.maximum(topo["cq_cohort"][q], 0)
+        chain = topo["cq_chain"][q]  # [DC]
         has_cohort = topo["cq_cohort"][q] >= 0
         au = asg_usage[w_idx]  # [F,R]
 
         # Single-CQ availability (cheaper than re-deriving all of [Q,F,R]):
         nominal_q = topo["nominal"][q]
         guar_q = topo["guaranteed"][q]
-        bl_q = topo["borrow_limit"][q]
-        local = jnp.maximum(0, guar_q - usage_c[q])
-        parent_avail = topo["cohort_subtree"][c] - cohort_c[c]
-        cap = (nominal_q - guar_q) - jnp.maximum(0, usage_c[q] - guar_q) + \
-            jnp.minimum(bl_q, NO_LIMIT // 4)
-        avail_q = jnp.where(has_cohort, local + jnp.minimum(parent_avail, cap),
+        parent_avail = _chain_avail(topo, cohort_c, chain)
+        avail_q = jnp.where(has_cohort,
+                            _avail_level(nominal_q, guar_q,
+                                         topo["borrow_limit"][q],
+                                         usage_c[q], parent_avail),
                             nominal_q - usage_c[q])
 
         still_fits = jnp.all((au == 0) | (au <= avail_q))
@@ -182,7 +253,7 @@ def solve_cycle_impl(topo, usage, cohort_usage, requests, podset_active, wl_cq,
         new_over = jnp.maximum(0, new_usage_q - guar_q)
         usage_c = usage_c.at[q].set(new_usage_q)
         cohort_delta = jnp.where(has_cohort & admit, new_over - old_over, 0)
-        cohort_c = cohort_c.at[c].add(cohort_delta)
+        cohort_c = _chain_add_usage(topo, cohort_c, chain, cohort_delta)
         admitted = admitted.at[w_idx].set(admit)
         return (usage_c, cohort_c, admitted), None
 
@@ -215,9 +286,9 @@ def solve_phase_a_impl(topo, usage, cohort_usage, requests, podset_active,
     (fit[W], borrows[W], chosen[W,P,R], asg_usage[W,F,R])."""
     W, P, R = requests.shape
     F = eligible.shape[2]
+    cohort_avail = _cohort_avail(topo, cohort_usage)
     avail = _available(topo["nominal"], topo["borrow_limit"], topo["guaranteed"],
-                       usage, topo["cohort_subtree"], cohort_usage,
-                       topo["cq_cohort"])
+                       usage, cohort_avail, topo["cq_cohort"])
     asg_usage = jnp.zeros((W, F, R), jnp.int64)
     chosen_all = []
     ok_all = jnp.ones(W, bool)
@@ -250,36 +321,33 @@ def solve_phase_b_domains_impl(topo, usage, cohort_usage, asg_usage, fit,
         valid = idx_row >= 0
         w = jnp.maximum(idx_row, 0)                       # [D]
         q = wl_cq[w]                                      # [D]
-        c_raw = topo["cq_cohort"][q]
-        c = jnp.maximum(c_raw, 0)
-        has_cohort = c_raw >= 0
+        chain = topo["cq_chain"][q]                       # [D,DC]
+        has_cohort = topo["cq_cohort"][q] >= 0
         au = asg_usage[w]                                 # [D,F,R]
 
         nominal_q = topo["nominal"][q]
         guar_q = topo["guaranteed"][q]
-        bl_q = topo["borrow_limit"][q]
         usage_q = usage_c[q]
-        local = jnp.maximum(0, guar_q - usage_q)
-        parent_avail = topo["cohort_subtree"][c] - cohort_c[c]
-        cap = (nominal_q - guar_q) - jnp.maximum(0, usage_q - guar_q) + \
-            jnp.minimum(bl_q, NO_LIMIT // 4)
+        parent_avail = _chain_avail(topo, cohort_c, chain)
         avail_q = jnp.where(has_cohort[:, None, None],
-                            local + jnp.minimum(parent_avail, cap),
+                            _avail_level(nominal_q, guar_q,
+                                         topo["borrow_limit"][q],
+                                         usage_q, parent_avail),
                             nominal_q - usage_q)
 
         still_fits = jnp.all((au == 0) | (au <= avail_q), axis=(1, 2))
         admit = fit[w] & still_fits & valid               # [D]
         add = jnp.where(admit[:, None, None], au, 0)
 
-        # valid lanes have distinct q/c; padded lanes contribute zeros, so
-        # duplicate-index adds are harmless
+        # valid lanes have distinct CQs/cohort trees; padded lanes
+        # contribute zeros, so duplicate-index adds are harmless
         new_usage_q = usage_q + add
         old_over = jnp.maximum(0, usage_q - guar_q)
         new_over = jnp.maximum(0, new_usage_q - guar_q)
         usage_c = usage_c.at[q].add(add)
         cohort_delta = jnp.where((has_cohort & admit)[:, None, None],
                                  new_over - old_over, 0)
-        cohort_c = cohort_c.at[c].add(cohort_delta)
+        cohort_c = _chain_add_usage(topo, cohort_c, chain, cohort_delta)
         # max-scatter: duplicate padded w=0 lanes write 0, never clobber
         admitted = admitted.at[w].max(admit.astype(jnp.int8))
         return (usage_c, cohort_c, admitted), None
@@ -295,12 +363,13 @@ solve_phase_b_domains = jax.jit(solve_phase_b_domains_impl)
 
 
 def build_order_grid(fit, borrows, priority, timestamp, wl_cq, cq_cohort,
-                     num_cohorts: int):
+                     num_cohorts: int, cohort_root=None):
     """Host-side: global admit order -> [L,D] grid of workload indices.
 
-    Domain = cohort, or a synthetic per-CQ domain for cohortless CQs.
-    Within each domain, workloads keep their global-order rank; rows pad
-    with -1. numpy only (runs between the two device calls)."""
+    Domain = root cohort (the whole tree is one capacity domain for
+    hierarchical cohorts), or a synthetic per-CQ domain for cohortless
+    CQs. Within each domain, workloads keep their global-order rank; rows
+    pad with -1. numpy only (runs between the two device calls)."""
     import numpy as np
     fit = np.asarray(fit)
     borrows = np.asarray(borrows)
@@ -313,6 +382,10 @@ def build_order_grid(fit, borrows, priority, timestamp, wl_cq, cq_cohort,
                         (~fit).astype(np.int32)))
     order = order[fit[order]]  # non-fit entries can never admit
     cohort_of_wl = cq_cohort[wl_cq]
+    if cohort_root is not None:
+        cohort_of_wl = np.where(cohort_of_wl >= 0,
+                                np.asarray(cohort_root)[np.maximum(cohort_of_wl, 0)],
+                                -1)
     # static domain space: all cohorts + one synthetic domain per CQ
     # (stable D across cycles -> no jit recompilation)
     domain = np.where(cohort_of_wl >= 0, cohort_of_wl,
@@ -347,7 +420,8 @@ def solve_cycle_cohort_parallel(topo_dev, topo_np, usage, cohort_usage,
         eligible, solvable, num_podsets=num_podsets)
     grid = build_order_grid(fit, borrows, priority, timestamp,
                             np.asarray(wl_cq), topo_np.cq_cohort,
-                            topo_np.cohort_subtree.shape[0])
+                            topo_np.cohort_subtree.shape[0],
+                            cohort_root=topo_np.cohort_root)
     admitted, usage_out, cohort_out = solve_phase_b_domains(
         topo_dev, usage, cohort_usage, asg_usage, fit, wl_cq,
         jnp.asarray(grid))
@@ -368,4 +442,10 @@ def topo_to_device(topo) -> dict:
         "flavor_rank": jnp.asarray(topo.flavor_rank),
         "prefer_no_borrow": jnp.asarray(topo.prefer_no_borrow),
         "cohort_subtree": jnp.asarray(topo.cohort_subtree),
+        "cohort_parent": jnp.asarray(topo.cohort_parent),
+        "cohort_depth": jnp.asarray(topo.cohort_depth),
+        "cohort_root": jnp.asarray(topo.cohort_root),
+        "cohort_guaranteed": jnp.asarray(topo.cohort_guaranteed),
+        "cohort_borrow_limit": jnp.asarray(topo.cohort_borrow_limit),
+        "cq_chain": jnp.asarray(topo.cq_chain),
     }

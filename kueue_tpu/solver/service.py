@@ -50,7 +50,9 @@ class BatchSolver:
     # --- encoding with topology caching across cycles ---
 
     def _topology(self, snapshot: Snapshot):
-        key = tuple(sorted(
+        # cohort_epoch: cohort re-parents / quota edits don't bump any
+        # CQ's generation but change the encoded tree.
+        key = (snapshot.cohort_epoch,) + tuple(sorted(
             (name, cq.allocatable_resource_generation)
             for name, cq in snapshot.cluster_queues.items()))
         if key != self._topo_key:
@@ -78,7 +80,10 @@ class BatchSolver:
             return {}
 
         result = None
-        if self.backend == "native" and self.mesh is None:
+        # The native ABI encodes the flat (single-level) cohort forest;
+        # nested trees go through the jit path's chain walk.
+        if (self.backend == "native" and self.mesh is None
+                and topo.cq_chain.shape[1] == 1):
             from kueue_tpu import native
             result = native.solve_cycle_native(
                 topo, state.usage, state.cohort_usage, batch.requests,

@@ -33,6 +33,13 @@ def synth_solver_inputs(num_cqs: int = 256, num_cohorts: int = 32,
         "flavor_rank": np.tile(np.arange(F, dtype=np.int32), (Q, 1)),
         "prefer_no_borrow": np.zeros(Q, bool),
         "cohort_subtree": np.zeros((C, F, R), np.int64),
+        # flat (single-level) cohort forest
+        "cohort_parent": np.full(C, -1, np.int32),
+        "cohort_depth": np.zeros(C, np.int32),
+        "cohort_root": np.arange(C, dtype=np.int32),
+        "cohort_guaranteed": np.zeros((C, F, R), np.int64),
+        "cohort_borrow_limit": np.full((C, F, R), 2**62, np.int64),
+        "cq_chain": (np.arange(Q) % C).astype(np.int32).reshape(Q, 1),
     }
     for c in range(C):
         members = topo["cq_cohort"] == c

@@ -1,0 +1,301 @@
+"""Hierarchical (nested) cohort trees: cache math, scheduler borrowing,
+preemption reclaim, and solver differential conformance.
+
+The v1alpha1 Cohort CRD forms arbitrary-depth trees
+(reference: apis/kueue/v1alpha1/cohort_types.go:26-100); quota math walks
+the chain to the root (reference: pkg/cache/resource_node.go:89-146).
+"""
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.core.resources import FlavorResource
+from tests.test_scheduler import Env
+from tests.test_solver import admitted_map, assert_differential, build_env
+from tests.wrappers import ClusterQueueWrapper, WorkloadWrapper, flavor_quotas
+
+CPU = "cpu"
+FR = FlavorResource("default", CPU)
+
+
+def three_level_env(env):
+    """root <- {left, right}; a under left, b under right (quota only on
+    the CQs: each subtree lends everything)."""
+    env.add_flavor("default")
+    env.add_cohort("root")
+    env.add_cohort("left", "root")
+    env.add_cohort("right", "root")
+    env.add_cq(ClusterQueueWrapper("a").cohort("left")
+               .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq-a")
+    env.add_cq(ClusterQueueWrapper("b").cohort("right")
+               .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq-b")
+
+
+class TestNestedCohortCache:
+    def test_subtree_quota_aggregation(self):
+        env = Env()
+        three_level_env(env)
+        hm = env.cache.hm
+        root = hm.cohorts["root"].payload
+        left = hm.cohorts["left"].payload
+        assert left.resource_node.subtree_quota[FR] == 10000
+        assert root.resource_node.subtree_quota[FR] == 20000
+
+    def test_usage_bubbles_to_root(self):
+        env = Env()
+        three_level_env(env)
+        wl = (WorkloadWrapper("w").queue("lq-a").pod_set(count=1, cpu="14")
+              .reserve("a").obj())
+        env.cache.add_or_update_workload(wl)
+        hm = env.cache.hm
+        # a has guaranteed 0 => all 14 bubble into left, then root
+        assert hm.cohorts["left"].payload.resource_node.usage[FR] == 14000
+        assert hm.cohorts["root"].payload.resource_node.usage[FR] == 14000
+
+    def test_mid_cohort_lending_limit(self):
+        """left holds its own quota (5) with lendingLimit 2: the root only
+        sees 2 of left's 15-unit subtree."""
+        env = Env()
+        env.add_flavor("default")
+        env.add_cohort("root")
+        env.add_cohort("left", "root", flavor_quotas("default", cpu=("5", None, "2")))
+        env.add_cohort("right", "root")
+        env.add_cq(ClusterQueueWrapper("a").cohort("left")
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq-a")
+        env.add_cq(ClusterQueueWrapper("b").cohort("right")
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq-b")
+        hm = env.cache.hm
+        left = hm.cohorts["left"].payload
+        root = hm.cohorts["root"].payload
+        assert left.resource_node.subtree_quota[FR] == 15000
+        assert left.resource_node.guaranteed_quota(FR) == 13000
+        # root subtree = (left 15 - guaranteed 13) + right 10 = 12
+        assert root.resource_node.subtree_quota[FR] == 12000
+
+    def test_reparent_refreshes_old_tree(self):
+        env = Env()
+        three_level_env(env)
+        hm = env.cache.hm
+        # move right out from under root
+        env.add_cohort("right", "")
+        assert hm.cohorts["root"].payload.resource_node.subtree_quota[FR] == 10000
+        assert hm.cohorts["right"].payload.resource_node.subtree_quota[FR] == 10000
+
+
+class TestNestedCohortInvalidation:
+    def test_tree_wide_generation_aggregate(self):
+        """A generation bump anywhere in a tree must be visible from every
+        cohort in it (flavor-resume invalidation across subtrees)."""
+        env = Env()
+        three_level_env(env)
+        snap1 = env.cache.snapshot()
+        gens1 = {c.name: c.allocatable_resource_generation
+                 for c in (snap1.cluster_queues["a"].cohort,
+                           snap1.cluster_queues["b"].cohort)}
+        assert gens1["left"] == gens1["right"]  # shared tree aggregate
+        # finishing a workload in b bumps b's generation only
+        wl = (WorkloadWrapper("w").queue("lq-b").pod_set(count=1, cpu="4")
+              .reserve("b").obj())
+        env.cache.add_or_update_workload(wl)
+        env.cache.delete_workload(wl)
+        snap2 = env.cache.snapshot()
+        assert (snap2.cluster_queues["a"].cohort.allocatable_resource_generation
+                != gens1["left"])
+
+    def test_solver_topology_invalidated_by_reparent(self):
+        """Cohort re-parents don't bump CQ generations; the solver's
+        topology cache must still refresh (cohort_epoch)."""
+        from kueue_tpu.solver import BatchSolver
+        env = Env()
+        three_level_env(env)
+        solver = BatchSolver()
+        topo1, _ = solver._topology(env.cache.snapshot())
+        assert topo1.cq_chain.shape[1] == 2
+        env.add_cohort("right", "")  # detach right from root
+        topo2, _ = solver._topology(env.cache.snapshot())
+        assert topo2 is not topo1
+        # b's chain no longer reaches root
+        qi = topo2.cq_index["b"]
+        assert topo2.cohort_names[topo2.cq_chain[qi, 0]] == "right"
+        assert (topo2.cq_chain.shape[1] == 1
+                or topo2.cq_chain[qi, 1] == -1)
+
+
+class TestNestedCohortScheduling:
+    def test_borrow_across_subtrees(self):
+        """a (nominal 10) admits a 16-cpu workload by borrowing b's
+        capacity through the root — invisible to a flat two-level tree."""
+        env = Env()
+        three_level_env(env)
+        env.submit(WorkloadWrapper("w").queue("lq-a")
+                   .pod_set(count=1, cpu="16").obj())
+        env.cycle()
+        assert "default/w" in env.client.applied
+
+    def test_reclaim_across_subtrees(self):
+        """b borrows via the root; a reclaims its nominal quota by
+        preempting the borrower in the sibling subtree."""
+        env = Env()
+        env.add_flavor("default")
+        env.add_cohort("root")
+        env.add_cohort("left", "root")
+        env.add_cohort("right", "root")
+        env.add_cq(ClusterQueueWrapper("a").cohort("left")
+                   .preemption(reclaim_within_cohort=api.PREEMPTION_ANY)
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq-a")
+        env.add_cq(ClusterQueueWrapper("b").cohort("right")
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(), "lq-b")
+        borrower = (WorkloadWrapper("borrower").queue("lq-b")
+                    .pod_set(count=1, cpu="14").reserve("b").obj())
+        env.admit_existing(borrower)
+        env.submit(WorkloadWrapper("claimant").queue("lq-a").priority(10)
+                   .pod_set(count=1, cpu="10").obj())
+        env.cycle()
+        evicted = env.client.evicted.get("default/borrower")
+        assert evicted is not None
+        assert any(c.type == api.WORKLOAD_EVICTED and c.status == "True"
+                   for c in evicted.status.conditions)
+
+
+class TestNestedCohortSolverDifferential:
+    def test_three_level_borrow(self):
+        def workloads():
+            return [WorkloadWrapper("w").queue("lq-a")
+                    .pod_set(count=1, cpu="16").obj()]
+
+        result = assert_differential(three_level_env, workloads)
+        assert set(result) == {"default/w"}
+
+    def test_three_level_contention(self):
+        """Both subtrees race for the root's shared capacity; intra-cycle
+        accounting must bubble through the tree identically."""
+        def workloads():
+            return [
+                WorkloadWrapper("w1").queue("lq-a").priority(5).creation(1)
+                .pod_set(count=1, cpu="16").obj(),
+                WorkloadWrapper("w2").queue("lq-b").priority(1).creation(2)
+                .pod_set(count=1, cpu="16").obj(),
+            ]
+
+        result = assert_differential(three_level_env, workloads)
+        assert set(result) == {"default/w1"}
+
+    def test_mid_cohort_lending_limit_capped_borrow(self):
+        """b can take at most 2 units of left's subtree (lendingLimit):
+        12 fits, 13 does not."""
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cohort("root")
+            env.add_cohort("left", "root",
+                           flavor_quotas("default", cpu=("5", None, "2")))
+            env.add_cohort("right", "root")
+            env.add_cq(ClusterQueueWrapper("a").cohort("left")
+                       .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                       "lq-a")
+            env.add_cq(ClusterQueueWrapper("b").cohort("right")
+                       .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                       "lq-b")
+
+        def workloads():
+            return [
+                WorkloadWrapper("too-big").queue("lq-b").creation(1)
+                .pod_set(count=1, cpu="13").obj(),
+                WorkloadWrapper("fits").queue("lq-b").creation(2)
+                .pod_set(count=1, cpu="12").obj(),
+            ]
+
+        result = assert_differential(setup, workloads, cycles=2)
+        assert set(result) == {"default/fits"}
+
+    def test_four_level_chain(self):
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cohort("t0")
+            env.add_cohort("t1", "t0")
+            env.add_cohort("t2", "t1")
+            env.add_cq(ClusterQueueWrapper("deep").cohort("t2")
+                       .resource_group(flavor_quotas("default", cpu="2")).obj(),
+                       "lq-deep")
+            env.add_cq(ClusterQueueWrapper("top").cohort("t0")
+                       .resource_group(flavor_quotas("default", cpu="8")).obj(),
+                       "lq-top")
+
+        def workloads():
+            return [WorkloadWrapper("w").queue("lq-deep")
+                    .pod_set(count=1, cpu="9").obj()]
+
+        result = assert_differential(setup, workloads)
+        assert set(result) == {"default/w"}
+
+    def test_mixed_depths_random(self):
+        """Random forest: flat cohorts, nested trees and cohortless CQs in
+        one cycle."""
+        import random
+        for seed in range(8):
+            rng = random.Random(1000 + seed)
+            quotas = [rng.choice([2, 5, 10]) for _ in range(5)]
+
+            def setup(env, quotas=quotas):
+                env.add_flavor("default")
+                env.add_cohort("root")
+                env.add_cohort("mid", "root")
+                env.add_cohort("flat")  # single-level cohort
+                homes = ["root", "mid", "flat", ""]
+                for i in range(5):
+                    home = homes[i % len(homes)]
+                    w = ClusterQueueWrapper(f"cq{i}")
+                    if home:
+                        w = w.cohort(home)
+                    env.add_cq(w.resource_group(
+                        flavor_quotas("default", cpu=str(quotas[i]))).obj(),
+                        f"lq-cq{i}")
+
+            specs = [(f"w{i}", f"lq-cq{rng.randrange(5)}", rng.randint(0, 3),
+                      float(i), str(rng.choice([1, 2, 4, 7, 12])))
+                     for i in range(rng.randint(4, 10))]
+
+            def workloads(specs=specs):
+                return [WorkloadWrapper(n).queue(q).priority(p).creation(ts)
+                        .pod_set(count=1, cpu=c).obj()
+                        for n, q, p, ts, c in specs]
+
+            assert_differential(setup, workloads)
+
+
+class TestNestedCohortShardedSolve:
+    def test_sharded_nested_matches(self):
+        """Conflict domains are root cohorts: two trees + lone CQs shard
+        cleanly across the 8-device mesh."""
+        from kueue_tpu.parallel.mesh import make_mesh
+
+        def setup(env):
+            env.add_flavor("default")
+            for t in ("t0", "t1"):
+                env.add_cohort(f"{t}-root")
+                env.add_cohort(f"{t}-mid", f"{t}-root")
+                env.add_cq(ClusterQueueWrapper(f"{t}-deep").cohort(f"{t}-mid")
+                           .resource_group(flavor_quotas("default", cpu="4")).obj(),
+                           f"lq-{t}-deep")
+                env.add_cq(ClusterQueueWrapper(f"{t}-top").cohort(f"{t}-root")
+                           .resource_group(flavor_quotas("default", cpu="4")).obj(),
+                           f"lq-{t}-top")
+
+        def workloads():
+            out = []
+            for i, t in enumerate(("t0", "t1")):
+                out.append(WorkloadWrapper(f"w-{t}-deep").queue(f"lq-{t}-deep")
+                           .priority(2).creation(i)
+                           .pod_set(count=1, cpu="6").obj())
+                out.append(WorkloadWrapper(f"w-{t}-top").queue(f"lq-{t}-top")
+                           .priority(1).creation(10 + i)
+                           .pod_set(count=1, cpu="4").obj())
+            return out
+
+        env_single = build_env(setup, solver=True)
+        env_sharded = build_env(setup, solver=True)
+        env_sharded.scheduler.solver.mesh = make_mesh()
+        env_cpu = build_env(setup, solver=False)
+        for env in (env_single, env_sharded, env_cpu):
+            for w in workloads():
+                env.submit(w)
+            env.cycle()
+        assert (admitted_map(env_single) == admitted_map(env_sharded)
+                == admitted_map(env_cpu))

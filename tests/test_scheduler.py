@@ -66,6 +66,10 @@ class Env:
     def add_flavor(self, name, labels=None, taints=None):
         self.cache.add_or_update_resource_flavor(make_flavor(name, labels, taints))
 
+    def add_cohort(self, name, parent="", *fqs):
+        from tests.wrappers import make_cohort
+        self.cache.add_or_update_cohort(make_cohort(name, parent, *fqs))
+
     def add_cq(self, cq, lq_name=None):
         self.cache.add_cluster_queue(cq)
         self.queues.add_cluster_queue(cq)

@@ -162,6 +162,23 @@ def flavor_quotas(flavor: str, **resources) -> api.FlavorQuotas:
     return api.FlavorQuotas(name=flavor, resources=rqs)
 
 
+def make_cohort(name: str, parent: str = "",
+                *fqs: api.FlavorQuotas) -> api.Cohort:
+    """v1alpha1 Cohort: optional parent edge + own quotas
+    (reference: cohort_types.go:26-100)."""
+    cohort = api.Cohort(metadata=ObjectMeta(name=name, uid=new_uid("cohort")))
+    cohort.spec.parent = parent
+    if fqs:
+        covered = []
+        for fq in fqs:
+            for rq in fq.resources:
+                if rq.name not in covered:
+                    covered.append(rq.name)
+        cohort.spec.resource_groups.append(
+            api.ResourceGroup(covered_resources=covered, flavors=list(fqs)))
+    return cohort
+
+
 def make_flavor(name: str, node_labels: Optional[dict] = None,
                 taints: Optional[list] = None) -> api.ResourceFlavor:
     rf = api.ResourceFlavor(metadata=ObjectMeta(name=name, uid=new_uid("rf")))

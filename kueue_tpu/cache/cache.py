@@ -127,17 +127,23 @@ class Cache:
     # --- Cohorts (explicit v1alpha1 objects with quotas) ---
 
     def add_or_update_cohort(self, cohort: api.Cohort) -> None:
+        """Raises ValueError on a cycle-inducing parent edge; the quota
+        update still lands and both trees stay consistent."""
         with self._lock:
             self.cohort_epoch += 1
             node = self.hm.add_cohort(cohort.metadata.name)
             node.payload.resource_node.quotas = build_quotas(cohort.spec.resource_groups)
             old_root = node.payload.root()
-            self.hm.update_cohort_edge(cohort.metadata.name,
-                                       cohort.spec.parent or "")
-            # A re-parent detaches this subtree: refresh the old tree too.
-            if old_root.name != node.payload.root().name:
-                update_cohort_resource_node(old_root)
-            update_cohort_resource_node(node.payload)
+            try:
+                self.hm.update_cohort_edge(cohort.metadata.name,
+                                           cohort.spec.parent or "")
+            finally:
+                # A re-parent detaches this subtree: refresh the old tree
+                # too (and always re-aggregate the quota edit above, even
+                # when the edge update raises on a cycle).
+                if old_root.name != node.payload.root().name:
+                    update_cohort_resource_node(old_root)
+                update_cohort_resource_node(node.payload)
 
     def delete_cohort(self, name: str) -> None:
         with self._lock:
@@ -354,6 +360,10 @@ class Cache:
             cohort_snaps: dict = {}
             for cname, node in self.hm.cohorts.items():
                 cohort_snap = CohortSnapshot(cname, node.payload.resource_node.clone())
+                # Seed with the cohort epoch so cohort-object edits (own
+                # quotas, re-parents) invalidate flavor-resume state even
+                # though they bump no CQ generation.
+                cohort_snap.allocatable_resource_generation = self.cohort_epoch
                 cohort_snaps[cname] = cohort_snap
                 for cqc in node.child_cqs.values():
                     if cqc.name in snap.cluster_queues:

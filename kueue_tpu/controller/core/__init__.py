@@ -120,7 +120,10 @@ def setup_core_controllers(runtime: Runtime, store: Store, queues, cache,
         if event == DELETED:
             cache.delete_cohort(cohort.metadata.name)
         else:
-            cache.add_or_update_cohort(cohort)
+            try:
+                cache.add_or_update_cohort(cohort)
+            except ValueError as exc:  # cycle-inducing parent edge
+                recorder.event(cohort, "Warning", "CohortCycle", str(exc))
         names = {name for name, cqc in cache.hm.cluster_queues.items()
                  if cqc.cohort is not None}
         queues.queue_inadmissible_workloads(names)

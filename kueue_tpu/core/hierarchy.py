@@ -80,6 +80,10 @@ class Manager(Generic[CQ, C]):
         return node
 
     def update_cohort_edge(self, name: str, parent_name: str) -> None:
+        # Cycle check BEFORE any mutation: a raise must leave the graph
+        # untouched (a partial detach would corrupt quota aggregation).
+        if parent_name and self._would_cycle(name, parent_name):
+            raise ValueError(f"cohort cycle: {name} -> {parent_name}")
         node = self._get_or_create(name)
         if node.parent is not None:
             if node.parent.name == parent_name:
@@ -89,8 +93,6 @@ class Manager(Generic[CQ, C]):
             node.parent = None
             self._gc_if_unreferenced(old_parent)
         if parent_name:
-            if self._would_cycle(name, parent_name):
-                raise ValueError(f"cohort cycle: {name} -> {parent_name}")
             parent = self._get_or_create(parent_name)
             parent.child_cohorts[name] = node
             node.parent = parent

@@ -27,7 +27,8 @@ def make_mesh(devices=None, axis_name: str = "cohorts") -> Mesh:
     return Mesh(np.asarray(devices), (axis_name,))
 
 
-def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int):
+def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int,
+                        fair_sharing: bool = False):
     """Run the batched solve SPMD over the mesh, partitioning capacity
     domains (cohorts, and cohortless CQs) across devices."""
     axis = mesh.axis_names[0]
@@ -46,7 +47,8 @@ def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int):
         mine = (domain % n_dev) == dev
         res = solve_cycle_impl(topo_, usage, cohort_usage, requests,
                                podset_active, wl_cq, priority, timestamp,
-                               eligible, solvable & mine, num_podsets)
+                               eligible, solvable & mine, num_podsets,
+                               fair_sharing=fair_sharing)
         usage_delta = res["usage"] - usage
         cohort_delta = res["cohort_usage"] - cohort_usage
         admitted = jax.lax.psum(res["admitted"].astype(jnp.int32), axis) > 0

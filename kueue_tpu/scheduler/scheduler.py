@@ -249,7 +249,8 @@ class Scheduler:
                 invalid_entries.append(e)
 
         try:
-            decisions = self.solver.solve(snapshot, valid_heads)
+            decisions = self.solver.solve(snapshot, valid_heads,
+                                          fair_sharing=self.fair_sharing_enabled)
         except Exception:  # noqa: BLE001 — device failure: CPU fallback
             return invalid_entries, valid_heads
 

@@ -70,6 +70,9 @@ class Topology:
     cohort_borrow_limit: np.ndarray = None  # [C,F,R] int64 (BIG = unlimited)
     cq_chain: np.ndarray = None           # [Q,DC] int32 — cohort ancestor chain
                                           #   (direct cohort first; -1 padding)
+    # Fair sharing (reference: clusterqueue.go:503-564):
+    fair_weight: np.ndarray = None        # [Q] int64 milli-weight
+    cohort_lendable: np.ndarray = None    # [C,R] int64 — root tree's lendable
     cq_index: dict = field(default_factory=dict)
     flavor_index: dict = field(default_factory=dict)
     resource_index: dict = field(default_factory=dict)
@@ -154,6 +157,8 @@ def encode_topology(snapshot: Snapshot) -> Topology:
     topo.cohort_root = np.arange(C, dtype=np.int32)
     topo.cohort_guaranteed = np.zeros((C, F, R), np.int64)
     topo.cohort_borrow_limit = np.full((C, F, R), BIG, np.int64)
+    topo.fair_weight = np.full(Q, 1000, np.int64)
+    topo.cohort_lendable = np.zeros((C, R), np.int64)
 
     for cname, cobj in cohort_objs.items():
         ci = cohort_index[cname]
@@ -172,6 +177,7 @@ def encode_topology(snapshot: Snapshot) -> Topology:
             if fi is not None and ri is not None and quota.borrowing_limit is not None:
                 topo.cohort_borrow_limit[ci, fi, ri] = quota.borrowing_limit
     # depth + root by chasing parents (trees are cycle-checked upstream)
+    lendable_by_root: dict = {}
     for cname in topo.cohort_names:
         ci = cohort_index[cname]
         depth, node = 0, cohort_objs[cname]
@@ -180,6 +186,16 @@ def encode_topology(snapshot: Snapshot) -> Topology:
             node = node.parent
         topo.cohort_depth[ci] = depth
         topo.cohort_root[ci] = cohort_index[node.name]
+        # DRF denominator: the root tree's lendable capacity per resource
+        # (host-computed so flavors outside this topology still count;
+        # only root rows are read by the kernel).
+        if node.name not in lendable_by_root:
+            lendable_by_root[node.name] = node.resource_node.calculate_lendable()
+        if cname == node.name:
+            for rname, q in lendable_by_root[node.name].items():
+                ri = topo.resource_index.get(rname)
+                if ri is not None:
+                    topo.cohort_lendable[ci, ri] = q
     # per-CQ ancestor chain, direct cohort first (static max depth)
     max_chain = 1
     for cq in snapshot.cluster_queues.values():
@@ -198,6 +214,7 @@ def encode_topology(snapshot: Snapshot) -> Topology:
                 node, d = node.parent, d + 1
         topo.prefer_no_borrow[qi] = (cq.flavor_fungibility.when_can_borrow
                                      == api.TRY_NEXT_FLAVOR)
+        topo.fair_weight[qi] = cq.fair_weight
         for gi, rg in enumerate(cq.resource_groups):
             for r in rg.covered_resources:
                 if r == RESOURCE_PODS:

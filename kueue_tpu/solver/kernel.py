@@ -190,8 +190,33 @@ def _choose_flavors_one_podset(req_p, eligible_p, wl_cq, usage, asg_usage,
     return chosen_f_r, ok, borrow, additions
 
 
+def _drf_share(topo, usage, asg_usage, wl_cq):
+    """Dominant resource share per workload, computed against the
+    pre-cycle usage exactly like the CPU nominate step (reference:
+    dominantResourceShare, clusterqueue.go:529-564 with m=1): the maximum
+    over resources of (usage above remaining nominal quota / the root
+    tree's lendable), scaled by 1000 and divided by the fair weight."""
+    remaining = (topo["nominal"] - usage)[wl_cq]                # [W,F,R]
+    offered = topo["offered"][wl_cq]
+    b = jnp.where(offered, asg_usage - remaining, 0)
+    borrowing = jnp.sum(jnp.maximum(0, b), axis=1)              # [W,R]
+    has_borrow = jnp.any(borrowing > 0, axis=1)                 # [W]
+    cohort = topo["cq_cohort"][wl_cq]
+    root = topo["cohort_root"][jnp.maximum(cohort, 0)]
+    lendable = topo["cohort_lendable"][root]                    # [W,R]
+    ratio = jnp.where(lendable > 0,
+                      borrowing * 1000 // jnp.maximum(lendable, 1),
+                      jnp.int64(-1))
+    drs = jnp.max(ratio, axis=1)                                # [W] >= -1
+    weight = topo["fair_weight"][wl_cq]
+    dws = jnp.where(weight > 0, drs * 1000 // jnp.maximum(weight, 1),
+                    jnp.int64(NO_LIMIT))
+    return jnp.where(has_borrow & (cohort >= 0), dws, 0)
+
+
 def solve_cycle_impl(topo, usage, cohort_usage, requests, podset_active, wl_cq,
-                     priority, timestamp, eligible, solvable, num_podsets: int):
+                     priority, timestamp, eligible, solvable, num_podsets: int,
+                     fair_sharing: bool = False):
     """One batched admission cycle.
 
     Returns dict with admitted[W] bool, chosen[W,P,R] int32 flavor index
@@ -223,9 +248,13 @@ def solve_cycle_impl(topo, usage, cohort_usage, requests, podset_active, wl_cq,
     fit = ok_all & solvable & jnp.any(podset_active, axis=1)
 
     # --- Phase B: sequential admit with intra-cycle accounting ---
-    # Order: non-borrowing first, then priority desc, then FIFO
-    # (reference: entryOrdering.Less, scheduler.go:643-672).
-    order = jnp.lexsort((timestamp, -priority, borrow_all.astype(jnp.int32),
+    # Order: non-borrowing first, then DRF share (fair sharing), then
+    # priority desc, then FIFO (reference: entryOrdering.Less,
+    # scheduler.go:643-672).
+    share = (_drf_share(topo, usage, asg_usage, wl_cq) if fair_sharing
+             else jnp.zeros(W, jnp.int64))
+    order = jnp.lexsort((timestamp, -priority, share,
+                         borrow_all.astype(jnp.int32),
                          (~fit).astype(jnp.int32)))
 
     def admit_step(carry, w_idx):
@@ -264,7 +293,8 @@ def solve_cycle_impl(topo, usage, cohort_usage, requests, podset_active, wl_cq,
             "fit": fit, "usage": usage_out, "cohort_usage": cohort_out}
 
 
-solve_cycle = partial(jax.jit, static_argnames=("num_podsets",))(solve_cycle_impl)
+solve_cycle = partial(jax.jit, static_argnames=("num_podsets", "fair_sharing"))(
+    solve_cycle_impl)
 
 
 # ---------------------------------------------------------------------------
@@ -281,9 +311,10 @@ solve_cycle = partial(jax.jit, static_argnames=("num_podsets",))(solve_cycle_imp
 # sequential scan (differentially tested).
 
 def solve_phase_a_impl(topo, usage, cohort_usage, requests, podset_active,
-                       wl_cq, eligible, solvable, num_podsets: int):
+                       wl_cq, eligible, solvable, num_podsets: int,
+                       fair_sharing: bool = False):
     """Phase A only: flavor assignment. Returns
-    (fit[W], borrows[W], chosen[W,P,R], asg_usage[W,F,R])."""
+    (fit[W], borrows[W], chosen[W,P,R], asg_usage[W,F,R], share[W])."""
     W, P, R = requests.shape
     F = eligible.shape[2]
     cohort_avail = _cohort_avail(topo, cohort_usage)
@@ -304,7 +335,9 @@ def solve_phase_a_impl(topo, usage, cohort_usage, requests, podset_active,
         asg_usage += jnp.where(active[:, None, None], additions, 0)
     chosen = jnp.stack(chosen_all, axis=1)
     fit = ok_all & solvable & jnp.any(podset_active, axis=1)
-    return fit, borrow_all, chosen, asg_usage
+    share = (_drf_share(topo, usage, asg_usage, wl_cq) if fair_sharing
+             else jnp.zeros(W, jnp.int64))
+    return fit, borrow_all, chosen, asg_usage, share
 
 
 def solve_phase_b_domains_impl(topo, usage, cohort_usage, asg_usage, fit,
@@ -358,12 +391,13 @@ def solve_phase_b_domains_impl(topo, usage, cohort_usage, asg_usage, fit,
     return admitted.astype(bool), usage_out, cohort_out
 
 
-solve_phase_a = partial(jax.jit, static_argnames=("num_podsets",))(solve_phase_a_impl)
+solve_phase_a = partial(jax.jit, static_argnames=("num_podsets", "fair_sharing"))(
+    solve_phase_a_impl)
 solve_phase_b_domains = jax.jit(solve_phase_b_domains_impl)
 
 
 def build_order_grid(fit, borrows, priority, timestamp, wl_cq, cq_cohort,
-                     num_cohorts: int, cohort_root=None):
+                     num_cohorts: int, cohort_root=None, share=None):
     """Host-side: global admit order -> [L,D] grid of workload indices.
 
     Domain = root cohort (the whole tree is one capacity domain for
@@ -378,8 +412,10 @@ def build_order_grid(fit, borrows, priority, timestamp, wl_cq, cq_cohort,
     wl_cq = np.asarray(wl_cq)
     cq_cohort = np.asarray(cq_cohort)
 
-    order = np.lexsort((timestamp, -priority, borrows.astype(np.int32),
-                        (~fit).astype(np.int32)))
+    share = (np.zeros(len(wl_cq), np.int64) if share is None
+             else np.asarray(share))
+    order = np.lexsort((timestamp, -priority, share,
+                        borrows.astype(np.int32), (~fit).astype(np.int32)))
     order = order[fit[order]]  # non-fit entries can never admit
     cohort_of_wl = cq_cohort[wl_cq]
     if cohort_root is not None:
@@ -411,17 +447,19 @@ def build_order_grid(fit, borrows, priority, timestamp, wl_cq, cq_cohort,
 def solve_cycle_cohort_parallel(topo_dev, topo_np, usage, cohort_usage,
                                 requests, podset_active, wl_cq, priority,
                                 timestamp, eligible, solvable,
-                                num_podsets: int):
+                                num_podsets: int, fair_sharing: bool = False):
     """The production single-chip path: Phase A on device, order grid on
     host, cohort-parallel Phase B on device. Same outputs as solve_cycle."""
     import numpy as np
-    fit, borrows, chosen, asg_usage = solve_phase_a(
+    fit, borrows, chosen, asg_usage, share = solve_phase_a(
         topo_dev, usage, cohort_usage, requests, podset_active, wl_cq,
-        eligible, solvable, num_podsets=num_podsets)
+        eligible, solvable, num_podsets=num_podsets,
+        fair_sharing=fair_sharing)
     grid = build_order_grid(fit, borrows, priority, timestamp,
                             np.asarray(wl_cq), topo_np.cq_cohort,
                             topo_np.cohort_subtree.shape[0],
-                            cohort_root=topo_np.cohort_root)
+                            cohort_root=topo_np.cohort_root,
+                            share=share if fair_sharing else None)
     admitted, usage_out, cohort_out = solve_phase_b_domains(
         topo_dev, usage, cohort_usage, asg_usage, fit, wl_cq,
         jnp.asarray(grid))
@@ -448,4 +486,6 @@ def topo_to_device(topo) -> dict:
         "cohort_guaranteed": jnp.asarray(topo.cohort_guaranteed),
         "cohort_borrow_limit": jnp.asarray(topo.cohort_borrow_limit),
         "cq_chain": jnp.asarray(topo.cq_chain),
+        "fair_weight": jnp.asarray(topo.fair_weight),
+        "cohort_lendable": jnp.asarray(topo.cohort_lendable),
     }

@@ -61,7 +61,8 @@ class BatchSolver:
             self._topo_cache = (topo, topo_to_device(topo))
         return self._topo_cache
 
-    def solve(self, snapshot: Snapshot, entries: list) -> dict:
+    def solve(self, snapshot: Snapshot, entries: list,
+              fair_sharing: bool = False) -> dict:
         """entries: list of workload Info. Returns
         {entry index -> (fa.Assignment, admitted)} for every entry the
         solver could fully assign (fit mode). admitted=False means the
@@ -80,10 +81,10 @@ class BatchSolver:
             return {}
 
         result = None
-        # The native ABI encodes the flat (single-level) cohort forest;
-        # nested trees go through the jit path's chain walk.
+        # The native ABI encodes the flat (single-level) cohort forest and
+        # no fair-share sort key; those go through the jit path.
         if (self.backend == "native" and self.mesh is None
-                and topo.cq_chain.shape[1] == 1):
+                and topo.cq_chain.shape[1] == 1 and not fair_sharing):
             from kueue_tpu import native
             result = native.solve_cycle_native(
                 topo, state.usage, state.cohort_usage, batch.requests,
@@ -93,7 +94,8 @@ class BatchSolver:
             if self.mesh is not None:
                 from kueue_tpu.parallel.mesh import solve_cycle_sharded
                 result = solve_cycle_sharded(self.mesh, topo_dev, state, batch,
-                                             self.max_podsets)
+                                             self.max_podsets,
+                                             fair_sharing=fair_sharing)
             else:
                 # cohort-parallel Phase B: scan length = max workloads per
                 # conflict domain instead of the whole batch
@@ -101,7 +103,8 @@ class BatchSolver:
                     topo_dev, topo, state.usage, state.cohort_usage,
                     batch.requests, batch.podset_active, batch.wl_cq,
                     batch.priority, batch.timestamp, batch.eligible,
-                    batch.solvable, num_podsets=self.max_podsets)
+                    batch.solvable, num_podsets=self.max_podsets,
+                    fair_sharing=fair_sharing)
 
         admitted = np.asarray(result["admitted"])
         fit = np.asarray(result["fit"])

@@ -40,10 +40,13 @@ def synth_solver_inputs(num_cqs: int = 256, num_cohorts: int = 32,
         "cohort_guaranteed": np.zeros((C, F, R), np.int64),
         "cohort_borrow_limit": np.full((C, F, R), 2**62, np.int64),
         "cq_chain": (np.arange(Q) % C).astype(np.int32).reshape(Q, 1),
+        "fair_weight": np.full(Q, 1000, np.int64),
+        "cohort_lendable": np.zeros((C, R), np.int64),
     }
     for c in range(C):
         members = topo["cq_cohort"] == c
         topo["cohort_subtree"][c] = nominal_units[members].sum(axis=0)
+        topo["cohort_lendable"][c] = topo["cohort_subtree"][c].sum(axis=0)
 
     usage = (nominal_units * rng.uniform(0, 0.5, size=(Q, F, R))).astype(np.int64)
     cohort_usage = np.zeros((C, F, R), np.int64)

@@ -119,6 +119,39 @@ class TestNestedCohortInvalidation:
                 or topo2.cq_chain[qi, 1] == -1)
 
 
+class TestCohortLifecycleEdgeCases:
+    def test_cycle_reparent_leaves_tree_intact(self):
+        """a <- b <- c, then updating b to parent=c must raise and leave
+        the old tree's aggregation consistent."""
+        import pytest
+        from tests.wrappers import make_cohort
+        env = Env()
+        env.add_flavor("default")
+        env.add_cohort("a")
+        env.add_cohort("b", "a")
+        env.add_cohort("c", "b")
+        env.add_cq(ClusterQueueWrapper("q1").cohort("c")
+                   .resource_group(flavor_quotas("default", cpu="4")).obj(), "lq1")
+        with pytest.raises(ValueError, match="cycle"):
+            env.cache.add_or_update_cohort(make_cohort("b", "c"))
+        hm = env.cache.hm
+        assert hm.cohorts["b"].parent.name == "a"
+        assert hm.cohorts["a"].payload.resource_node.subtree_quota[FR] == 4000
+
+    def test_cohort_quota_edit_invalidates_flavor_resume(self):
+        """Raising a Cohort's own quota bumps no CQ generation but must
+        still invalidate cached last-assignment state (cohort_epoch is
+        folded into the snapshot cohort generation)."""
+        env = Env()
+        three_level_env(env)
+        gen1 = env.cache.snapshot().cluster_queues["a"].cohort \
+            .allocatable_resource_generation
+        env.add_cohort("root", "", flavor_quotas("default", cpu="50"))
+        gen2 = env.cache.snapshot().cluster_queues["a"].cohort \
+            .allocatable_resource_generation
+        assert gen2 != gen1
+
+
 class TestNestedCohortScheduling:
     def test_borrow_across_subtrees(self):
         """a (nominal 10) admits a 16-cpu workload by borrowing b's

@@ -19,8 +19,8 @@ from tests.test_scheduler import Env
 from tests.wrappers import ClusterQueueWrapper, WorkloadWrapper, flavor_quotas, make_local_queue
 
 
-def build_env(setup, solver=False):
-    env = Env()
+def build_env(setup, solver=False, fair_sharing=False):
+    env = Env(fair_sharing=fair_sharing)
     if solver:
         env.scheduler.solver = BatchSolver()
         env.scheduler.solver_min_heads = 0  # force the solver path
@@ -38,10 +38,11 @@ def admitted_map(env):
     return out
 
 
-def assert_differential(setup, workloads, cycles=1):
+def assert_differential(setup, workloads, cycles=1, fair_sharing=False):
     """Run the same scenario through CPU-only and solver-enabled
     schedulers; decisions must match exactly."""
-    envs = [build_env(setup, solver=False), build_env(setup, solver=True)]
+    envs = [build_env(setup, solver=False, fair_sharing=fair_sharing),
+            build_env(setup, solver=True, fair_sharing=fair_sharing)]
     for env in envs:
         for w in workloads():
             env.submit(w)
@@ -198,6 +199,107 @@ class TestSolverMatchesCPU:
         assert flavors["cpu"] == "cpu-flavor"
         assert flavors["memory"] == "cpu-flavor"
         assert flavors["nvidia.com/gpu"] == "gpu-flavor"
+
+
+class TestSolverFairSharing:
+    """Device DRF share in the Phase B sort key (reference:
+    dominantResourceShare clusterqueue.go:503-564 feeding
+    entryOrdering.Less scheduler.go:643-672)."""
+
+    @staticmethod
+    def _three_cq_setup(weights=None):
+        def setup(env):
+            env.add_flavor("default")
+            for name, nominal in (("a", "2"), ("b", "8"), ("c", "4")):
+                w = ClusterQueueWrapper(name).cohort("team")
+                if weights and name in weights:
+                    w = w.fair_weight(weights[name])
+                env.add_cq(w.resource_group(
+                    flavor_quotas("default", cpu=nominal)).obj(), f"lq-{name}")
+        return setup
+
+    @staticmethod
+    def _contending_workloads():
+        # wa borrows 6/14 (share 428), wb borrows 4/14 (share 285);
+        # fair sharing admits wb first despite wa's higher priority.
+        return [
+            WorkloadWrapper("wa").queue("lq-a").priority(10).creation(1)
+            .pod_set(count=1, cpu="8").obj(),
+            WorkloadWrapper("wb").queue("lq-b").priority(1).creation(2)
+            .pod_set(count=1, cpu="12").obj(),
+        ]
+
+    def test_share_orders_before_priority(self):
+        result = assert_differential(self._three_cq_setup(),
+                                     self._contending_workloads,
+                                     fair_sharing=True)
+        assert set(result) == {"default/wb"}
+
+    def test_without_fair_sharing_priority_wins(self):
+        result = assert_differential(self._three_cq_setup(),
+                                     self._contending_workloads,
+                                     fair_sharing=False)
+        assert set(result) == {"default/wa"}
+
+    def test_fair_weight_scales_share(self):
+        # a's weight 4000 divides its share to 107 < wb's 285: wa first.
+        result = assert_differential(self._three_cq_setup({"a": 4000}),
+                                     self._contending_workloads,
+                                     fair_sharing=True)
+        assert set(result) == {"default/wa"}
+
+    def test_zero_weight_sorts_last(self):
+        # weight 0 => infinite share: wb admits first even though wa
+        # borrows less after c's quota shrinks.
+        def setup(env):
+            env.add_flavor("default")
+            for name, nominal in (("a", "2"), ("b", "8"), ("c", "4")):
+                w = ClusterQueueWrapper(name).cohort("team")
+                if name == "a":
+                    w = w.fair_weight(0)
+                env.add_cq(w.resource_group(
+                    flavor_quotas("default", cpu=nominal)).obj(), f"lq-{name}")
+
+        def workloads():
+            return [
+                WorkloadWrapper("wa").queue("lq-a").priority(10).creation(1)
+                .pod_set(count=1, cpu="3").obj(),
+                WorkloadWrapper("wb").queue("lq-b").priority(1).creation(2)
+                .pod_set(count=1, cpu="12").obj(),
+            ]
+
+        result = assert_differential(setup, workloads, fair_sharing=True)
+        assert set(result) == {"default/wb"}
+
+    def test_fair_sharing_random_differential(self):
+        import random
+        for seed in range(10):
+            rng = random.Random(7000 + seed)
+            n_cqs = rng.randint(2, 5)
+            specs = [(f"cq{i}", rng.choice([2, 5, 8]),
+                      rng.choice([500, 1000, 2000]))
+                     for i in range(n_cqs)]
+
+            def setup(env, specs=specs):
+                env.add_flavor("default")
+                for name, nominal, weight in specs:
+                    env.add_cq(ClusterQueueWrapper(name).cohort("team")
+                               .fair_weight(weight)
+                               .resource_group(flavor_quotas(
+                                   "default", cpu=str(nominal))).obj(),
+                               f"lq-{name}")
+
+            wl_specs = [(f"w{i}", f"lq-cq{rng.randrange(n_cqs)}",
+                         rng.randint(0, 3), float(i),
+                         str(rng.choice([1, 2, 4, 7, 12])))
+                        for i in range(rng.randint(3, 10))]
+
+            def workloads(wl_specs=wl_specs):
+                return [WorkloadWrapper(n).queue(q).priority(p).creation(ts)
+                        .pod_set(count=1, cpu=c).obj()
+                        for n, q, p, ts, c in wl_specs]
+
+            assert_differential(setup, workloads, fair_sharing=True)
 
 
 class TestSolverRandomDifferential:

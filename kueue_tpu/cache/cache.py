@@ -28,12 +28,6 @@ from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.core.hierarchy import Manager as HierarchyManager
 
 
-def _iter_tree(cohort_snap):
-    yield cohort_snap
-    for child in cohort_snap.child_cohorts:
-        yield from _iter_tree(child)
-
-
 @dataclass
 class AdmissionCheckEntry:
     controller_name: str = ""
@@ -55,6 +49,13 @@ class Cache:
         # Bumped on cohort-object changes (re-parent, cohort quotas):
         # structural edits invisible to per-CQ generations.
         self.cohort_epoch = 0
+        # Monotonic capacity version: bumped on ANY capacity-affecting
+        # change (CQ/cohort/flavor edits, workload removal). Snapshot
+        # cohorts carry it as their allocatable generation so stored
+        # flavor-resume state is invalidated by a simple `>` check — a
+        # per-tree sum would shrink when a tree loses members and stall
+        # invalidation forever.
+        self._capacity_version = 0
 
     def _new_cohort(self, name: str) -> CohortCache:
         cohort = CohortCache(name)
@@ -65,6 +66,7 @@ class Cache:
 
     def add_cluster_queue(self, cq: api.ClusterQueue) -> ClusterQueueCache:
         with self._lock:
+            self._capacity_version += 1
             cqc = ClusterQueueCache(cq)
             self.hm.add_cluster_queue(cqc.name, cqc)
             self.hm.update_cluster_queue_edge(cqc.name, cq.spec.cohort)
@@ -76,6 +78,7 @@ class Cache:
 
     def update_cluster_queue(self, cq: api.ClusterQueue) -> None:
         with self._lock:
+            self._capacity_version += 1
             cqc = self.hm.cluster_queues.get(cq.metadata.name)
             if cqc is None:
                 return
@@ -100,6 +103,7 @@ class Cache:
 
     def delete_cluster_queue(self, name: str) -> None:
         with self._lock:
+            self._capacity_version += 1
             cqc = self.hm.cluster_queues.get(name)
             if cqc is None:
                 return
@@ -131,6 +135,7 @@ class Cache:
         update still lands and both trees stay consistent."""
         with self._lock:
             self.cohort_epoch += 1
+            self._capacity_version += 1
             node = self.hm.add_cohort(cohort.metadata.name)
             node.payload.resource_node.quotas = build_quotas(cohort.spec.resource_groups)
             old_root = node.payload.root()
@@ -148,6 +153,7 @@ class Cache:
     def delete_cohort(self, name: str) -> None:
         with self._lock:
             self.cohort_epoch += 1
+            self._capacity_version += 1
             node = self.hm.cohorts.get(name)
             if node is None:
                 return
@@ -173,6 +179,7 @@ class Cache:
             return self._refresh_flavor_dependents()
 
     def _refresh_flavor_dependents(self) -> set:
+        self._capacity_version += 1
         affected = set()
         for cqc in self.hm.cluster_queues.values():
             was = cqc.active
@@ -283,6 +290,7 @@ class Cache:
             return False
         cqc.delete_workload(info)
         cqc.workloads_not_ready.discard(key)
+        self._capacity_version += 1  # freed capacity invalidates resume state
         return True
 
     def assume_workload(self, wl: api.Workload) -> None:
@@ -360,32 +368,22 @@ class Cache:
             cohort_snaps: dict = {}
             for cname, node in self.hm.cohorts.items():
                 cohort_snap = CohortSnapshot(cname, node.payload.resource_node.clone())
-                # Seed with the cohort epoch so cohort-object edits (own
-                # quotas, re-parents) invalidate flavor-resume state even
-                # though they bump no CQ generation.
-                cohort_snap.allocatable_resource_generation = self.cohort_epoch
+                # The monotonic capacity version: any capacity change
+                # anywhere (including in sibling subtrees of a tree)
+                # invalidates stored flavor-resume state via a `>` check.
+                cohort_snap.allocatable_resource_generation = self._capacity_version
                 cohort_snaps[cname] = cohort_snap
                 for cqc in node.child_cqs.values():
                     if cqc.name in snap.cluster_queues:
                         cq_snap = snap.cluster_queues[cqc.name]
                         cq_snap.cohort = cohort_snap
                         cohort_snap.members.add(cq_snap)
-                        cohort_snap.allocatable_resource_generation += cq_snap.allocatable_resource_generation
             # Wire the cohort tree (hierarchical v1alpha1 cohorts).
             for cname, node in self.hm.cohorts.items():
                 if node.parent is not None:
                     parent_snap = cohort_snaps[node.parent.name]
                     cohort_snaps[cname].parent = parent_snap
                     parent_snap.child_cohorts.add(cohort_snaps[cname])
-            # Generation must invalidate across the whole borrowing domain:
-            # a capacity change anywhere in a tree affects every member, so
-            # every cohort in a tree carries the tree-wide aggregate.
-            for cs in cohort_snaps.values():
-                if cs.parent is None and cs.child_cohorts:
-                    total = sum(c.allocatable_resource_generation
-                                for c in _iter_tree(cs))
-                    for c in _iter_tree(cs):
-                        c.allocatable_resource_generation = total
             snap.cohort_epoch = self.cohort_epoch
             return snap
 

@@ -28,7 +28,7 @@ def make_mesh(devices=None, axis_name: str = "cohorts") -> Mesh:
 
 
 def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int,
-                        fair_sharing: bool = False):
+                        fair_sharing: bool = False, start_rank=None):
     """Run the batched solve SPMD over the mesh, partitioning capacity
     domains (cohorts, and cohortless CQs) across devices."""
     axis = mesh.axis_names[0]
@@ -36,7 +36,7 @@ def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int,
     C = topo["cohort_subtree"].shape[0]
 
     def body(topo_, usage, cohort_usage, requests, podset_active, wl_cq,
-             priority, timestamp, eligible, solvable):
+             priority, timestamp, eligible, solvable, start_rank_):
         dev = jax.lax.axis_index(axis)
         cohort_of_wl = topo_["cq_cohort"][wl_cq]
         root_of_wl = topo_["cohort_root"][jnp.maximum(cohort_of_wl, 0)]
@@ -48,7 +48,8 @@ def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int,
         res = solve_cycle_impl(topo_, usage, cohort_usage, requests,
                                podset_active, wl_cq, priority, timestamp,
                                eligible, solvable & mine, num_podsets,
-                               fair_sharing=fair_sharing)
+                               fair_sharing=fair_sharing,
+                               start_rank=start_rank_)
         usage_delta = res["usage"] - usage
         cohort_delta = res["cohort_usage"] - cohort_usage
         admitted = jax.lax.psum(res["admitted"].astype(jnp.int32), axis) > 0
@@ -57,15 +58,18 @@ def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int,
         # chosen flavors are computed identically on every device (phase A
         # is deterministic given the snapshot); take them as-is.
         return {"admitted": admitted, "chosen": res["chosen"],
-                "borrows": res["borrows"], "fit": res["fit"],
+                "borrows": res["borrows"],
+                "chosen_borrow": res["chosen_borrow"], "fit": res["fit"],
                 "usage": usage_out, "cohort_usage": cohort_out}
 
+    if start_rank is None:
+        start_rank = np.zeros(batch.requests.shape, np.int32)
     sharded = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(),) * 10,
+        in_specs=(P(),) * 11,
         out_specs=P(),
         check_vma=False)
     return jax.jit(sharded)(
         topo, state.usage, state.cohort_usage, batch.requests,
         batch.podset_active, batch.wl_cq, batch.priority, batch.timestamp,
-        batch.eligible, batch.solvable)
+        batch.eligible, batch.solvable, start_rank)

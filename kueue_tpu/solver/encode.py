@@ -21,6 +21,7 @@ from typing import Optional
 
 import numpy as np
 
+from kueue_tpu import features
 from kueue_tpu.api import kueue as api
 from kueue_tpu.api.corev1 import RESOURCE_PODS
 from kueue_tpu.cache.snapshot import Snapshot
@@ -97,6 +98,8 @@ class WorkloadBatch:
     timestamp: np.ndarray = None       # [W] float64
     eligible: np.ndarray = None        # [W,P,F] bool (taints/affinity, host-computed)
     solvable: np.ndarray = None        # [W] bool — encodable by the solver
+    start_rank: np.ndarray = None      # [W,P,R] int32 — flavor-resume position
+                                       #   (LastTriedFlavorIdx + 1; 0 = from start)
 
 
 def iter_cohorts(snapshot: Snapshot) -> dict:
@@ -278,7 +281,9 @@ def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
     batch.timestamp = np.zeros(W, np.float64)
     batch.eligible = np.zeros((W, P, F), bool)
     batch.solvable = np.zeros(W, bool)
+    batch.start_rank = np.zeros((W, P, R), np.int32)
 
+    elig_cache: dict = {}  # (qi, pod-spec signature) -> [F] bool row
     for wi, info in enumerate(entries):
         cq = snapshot.cluster_queues.get(info.cluster_queue)
         if cq is None:
@@ -289,6 +294,24 @@ def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
         batch.timestamp[wi] = ordering.queue_order_timestamp(info.obj)
         if len(info.total_requests) > P:
             continue  # too many podsets for this bucket: CPU fallback
+        # Flavor-fungibility resume (reference: flavorassigner.go:289-296):
+        # start each resource's search after the last tried flavor, unless
+        # the capacity generation moved (then restart from 0). Both the
+        # outdated check and the resume apply regardless of the
+        # FlavorFungibility gate, mirroring the CPU assigner.
+        la = info.last_assignment
+        if la is not None:
+            outdated = (cq.allocatable_resource_generation
+                        > la.cluster_queue_generation
+                        or (cq.cohort is not None
+                            and cq.cohort.allocatable_resource_generation
+                            > la.cohort_generation))
+            if outdated:
+                info.last_assignment = la = None
+        if la is not None:
+            for pi in range(min(len(info.total_requests), P)):
+                for r, ri in topo.resource_index.items():
+                    batch.start_rank[wi, pi, ri] = la.next_flavor_to_try(pi, r)
         ok = True
         for pi, psr in enumerate(info.total_requests):
             reqs = dict(psr.requests)
@@ -305,20 +328,45 @@ def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
                 ok = False
                 break
             batch.podset_active[wi, pi] = True
-            # host-side taints/affinity per flavor
+            # host-side taints/affinity per flavor, memoized by pod-spec
+            # signature: identical pod shapes (the common case at scale)
+            # share one eligibility row instead of re-running the
+            # string-matching loop per workload
             pod_spec = info.obj.spec.pod_sets[pi].template.spec
-            for rg in cq.resource_groups:
-                for fname in rg.flavors:
-                    flavor = snapshot.resource_flavors.get(fname)
-                    if flavor is None:
-                        continue
-                    fi = topo.flavor_index[fname]
-                    if find_untolerated_taint(flavor.spec.node_taints,
-                                              pod_spec.tolerations) is not None:
-                        continue
-                    if not flavor_selector_matches(pod_spec, rg.label_keys,
-                                                   flavor.spec.node_labels):
-                        continue
-                    batch.eligible[wi, pi, fi] = True
+            key = (qi, _eligibility_key(pod_spec))
+            row = elig_cache.get(key)
+            if row is None:
+                row = np.zeros(batch.eligible.shape[2], bool)
+                for rg in cq.resource_groups:
+                    for fname in rg.flavors:
+                        flavor = snapshot.resource_flavors.get(fname)
+                        if flavor is None:
+                            continue
+                        if find_untolerated_taint(flavor.spec.node_taints,
+                                                  pod_spec.tolerations) is not None:
+                            continue
+                        if not flavor_selector_matches(pod_spec, rg.label_keys,
+                                                       flavor.spec.node_labels):
+                            continue
+                        row[topo.flavor_index[fname]] = True
+                elig_cache[key] = row
+            batch.eligible[wi, pi] = row
         batch.solvable[wi] = ok
     return batch
+
+
+def _eligibility_key(pod_spec) -> tuple:
+    """Hashable signature of the pod-spec fields that feed flavor
+    eligibility (tolerations, node selector, node affinity)."""
+    tols = tuple((t.key, t.operator, t.value, t.effect)
+                 for t in pod_spec.tolerations)
+    sel = tuple(sorted(pod_spec.node_selector.items()))
+    aff = ()
+    if pod_spec.affinity is not None and pod_spec.affinity.node_affinity is not None:
+        req = pod_spec.affinity.node_affinity.required
+        if req is not None:
+            aff = tuple(
+                tuple((e.key, e.operator, tuple(e.values))
+                      for e in term.match_expressions)
+                for term in req.node_selector_terms)
+    return tols, sel, aff

@@ -126,12 +126,14 @@ def _chain_add_usage(topo, cohort_c, chain, delta):
 
 
 def _choose_flavors_one_podset(req_p, eligible_p, wl_cq, usage, asg_usage,
-                               avail, topo):
+                               avail, topo, start_rank_p=None):
     """Phase A for one podset slot, vectorized over W.
 
     req_p: [W,R], eligible_p: [W,F], asg_usage: [W,F,R] accumulated from
-    earlier podsets of the same workload.
-    Returns (chosen_f_r [W,R] int32 (-1 = none), ok [W], borrow [W],
+    earlier podsets of the same workload; start_rank_p: [W,R] first
+    flavor rank to consider (LastTriedFlavorIdx resume, reference:
+    flavorassigner.go:289-324).
+    Returns (chosen_f_r [W,R] int32 (-1 = none), ok [W], borrow_r [W,R],
     new asg additions [W,F,R]).
     """
     W, R = req_p.shape
@@ -165,9 +167,12 @@ def _choose_flavors_one_podset(req_p, eligible_p, wl_cq, usage, asg_usage,
     rank_fit_nb = jnp.where(fit_f & ~borrow_f, flavor_rank, INF)   # [W,F]
 
     # For each resource r, its group's candidate flavors are those with
-    # flavor_group == group_id[r]; reduce over F per (w, r).
+    # flavor_group == group_id[r]; reduce over F per (w, r). Flavors
+    # before the resume rank are excluded (LastTriedFlavorIdx).
     same_group = (flavor_group[:, :, None] == group_id[:, None, :]) & \
                  (group_id[:, None, :] >= 0)                        # [W,F,R]
+    if start_rank_p is not None:
+        same_group &= flavor_rank[:, :, None] >= start_rank_p[:, None, :]
     rank_fit_r = jnp.where(same_group, rank_fit[:, :, None], INF)
     rank_fit_nb_r = jnp.where(same_group, rank_fit_nb[:, :, None], INF)
     best_rank = jnp.min(rank_fit_r, axis=1)        # [W,R]
@@ -186,8 +191,7 @@ def _choose_flavors_one_podset(req_p, eligible_p, wl_cq, usage, asg_usage,
     additions = one_hot * jnp.where(chosen_f_r >= 0, req_p, 0)[:, None, :]
     chosen_borrow = jnp.take_along_axis(
         borrow_f, jnp.maximum(chosen_f_r, 0), axis=1) & (chosen_f_r >= 0)
-    borrow = jnp.any(chosen_borrow, axis=1)
-    return chosen_f_r, ok, borrow, additions
+    return chosen_f_r, ok, chosen_borrow, additions
 
 
 def _drf_share(topo, usage, asg_usage, wl_cq):
@@ -214,38 +218,50 @@ def _drf_share(topo, usage, asg_usage, wl_cq):
     return jnp.where(has_borrow & (cohort >= 0), dws, 0)
 
 
-def solve_cycle_impl(topo, usage, cohort_usage, requests, podset_active, wl_cq,
-                     priority, timestamp, eligible, solvable, num_podsets: int,
-                     fair_sharing: bool = False):
-    """One batched admission cycle.
-
-    Returns dict with admitted[W] bool, chosen[W,P,R] int32 flavor index
-    (-1 = none), borrows[W] bool, fit[W] bool, usage'[Q,F,R],
-    cohort_usage'[C,F,R].
-    """
+def _phase_a(topo, usage, cohort_avail, requests, podset_active, wl_cq,
+             eligible, solvable, num_podsets: int, start_rank=None):
+    """Flavor assignment over all podsets (usage accumulates within a
+    workload). Returns (fit[W], borrows[W], chosen[W,P,R],
+    chosen_borrow[W,P,R], asg_usage[W,F,R])."""
     W, P, R = requests.shape
     F = eligible.shape[2]
-
-    cohort_avail = _cohort_avail(topo, cohort_usage)
     avail = _available(topo["nominal"], topo["borrow_limit"], topo["guaranteed"],
                        usage, cohort_avail, topo["cq_cohort"])
-
-    # --- Phase A: flavor assignment (podsets accumulate within a workload) ---
     asg_usage = jnp.zeros((W, F, R), jnp.int64)
-    chosen_all = []
+    chosen_all, borrow_all_r = [], []
     ok_all = jnp.ones(W, bool)
-    borrow_all = jnp.zeros(W, bool)
     for p in range(num_podsets):
         chosen_p, ok_p, borrow_p, additions = _choose_flavors_one_podset(
             requests[:, p, :], eligible[:, p, :], wl_cq, usage, asg_usage,
-            avail, topo)
+            avail, topo,
+            start_rank[:, p, :] if start_rank is not None else None)
         active = podset_active[:, p]
         chosen_all.append(jnp.where(active[:, None], chosen_p, -1))
         ok_all &= jnp.where(active, ok_p, True)
-        borrow_all |= jnp.where(active, borrow_p, False)
+        borrow_all_r.append(jnp.where(active[:, None], borrow_p, False))
         asg_usage += jnp.where(active[:, None, None], additions, 0)
-    chosen = jnp.stack(chosen_all, axis=1)  # [W,P,R]
+    chosen = jnp.stack(chosen_all, axis=1)        # [W,P,R]
+    chosen_borrow = jnp.stack(borrow_all_r, axis=1)  # [W,P,R]
+    borrows = jnp.any(chosen_borrow, axis=(1, 2))
     fit = ok_all & solvable & jnp.any(podset_active, axis=1)
+    return fit, borrows, chosen, chosen_borrow, asg_usage
+
+
+def solve_cycle_impl(topo, usage, cohort_usage, requests, podset_active, wl_cq,
+                     priority, timestamp, eligible, solvable, num_podsets: int,
+                     fair_sharing: bool = False, start_rank=None):
+    """One batched admission cycle.
+
+    Returns dict with admitted[W] bool, chosen[W,P,R] int32 flavor index
+    (-1 = none), borrows[W] bool, chosen_borrow[W,P,R] bool, fit[W] bool,
+    usage'[Q,F,R], cohort_usage'[C,F,R].
+    """
+    W, P, R = requests.shape
+
+    cohort_avail = _cohort_avail(topo, cohort_usage)
+    fit, borrow_all, chosen, chosen_borrow, asg_usage = _phase_a(
+        topo, usage, cohort_avail, requests, podset_active, wl_cq, eligible,
+        solvable, num_podsets, start_rank)
 
     # --- Phase B: sequential admit with intra-cycle accounting ---
     # Order: non-borrowing first, then DRF share (fair sharing), then
@@ -290,7 +306,8 @@ def solve_cycle_impl(topo, usage, cohort_usage, requests, podset_active, wl_cq,
     (usage_out, cohort_out, admitted), _ = jax.lax.scan(admit_step, init, order)
 
     return {"admitted": admitted, "chosen": chosen, "borrows": borrow_all,
-            "fit": fit, "usage": usage_out, "cohort_usage": cohort_out}
+            "chosen_borrow": chosen_borrow, "fit": fit, "usage": usage_out,
+            "cohort_usage": cohort_out}
 
 
 solve_cycle = partial(jax.jit, static_argnames=("num_podsets", "fair_sharing"))(
@@ -312,32 +329,17 @@ solve_cycle = partial(jax.jit, static_argnames=("num_podsets", "fair_sharing"))(
 
 def solve_phase_a_impl(topo, usage, cohort_usage, requests, podset_active,
                        wl_cq, eligible, solvable, num_podsets: int,
-                       fair_sharing: bool = False):
-    """Phase A only: flavor assignment. Returns
-    (fit[W], borrows[W], chosen[W,P,R], asg_usage[W,F,R], share[W])."""
-    W, P, R = requests.shape
-    F = eligible.shape[2]
+                       fair_sharing: bool = False, start_rank=None):
+    """Phase A only: flavor assignment. Returns (fit[W], borrows[W],
+    chosen[W,P,R], chosen_borrow[W,P,R], asg_usage[W,F,R], share[W])."""
+    W = requests.shape[0]
     cohort_avail = _cohort_avail(topo, cohort_usage)
-    avail = _available(topo["nominal"], topo["borrow_limit"], topo["guaranteed"],
-                       usage, cohort_avail, topo["cq_cohort"])
-    asg_usage = jnp.zeros((W, F, R), jnp.int64)
-    chosen_all = []
-    ok_all = jnp.ones(W, bool)
-    borrow_all = jnp.zeros(W, bool)
-    for p in range(num_podsets):
-        chosen_p, ok_p, borrow_p, additions = _choose_flavors_one_podset(
-            requests[:, p, :], eligible[:, p, :], wl_cq, usage, asg_usage,
-            avail, topo)
-        active = podset_active[:, p]
-        chosen_all.append(jnp.where(active[:, None], chosen_p, -1))
-        ok_all &= jnp.where(active, ok_p, True)
-        borrow_all |= jnp.where(active, borrow_p, False)
-        asg_usage += jnp.where(active[:, None, None], additions, 0)
-    chosen = jnp.stack(chosen_all, axis=1)
-    fit = ok_all & solvable & jnp.any(podset_active, axis=1)
+    fit, borrows, chosen, chosen_borrow, asg_usage = _phase_a(
+        topo, usage, cohort_avail, requests, podset_active, wl_cq, eligible,
+        solvable, num_podsets, start_rank)
     share = (_drf_share(topo, usage, asg_usage, wl_cq) if fair_sharing
              else jnp.zeros(W, jnp.int64))
-    return fit, borrow_all, chosen, asg_usage, share
+    return fit, borrows, chosen, chosen_borrow, asg_usage, share
 
 
 def solve_phase_b_domains_impl(topo, usage, cohort_usage, asg_usage, fit,
@@ -447,14 +449,15 @@ def build_order_grid(fit, borrows, priority, timestamp, wl_cq, cq_cohort,
 def solve_cycle_cohort_parallel(topo_dev, topo_np, usage, cohort_usage,
                                 requests, podset_active, wl_cq, priority,
                                 timestamp, eligible, solvable,
-                                num_podsets: int, fair_sharing: bool = False):
+                                num_podsets: int, fair_sharing: bool = False,
+                                start_rank=None):
     """The production single-chip path: Phase A on device, order grid on
     host, cohort-parallel Phase B on device. Same outputs as solve_cycle."""
     import numpy as np
-    fit, borrows, chosen, asg_usage, share = solve_phase_a(
+    fit, borrows, chosen, chosen_borrow, asg_usage, share = solve_phase_a(
         topo_dev, usage, cohort_usage, requests, podset_active, wl_cq,
         eligible, solvable, num_podsets=num_podsets,
-        fair_sharing=fair_sharing)
+        fair_sharing=fair_sharing, start_rank=start_rank)
     grid = build_order_grid(fit, borrows, priority, timestamp,
                             np.asarray(wl_cq), topo_np.cq_cohort,
                             topo_np.cohort_subtree.shape[0],
@@ -464,7 +467,8 @@ def solve_cycle_cohort_parallel(topo_dev, topo_np, usage, cohort_usage,
         topo_dev, usage, cohort_usage, asg_usage, fit, wl_cq,
         jnp.asarray(grid))
     return {"admitted": admitted, "chosen": chosen, "borrows": borrows,
-            "fit": fit, "usage": usage_out, "cohort_usage": cohort_out}
+            "chosen_borrow": chosen_borrow, "fit": fit, "usage": usage_out,
+            "cohort_usage": cohort_out}
 
 
 def topo_to_device(topo) -> dict:

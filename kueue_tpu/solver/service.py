@@ -22,6 +22,7 @@ from typing import Optional
 
 import numpy as np
 
+from kueue_tpu import features
 from kueue_tpu.cache.snapshot import Snapshot
 from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.core.resources import FlavorResource
@@ -81,10 +82,14 @@ class BatchSolver:
             return {}
 
         result = None
+        start_rank = batch.start_rank if batch.start_rank.any() else None
         # The native ABI encodes the flat (single-level) cohort forest and
-        # no fair-share sort key; those go through the jit path.
+        # no fair-share sort key, flavor-resume state, or per-resource
+        # borrow flags (needed for TryNextFlavor resume decode); those go
+        # through the jit path.
         if (self.backend == "native" and self.mesh is None
-                and topo.cq_chain.shape[1] == 1 and not fair_sharing):
+                and topo.cq_chain.shape[1] == 1 and not fair_sharing
+                and start_rank is None and not topo.prefer_no_borrow.any()):
             from kueue_tpu import native
             result = native.solve_cycle_native(
                 topo, state.usage, state.cohort_usage, batch.requests,
@@ -95,7 +100,8 @@ class BatchSolver:
                 from kueue_tpu.parallel.mesh import solve_cycle_sharded
                 result = solve_cycle_sharded(self.mesh, topo_dev, state, batch,
                                              self.max_podsets,
-                                             fair_sharing=fair_sharing)
+                                             fair_sharing=fair_sharing,
+                                             start_rank=start_rank)
             else:
                 # cohort-parallel Phase B: scan length = max workloads per
                 # conflict domain instead of the whole batch
@@ -104,26 +110,34 @@ class BatchSolver:
                     batch.requests, batch.podset_active, batch.wl_cq,
                     batch.priority, batch.timestamp, batch.eligible,
                     batch.solvable, num_podsets=self.max_podsets,
-                    fair_sharing=fair_sharing)
+                    fair_sharing=fair_sharing, start_rank=start_rank)
 
         admitted = np.asarray(result["admitted"])
         fit = np.asarray(result["fit"])
         chosen = np.asarray(result["chosen"])
         borrows = np.asarray(result["borrows"])
+        chosen_borrow = np.asarray(result.get("chosen_borrow"))
 
         out = {}
         for wi in range(batch.n):
             if not fit[wi]:
                 continue  # CPU path: preemption / partial admission / status
-            out[wi] = (self._build_assignment(entries[wi], snapshot, topo,
-                                              chosen[wi], bool(borrows[wi])),
-                       bool(admitted[wi]))
+            out[wi] = (self._build_assignment(
+                entries[wi], snapshot, topo, chosen[wi], bool(borrows[wi]),
+                chosen_borrow[wi] if chosen_borrow.ndim == 3 else None),
+                bool(admitted[wi]))
         return out
 
     def _build_assignment(self, info: wlpkg.Info, snapshot: Snapshot,
                           topo: encode.Topology, chosen_w: np.ndarray,
-                          borrows: bool) -> fa.Assignment:
-        """Decode device output into the scheduler's Assignment form."""
+                          borrows: bool,
+                          chosen_borrow_w=None) -> fa.Assignment:
+        """Decode device output into the scheduler's Assignment form,
+        including the LastTriedFlavorIdx resume state exactly as the CPU
+        assigner stores it (reference: flavorassigner.go:289-324): the
+        rank where the search ended, -1 when the list was exhausted
+        (chosen == last flavor, or a TryNextFlavor CQ settling for a
+        borrowing fit after scanning the whole list)."""
         from kueue_tpu.api.corev1 import RESOURCE_PODS
         assignment = fa.Assignment(borrowing=borrows)
         cq = snapshot.cluster_queues[info.cluster_queue]
@@ -132,6 +146,14 @@ class BatchSolver:
             cohort_generation=(cq.cohort.allocatable_resource_generation
                                if cq.cohort else 0))
         qi = topo.cq_index[info.cluster_queue]
+        group_size = {}
+        for fi, gi in enumerate(topo.flavor_group[qi]):
+            if gi >= 0:
+                group_size[int(gi)] = group_size.get(int(gi), 0) + 1
+        prefer_nb = bool(topo.prefer_no_borrow[qi])
+        # With FlavorFungibility off the CPU assigner never writes the
+        # tried index (stays at the dataclass default 0).
+        fungibility_on = features.enabled(features.FLAVOR_FUNGIBILITY)
         for pi, psr in enumerate(info.total_requests):
             reqs = dict(psr.requests)
             if topo.covers_pods[qi]:
@@ -143,8 +165,17 @@ class BatchSolver:
                 if v > 0 and fi < 0:
                     raise AssertionError("solver admitted workload without flavor")
                 fname = topo.flavors[fi] if fi >= 0 else topo.flavors[0]
+                tried = -1 if fungibility_on else 0
+                if fi >= 0 and fungibility_on:
+                    rank = int(topo.flavor_rank[qi, fi])
+                    gi = int(topo.group_id[qi, ri])
+                    exhausted = rank == group_size.get(gi, 1) - 1
+                    if prefer_nb and chosen_borrow_w is not None \
+                            and bool(chosen_borrow_w[pi, ri]):
+                        exhausted = True  # scanned past it looking for no-borrow
+                    tried = -1 if exhausted else rank
                 flavors[r] = fa.FlavorAssignment(name=fname, mode=fa.FIT,
-                                                 tried_flavor_idx=-1)
+                                                 tried_flavor_idx=tried)
             ps = fa.PodSetAssignmentResult(name=psr.name, flavors=flavors,
                                            requests=reqs, count=psr.count)
             assignment.pod_sets.append(ps)
@@ -152,6 +183,6 @@ class BatchSolver:
             for r, fassign in flavors.items():
                 fr = FlavorResource(fassign.name, r)
                 assignment.usage[fr] = assignment.usage.get(fr, 0) + reqs[r]
-                flavor_idx[r] = -1
+                flavor_idx[r] = fassign.tried_flavor_idx
             assignment.last_state.last_tried_flavor_idx.append(flavor_idx)
         return assignment

@@ -82,15 +82,16 @@ class TestNestedCohortCache:
 
 class TestNestedCohortInvalidation:
     def test_tree_wide_generation_aggregate(self):
-        """A generation bump anywhere in a tree must be visible from every
-        cohort in it (flavor-resume invalidation across subtrees)."""
+        """A capacity change anywhere in a tree must be visible from every
+        cohort in it (flavor-resume invalidation across subtrees), and
+        the generation must grow monotonically."""
         env = Env()
         three_level_env(env)
         snap1 = env.cache.snapshot()
         gens1 = {c.name: c.allocatable_resource_generation
                  for c in (snap1.cluster_queues["a"].cohort,
                            snap1.cluster_queues["b"].cohort)}
-        assert gens1["left"] == gens1["right"]  # shared tree aggregate
+        assert gens1["left"] == gens1["right"]  # shared capacity version
         # finishing a workload in b bumps b's generation only
         wl = (WorkloadWrapper("w").queue("lq-b").pod_set(count=1, cpu="4")
               .reserve("b").obj())
@@ -98,7 +99,24 @@ class TestNestedCohortInvalidation:
         env.cache.delete_workload(wl)
         snap2 = env.cache.snapshot()
         assert (snap2.cluster_queues["a"].cohort.allocatable_resource_generation
-                != gens1["left"])
+                > gens1["left"])
+
+    def test_generation_monotonic_across_tree_shrink(self):
+        """Detaching a subtree must not make generations go backwards —
+        stored resume state compares with `>` and would never invalidate
+        again (the shrink-then-edit trap)."""
+        env = Env()
+        three_level_env(env)
+        g1 = env.cache.snapshot().cluster_queues["a"].cohort \
+            .allocatable_resource_generation
+        env.add_cohort("right", "")  # tree shrinks
+        g2 = env.cache.snapshot().cluster_queues["a"].cohort \
+            .allocatable_resource_generation
+        assert g2 > g1
+        env.add_cohort("root", "", flavor_quotas("default", cpu="50"))
+        g3 = env.cache.snapshot().cluster_queues["a"].cohort \
+            .allocatable_resource_generation
+        assert g3 > g2
 
     def test_solver_topology_invalidated_by_reparent(self):
         """Cohort re-parents don't bump CQ generations; the solver's

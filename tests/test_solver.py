@@ -302,6 +302,107 @@ class TestSolverFairSharing:
             assert_differential(setup, workloads, fair_sharing=True)
 
 
+class TestSolverFungibilityState:
+    """Solver admissions must carry the same LastTriedFlavorIdx resume
+    state as the CPU assigner (reference: flavorassigner.go:289-324)."""
+
+    @staticmethod
+    def _last_states(setup, workloads):
+        """Returns (cpu last_state list, solver last_state list) for the
+        nominated heads of one cycle."""
+        from kueue_tpu.scheduler import flavorassigner as fa
+        env = build_env(setup, solver=True)
+        for w in workloads():
+            env.submit(w)
+        heads = env.queues.heads(timeout=0.01)
+        snapshot = env.cache.snapshot()
+        cpu_states, solver_states = [], []
+        for info in heads:
+            cq = snapshot.cluster_queues[info.cluster_queue]
+            assigner = fa.FlavorAssigner(info, cq, snapshot.resource_flavors,
+                                         False, lambda *a: False)
+            cpu_states.append(assigner.assign().last_state)
+        decisions = env.scheduler.solver.solve(snapshot, heads)
+        for i in range(len(heads)):
+            assignment, _ = decisions[i]
+            solver_states.append(assignment.last_state)
+        return cpu_states, solver_states
+
+    def test_mid_list_fit_records_rank(self):
+        def setup(env):
+            env.add_flavor("f0")
+            env.add_flavor("f1")
+            env.add_flavor("f2")
+            env.add_cq(ClusterQueueWrapper("cq")
+                       .resource_group(flavor_quotas("f0", cpu="0"),
+                                       flavor_quotas("f1", cpu="8"),
+                                       flavor_quotas("f2", cpu="8")).obj(), "lq")
+
+        cpu, tpu = self._last_states(
+            setup, lambda: [WorkloadWrapper("w").queue("lq")
+                            .pod_set(count=1, cpu="4").obj()])
+        assert cpu[0].last_tried_flavor_idx == tpu[0].last_tried_flavor_idx
+        assert tpu[0].last_tried_flavor_idx == [{"cpu": 1}]
+
+    def test_last_flavor_fit_records_minus_one(self):
+        def setup(env):
+            env.add_flavor("f0")
+            env.add_flavor("f1")
+            env.add_cq(ClusterQueueWrapper("cq")
+                       .resource_group(flavor_quotas("f0", cpu="0"),
+                                       flavor_quotas("f1", cpu="8")).obj(), "lq")
+
+        cpu, tpu = self._last_states(
+            setup, lambda: [WorkloadWrapper("w").queue("lq")
+                            .pod_set(count=1, cpu="4").obj()])
+        assert cpu[0].last_tried_flavor_idx == tpu[0].last_tried_flavor_idx
+        assert tpu[0].last_tried_flavor_idx == [{"cpu": -1}]
+
+    def test_try_next_flavor_borrow_fit_exhausts_list(self):
+        # TryNextFlavor + only borrowing fits anywhere: CPU scans the
+        # whole list, stores -1, picks the first borrow fit.
+        def setup(env):
+            env.add_flavor("f0")
+            env.add_flavor("f1")
+            env.add_cq(ClusterQueueWrapper("a").cohort("team")
+                       .flavor_fungibility(when_can_borrow=api.TRY_NEXT_FLAVOR)
+                       .resource_group(flavor_quotas("f0", cpu="2"),
+                                       flavor_quotas("f1", cpu="2")).obj(), "lq-a")
+            env.add_cq(ClusterQueueWrapper("b").cohort("team")
+                       .resource_group(flavor_quotas("f0", cpu="8"),
+                                       flavor_quotas("f1", cpu="8")).obj(), "lq-b")
+
+        cpu, tpu = self._last_states(
+            setup, lambda: [WorkloadWrapper("w").queue("lq-a")
+                            .pod_set(count=1, cpu="4").obj()])
+        assert cpu[0].last_tried_flavor_idx == tpu[0].last_tried_flavor_idx
+        assert tpu[0].last_tried_flavor_idx == [{"cpu": -1}]
+
+    def test_resume_differential_across_cycles(self):
+        """Intra-cycle skip records resume state; the next cycle must
+        start from it identically on both paths."""
+        def setup(env):
+            env.add_flavor("f0")
+            env.add_flavor("f1")
+            env.add_cq(ClusterQueueWrapper("a").cohort("team")
+                       .resource_group(flavor_quotas("f0", cpu="8")).obj(), "lq-a")
+            env.add_cq(ClusterQueueWrapper("b").cohort("team")
+                       .resource_group(flavor_quotas("f0", cpu="0"),
+                                       flavor_quotas("f1", cpu="4")).obj(), "lq-b")
+
+        def workloads():
+            return [
+                WorkloadWrapper("wa").queue("lq-a").priority(10).creation(1)
+                .pod_set(count=1, cpu="8").obj(),
+                WorkloadWrapper("wb").queue("lq-b").priority(1).creation(2)
+                .pod_set(count=1, cpu="4").obj(),
+            ]
+
+        result = assert_differential(setup, workloads, cycles=3)
+        assert set(result) == {"default/wa", "default/wb"}
+        assert dict(result["default/wb"][0][0])["cpu"] == "f1"
+
+
 class TestSolverRandomDifferential:
     @pytest.mark.parametrize("seed", range(12))
     def test_random_single_cycle(self, seed):

@@ -106,6 +106,7 @@ class Scheduler:
         self.fair_sharing_enabled = fair_sharing_enabled
         self.clock = clock
         self.attempt_count = 0
+        self.preemption_fallbacks = 0  # device-preemption error fallbacks
         self.metrics = metrics
         # Optional kueue_tpu.solver.BatchSolver: batched fit-mode admission
         # on TPU; CPU path handles the remainder (preemption, partial
@@ -157,7 +158,19 @@ class Scheduler:
         if self.solver is not None and len(heads) >= self.solver_min_heads:
             solver_entries, heads = self._solve_batch(heads, snapshot, timeout)
 
-        entries = self.nominate(heads, snapshot)
+        # Device preemption: defer preempt-mode target selection out of
+        # nominate and solve all entries' simulations in one batched
+        # program (fairPreemptions' DRF heap stays on the CPU path).
+        # solver_min_heads gates dispatch overhead exactly as for the
+        # fit-mode batch.
+        defer_preemption = (self.solver is not None
+                            and not self.fair_sharing_enabled
+                            and len(heads) + len(solver_entries)
+                            >= self.solver_min_heads)
+        entries = self.nominate(heads, snapshot,
+                                defer_preemption=defer_preemption)
+        if defer_preemption:
+            self._solve_preemption_batch(entries, snapshot)
         entries.sort(key=self._entry_sort_key())
 
         preempted_workloads: set = set()
@@ -317,7 +330,8 @@ class Scheduler:
 
     # --- nomination (reference: scheduler.go:404-441) ---
 
-    def nominate(self, heads: list, snapshot: Snapshot) -> list:
+    def nominate(self, heads: list, snapshot: Snapshot,
+                 defer_preemption: bool = False) -> list:
         entries = []
         for w in heads:
             cq = snapshot.cluster_queues.get(w.cluster_queue)
@@ -328,7 +342,8 @@ class Scheduler:
             if err is not None:
                 e.inadmissible_msg, e.requeue_reason = err
             else:
-                e.assignment, e.preemption_targets = self.get_assignments(w, snapshot)
+                e.assignment, e.preemption_targets = self.get_assignments(
+                    w, snapshot, defer_preemption=defer_preemption)
                 e.inadmissible_msg = e.assignment.message()
                 w.last_assignment = e.assignment.last_state
                 if self.fair_sharing_enabled and e.assignment.representative_mode() != fa.NO_FIT:
@@ -337,7 +352,53 @@ class Scheduler:
             entries.append(e)
         return entries
 
-    def get_assignments(self, wl: wlpkg.Info, snapshot: Snapshot):
+    def _solve_preemption_batch(self, entries: list, snapshot: Snapshot) -> None:
+        """Resolve deferred preempt-mode entries on device in one batch
+        (kueue_tpu.solver.preempt); entries the device finds infeasible
+        fall back to the CPU path to preserve the partial-admission
+        semantics of get_assignments."""
+        from kueue_tpu.solver import preempt as devpreempt
+        pending = [e for e in entries if e.preemption_targets is None]
+        for e in pending:
+            e.preemption_targets = []
+        if not pending:
+            return
+        try:
+            problems = []
+            requests_by, frs_by, cq_by = {}, {}, {}
+            for i, e in enumerate(pending):
+                requests_by[i] = e.assignment.total_requests_for(e.info)
+                frs_by[i] = fa.flavor_resources_need_preemption(e.assignment)
+                cq_by[i] = e.info.cluster_queue
+                problems.extend(devpreempt.build_problems(
+                    i, e.info, requests_by[i], frs_by[i], snapshot,
+                    self.preemptor))
+            if problems:
+                batch = devpreempt.encode_problems(
+                    problems, snapshot, requests_by, frs_by, cq_by)
+                mask, feasible = devpreempt.solve_preemption_batch(batch)
+                decisions = devpreempt.decode_targets(batch, mask, feasible,
+                                                      snapshot, cq_by)
+                for i, e in enumerate(pending):
+                    e.preemption_targets = decisions.get(i, [])
+        except Exception:  # noqa: BLE001 — device failure: CPU fallback
+            self.preemption_fallbacks += 1
+            for e in pending:
+                e.assignment, e.preemption_targets = self.get_assignments(
+                    e.info, snapshot)
+            return
+        # No feasible target set: the CPU path would now attempt partial
+        # admission (get_assignments' reducer branch).
+        if features.enabled(features.PARTIAL_ADMISSION):
+            for e in pending:
+                if not e.preemption_targets and e.info.can_be_partially_admitted():
+                    e.assignment, e.preemption_targets = self.get_assignments(
+                        e.info, snapshot)
+                    e.inadmissible_msg = e.assignment.message()
+                    e.info.last_assignment = e.assignment.last_state
+
+    def get_assignments(self, wl: wlpkg.Info, snapshot: Snapshot,
+                        defer_preemption: bool = False):
         """reference: scheduler.go:469-507."""
         cq = snapshot.cluster_queues[wl.cluster_queue]
         oracle = make_reclaim_oracle(self.preemptor, snapshot)
@@ -347,6 +408,8 @@ class Scheduler:
         mode = full.representative_mode()
         if mode == fa.FIT:
             return full, []
+        if defer_preemption and mode == fa.PREEMPT:
+            return full, None  # targets resolved by _solve_preemption_batch
         targets: list = []
         if mode == fa.PREEMPT:
             targets = self.preemptor.get_targets(wl, full, snapshot)

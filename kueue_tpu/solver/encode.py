@@ -21,7 +21,6 @@ from typing import Optional
 
 import numpy as np
 
-from kueue_tpu import features
 from kueue_tpu.api import kueue as api
 from kueue_tpu.api.corev1 import RESOURCE_PODS
 from kueue_tpu.cache.snapshot import Snapshot

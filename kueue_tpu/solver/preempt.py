@@ -1,0 +1,431 @@
+"""Device-side preemption target selection (TPU solver v2).
+
+Replaces the per-entry sequential simulation of the reference's
+minimalPreemptions (remove candidates in order until the preemptor fits,
+then fill back in reverse — pkg/scheduler/preemption/preemption.go:237-310)
+with one batched program: every preempt-mode entry's simulation runs as an
+independent lane of a vmapped lax.scan over a padded candidate axis.
+
+Host side (cheap, O(entries x candidates) filters):
+- candidate discovery + ordering (findCandidates / candidatesOrdering,
+  preemption.go:488-614) — static per entry, no simulation state
+- the get_targets_internal policy dispatch (preemption.go:116-171),
+  encoded as up to two device "problems" per entry (the under-nominal
+  reclaim attempt falls back to same-queue-only)
+
+Device side (the hot loop):
+- per problem: a local sub-snapshot of the entry's cohort tree
+  (CQs/cohorts re-indexed into small padded spaces, quotas/usage projected
+  onto the entry's requested FlavorResources), then a K-step scan that
+  removes candidates (with the dynamic cq-is-borrowing skip and the
+  borrowWithinCohort priority-threshold borrowing flip), checks fit after
+  each removal, and a reverse fill-back scan.
+
+Fair-sharing preemption (fairPreemptions' DRF heap) stays on the CPU
+path; the scheduler gates this solver off when fair sharing is enabled.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.core import priority as prioritypkg
+from kueue_tpu.scheduler import preemption as cpu_preempt
+
+BIG = np.int64(2**61)
+
+
+def _bucket(n: int, minimum: int = 4) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class PreemptionProblem:
+    """One minimal_preemptions run in local index space."""
+
+    entry_idx: int = -1
+    candidates: list = field(default_factory=list)  # workload Infos, ordered
+    allow_borrowing: bool = True
+    threshold_active: bool = False
+    threshold: int = 0
+
+
+@dataclass
+class PreemptionBatch:
+    problems: list = field(default_factory=list)
+    # device tensors, leading axis = problem
+    requests: np.ndarray = None       # [B,RF] int64
+    frs_np: np.ndarray = None         # [B,RF] bool — needs-preemption frs
+    nominal: np.ndarray = None        # [B,QL,RF]
+    borrow_limit: np.ndarray = None   # [B,QL,RF]
+    guaranteed: np.ndarray = None     # [B,QL,RF]
+    usage: np.ndarray = None          # [B,QL,RF]
+    cq_chain: np.ndarray = None       # [B,QL,DC] local cohort ids
+    c_subtree: np.ndarray = None      # [B,CL,RF]
+    c_guaranteed: np.ndarray = None   # [B,CL,RF]
+    c_borrow_limit: np.ndarray = None  # [B,CL,RF]
+    c_usage: np.ndarray = None        # [B,CL,RF]
+    cand_q: np.ndarray = None         # [B,K] local cq (-1 pad)
+    cand_usage: np.ndarray = None     # [B,K,RF]
+    cand_prio: np.ndarray = None      # [B,K]
+    allow_borrowing: np.ndarray = None   # [B] bool
+    threshold_active: np.ndarray = None  # [B] bool
+    threshold: np.ndarray = None         # [B] int64
+    has_cohort: np.ndarray = None        # [B] bool
+
+
+def build_problems(entry_idx: int, wl, requests: dict, frs_need_preemption: set,
+                   snapshot, preemptor: "cpu_preempt.Preemptor") -> list:
+    """get_targets_internal's policy dispatch (preemption.go:116-171) as a
+    list of 1-2 PreemptionProblems (first non-empty result wins)."""
+    cq = snapshot.cluster_queues[wl.cluster_queue]
+    candidates = preemptor.find_candidates(wl.obj, cq, frs_need_preemption)
+    if not candidates:
+        return []
+    # candidatesOrdering — reuse the CPU oracle's key so the two paths
+    # can never diverge on ordering (preemption.go:587-614).
+    candidates.sort(key=preemptor._candidate_sort_key(cq.name))
+    same_queue = [c for c in candidates if c.cluster_queue == cq.name]
+
+    if len(same_queue) == len(candidates):
+        return [PreemptionProblem(entry_idx, candidates, allow_borrowing=True)]
+
+    borrow_within, threshold = cpu_preempt.can_borrow_within_cohort(cq, wl.obj)
+    if borrow_within:
+        cands = candidates
+        if not cpu_preempt.queue_under_nominal(frs_need_preemption, cq):
+            cands = [c for c in candidates
+                     if c.cluster_queue == cq.name
+                     or prioritypkg.priority(c.obj) < threshold]
+        return [PreemptionProblem(entry_idx, cands, allow_borrowing=True,
+                                  threshold_active=True, threshold=threshold)]
+
+    problems = []
+    if cpu_preempt.queue_under_nominal(frs_need_preemption, cq):
+        problems.append(PreemptionProblem(entry_idx, candidates,
+                                          allow_borrowing=False))
+    problems.append(PreemptionProblem(entry_idx, same_queue,
+                                      allow_borrowing=True))
+    return problems
+
+
+def encode_problems(problems: list, snapshot, requests_by_entry: dict,
+                    frs_np_by_entry: dict, wl_cq_by_entry: dict) -> PreemptionBatch:
+    """Project each problem's cohort tree onto local padded index spaces."""
+    B = _bucket(max(1, len(problems)), 1)
+    RF = _bucket(max(max((len(requests_by_entry[p.entry_idx]) for p in problems),
+                         default=1), 1))
+    QL = _bucket(max(max((1 + len({c.cluster_queue for c in p.candidates
+                                   if c.cluster_queue != wl_cq_by_entry[p.entry_idx]})
+                          for p in problems), default=1), 1))
+    K = _bucket(max(max((len(p.candidates) for p in problems), default=1), 1))
+
+    # local cohort space: union of chains of all local CQs
+    def chain_of(cq_snap):
+        out = []
+        node = cq_snap.cohort
+        while node is not None:
+            out.append(node)
+            node = node.parent
+        return out
+
+    CL, DC = 1, 1
+    for p in problems:
+        cq_names = {wl_cq_by_entry[p.entry_idx]} | {
+            c.cluster_queue for c in p.candidates}
+        cohorts = {}
+        for name in cq_names:
+            ch = chain_of(snapshot.cluster_queues[name])
+            DC = max(DC, len(ch))
+            for c in ch:
+                cohorts[c.name] = c
+        CL = max(CL, len(cohorts))
+    CL = _bucket(CL)
+
+    batch = PreemptionBatch(problems=list(problems))
+    batch.requests = np.zeros((B, RF), np.int64)
+    batch.frs_np = np.zeros((B, RF), bool)
+    batch.nominal = np.zeros((B, QL, RF), np.int64)
+    batch.borrow_limit = np.full((B, QL, RF), BIG, np.int64)
+    batch.guaranteed = np.zeros((B, QL, RF), np.int64)
+    batch.usage = np.zeros((B, QL, RF), np.int64)
+    batch.cq_chain = np.full((B, QL, DC), -1, np.int32)
+    batch.c_subtree = np.zeros((B, CL, RF), np.int64)
+    batch.c_guaranteed = np.zeros((B, CL, RF), np.int64)
+    batch.c_borrow_limit = np.full((B, CL, RF), BIG, np.int64)
+    batch.c_usage = np.zeros((B, CL, RF), np.int64)
+    batch.cand_q = np.full((B, K), -1, np.int32)
+    batch.cand_usage = np.zeros((B, K, RF), np.int64)
+    batch.cand_prio = np.zeros((B, K), np.int64)
+    batch.allow_borrowing = np.zeros(B, bool)
+    batch.threshold_active = np.zeros(B, bool)
+    batch.threshold = np.zeros(B, np.int64)
+    batch.has_cohort = np.zeros(B, bool)
+
+    for bi, p in enumerate(problems):
+        ei = p.entry_idx
+        requests = requests_by_entry[ei]
+        frs = list(requests)
+        fr_index = {fr: i for i, fr in enumerate(frs)}
+        preemptor_cq = wl_cq_by_entry[ei]
+
+        local_cqs = [preemptor_cq]
+        for c in p.candidates:
+            if c.cluster_queue not in local_cqs:
+                local_cqs.append(c.cluster_queue)
+        cq_index = {n: i for i, n in enumerate(local_cqs)}
+        cohort_index: dict = {}
+
+        for qn, qi in cq_index.items():
+            cq_snap = snapshot.cluster_queues[qn]
+            for ci, cobj in enumerate(chain_of(cq_snap)):
+                li = cohort_index.setdefault(cobj.name, len(cohort_index))
+                batch.cq_chain[bi, qi, ci] = li
+            for fr, i in fr_index.items():
+                quota = cq_snap.quota_for(fr)
+                batch.nominal[bi, qi, i] = quota.nominal
+                if quota.borrowing_limit is not None:
+                    batch.borrow_limit[bi, qi, i] = quota.borrowing_limit
+                batch.guaranteed[bi, qi, i] = \
+                    cq_snap.resource_node.guaranteed_quota(fr)
+                batch.usage[bi, qi, i] = cq_snap.usage_for(fr)
+        for cname, li in cohort_index.items():
+            # find the cohort snapshot object via any chain
+            cobj = None
+            for qn in local_cqs:
+                for c in chain_of(snapshot.cluster_queues[qn]):
+                    if c.name == cname:
+                        cobj = c
+                        break
+                if cobj is not None:
+                    break
+            rn = cobj.resource_node
+            for fr, i in fr_index.items():
+                batch.c_subtree[bi, li, i] = rn.subtree_quota.get(fr, 0)
+                batch.c_guaranteed[bi, li, i] = rn.guaranteed_quota(fr)
+                quota = rn.quotas.get(fr)
+                if quota is not None and quota.borrowing_limit is not None:
+                    batch.c_borrow_limit[bi, li, i] = quota.borrowing_limit
+                batch.c_usage[bi, li, i] = rn.usage.get(fr, 0)
+
+        for i, fr in enumerate(frs):
+            batch.requests[bi, i] = requests[fr]
+            batch.frs_np[bi, i] = fr in frs_np_by_entry[ei]
+        for ki, cand in enumerate(p.candidates):
+            batch.cand_q[bi, ki] = cq_index[cand.cluster_queue]
+            batch.cand_prio[bi, ki] = prioritypkg.priority(cand.obj)
+            for fr, v in cand.flavor_resource_usage().items():
+                i = fr_index.get(fr)
+                if i is not None:
+                    batch.cand_usage[bi, ki, i] = v
+        batch.allow_borrowing[bi] = p.allow_borrowing
+        batch.threshold_active[bi] = p.threshold_active
+        batch.threshold[bi] = p.threshold if p.threshold_active else 0
+        batch.has_cohort[bi] = \
+            snapshot.cluster_queues[preemptor_cq].cohort is not None
+    return batch
+
+
+# --------------------------------------------------------------------------
+# Device kernel
+# --------------------------------------------------------------------------
+
+def _make_kernel():
+    import jax
+    import jax.numpy as jnp
+
+    NOLIM = 2**61
+
+    def avail_cq0(nominal, borrow_limit, guaranteed, usage, cq_chain,
+                  c_subtree, c_guar, c_bl, c_usage, has_cohort):
+        """available() for local CQ 0 (the preemptor's), walking its
+        cohort chain (reference: resource_node.go:89-104)."""
+        chain = cq_chain[0]                       # [DC]
+        DC = chain.shape[0]
+        RF = nominal.shape[1]
+        parent = jnp.zeros(RF, jnp.int64)
+        started = jnp.zeros((), bool)
+        for d in range(DC - 1, -1, -1):
+            c = chain[d]
+            valid = c >= 0
+            c_ = jnp.maximum(c, 0)
+            cu = c_usage[c_]
+            root_avail = c_subtree[c_] - cu
+            local = jnp.maximum(0, c_guar[c_] - cu)
+            cap = (c_subtree[c_] - c_guar[c_]) - jnp.maximum(0, cu - c_guar[c_]) \
+                + jnp.minimum(c_bl[c_], NOLIM // 4)
+            child = local + jnp.minimum(parent, cap)
+            new = jnp.where(started, child, root_avail)
+            parent = jnp.where(valid, new, parent)
+            started = started | valid
+        local0 = jnp.maximum(0, guaranteed[0] - usage[0])
+        cap0 = (nominal[0] - guaranteed[0]) - jnp.maximum(0, usage[0] - guaranteed[0]) \
+            + jnp.minimum(borrow_limit[0], NOLIM // 4)
+        with_cohort = local0 + jnp.minimum(parent, cap0)
+        return jnp.where(has_cohort, with_cohort, nominal[0] - usage[0])
+
+    def fits(requests, nominal, borrow_limit, guaranteed, usage, cq_chain,
+             c_subtree, c_guar, c_bl, c_usage, has_cohort, allow_borrowing):
+        """workload_fits (reference: preemption.go:576-585)."""
+        has_req = requests > 0
+        avail = avail_cq0(nominal, borrow_limit, guaranteed, usage, cq_chain,
+                          c_subtree, c_guar, c_bl, c_usage, has_cohort)
+        borrow_ok = allow_borrowing | \
+            jnp.all(~has_req | (usage[0] + requests <= nominal[0]))
+        return borrow_ok & jnp.all(~has_req | (requests <= avail))
+
+    def remove_usage(usage, c_usage, cq_chain, guaranteed, c_guar, q, val):
+        """removeUsage bubbling (reference: resource_node.go:133-143)."""
+        stored = usage[q] - guaranteed[q]          # pre-removal
+        usage = usage.at[q].add(-val)
+        delta = jnp.minimum(val, jnp.maximum(0, stored))
+        chain = cq_chain[q]
+        DC = chain.shape[0]
+        for d in range(DC):
+            c = chain[d]
+            valid = (c >= 0) & jnp.any(delta > 0)
+            c_ = jnp.maximum(c, 0)
+            stored_c = c_usage[c_] - c_guar[c_]
+            dd = jnp.where(valid, delta, 0)
+            c_usage = c_usage.at[c_].add(-dd)
+            delta = jnp.minimum(dd, jnp.maximum(0, stored_c))
+        return usage, c_usage
+
+    def add_usage(usage, c_usage, cq_chain, guaranteed, c_guar, q, val):
+        """addUsage bubbling (reference: resource_node.go:121-131)."""
+        local_avail = jnp.maximum(0, guaranteed[q] - usage[q])
+        usage = usage.at[q].add(val)
+        delta = jnp.maximum(0, val - local_avail)
+        chain = cq_chain[q]
+        DC = chain.shape[0]
+        for d in range(DC):
+            c = chain[d]
+            valid = c >= 0
+            c_ = jnp.maximum(c, 0)
+            local_c = jnp.maximum(0, c_guar[c_] - c_usage[c_])
+            dd = jnp.where(valid, delta, 0)
+            c_usage = c_usage.at[c_].add(dd)
+            delta = jnp.where(valid, jnp.maximum(0, dd - local_c), delta)
+        return usage, c_usage
+
+    def solve_one(requests, frs_np, nominal, borrow_limit, guaranteed, usage,
+                  cq_chain, c_subtree, c_guar, c_bl, c_usage, cand_q,
+                  cand_usage, cand_prio, allow_borrowing0, threshold_active,
+                  threshold, has_cohort):
+        K = cand_q.shape[0]
+
+        def fits_now(u, cu, ab):
+            return fits(requests, nominal, borrow_limit, guaranteed, u,
+                        cq_chain, c_subtree, c_guar, c_bl, cu, has_cohort, ab)
+
+        # --- forward: remove until fit (minimalPreemptions) ---
+        def fwd(carry, k):
+            u, cu, ab, done, targets = carry
+            valid = (cand_q[k] >= 0) & ~done
+            q = jnp.maximum(cand_q[k], 0)
+            in_cq = q == 0
+            # dynamic skip: other-CQ candidate whose CQ stopped borrowing
+            borrowing_cq = jnp.any(frs_np & (u[q] > nominal[q]))
+            skip = (~in_cq) & ~borrowing_cq
+            # borrowWithinCohort threshold: candidate at/above threshold
+            # forbids borrowing for the remainder (preemption.go:252-270)
+            at_or_above = threshold_active & (~in_cq) & \
+                (cand_prio[k] >= threshold)
+            ab = ab & ~(valid & ~skip & at_or_above)
+            do = valid & ~skip
+            val = jnp.where(do, cand_usage[k], 0)
+            u2, cu2 = remove_usage(u, cu, cq_chain, guaranteed, c_guar, q, val)
+            u = jnp.where(do, u2, u)
+            cu = jnp.where(do, cu2, cu)
+            targets = targets.at[k].set(do)
+            done = done | (do & fits_now(u, cu, ab))
+            return (u, cu, ab, done, targets), None
+
+        init = (usage, c_usage, allow_borrowing0, jnp.zeros((), bool),
+                jnp.zeros(K, bool))
+        (u, cu, ab, done, targets), _ = jax.lax.scan(
+            fwd, init, jnp.arange(K))
+
+        # no fit => no targets (preemption.go:300-303)
+        targets = targets & done
+
+        # --- reverse: fill back (fillBackWorkloads) — skip the last-added
+        # target (the one that achieved the fit) ---
+        last_idx = jnp.where(done,
+                             (K - 1) - jnp.argmax(targets[::-1], axis=0), -1)
+
+        def back(carry, k_rev):
+            u, cu, targets = carry
+            k = K - 1 - k_rev
+            consider = targets[k] & (k != last_idx)
+            q = jnp.maximum(cand_q[k], 0)
+            val = jnp.where(consider, cand_usage[k], 0)
+            u2, cu2 = add_usage(u, cu, cq_chain, guaranteed, c_guar, q, val)
+            still = fits_now(u2, cu2, ab)
+            keep_back = consider & still     # workload comes back
+            u = jnp.where(keep_back, u2, u)
+            cu = jnp.where(keep_back, cu2, cu)
+            targets = targets.at[k].set(targets[k] & ~keep_back)
+            return (u, cu, targets), None
+
+        (_, _, targets), _ = jax.lax.scan(back, (u, cu, targets),
+                                          jnp.arange(K))
+        return targets, done
+
+    solve = jax.jit(jax.vmap(solve_one))
+    return solve
+
+
+_KERNEL = None
+
+
+def solve_preemption_batch(batch: PreemptionBatch):
+    """Returns (targets_mask [B,K] bool, feasible [B] bool)."""
+    global _KERNEL
+    import jax.numpy as jnp
+    if _KERNEL is None:
+        _KERNEL = _make_kernel()
+    args = (batch.requests, batch.frs_np, batch.nominal, batch.borrow_limit,
+            batch.guaranteed, batch.usage, batch.cq_chain, batch.c_subtree,
+            batch.c_guaranteed, batch.c_borrow_limit, batch.c_usage,
+            batch.cand_q, batch.cand_usage, batch.cand_prio,
+            batch.allow_borrowing, batch.threshold_active, batch.threshold,
+            batch.has_cohort)
+    targets, feasible = _KERNEL(*tuple(jnp.asarray(a) for a in args))
+    return np.asarray(targets), np.asarray(feasible)
+
+
+def decode_targets(batch: PreemptionBatch, targets_mask: np.ndarray,
+                   feasible: np.ndarray, snapshot,
+                   wl_cq_by_entry: dict) -> dict:
+    """entry_idx -> list[Target]; the first feasible problem per entry
+    wins (matching get_targets_internal's fallthrough order)."""
+    out: dict = {}
+    for bi, p in enumerate(batch.problems):
+        ei = p.entry_idx
+        if ei in out and out[ei]:
+            continue
+        if not feasible[bi]:
+            out.setdefault(ei, [])
+            continue
+        preemptor_cq = wl_cq_by_entry[ei]
+        targets = []
+        for ki, cand in enumerate(p.candidates):
+            if not targets_mask[bi, ki]:
+                continue
+            if cand.cluster_queue == preemptor_cq:
+                reason = api.IN_CLUSTER_QUEUE_REASON
+            elif p.threshold_active and \
+                    prioritypkg.priority(cand.obj) < p.threshold:
+                reason = api.IN_COHORT_RECLAIM_WHILE_BORROWING_REASON
+            else:
+                reason = api.IN_COHORT_RECLAMATION_REASON
+            targets.append(cpu_preempt.Target(cand, reason))
+        out[ei] = targets
+    return out

@@ -1,0 +1,311 @@
+"""Differential tests: device-side preemption target selection vs the
+CPU preemptor (the conformance oracle).
+
+Every scenario runs the full scheduler twice — CPU-only and
+solver-enabled — and requires identical admitted AND evicted sets
+(reference semantics: preemption.go:116-310).
+"""
+
+import random
+
+import pytest
+
+from kueue_tpu.api import kueue as api
+from tests.test_solver import admitted_map, build_env
+from tests.wrappers import ClusterQueueWrapper, WorkloadWrapper, flavor_quotas
+
+
+def run_both(setup, existing, workloads, cycles=1, fair_sharing=False):
+    envs = [build_env(setup, solver=False, fair_sharing=fair_sharing),
+            build_env(setup, solver=True, fair_sharing=fair_sharing)]
+    for env in envs:
+        for w in existing():
+            env.admit_existing(w)
+        for w in workloads():
+            env.submit(w)
+        for _ in range(cycles):
+            env.cycle()
+    return envs
+
+
+def assert_preemption_differential(setup, existing, workloads, cycles=1):
+    cpu_env, tpu_env = run_both(setup, existing, workloads, cycles)
+    assert tpu_env.scheduler.preemption_fallbacks == 0, \
+        "device preemption silently fell back to CPU"
+    cpu_evicted = set(cpu_env.client.evicted)
+    tpu_evicted = set(tpu_env.client.evicted)
+    assert cpu_evicted == tpu_evicted, \
+        f"CPU evicted {sorted(cpu_evicted)}, solver evicted {sorted(tpu_evicted)}"
+    assert admitted_map(cpu_env) == admitted_map(tpu_env)
+    # reasons must match too
+    for key in cpu_evicted:
+        c_reasons = [c.reason for c in cpu_env.client.evicted[key].status.conditions
+                     if c.type == api.WORKLOAD_PREEMPTED]
+        t_reasons = [c.reason for c in tpu_env.client.evicted[key].status.conditions
+                     if c.type == api.WORKLOAD_PREEMPTED]
+        assert c_reasons == t_reasons, (key, c_reasons, t_reasons)
+    return cpu_env, tpu_env
+
+
+class TestDevicePreemption:
+    def test_within_cq_priority(self):
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(ClusterQueueWrapper("cq")
+                       .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                       .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                       "lq")
+
+        def existing():
+            return [WorkloadWrapper("low").queue("lq").priority(1)
+                    .pod_set(count=1, cpu="8").reserve("cq").obj()]
+
+        def workloads():
+            return [WorkloadWrapper("high").queue("lq").priority(10)
+                    .pod_set(count=1, cpu="8").obj()]
+
+        cpu_env, _ = assert_preemption_differential(setup, existing, workloads)
+        assert set(cpu_env.client.evicted) == {"default/low"}
+
+    def test_minimal_set_not_all_candidates(self):
+        """Three 3-cpu victims, preemptor needs 4: exactly two removed
+        then one filled back — the minimal set is 2... or 1+fit? Both
+        paths must agree exactly."""
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(ClusterQueueWrapper("cq")
+                       .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                       .resource_group(flavor_quotas("default", cpu="9")).obj(),
+                       "lq")
+
+        def existing():
+            return [WorkloadWrapper(f"low{i}").queue("lq").priority(i)
+                    .pod_set(count=1, cpu="3").reserve("cq", now=float(i)).obj()
+                    for i in range(3)]
+
+        def workloads():
+            return [WorkloadWrapper("high").queue("lq").priority(10)
+                    .pod_set(count=1, cpu="4").obj()]
+
+        cpu_env, _ = assert_preemption_differential(setup, existing, workloads)
+        assert len(cpu_env.client.evicted) == 2  # 4 needed, 3+3 removed
+
+    def test_fill_back(self):
+        """Victims of different sizes: the greedy scan over-removes, the
+        fill-back returns the small one."""
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(ClusterQueueWrapper("cq")
+                       .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                       .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                       "lq")
+
+        def existing():
+            # candidate order: prio asc -> small(1) first, then big(2)
+            return [
+                WorkloadWrapper("small").queue("lq").priority(1)
+                .pod_set(count=1, cpu="2").reserve("cq", now=1.0).obj(),
+                WorkloadWrapper("big").queue("lq").priority(2)
+                .pod_set(count=1, cpu="8").reserve("cq", now=2.0).obj(),
+            ]
+
+        def workloads():
+            return [WorkloadWrapper("high").queue("lq").priority(10)
+                    .pod_set(count=1, cpu="8").obj()]
+
+        cpu_env, _ = assert_preemption_differential(setup, existing, workloads)
+        # removing small doesn't fit; removing big fits; fill-back returns small
+        assert set(cpu_env.client.evicted) == {"default/big"}
+
+    def test_reclaim_within_cohort(self):
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(ClusterQueueWrapper("a").cohort("team")
+                       .preemption(reclaim_within_cohort=api.PREEMPTION_ANY)
+                       .resource_group(flavor_quotas("default", cpu="6")).obj(),
+                       "lq-a")
+            env.add_cq(ClusterQueueWrapper("b").cohort("team")
+                       .resource_group(flavor_quotas("default", cpu="6")).obj(),
+                       "lq-b")
+
+        def existing():
+            return [WorkloadWrapper("borrower").queue("lq-b").priority(5)
+                    .pod_set(count=1, cpu="10").reserve("b").obj()]
+
+        def workloads():
+            return [WorkloadWrapper("claimant").queue("lq-a").priority(1)
+                    .pod_set(count=1, cpu="6").obj()]
+
+        cpu_env, _ = assert_preemption_differential(setup, existing, workloads)
+        assert set(cpu_env.client.evicted) == {"default/borrower"}
+
+    def test_reclaim_skips_non_borrowing_cq(self):
+        """Candidates in a cohort CQ that is not borrowing are skipped
+        dynamically during the scan."""
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(ClusterQueueWrapper("a").cohort("team")
+                       .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                                   reclaim_within_cohort=api.PREEMPTION_ANY)
+                       .resource_group(flavor_quotas("default", cpu="6")).obj(),
+                       "lq-a")
+            env.add_cq(ClusterQueueWrapper("b").cohort("team")
+                       .resource_group(flavor_quotas("default", cpu="6")).obj(),
+                       "lq-b")
+
+        def existing():
+            return [
+                WorkloadWrapper("in-quota").queue("lq-b").priority(0)
+                .pod_set(count=1, cpu="5").reserve("b", now=1.0).obj(),
+                WorkloadWrapper("own-low").queue("lq-a").priority(0)
+                .pod_set(count=1, cpu="6").reserve("a", now=2.0).obj(),
+            ]
+
+        def workloads():
+            return [WorkloadWrapper("claimant").queue("lq-a").priority(9)
+                    .pod_set(count=1, cpu="6").obj()]
+
+        cpu_env, _ = assert_preemption_differential(setup, existing, workloads)
+        assert set(cpu_env.client.evicted) == {"default/own-low"}
+
+    def test_borrow_within_cohort_threshold(self):
+        """borrowWithinCohort: candidates below the priority threshold are
+        preemptible while borrowing; ones at/above flip allow_borrowing."""
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(ClusterQueueWrapper("a").cohort("team")
+                       .preemption(reclaim_within_cohort=api.PREEMPTION_ANY,
+                                   borrow_within_cohort=api.BorrowWithinCohort(
+                                       policy=api.BORROW_WITHIN_COHORT_LOWER_PRIORITY,
+                                       max_priority_threshold=5))
+                       .resource_group(flavor_quotas("default", cpu="4")).obj(),
+                       "lq-a")
+            env.add_cq(ClusterQueueWrapper("b").cohort("team")
+                       .resource_group(flavor_quotas("default", cpu="8")).obj(),
+                       "lq-b")
+
+        def existing():
+            return [WorkloadWrapper("victim").queue("lq-b").priority(2)
+                    .pod_set(count=1, cpu="10").reserve("b").obj()]
+
+        def workloads():
+            # needs 6 = borrow 2 beyond nominal while preempting
+            return [WorkloadWrapper("preemptor").queue("lq-a").priority(10)
+                    .pod_set(count=1, cpu="6").obj()]
+
+        cpu_env, _ = assert_preemption_differential(setup, existing, workloads)
+        assert set(cpu_env.client.evicted) == {"default/victim"}
+
+    def test_nested_tree_reclaim(self):
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cohort("root")
+            env.add_cohort("left", "root")
+            env.add_cohort("right", "root")
+            env.add_cq(ClusterQueueWrapper("a").cohort("left")
+                       .preemption(reclaim_within_cohort=api.PREEMPTION_ANY)
+                       .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                       "lq-a")
+            env.add_cq(ClusterQueueWrapper("b").cohort("right")
+                       .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                       "lq-b")
+
+        def existing():
+            return [WorkloadWrapper("borrower").queue("lq-b").priority(0)
+                    .pod_set(count=1, cpu="14").reserve("b").obj()]
+
+        def workloads():
+            return [WorkloadWrapper("claimant").queue("lq-a").priority(10)
+                    .pod_set(count=1, cpu="10").obj()]
+
+        cpu_env, _ = assert_preemption_differential(setup, existing, workloads)
+        assert set(cpu_env.client.evicted) == {"default/borrower"}
+
+    def test_preemption_then_admission_cycles(self):
+        """Multi-cycle: eviction completes, then the preemptor admits."""
+        from tests.wrappers import finish_eviction
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(ClusterQueueWrapper("cq")
+                       .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                       .resource_group(flavor_quotas("default", cpu="8")).obj(),
+                       "lq")
+
+        envs = []
+        for solver in (False, True):
+            env = build_env(setup, solver=solver)
+            low = (WorkloadWrapper("low").queue("lq").priority(1)
+                   .pod_set(count=1, cpu="8").reserve("cq").obj())
+            env.admit_existing(low)
+            env.submit(WorkloadWrapper("high").queue("lq").priority(10)
+                       .pod_set(count=1, cpu="8").obj())
+            env.cycle()
+            assert "default/low" in env.client.evicted
+            # finish the eviction: remove the victim from cache, requeue
+            env.cache.delete_workload(low)
+            env.cycle()
+            envs.append(env)
+        assert admitted_map(envs[0]) == admitted_map(envs[1])
+        assert "default/high" in admitted_map(envs[1])
+
+
+class TestDevicePreemptionFuzz:
+    @pytest.mark.parametrize("seed", range(24))
+    def test_random_preemption_differential(self, seed):
+        rng = random.Random(9000 + seed)
+        n_cohorts = rng.randint(1, 2)
+        n_cqs = rng.randint(2, 5)
+        policies = [api.PREEMPTION_NEVER, api.PREEMPTION_LOWER_PRIORITY,
+                    api.PREEMPTION_LOWER_OR_NEWER_EQUAL_PRIORITY]
+        reclaims = [api.PREEMPTION_NEVER, api.PREEMPTION_ANY,
+                    api.PREEMPTION_LOWER_PRIORITY]
+
+        cq_specs = []
+        for i in range(n_cqs):
+            cohort = (f"cohort-{rng.randrange(n_cohorts)}"
+                      if rng.random() < 0.85 else "")
+            bwc = None
+            if cohort and rng.random() < 0.3:
+                bwc = api.BorrowWithinCohort(
+                    policy=api.BORROW_WITHIN_COHORT_LOWER_PRIORITY,
+                    max_priority_threshold=rng.choice([None, 3, 6]))
+            cq_specs.append((f"cq{i}", cohort, rng.choice(["4", "8", "12"]),
+                             rng.choice(policies), rng.choice(reclaims), bwc))
+
+        def setup(env):
+            env.add_flavor("default")
+            for name, cohort, nominal, wcq, rwc, bwc in cq_specs:
+                w = ClusterQueueWrapper(name)
+                if cohort:
+                    w = w.cohort(cohort)
+                w = w.preemption(within_cluster_queue=wcq,
+                                 reclaim_within_cohort=rwc,
+                                 borrow_within_cohort=bwc)
+                env.add_cq(w.resource_group(
+                    flavor_quotas("default", cpu=nominal)).obj(), f"lq-{name}")
+
+        existing_specs = []
+        for i in range(rng.randint(1, 6)):
+            cq = rng.randrange(n_cqs)
+            existing_specs.append(
+                (f"old{i}", f"cq{cq}", rng.randint(0, 6),
+                 rng.choice(["2", "4", "6", "9"]), float(i)))
+
+        pending_specs = []
+        for i in range(rng.randint(1, 5)):
+            cq = rng.randrange(n_cqs)
+            pending_specs.append(
+                (f"new{i}", f"lq-cq{cq}", rng.randint(2, 10),
+                 rng.choice(["2", "4", "7", "10"]), float(100 + i)))
+
+        def existing():
+            return [WorkloadWrapper(n).queue(f"lq-{cq}").priority(p)
+                    .pod_set(count=1, cpu=c).reserve(cq, now=ts).obj()
+                    for n, cq, p, c, ts in existing_specs]
+
+        def workloads():
+            return [WorkloadWrapper(n).queue(q).priority(p).creation(ts)
+                    .pod_set(count=1, cpu=c).obj()
+                    for n, q, p, c, ts in pending_specs]
+
+        assert_preemption_differential(setup, existing, workloads, cycles=2)

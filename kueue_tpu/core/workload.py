@@ -387,3 +387,14 @@ def queued_wait_time(wl: api.Workload, now: float) -> float:
 
 def deepcopy(wl: api.Workload) -> api.Workload:
     return copy.deepcopy(wl)
+
+
+def clone_for_status_update(wl: api.Workload) -> api.Workload:
+    """Clone for a status-only write: fresh metadata + deep-copied status,
+    shared (immutable on this path) spec. The scheduler's admission /
+    eviction / pending patches mutate only status; a full deepcopy of the
+    pod templates dominated the admit hot path."""
+    out = copy.copy(wl)
+    out.metadata = copy.copy(wl.metadata)
+    out.status = copy.deepcopy(wl.status)
+    return out

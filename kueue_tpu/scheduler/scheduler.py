@@ -466,7 +466,7 @@ class Scheduler:
     # --- admission (reference: scheduler.go:571-623) ---
 
     def admit(self, e: Entry, cq: ClusterQueueSnapshot) -> None:
-        new_wl = wlpkg.deepcopy(e.info.obj)
+        new_wl = wlpkg.clone_for_status_update(e.info.obj)
         admission = api.Admission(cluster_queue=e.info.cluster_queue,
                                   pod_set_assignments=e.assignment.to_api())
         now = self.clock.now()
@@ -504,7 +504,7 @@ class Scheduler:
 
     def _apply_preemption(self, wl: api.Workload, preempting_cq: str,
                           reason: str, message: str) -> None:
-        target = wlpkg.deepcopy(wl)
+        target = wlpkg.clone_for_status_update(wl)
         now = self.clock.now()
         wlpkg.set_evicted_condition(target, api.EVICTED_BY_PREEMPTION, message, now)
         wlpkg.set_preempted_condition(target, reason, message, now)
@@ -520,7 +520,7 @@ class Scheduler:
             e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
         self.queues.requeue_workload(e.info, e.requeue_reason)
         if e.status in (NOT_NOMINATED, SKIPPED):
-            patch = wlpkg.deepcopy(e.info.obj)
+            patch = wlpkg.clone_for_status_update(e.info.obj)
             if wlpkg.unset_quota_reservation_with_condition(
                     patch, "Pending", e.inadmissible_msg, self.clock.now()):
                 self.client.patch_not_admitted(patch)

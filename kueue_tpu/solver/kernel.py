@@ -398,6 +398,86 @@ solve_phase_a = partial(jax.jit, static_argnames=("num_podsets", "fair_sharing")
 solve_phase_b_domains = jax.jit(solve_phase_b_domains_impl)
 
 
+def solve_cycle_fused_impl(topo, usage, cohort_usage, requests, podset_active,
+                           wl_cq, priority, timestamp, eligible, solvable,
+                           num_podsets: int, max_rank: int,
+                           fair_sharing: bool = False, start_rank=None):
+    """The production single-chip path, fully fused: Phase A, the
+    domain-rank order grid, and the cohort-parallel Phase B run as ONE
+    device program — no host round-trip between phases.
+
+    max_rank (static): upper bound on workloads per conflict domain,
+    computed host-side from wl_cq alone (independent of fit results —
+    non-fit entries occupy grid slots but never admit)."""
+    W = requests.shape[0]
+    C = topo["cohort_subtree"].shape[0]
+
+    cohort_avail = _cohort_avail(topo, cohort_usage)
+    fit, borrows, chosen, chosen_borrow, asg_usage = _phase_a(
+        topo, usage, cohort_avail, requests, podset_active, wl_cq, eligible,
+        solvable, num_podsets, start_rank)
+    share = (_drf_share(topo, usage, asg_usage, wl_cq) if fair_sharing
+             else jnp.zeros(W, jnp.int64))
+
+    # admit order (reference: entryOrdering.Less, scheduler.go:643-672)
+    order = jnp.lexsort((timestamp, -priority, share,
+                         borrows.astype(jnp.int32),
+                         (~fit).astype(jnp.int32)))
+
+    # conflict domain = root cohort, or a synthetic per-CQ domain
+    cohort_of = topo["cq_cohort"][wl_cq]
+    root_of = topo["cohort_root"][jnp.maximum(cohort_of, 0)]
+    domain = jnp.where(cohort_of >= 0, root_of.astype(jnp.int32),
+                       C + wl_cq.astype(jnp.int32))          # [W]
+    D = C + topo["cq_cohort"].shape[0]
+
+    # rank of each ordered entry within its domain: stable-sort the
+    # ordered domains, then position minus segment start
+    dom_of_order = domain[order]                              # [W]
+    perm = jnp.argsort(dom_of_order, stable=True)
+    sorted_dom = dom_of_order[perm]
+    pos = jnp.arange(W)
+    first = jnp.concatenate([jnp.ones(1, bool),
+                             sorted_dom[1:] != sorted_dom[:-1]])
+    seg_start = jax.lax.cummax(jnp.where(first, pos, 0))
+    rank_sorted = pos - seg_start                             # [W]
+
+    # grid[rank, domain] = workload index (drop ranks beyond the bound —
+    # cannot happen when max_rank really bounds the per-domain counts)
+    grid = jnp.full((max_rank, D), -1, jnp.int32)
+    grid = grid.at[rank_sorted, sorted_dom].set(
+        order[perm].astype(jnp.int32), mode="drop")
+
+    admitted, usage_out, cohort_out = solve_phase_b_domains_impl(
+        topo, usage, cohort_usage, asg_usage, fit, wl_cq, grid)
+    return {"admitted": admitted, "chosen": chosen, "borrows": borrows,
+            "chosen_borrow": chosen_borrow, "fit": fit, "usage": usage_out,
+            "cohort_usage": cohort_out}
+
+
+solve_cycle_fused = partial(
+    jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing"))(
+    solve_cycle_fused_impl)
+
+
+def max_rank_bound(wl_cq, cq_cohort, cohort_root) -> int:
+    """Host-side static bound for solve_cycle_fused: the max number of
+    batch workloads sharing one conflict domain, bucketed to a power of
+    two for jit-cache stability."""
+    import numpy as np
+    wl_cq = np.asarray(wl_cq)
+    cq_cohort = np.asarray(cq_cohort)
+    cohort_of = cq_cohort[wl_cq]
+    root_of = np.asarray(cohort_root)[np.maximum(cohort_of, 0)]
+    C = len(np.asarray(cohort_root))
+    domain = np.where(cohort_of >= 0, root_of, C + wl_cq)
+    raw = int(np.bincount(domain).max()) if len(domain) else 1
+    b = 8
+    while b < raw:
+        b *= 2
+    return b
+
+
 def build_order_grid(fit, borrows, priority, timestamp, wl_cq, cq_cohort,
                      num_cohorts: int, cohort_root=None, share=None):
     """Host-side: global admit order -> [L,D] grid of workload indices.
@@ -430,14 +510,19 @@ def build_order_grid(fit, borrows, priority, timestamp, wl_cq, cq_cohort,
                       num_cohorts + wl_cq).astype(np.int64)
     D = num_cohorts + len(cq_cohort)
     # rank of each workload within its domain, in global order
-    ranks = np.empty(len(order), np.int64)
-    counters = np.zeros(D, np.int64)
+    # (vectorized: stable-sort by domain, position minus segment start)
     dom_of_sorted = domain[order]
-    for pos, d in enumerate(dom_of_sorted):
-        ranks[pos] = counters[d]
-        counters[d] += 1
+    n = len(order)
+    ranks = np.zeros(n, np.int64)
+    if n:
+        perm = np.argsort(dom_of_sorted, kind="stable")
+        sd = dom_of_sorted[perm]
+        pos = np.arange(n)
+        first = np.r_[True, sd[1:] != sd[:-1]]
+        seg_start = np.maximum.accumulate(np.where(first, pos, 0))
+        ranks[perm] = pos - seg_start
     # bucket L to a power of two so repeated cycles reuse the compilation
-    raw_l = max(1, int(counters.max()))
+    raw_l = max(1, int(ranks.max()) + 1) if n else 1
     L = 8
     while L < raw_l:
         L *= 2

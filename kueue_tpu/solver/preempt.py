@@ -397,7 +397,9 @@ def solve_preemption_batch(batch: PreemptionBatch):
             batch.cand_q, batch.cand_usage, batch.cand_prio,
             batch.allow_borrowing, batch.threshold_active, batch.threshold,
             batch.has_cohort)
-    targets, feasible = _KERNEL(*tuple(jnp.asarray(a) for a in args))
+    import jax
+    targets, feasible = jax.device_get(
+        _KERNEL(*tuple(jnp.asarray(a) for a in args)))
     return np.asarray(targets), np.asarray(feasible)
 
 

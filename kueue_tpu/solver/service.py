@@ -28,9 +28,11 @@ from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.core.resources import FlavorResource
 from kueue_tpu.scheduler import flavorassigner as fa
 from kueue_tpu.solver import encode
+import jax
+
 from kueue_tpu.solver.kernel import (
-    solve_cycle,
-    solve_cycle_cohort_parallel,
+    max_rank_bound,
+    solve_cycle_fused,
     topo_to_device,
 )
 
@@ -47,6 +49,7 @@ class BatchSolver:
         self.backend = backend
         self._topo_cache = None
         self._topo_key = None
+        self._decode_cache: dict = {}  # qi -> (group_size, prefer_nb)
 
     # --- encoding with topology caching across cycles ---
 
@@ -60,6 +63,7 @@ class BatchSolver:
             self._topo_key = key
             topo = encode.encode_topology(snapshot)
             self._topo_cache = (topo, topo_to_device(topo))
+            self._decode_cache = {}
         return self._topo_cache
 
     def solve(self, snapshot: Snapshot, entries: list,
@@ -103,20 +107,30 @@ class BatchSolver:
                                              fair_sharing=fair_sharing,
                                              start_rank=start_rank)
             else:
-                # cohort-parallel Phase B: scan length = max workloads per
-                # conflict domain instead of the whole batch
-                result = solve_cycle_cohort_parallel(
-                    topo_dev, topo, state.usage, state.cohort_usage,
+                # fused cohort-parallel cycle: Phase A + device-built
+                # order grid + row-parallel Phase B in ONE dispatch; scan
+                # length = max workloads per conflict domain instead of
+                # the whole batch
+                result = solve_cycle_fused(
+                    topo_dev, state.usage, state.cohort_usage,
                     batch.requests, batch.podset_active, batch.wl_cq,
                     batch.priority, batch.timestamp, batch.eligible,
                     batch.solvable, num_podsets=self.max_podsets,
+                    max_rank=max_rank_bound(batch.wl_cq, topo.cq_cohort,
+                                            topo.cohort_root),
                     fair_sharing=fair_sharing, start_rank=start_rank)
 
-        admitted = np.asarray(result["admitted"])
-        fit = np.asarray(result["fit"])
-        chosen = np.asarray(result["chosen"])
-        borrows = np.asarray(result["borrows"])
-        chosen_borrow = np.asarray(result.get("chosen_borrow"))
+        # One batched fetch: per-array transfers each pay a full device
+        # round-trip (severe over a tunneled TPU).
+        fetched = jax.device_get({k: result[k] for k in
+                                  ("admitted", "fit", "chosen", "borrows",
+                                   "chosen_borrow") if k in result})
+        admitted = np.asarray(fetched["admitted"])
+        fit = np.asarray(fetched["fit"])
+        chosen = np.asarray(fetched["chosen"])
+        borrows = np.asarray(fetched["borrows"])
+        cb = fetched.get("chosen_borrow")
+        chosen_borrow = np.asarray(cb) if cb is not None else np.zeros(0)
 
         out = {}
         for wi in range(batch.n):
@@ -146,11 +160,15 @@ class BatchSolver:
             cohort_generation=(cq.cohort.allocatable_resource_generation
                                if cq.cohort else 0))
         qi = topo.cq_index[info.cluster_queue]
-        group_size = {}
-        for fi, gi in enumerate(topo.flavor_group[qi]):
-            if gi >= 0:
-                group_size[int(gi)] = group_size.get(int(gi), 0) + 1
-        prefer_nb = bool(topo.prefer_no_borrow[qi])
+        cached = self._decode_cache.get(qi)
+        if cached is None:
+            group_size = {}
+            for gi in topo.flavor_group[qi]:
+                if gi >= 0:
+                    group_size[int(gi)] = group_size.get(int(gi), 0) + 1
+            cached = (group_size, bool(topo.prefer_no_borrow[qi]))
+            self._decode_cache[qi] = cached
+        group_size, prefer_nb = cached
         # With FlavorFungibility off the CPU assigner never writes the
         # tried index (stays at the dataclass default 0).
         fungibility_on = features.enabled(features.FLAVOR_FUNGIBILITY)

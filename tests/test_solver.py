@@ -484,12 +484,14 @@ class TestShardedSolve:
 
 class TestCohortParallelKernel:
     def test_matches_global_sequential_scan(self):
-        """solve_cycle (global W-step scan) and solve_cycle_cohort_parallel
-        (L-step domain-parallel scan) must produce identical tensors."""
+        """solve_cycle (global W-step scan), solve_cycle_cohort_parallel
+        (host-gridded L-step scan) and solve_cycle_fused (single-dispatch
+        device grid) must produce identical tensors."""
         import numpy as np
         import jax.numpy as jnp
         from kueue_tpu.solver.kernel import (
-            solve_cycle, solve_cycle_cohort_parallel, topo_to_device)
+            max_rank_bound, solve_cycle, solve_cycle_cohort_parallel,
+            solve_cycle_fused)
         from kueue_tpu.solver.synth import synth_solver_inputs
 
         for seed in range(6):
@@ -512,10 +514,17 @@ class TestCohortParallelKernel:
             par = solve_cycle_cohort_parallel(
                 topo_dev, topo_np, jnp.asarray(usage),
                 jnp.asarray(cohort_usage), *args, num_podsets=1)
+            fused = solve_cycle_fused(
+                topo_dev, jnp.asarray(usage), jnp.asarray(cohort_usage),
+                *args, num_podsets=1,
+                max_rank=max_rank_bound(wl["wl_cq"], topo["cq_cohort"],
+                                        topo["cohort_root"]))
             for key in ("admitted", "fit", "borrows"):
-                assert np.array_equal(np.asarray(seq[key]),
-                                      np.asarray(par[key])), (key, seed)
-            assert np.array_equal(np.asarray(seq["usage"]),
-                                  np.asarray(par["usage"])), seed
-            assert np.array_equal(np.asarray(seq["cohort_usage"]),
-                                  np.asarray(par["cohort_usage"])), seed
+                for other in (par, fused):
+                    assert np.array_equal(np.asarray(seq[key]),
+                                          np.asarray(other[key])), (key, seed)
+            for other in (par, fused):
+                assert np.array_equal(np.asarray(seq["usage"]),
+                                      np.asarray(other["usage"])), seed
+                assert np.array_equal(np.asarray(seq["cohort_usage"]),
+                                      np.asarray(other["cohort_usage"])), seed

@@ -1,12 +1,18 @@
 """Cohort-parallel sharded admission solve.
 
 The scaling axis of the reference is head-of-queue width x flavor count x
-cohort depth (SURVEY.md §5). Cohorts are *independent capacity domains*:
-workloads in different cohorts never contend for the same quota
+cohort depth (SURVEY.md §5). Conflict domains — root cohorts, plus a
+synthetic domain per cohortless CQ — are *independent capacity domains*:
+workloads in different domains never contend for the same quota
 (reference: all fit/borrow math walks within one cohort tree,
-pkg/cache/resource_node.go). That makes the cohort the natural SPMD axis:
-each device solves the full cycle for the cohorts it owns, and decisions
-are combined with a single psum — no sequential cross-device dependency.
+pkg/cache/resource_node.go). That makes the domain the natural SPMD axis.
+
+v2 (real partitioning): ONE dispatch per cycle. Every device runs the
+cheap replicated parts (Phase A flavor assignment, the device-built
+order grid) and then scans only ITS OWN slice of the grid's domain
+columns — per-device Phase B work shrinks ~linearly with the mesh size
+(row width D/n instead of D). Distinct domains touch disjoint CQ/cohort
+state, so the per-device usage deltas combine with a single psum.
 
 ICI/DCN traffic per cycle: one replicated broadcast of the batch in, one
 psum of usage deltas + admitted masks out.
@@ -19,7 +25,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from kueue_tpu.solver.kernel import solve_cycle_impl
+from kueue_tpu.solver.kernel import (
+    _cohort_avail,
+    _drf_share,
+    _phase_a,
+    max_rank_bound,
+    solve_phase_b_domains_impl,
+)
 
 
 def make_mesh(devices=None, axis_name: str = "cohorts") -> Mesh:
@@ -29,38 +41,62 @@ def make_mesh(devices=None, axis_name: str = "cohorts") -> Mesh:
 
 def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int,
                         fair_sharing: bool = False, start_rank=None):
-    """Run the batched solve SPMD over the mesh, partitioning capacity
-    domains (cohorts, and cohortless CQs) across devices."""
+    """Run the fused admission cycle SPMD over the mesh, partitioning the
+    conflict-domain axis across devices."""
     axis = mesh.axis_names[0]
     n_dev = mesh.devices.size
     C = topo["cohort_subtree"].shape[0]
+    Q = topo["cq_cohort"].shape[0]
+    D = C + Q
+    d_local = -(-D // n_dev)  # ceil
+    d_pad = d_local * n_dev
+    max_rank = max_rank_bound(batch.wl_cq, topo["cq_cohort"],
+                              topo["cohort_root"])
 
     def body(topo_, usage, cohort_usage, requests, podset_active, wl_cq,
              priority, timestamp, eligible, solvable, start_rank_):
+        W = requests.shape[0]
         dev = jax.lax.axis_index(axis)
-        cohort_of_wl = topo_["cq_cohort"][wl_cq]
-        root_of_wl = topo_["cohort_root"][jnp.maximum(cohort_of_wl, 0)]
-        # capacity domain id: root cohort index (whole tree = one
-        # domain), or C + cq index for lone CQs
-        domain = jnp.where(cohort_of_wl >= 0, root_of_wl,
+
+        # --- replicated: Phase A + admit order + domain-rank grid ---
+        cohort_avail = _cohort_avail(topo_, cohort_usage)
+        fit, borrows, chosen, chosen_borrow, asg_usage = _phase_a(
+            topo_, usage, cohort_avail, requests, podset_active, wl_cq,
+            eligible, solvable, num_podsets, start_rank_)
+        share = (_drf_share(topo_, usage, asg_usage, wl_cq) if fair_sharing
+                 else jnp.zeros(W, jnp.int64))
+        order = jnp.lexsort((timestamp, -priority, share,
+                             borrows.astype(jnp.int32),
+                             (~fit).astype(jnp.int32)))
+        cohort_of = topo_["cq_cohort"][wl_cq]
+        root_of = topo_["cohort_root"][jnp.maximum(cohort_of, 0)]
+        domain = jnp.where(cohort_of >= 0, root_of.astype(jnp.int32),
                            C + wl_cq.astype(jnp.int32))
-        mine = (domain % n_dev) == dev
-        res = solve_cycle_impl(topo_, usage, cohort_usage, requests,
-                               podset_active, wl_cq, priority, timestamp,
-                               eligible, solvable & mine, num_podsets,
-                               fair_sharing=fair_sharing,
-                               start_rank=start_rank_)
-        usage_delta = res["usage"] - usage
-        cohort_delta = res["cohort_usage"] - cohort_usage
-        admitted = jax.lax.psum(res["admitted"].astype(jnp.int32), axis) > 0
-        usage_out = usage + jax.lax.psum(usage_delta, axis)
-        cohort_out = cohort_usage + jax.lax.psum(cohort_delta, axis)
-        # chosen flavors are computed identically on every device (phase A
-        # is deterministic given the snapshot); take them as-is.
-        return {"admitted": admitted, "chosen": res["chosen"],
-                "borrows": res["borrows"],
-                "chosen_borrow": res["chosen_borrow"], "fit": res["fit"],
-                "usage": usage_out, "cohort_usage": cohort_out}
+        dom_of_order = domain[order]
+        perm = jnp.argsort(dom_of_order, stable=True)
+        sorted_dom = dom_of_order[perm]
+        pos = jnp.arange(W)
+        first = jnp.concatenate([jnp.ones(1, bool),
+                                 sorted_dom[1:] != sorted_dom[:-1]])
+        seg_start = jax.lax.cummax(jnp.where(first, pos, 0))
+        rank_sorted = pos - seg_start
+        grid = jnp.full((max_rank, d_pad), -1, jnp.int32)
+        grid = grid.at[rank_sorted, sorted_dom].set(
+            order[perm].astype(jnp.int32), mode="drop")
+
+        # --- partitioned: this device scans columns d ≡ dev (mod n) ---
+        grid_local = grid.reshape(max_rank, d_local, n_dev)[:, :, dev]
+        admitted, usage_out, cohort_out = solve_phase_b_domains_impl(
+            topo_, usage, cohort_usage, asg_usage, fit, wl_cq, grid_local)
+
+        # disjoint domains => disjoint deltas; combine with psum
+        admitted = jax.lax.psum(admitted.astype(jnp.int32), axis) > 0
+        usage_out = usage + jax.lax.psum(usage_out - usage, axis)
+        cohort_out = cohort_usage + jax.lax.psum(cohort_out - cohort_usage,
+                                                 axis)
+        return {"admitted": admitted, "chosen": chosen,
+                "borrows": borrows, "chosen_borrow": chosen_borrow,
+                "fit": fit, "usage": usage_out, "cohort_usage": cohort_out}
 
     if start_rank is None:
         start_rank = np.zeros(batch.requests.shape, np.int32)
@@ -73,3 +109,10 @@ def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int,
         topo, state.usage, state.cohort_usage, batch.requests,
         batch.podset_active, batch.wl_cq, batch.priority, batch.timestamp,
         batch.eligible, batch.solvable, start_rank)
+
+
+def per_device_scan_width(num_cqs: int, num_cohorts: int, n_dev: int) -> tuple:
+    """(replicated width, per-device width) of one Phase B scan row —
+    the measured work reduction the partitioning buys."""
+    D = num_cqs + num_cohorts
+    return D, -(-D // n_dev)

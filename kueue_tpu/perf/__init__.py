@@ -16,10 +16,10 @@ from kueue_tpu.perf.generator import (
     generate,
 )
 from kueue_tpu.perf.runner import RunResult, Runner
-from kueue_tpu.perf.checker import RangeSpec, check
+from kueue_tpu.perf.checker import RangeSpec, check, default_rangespec
 
 __all__ = [
     "CohortClass", "QueueClass", "WorkloadClass", "WorkloadSet",
     "default_generator_config", "generate",
-    "Runner", "RunResult", "RangeSpec", "check",
+    "Runner", "RunResult", "RangeSpec", "check", "default_rangespec",
 ]

@@ -1,0 +1,84 @@
+"""Full-scale scheduler scalability run.
+
+Reference scenario (test/performance/scheduler/default_generator_config.yaml:
+5 cohorts x 6 CQs, 350 small + 100 medium + 50 large per CQ = 15,000
+workloads / 30 CQs), driven through the full manager on a virtual clock,
+checked against the carried-over rangespec queueing-dynamics bounds
+(default_rangespec.yaml:8-30). Runs the pure-CPU scheduler and the
+solver-enabled scheduler and writes PERF_r{N}.json.
+
+Usage: python perf_run.py [--scale 1.0] [--out PERF_r02.json]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def run_mode(label, scale, solver):
+    from kueue_tpu.perf import (
+        Runner, check, default_generator_config, default_rangespec, generate)
+    load = generate(default_generator_config(), scale=scale)
+    t0 = time.monotonic()
+    result = Runner(load, solver=solver).run()
+    spec = default_rangespec()
+    violations = check(result, spec)
+    out = {
+        "mode": label,
+        "scale": scale,
+        "total_workloads": result.total,
+        "admitted": result.admitted,
+        "finished": result.finished,
+        "cycles": result.cycles,
+        "wall_s": round(result.wall_s, 1),
+        "virtual_makespan_s": round(result.virtual_makespan_s, 1),
+        "admissions_per_wall_second": round(result.admissions_per_wall_second, 1),
+        "class_avg_tta_s": {
+            cls: round(st.avg, 2) for cls, st in result.class_stats.items()},
+        "class_p99_tta_s": {
+            cls: round(st.p99, 2) for cls, st in result.class_stats.items()},
+        "cq_class_avg_usage_pct": {
+            cls: round(pct, 1)
+            for cls, pct in result.cq_class_avg_usage_pct.items()},
+        "rangespec_violations": violations,
+        "rangespec_ok": not violations,
+    }
+    print(json.dumps(out), file=sys.stderr, flush=True)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--modes", default="cpu,solver")
+    args = ap.parse_args()
+
+    results = {"scenario": "reference default_generator_config "
+                           "(5 cohorts x 6 CQs, 15k workloads at scale=1)",
+               "rangespec": "reference default_rangespec queueing-dynamics "
+                            "bounds (large<=11s, medium<=90s, small<=233s avg "
+                            "TTA; cq usage>=55%)",
+               "runs": []}
+    for mode in args.modes.split(","):
+        if mode == "cpu":
+            results["runs"].append(run_mode("cpu", args.scale, None))
+        elif mode == "solver":
+            from kueue_tpu.solver import BatchSolver
+            results["runs"].append(
+                run_mode("solver", args.scale, BatchSolver()))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+    print(json.dumps({
+        "perf": "scalability_harness",
+        "runs": [{k: r[k] for k in ("mode", "admitted", "wall_s",
+                                    "admissions_per_wall_second",
+                                    "rangespec_ok")}
+                 for r in results["runs"]],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,775 @@
+"""Flavor-assignment depth suite.
+
+Transliteration of the reference's
+pkg/scheduler/flavorassigner/flavorassigner_test.go tables
+(TestAssignFlavors:51-1976, TestReclaimBeforePriorityPreemption:1981-2131)
+driving FlavorAssigner.assign against a snapshot whose cohort aggregates
+are overridden exactly as the reference harness does
+(flavorassigner_test.go:1957-1963).
+"""
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.corev1 import (
+    Affinity, NodeAffinity, NodeSelector, NodeSelectorRequirement,
+    NodeSelectorTerm, RESOURCE_PODS, Taint, parse_quantity,
+)
+from kueue_tpu.cache import Cache
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.core.resources import FlavorResource
+from kueue_tpu.scheduler.flavorassigner import (
+    FIT, NO_FIT, PREEMPT, FlavorAssigner,
+)
+from tests.wrappers import (
+    ClusterQueueWrapper,
+    WorkloadWrapper,
+    flavor_quotas,
+    make_flavor,
+)
+
+CPU = "cpu"
+MEM = "memory"
+GPU = "example.com/gpu"
+
+SPOT_TOLERATION = dict(key="instance", value="spot", effect="NoSchedule")
+
+
+def fixture_flavors():
+    """flavorassigner_test.go:52-67."""
+    return {
+        "default": make_flavor("default"),
+        "one": make_flavor("one", node_labels={"type": "one"}),
+        "two": make_flavor("two", node_labels={"type": "two"}),
+        "b_one": make_flavor("b_one", node_labels={"b_type": "one"}),
+        "b_two": make_flavor("b_two", node_labels={"b_type": "two"}),
+        "tainted": make_flavor("tainted", taints=[
+            Taint(key="instance", value="spot", effect="NoSchedule")]),
+    }
+
+
+def fq(flavor, **resources):
+    """flavor_quotas but allowing the gpu resource via 'gpu' shorthand."""
+    mapped = {}
+    for k, v in resources.items():
+        mapped[k] = v
+    out = flavor_quotas(flavor, **{k: v for k, v in mapped.items()
+                                   if k not in ("gpu",)})
+    if "gpu" in mapped:
+        spec = mapped["gpu"]
+        if isinstance(spec, tuple):
+            nominal, borrowing = spec[0], spec[1] if len(spec) > 1 else None
+        else:
+            nominal, borrowing = spec, None
+        out.resources.append(api.ResourceQuota(
+            name=GPU, nominal_quota=parse_quantity(nominal, GPU),
+            borrowing_limit=(parse_quantity(borrowing, GPU)
+                             if borrowing is not None else None)))
+    return out
+
+
+def frq(pairs):
+    """{(flavor, res): qty-string} -> {FlavorResource: int}."""
+    return {FlavorResource(f, r): parse_quantity(q, r)
+            for (f, r), q in pairs.items()}
+
+
+def run_assign(cq_wrapper, pod_sets, cq_usage=None, cohort_requestable=None,
+               cohort_usage=None, reclaimable=None, fair=False,
+               extra_cqs=(), extra_usage=None, flavors=None):
+    flavors = flavors or fixture_flavors()
+    cache = Cache()
+    for f in flavors.values():
+        cache.add_or_update_resource_flavor(f)
+    cq = cq_wrapper.obj()
+    cache.add_cluster_queue(cq)
+    for other in extra_cqs:
+        cache.add_cluster_queue(other.obj())
+    snapshot = cache.snapshot()
+    cq_snap = snapshot.cluster_queues[cq.metadata.name]
+
+    if cohort_requestable is not None:
+        assert cq_snap.cohort is not None
+        cq_snap.cohort.resource_node.subtree_quota = frq(cohort_requestable)
+        cq_snap.cohort.resource_node.usage = frq(cohort_usage or {})
+    if cq_usage:
+        cq_snap.resource_node.usage = frq(cq_usage)
+    if extra_usage:
+        for name, usage in extra_usage.items():
+            snapshot.cluster_queues[name].add_usage(frq(usage))
+
+    w = WorkloadWrapper("wl")
+    for spec in pod_sets:
+        spec = dict(spec)
+        tolerate = spec.pop("_tolerate_spot", False)
+        w.pod_set(**spec)
+        if tolerate:
+            w.toleration(**SPOT_TOLERATION)
+    wl = w.obj()
+    if reclaimable:
+        wl.status.reclaimable_pods = [
+            api.ReclaimablePod(name=n, count=c) for n, c in reclaimable.items()]
+    info = wlpkg.Info(wl, cluster_queue=cq.metadata.name)
+
+    # the reference's testOracle: reclaim possible iff not borrowing
+    # (flavorassigner_test.go:45-49)
+    def oracle(cq_, wl_, fr, q):
+        return not cq_.borrowing_with(fr, q)
+
+    rf_specs = {name: f for name, f in flavors.items()}
+    assigner = FlavorAssigner(info, cq_snap, rf_specs,
+                              enable_fair_sharing=fair, oracle=oracle)
+    return assigner.assign()
+
+
+def flavors_of(assignment, ps=0):
+    return {res: (fa.name, fa.mode, fa.tried_flavor_idx)
+            for res, fa in (assignment.pod_sets[ps].flavors or {}).items()}
+
+
+def usage_of(assignment):
+    return dict(assignment.usage)
+
+
+class TestAssignFlavors:
+    def test_single_flavor_fits(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").resource_group(
+                flavor_quotas("default", cpu="1", memory="2Mi")),
+            [dict(count=1, cpu="1", memory="1Mi")])
+        assert a.representative_mode() == FIT
+        assert flavors_of(a) == {CPU: ("default", FIT, -1),
+                                 MEM: ("default", FIT, -1)}
+        assert usage_of(a) == frq({("default", CPU): "1",
+                                   ("default", MEM): "1Mi"})
+
+    def test_single_flavor_fits_tainted_flavor(self):
+        cqw = ClusterQueueWrapper("cq").resource_group(
+            flavor_quotas("tainted", cpu="4"))
+        cache = Cache()
+        for f in fixture_flavors().values():
+            cache.add_or_update_resource_flavor(f)
+        cache.add_cluster_queue(cqw.obj())
+        snapshot = cache.snapshot()
+        w = WorkloadWrapper("wl")
+        w.pod_set(count=1, cpu="1")
+        w.toleration(**SPOT_TOLERATION)
+        info = wlpkg.Info(w.obj(), cluster_queue="cq")
+        a = FlavorAssigner(info, snapshot.cluster_queues["cq"],
+                           fixture_flavors()).assign()
+        assert a.representative_mode() == FIT
+        assert flavors_of(a) == {CPU: ("tainted", FIT, -1)}
+
+    def test_single_flavor_used_resources_preempt(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").resource_group(
+                flavor_quotas("default", cpu="4")),
+            [dict(count=1, cpu="2")],
+            cq_usage={("default", CPU): "3"})
+        assert a.representative_mode() == PREEMPT
+        assert flavors_of(a) == {CPU: ("default", PREEMPT, -1)}
+        assert usage_of(a) == frq({("default", CPU): "2"})
+
+    def test_multiple_resource_groups_fits(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq")
+            .resource_group(flavor_quotas("one", cpu="2"),
+                            flavor_quotas("two", cpu="4"))
+            .resource_group(flavor_quotas("b_one", memory="1Gi"),
+                            flavor_quotas("b_two", memory="5Gi")),
+            [dict(count=1, cpu="3", memory="10Mi")])
+        assert a.representative_mode() == FIT
+        assert flavors_of(a) == {CPU: ("two", FIT, -1),
+                                 MEM: ("b_one", FIT, 0)}
+
+    def test_multiple_resource_groups_one_preempt_other_nofit(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq")
+            .resource_group(flavor_quotas("one", cpu="3"))
+            .resource_group(flavor_quotas("b_one", memory="1Mi")),
+            [dict(count=1, cpu="3", memory="10Mi")],
+            cq_usage={("one", CPU): "1"})
+        assert a.representative_mode() == NO_FIT
+        assert a.pod_sets[0].flavors is None
+        assert usage_of(a) == {}
+
+    def test_multiple_rg_multiple_resources_fits(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq")
+            .resource_group(flavor_quotas("one", cpu="2", memory="1Gi"),
+                            flavor_quotas("two", cpu="4", memory="15Mi"))
+            .resource_group(fq("b_one", gpu="4"), fq("b_two", gpu="2")),
+            [dict(count=1, cpu="3", memory="10Mi", **{GPU: "3"})])
+        assert a.representative_mode() == FIT
+        assert flavors_of(a) == {CPU: ("two", FIT, -1),
+                                 MEM: ("two", FIT, -1),
+                                 GPU: ("b_one", FIT, 0)}
+
+    def test_multiple_rg_fits_with_different_modes(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").cohort("test-cohort")
+            .resource_group(flavor_quotas("one", cpu="2", memory="1Gi"),
+                            flavor_quotas("two", cpu="4", memory="15Mi"))
+            .resource_group(fq("b_one", gpu="4")),
+            [dict(count=1, cpu="3", memory="10Mi", **{GPU: "3"})],
+            cq_usage={("two", MEM): "10Mi"},
+            cohort_requestable={("one", CPU): "2", ("one", MEM): "1Gi",
+                                ("two", CPU): "4", ("two", MEM): "15Mi",
+                                ("b_one", GPU): "4"},
+            cohort_usage={("two", MEM): "10Mi", ("b_one", GPU): "2"})
+        assert a.representative_mode() == PREEMPT
+        assert flavors_of(a) == {CPU: ("two", FIT, -1),
+                                 MEM: ("two", PREEMPT, -1),
+                                 GPU: ("b_one", PREEMPT, -1)}
+
+    def test_multiple_resources_in_group_nofit(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq")
+            .resource_group(flavor_quotas("one", cpu="2", memory="1Gi"),
+                            flavor_quotas("two", cpu="4", memory="5Mi")),
+            [dict(count=1, cpu="3", memory="10Mi")])
+        assert a.representative_mode() == NO_FIT
+        assert a.pod_sets[0].flavors is None
+
+    def test_skips_tainted_flavor(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq")
+            .resource_group(flavor_quotas("tainted", cpu="4"),
+                            flavor_quotas("two", cpu="4")),
+            [dict(count=1, cpu="3")])
+        assert a.representative_mode() == FIT
+        assert flavors_of(a) == {CPU: ("two", FIT, -1)}
+
+    def test_fits_node_selector(self):
+        cqw = (ClusterQueueWrapper("cq")
+               .resource_group(flavor_quotas("one", cpu="4"),
+                               flavor_quotas("two", cpu="4")))
+        cache = Cache()
+        for f in fixture_flavors().values():
+            cache.add_or_update_resource_flavor(f)
+        cache.add_cluster_queue(cqw.obj())
+        snapshot = cache.snapshot()
+        w = WorkloadWrapper("wl")
+        w.pod_set(count=1, cpu="1")
+        # ignored1 key is not a flavor label key => ignored
+        w.node_selector("type", "two")
+        w.node_selector("ignored1", "foo")
+        spec = w.wl.spec.pod_sets[0].template.spec
+        spec.affinity = Affinity(node_affinity=NodeAffinity(
+            required=NodeSelector(node_selector_terms=[NodeSelectorTerm(
+                match_expressions=[NodeSelectorRequirement(
+                    key="ignored2", operator="In", values=["bar"])])])))
+        info = wlpkg.Info(w.obj(), cluster_queue="cq")
+        a = FlavorAssigner(info, snapshot.cluster_queues["cq"],
+                           fixture_flavors()).assign()
+        assert a.representative_mode() == FIT
+        assert flavors_of(a) == {CPU: ("two", FIT, -1)}
+
+    def test_fits_node_affinity(self):
+        cqw = (ClusterQueueWrapper("cq")
+               .resource_group(flavor_quotas("one", cpu="4", memory="1Gi"),
+                               flavor_quotas("two", cpu="4", memory="1Gi")))
+        cache = Cache()
+        for f in fixture_flavors().values():
+            cache.add_or_update_resource_flavor(f)
+        cache.add_cluster_queue(cqw.obj())
+        snapshot = cache.snapshot()
+        w = WorkloadWrapper("wl")
+        w.pod_set(count=1, cpu="1", memory="1Mi")
+        w.affinity_in("type", "two")
+        info = wlpkg.Info(w.obj(), cluster_queue="cq")
+        a = FlavorAssigner(info, snapshot.cluster_queues["cq"],
+                           fixture_flavors()).assign()
+        assert a.representative_mode() == FIT
+        assert flavors_of(a) == {CPU: ("two", FIT, -1),
+                                 MEM: ("two", FIT, -1)}
+
+    def test_node_affinity_ored_terms_fit_any_flavor(self):
+        cqw = (ClusterQueueWrapper("cq")
+               .resource_group(flavor_quotas("one", cpu="4"),
+                               flavor_quotas("two", cpu="4")))
+        cache = Cache()
+        for f in fixture_flavors().values():
+            cache.add_or_update_resource_flavor(f)
+        cache.add_cluster_queue(cqw.obj())
+        snapshot = cache.snapshot()
+        w = WorkloadWrapper("wl")
+        w.pod_set(count=1, cpu="1")
+        spec = w.wl.spec.pod_sets[0].template.spec
+        spec.affinity = Affinity(node_affinity=NodeAffinity(
+            required=NodeSelector(node_selector_terms=[
+                NodeSelectorTerm(match_expressions=[NodeSelectorRequirement(
+                    key="ignored2", operator="In", values=["bar"])]),
+                NodeSelectorTerm(match_expressions=[NodeSelectorRequirement(
+                    key="cpuType", operator="In", values=["two"])]),
+            ])))
+        info = wlpkg.Info(w.obj(), cluster_queue="cq")
+        a = FlavorAssigner(info, snapshot.cluster_queues["cq"],
+                           fixture_flavors()).assign()
+        assert a.representative_mode() == FIT
+        assert flavors_of(a) == {CPU: ("one", FIT, 0)}
+
+    def test_doesnt_fit_node_affinity(self):
+        cqw = (ClusterQueueWrapper("cq")
+               .resource_group(flavor_quotas("one", cpu="4"),
+                               flavor_quotas("two", cpu="4")))
+        cache = Cache()
+        for f in fixture_flavors().values():
+            cache.add_or_update_resource_flavor(f)
+        cache.add_cluster_queue(cqw.obj())
+        snapshot = cache.snapshot()
+        w = WorkloadWrapper("wl")
+        w.pod_set(count=1, cpu="1")
+        w.affinity_in("type", "three")
+        info = wlpkg.Info(w.obj(), cluster_queue="cq")
+        a = FlavorAssigner(info, snapshot.cluster_queues["cq"],
+                           fixture_flavors()).assign()
+        assert a.representative_mode() == NO_FIT
+        reasons = a.pod_sets[0].reasons
+        assert any("one" in r and "affinity" in r for r in reasons)
+        assert any("two" in r and "affinity" in r for r in reasons)
+
+    def test_multiple_specs_fit_different_flavors(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq")
+            .resource_group(flavor_quotas("one", cpu="4"),
+                            flavor_quotas("two", cpu="10")),
+            [dict(name="driver", count=1, cpu="5"),
+             dict(name="worker", count=1, cpu="3")])
+        assert a.representative_mode() == FIT
+        assert flavors_of(a, 0) == {CPU: ("two", FIT, -1)}
+        assert flavors_of(a, 1) == {CPU: ("one", FIT, 0)}
+
+    def test_multiple_specs_fits_borrowing(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").cohort("test-cohort")
+            .resource_group(flavor_quotas("default", cpu=("2", "98"),
+                                          memory="2Gi")),
+            [dict(name="driver", count=1, cpu="4", memory="1Gi"),
+             dict(name="worker", count=1, cpu="6", memory="4Gi")],
+            cohort_requestable={("default", CPU): "200",
+                                ("default", MEM): "200Gi"})
+        assert a.representative_mode() == FIT
+        assert a.borrowing
+        assert flavors_of(a, 0) == {CPU: ("default", FIT, -1),
+                                    MEM: ("default", FIT, -1)}
+        assert usage_of(a) == frq({("default", CPU): "10",
+                                   ("default", MEM): "5Gi"})
+
+    def test_not_enough_space_to_borrow(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").cohort("test-cohort")
+            .resource_group(flavor_quotas("one", cpu="1")),
+            [dict(count=1, cpu="2")],
+            cohort_requestable={("one", CPU): "10"},
+            cohort_usage={("one", CPU): "9"})
+        assert a.representative_mode() == NO_FIT
+        assert any("cohort" in r for r in a.pod_sets[0].reasons)
+
+    def test_past_max_can_preempt_in_cq(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").cohort("test-cohort")
+            .resource_group(flavor_quotas("one", cpu=("2", "8"))),
+            [dict(count=1, cpu="2")],
+            cq_usage={("one", CPU): "9"},
+            cohort_requestable={("one", CPU): "100"},
+            cohort_usage={("one", CPU): "9"})
+        assert a.representative_mode() == PREEMPT
+        assert flavors_of(a) == {CPU: ("one", PREEMPT, -1)}
+
+    def test_past_min_can_preempt_in_cq(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq")
+            .resource_group(flavor_quotas("one", cpu="2")),
+            [dict(count=1, cpu="2")],
+            cq_usage={("one", CPU): "1"})
+        assert a.representative_mode() == PREEMPT
+        assert flavors_of(a) == {CPU: ("one", PREEMPT, -1)}
+
+    def test_past_min_can_preempt_in_cohort_and_cq(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").cohort("test-cohort")
+            .resource_group(flavor_quotas("one", cpu="3")),
+            [dict(count=1, cpu="2")],
+            cq_usage={("one", CPU): "2"},
+            cohort_requestable={("one", CPU): "10"},
+            cohort_usage={("one", CPU): "10"})
+        assert a.representative_mode() == PREEMPT
+        assert flavors_of(a) == {CPU: ("one", PREEMPT, -1)}
+
+    def test_can_only_preempt_flavors_matching_affinity(self):
+        cqw = (ClusterQueueWrapper("cq")
+               .resource_group(flavor_quotas("one", cpu="4"),
+                               flavor_quotas("two", cpu="4")))
+        cache = Cache()
+        for f in fixture_flavors().values():
+            cache.add_or_update_resource_flavor(f)
+        cache.add_cluster_queue(cqw.obj())
+        snapshot = cache.snapshot()
+        cq_snap = snapshot.cluster_queues["cq"]
+        cq_snap.resource_node.usage = frq({("one", CPU): "3",
+                                           ("two", CPU): "3"})
+        w = WorkloadWrapper("wl")
+        w.pod_set(count=1, cpu="2")
+        w.node_selector("type", "two")
+        info = wlpkg.Info(w.obj(), cluster_queue="cq")
+        a = FlavorAssigner(info, cq_snap, fixture_flavors()).assign()
+        assert a.representative_mode() == PREEMPT
+        assert flavors_of(a) == {CPU: ("two", PREEMPT, -1)}
+
+    def test_each_podset_preempts_a_different_flavor(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq")
+            .resource_group(flavor_quotas("one", cpu="4"),
+                            flavor_quotas("tainted", cpu="10")),
+            [dict(name="launcher", count=1, cpu="2"),
+             dict(name="workers", count=10, cpu="1",
+                  _tolerate_spot=True)],
+            cq_usage={("one", CPU): "3", ("tainted", CPU): "3"})
+        assert a.representative_mode() == PREEMPT
+        assert flavors_of(a, 0) == {CPU: ("one", PREEMPT, -1)}
+        assert flavors_of(a, 1) == {CPU: ("tainted", PREEMPT, -1)}
+        assert usage_of(a) == frq({("one", CPU): "2",
+                                   ("tainted", CPU): "10"})
+
+    def test_resource_not_listed_in_cq(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq")
+            .resource_group(flavor_quotas("one", cpu="4")),
+            [dict(count=1, **{GPU: "2"})])
+        assert a.representative_mode() == NO_FIT
+        assert a.pod_sets[0].flavors is None
+
+    def test_num_pods_fit(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq")
+            .resource_group(flavor_quotas("default", pods="3", cpu="10")),
+            [dict(count=3, cpu="1")])
+        assert a.representative_mode() == FIT
+        assert flavors_of(a) == {CPU: ("default", FIT, -1),
+                                 RESOURCE_PODS: ("default", FIT, -1)}
+        assert usage_of(a) == {FlavorResource("default", RESOURCE_PODS): 3,
+                               FlavorResource("default", CPU): 3000}
+
+    def test_num_pods_dont_fit(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq")
+            .resource_group(flavor_quotas("default", pods="2", cpu="10")),
+            [dict(count=3, cpu="1")])
+        assert a.representative_mode() == NO_FIT
+
+    def test_with_reclaimable_pods(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq")
+            .resource_group(flavor_quotas("default", pods="3", cpu="10")),
+            [dict(name="main", count=5, cpu="1")],
+            reclaimable={"main": 2})
+        assert a.representative_mode() == FIT
+        assert a.pod_sets[0].count == 3
+        assert usage_of(a) == {FlavorResource("default", RESOURCE_PODS): 3,
+                               FlavorResource("default", CPU): 3000}
+
+    # --- FlavorFungibility policies (flavorassigner_test.go:1223-1783) ---
+
+    def _fungibility_cq(self, when_borrow=None, when_preempt=None,
+                        quotas=None):
+        cqw = ClusterQueueWrapper("cq")
+        if when_borrow or when_preempt:
+            cqw.flavor_fungibility(
+                when_can_borrow=when_borrow or api.BORROW,
+                when_can_preempt=when_preempt or api.TRY_NEXT_FLAVOR)
+        cqw.resource_group(*(quotas or (
+            flavor_quotas("one", pods="10", cpu="10"),
+            flavor_quotas("two", pods="10", cpu="10"))))
+        return cqw
+
+    def test_preempt_before_try_next_flavor(self):
+        a = run_assign(
+            self._fungibility_cq(api.BORROW, api.PREEMPT),
+            [dict(count=1, cpu="9")],
+            cq_usage={("one", CPU): "2"})
+        assert a.representative_mode() == PREEMPT
+        assert flavors_of(a)[CPU] == ("one", PREEMPT, 0)
+        assert flavors_of(a)[RESOURCE_PODS] == ("one", FIT, 0)
+
+    def test_preempt_try_next_flavor(self):
+        a = run_assign(
+            self._fungibility_cq(),
+            [dict(count=1, cpu="9")],
+            cq_usage={("one", CPU): "2"})
+        assert a.representative_mode() == FIT
+        assert flavors_of(a)[CPU] == ("two", FIT, -1)
+
+    def test_borrow_try_next_flavor_found_first(self):
+        a = run_assign(
+            self._fungibility_cq(
+                api.TRY_NEXT_FLAVOR, api.TRY_NEXT_FLAVOR,
+                quotas=(flavor_quotas("one", pods="10", cpu=("10", "1")),
+                        flavor_quotas("two", pods="10", cpu="1")))
+            .cohort("test-cohort"),
+            [dict(count=1, cpu="9")],
+            cq_usage={("one", CPU): "2"},
+            cohort_requestable={("one", CPU): "11", ("one", RESOURCE_PODS): 10,
+                                ("two", CPU): "1", ("two", RESOURCE_PODS): 10},
+            cohort_usage={("one", CPU): "2"})
+        assert a.representative_mode() == FIT
+        assert a.borrowing
+        assert flavors_of(a)[CPU] == ("one", FIT, -1)
+
+    def test_borrow_try_next_flavor_found_second(self):
+        a = run_assign(
+            self._fungibility_cq(
+                api.TRY_NEXT_FLAVOR, api.TRY_NEXT_FLAVOR,
+                quotas=(flavor_quotas("one", pods="10", cpu=("10", "1")),
+                        flavor_quotas("two", pods="10", cpu="10")))
+            .cohort("test-cohort"),
+            [dict(count=1, cpu="9")],
+            cq_usage={("one", CPU): "2"},
+            cohort_requestable={("one", CPU): "11", ("one", RESOURCE_PODS): 10,
+                                ("two", CPU): "10", ("two", RESOURCE_PODS): 10},
+            cohort_usage={("one", CPU): "2"})
+        assert a.representative_mode() == FIT
+        assert not a.borrowing
+        assert flavors_of(a)[CPU] == ("two", FIT, -1)
+
+    def test_borrow_before_try_next_flavor(self):
+        a = run_assign(
+            self._fungibility_cq(
+                quotas=(flavor_quotas("one", pods="10", cpu=("10", "1")),
+                        flavor_quotas("two", pods="10", cpu="10")))
+            .cohort("test-cohort"),
+            [dict(count=1, cpu="9")],
+            cq_usage={("one", CPU): "2"},
+            cohort_requestable={("one", CPU): "11", ("one", RESOURCE_PODS): 10,
+                                ("two", CPU): "10", ("two", RESOURCE_PODS): 10},
+            cohort_usage={("one", CPU): "2"})
+        assert a.representative_mode() == FIT
+        assert a.borrowing
+        assert flavors_of(a)[CPU] == ("one", FIT, 0)
+
+    def test_borrow_while_preempt_when_can_borrow(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").cohort("test-cohort")
+            .preemption(reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY,
+                        borrow_within_cohort=api.BorrowWithinCohort(
+                            policy=api.BORROW_WITHIN_COHORT_LOWER_PRIORITY))
+            .flavor_fungibility(when_can_borrow=api.BORROW,
+                                when_can_preempt=api.PREEMPT)
+            .resource_group(flavor_quotas("one", cpu=("0", "12")),
+                            flavor_quotas("two", cpu="12")),
+            [dict(count=1, cpu="12")],
+            cohort_requestable={("one", CPU): "12", ("two", CPU): "12"},
+            cohort_usage={("one", CPU): "10"})
+        assert a.representative_mode() == PREEMPT
+        assert a.borrowing
+        assert flavors_of(a)[CPU] == ("one", PREEMPT, 0)
+
+    def test_borrow_while_preempt_no_borrowing_limit(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").cohort("test-cohort")
+            .preemption(reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY,
+                        borrow_within_cohort=api.BorrowWithinCohort(
+                            policy=api.BORROW_WITHIN_COHORT_LOWER_PRIORITY))
+            .flavor_fungibility(when_can_borrow=api.BORROW,
+                                when_can_preempt=api.PREEMPT)
+            .resource_group(flavor_quotas("one", cpu="0"),
+                            flavor_quotas("two", cpu="12")),
+            [dict(count=1, cpu="12")],
+            cohort_requestable={("one", CPU): "12", ("two", CPU): "12"},
+            cohort_usage={("one", CPU): "10"})
+        assert a.representative_mode() == PREEMPT
+        assert a.borrowing
+        assert flavors_of(a)[CPU] == ("one", PREEMPT, 0)
+
+    def test_borrow_while_preempt_try_next_flavor(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").cohort("test-cohort")
+            .preemption(reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY,
+                        borrow_within_cohort=api.BorrowWithinCohort(
+                            policy=api.BORROW_WITHIN_COHORT_LOWER_PRIORITY))
+            .flavor_fungibility(when_can_borrow=api.TRY_NEXT_FLAVOR,
+                                when_can_preempt=api.PREEMPT)
+            .resource_group(flavor_quotas("one", cpu=("0", "12")),
+                            flavor_quotas("two", cpu="12")),
+            [dict(count=1, cpu="12")],
+            cohort_requestable={("one", CPU): "12", ("two", CPU): "12"},
+            cohort_usage={("one", CPU): "10"})
+        assert a.representative_mode() == FIT
+        assert flavors_of(a)[CPU] == ("two", FIT, -1)
+
+    def test_borrowing_limit_exceeds_cohort_quota(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").cohort("test-cohort")
+            .preemption(reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY,
+                        borrow_within_cohort=api.BorrowWithinCohort(
+                            policy=api.BORROW_WITHIN_COHORT_LOWER_PRIORITY))
+            .resource_group(flavor_quotas("one", cpu=("0", "12"))),
+            [dict(count=1, cpu="12")],
+            cohort_requestable={("one", CPU): "11"},
+            cohort_usage={("one", CPU): "10"})
+        assert a.representative_mode() == NO_FIT
+
+    def test_lend_try_next_flavor_found_second(self):
+        a = run_assign(
+            self._fungibility_cq(
+                api.TRY_NEXT_FLAVOR, api.TRY_NEXT_FLAVOR,
+                quotas=(flavor_quotas("one", pods="10",
+                                      cpu=("10", None, "1")),
+                        flavor_quotas("two", pods="10",
+                                      cpu=("10", None, "0"))))
+            .cohort("test-cohort"),
+            [dict(count=1, cpu="9")],
+            cq_usage={("one", CPU): "2"},
+            cohort_requestable={("one", CPU): "11", ("one", RESOURCE_PODS): 10,
+                                ("two", CPU): "10", ("two", RESOURCE_PODS): 10},
+            cohort_usage={("one", CPU): "2"})
+        assert a.representative_mode() == FIT
+        assert flavors_of(a)[CPU] == ("two", FIT, -1)
+
+    def test_lend_try_next_flavor_found_first(self):
+        a = run_assign(
+            self._fungibility_cq(
+                api.TRY_NEXT_FLAVOR, api.TRY_NEXT_FLAVOR,
+                quotas=(flavor_quotas("one", pods="10",
+                                      cpu=("10", None, "1")),
+                        flavor_quotas("two", pods="10",
+                                      cpu=("1", None, "0"))))
+            .cohort("test-cohort"),
+            [dict(count=1, cpu="9")],
+            cq_usage={("one", CPU): "2"},
+            cohort_requestable={("one", CPU): "11", ("one", RESOURCE_PODS): 10,
+                                ("two", CPU): "1", ("two", RESOURCE_PODS): 10},
+            cohort_usage={("one", CPU): "2"})
+        assert a.representative_mode() == FIT
+        assert a.borrowing
+        assert flavors_of(a)[CPU] == ("one", FIT, -1)
+
+    def test_quota_exhausted_can_preempt_in_cohort_and_cq(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").cohort("test-cohort")
+            .resource_group(flavor_quotas("one", pods="10",
+                                          cpu=("10", None, "0"))),
+            [dict(count=1, cpu="9")],
+            cq_usage={("one", CPU): "2"},
+            cohort_requestable={("one", CPU): "10",
+                                ("one", RESOURCE_PODS): 10},
+            cohort_usage={("one", CPU): "10"})
+        assert a.representative_mode() == PREEMPT
+        assert flavors_of(a)[CPU] == ("one", PREEMPT, -1)
+        assert flavors_of(a)[RESOURCE_PODS] == ("one", FIT, -1)
+
+    def test_fair_sharing_reclaim_any_stays_on_first_flavor(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").cohort("test-cohort")
+            .preemption(reclaim_within_cohort=api.PREEMPTION_ANY)
+            .flavor_fungibility(when_can_borrow=api.BORROW,
+                                when_can_preempt=api.PREEMPT)
+            .resource_group(flavor_quotas("one", cpu="0"),
+                            flavor_quotas("two", cpu="12")),
+            [dict(count=1, cpu="12")],
+            cohort_requestable={("one", CPU): "12", ("two", CPU): "12"},
+            cohort_usage={("one", CPU): "10"},
+            fair=True)
+        assert a.representative_mode() == PREEMPT
+        assert a.borrowing
+        assert flavors_of(a)[CPU] == ("one", PREEMPT, 0)
+
+    def test_fair_sharing_reclaim_never_goes_to_second_flavor(self):
+        a = run_assign(
+            ClusterQueueWrapper("cq").cohort("test-cohort")
+            .preemption(reclaim_within_cohort=api.PREEMPTION_NEVER)
+            .flavor_fungibility(when_can_borrow=api.BORROW,
+                                when_can_preempt=api.PREEMPT)
+            .resource_group(flavor_quotas("one", cpu="0"),
+                            flavor_quotas("two", cpu="12")),
+            [dict(count=1, cpu="12")],
+            cohort_requestable={("one", CPU): "12", ("two", CPU): "12"},
+            cohort_usage={("one", CPU): "10"},
+            fair=True)
+        assert a.representative_mode() == FIT
+        assert flavors_of(a)[CPU] == ("two", FIT, -1)
+
+
+class TestReclaimBeforePriorityPreemption:
+    """flavorassigner_test.go:1981-2131: with whenCanPreempt=TryNextFlavor
+    the assigner prefers a flavor where reclaim (not in-CQ priority
+    preemption) is possible."""
+
+    def _run(self, requests, test_usage, other_usage, fungibility=None):
+        flavors = {n: make_flavor(n) for n in ("uno", "due", "tre")}
+        test_cq = (ClusterQueueWrapper("test-cq").cohort("cohort")
+                   .preemption(
+                       within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                       reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY))
+        if fungibility is None:
+            test_cq.flavor_fungibility(when_can_preempt=api.TRY_NEXT_FLAVOR)
+        else:
+            test_cq.flavor_fungibility(when_can_preempt=fungibility)
+        qs = []
+        for n in ("uno", "due", "tre"):
+            qs.append(api.FlavorQuotas(name=n, resources=[
+                api.ResourceQuota(name="compute", nominal_quota=10),
+                api.ResourceQuota(name="gpu", nominal_quota=10)]))
+        test_cq.cq.spec.resource_groups.append(api.ResourceGroup(
+            covered_resources=["compute", "gpu"], flavors=qs))
+
+        other_cq = ClusterQueueWrapper("other-cq").cohort("cohort")
+        zeros = []
+        for n in ("uno", "due", "tre"):
+            zeros.append(api.FlavorQuotas(name=n, resources=[
+                api.ResourceQuota(name="compute", nominal_quota=0),
+                api.ResourceQuota(name="gpu", nominal_quota=0)]))
+        other_cq.cq.spec.resource_groups.append(api.ResourceGroup(
+            covered_resources=["compute", "gpu"], flavors=zeros))
+
+        cache = Cache()
+        for f in flavors.values():
+            cache.add_or_update_resource_flavor(f)
+        cache.add_cluster_queue(test_cq.obj())
+        cache.add_cluster_queue(other_cq.obj())
+        snapshot = cache.snapshot()
+        snapshot.cluster_queues["other-cq"].add_usage(
+            {FlavorResource(f, r): q for (f, r), q in other_usage.items()})
+        test_snap = snapshot.cluster_queues["test-cq"]
+        test_snap.add_usage(
+            {FlavorResource(f, r): q for (f, r), q in test_usage.items()})
+
+        w = WorkloadWrapper("wl")
+        w.pod_set(count=1, **requests)
+        info = wlpkg.Info(w.obj(), cluster_queue="test-cq")
+
+        def oracle(cq_, wl_, fr, q):
+            return not cq_.borrowing_with(fr, q)
+
+        a = FlavorAssigner(info, test_snap, flavors, oracle=oracle).assign()
+        return (a.representative_mode(),
+                {res: fa.name
+                 for res, fa in (a.pod_sets[0].flavors or {}).items()})
+
+    def test_select_first_flavor_which_fits(self):
+        mode, flv = self._run({"gpu": 10}, {("uno", "gpu"): 1},
+                              {("due", "gpu"): 1})
+        assert mode == FIT and flv == {"gpu": "tre"}
+
+    def test_select_first_flavor_where_reclaim_possible(self):
+        mode, flv = self._run({"gpu": 10}, {("uno", "gpu"): 1},
+                              {("due", "gpu"): 1, ("tre", "gpu"): 1})
+        assert mode == PREEMPT and flv == {"gpu": "due"}
+
+    def test_select_first_flavor_when_fungibility_disabled(self):
+        mode, flv = self._run({"gpu": 10}, {("uno", "gpu"): 1},
+                              {("due", "gpu"): 1, ("tre", "gpu"): 1},
+                              fungibility=api.PREEMPT)
+        assert mode == PREEMPT and flv == {"gpu": "uno"}
+
+    def test_select_first_flavor_where_priority_preemption_possible(self):
+        mode, flv = self._run({"gpu": 10},
+                              {("uno", "gpu"): 1, ("due", "gpu"): 1,
+                               ("tre", "gpu"): 1}, {})
+        assert mode == PREEMPT and flv == {"gpu": "uno"}
+
+    def test_select_second_flavor_where_reclaim_possible_compute_fits(self):
+        mode, flv = self._run(
+            {"gpu": 10, "compute": 10},
+            {("uno", "gpu"): 1, ("uno", "compute"): 1,
+             ("due", "compute"): 1},
+            {("due", "gpu"): 1, ("tre", "gpu"): 1})
+        assert mode == PREEMPT and flv == {"gpu": "tre", "compute": "tre"}

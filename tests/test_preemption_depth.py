@@ -1,0 +1,816 @@
+"""Preemption target-selection depth suite.
+
+Transliteration of the reference's pkg/scheduler/preemption/preemption_test.go
+table cases (TestPreemption:284-1438, TestFairPreemptions:1479-1987,
+TestCandidatesOrdering:1993-2040) driving Preemptor.get_targets_internal
+directly against a cache snapshot, exactly as the reference drives
+GetTargets with a fixed flavor assignment.
+"""
+
+import pytest
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.corev1 import parse_quantity
+from kueue_tpu.api.meta import Condition, FakeClock, set_condition
+from kueue_tpu.cache import Cache
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.core.resources import FlavorResource
+from kueue_tpu.scheduler.preemption import Preemptor, parse_strategies
+from tests.wrappers import (
+    ClusterQueueWrapper,
+    WorkloadWrapper,
+    flavor_quotas,
+    make_flavor,
+)
+
+NOW = 1000.0
+CPU = "cpu"
+MEM = "memory"
+
+IN_CQ = api.IN_CLUSTER_QUEUE_REASON
+RECLAIM = api.IN_COHORT_RECLAMATION_REASON
+FAIR = api.IN_COHORT_FAIR_SHARING_REASON
+WHILE_BORROWING = api.IN_COHORT_RECLAIM_WHILE_BORROWING_REASON
+
+
+def bwc(threshold=0):
+    return api.BorrowWithinCohort(
+        policy=api.BORROW_WITHIN_COHORT_LOWER_PRIORITY,
+        max_priority_threshold=threshold)
+
+
+def preemption_fixture_cqs():
+    """The reference's ClusterQueue fixture list (preemption_test.go:71-277)."""
+    return [
+        ClusterQueueWrapper("standalone")
+        .resource_group(flavor_quotas("default", cpu="6"))
+        .resource_group(flavor_quotas("alpha", memory="3Gi"),
+                        flavor_quotas("beta", memory="3Gi"))
+        .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY).obj(),
+
+        ClusterQueueWrapper("c1").cohort("cohort")
+        .resource_group(flavor_quotas("default", cpu=("6", "6"),
+                                      memory=("3Gi", "3Gi")))
+        .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                    reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY).obj(),
+
+        ClusterQueueWrapper("c2").cohort("cohort")
+        .resource_group(flavor_quotas("default", cpu=("6", "6"),
+                                      memory=("3Gi", "3Gi")))
+        .preemption(within_cluster_queue=api.PREEMPTION_NEVER,
+                    reclaim_within_cohort=api.PREEMPTION_ANY).obj(),
+
+        ClusterQueueWrapper("d1").cohort("cohort-no-limits")
+        .resource_group(flavor_quotas("default", cpu="6", memory="3Gi"))
+        .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                    reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY).obj(),
+
+        ClusterQueueWrapper("d2").cohort("cohort-no-limits")
+        .resource_group(flavor_quotas("default", cpu="6", memory="3Gi"))
+        .preemption(within_cluster_queue=api.PREEMPTION_NEVER,
+                    reclaim_within_cohort=api.PREEMPTION_ANY).obj(),
+
+        ClusterQueueWrapper("l1").cohort("legion")
+        .resource_group(flavor_quotas("default", cpu=("6", "12"),
+                                      memory=("3Gi", "6Gi")))
+        .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                    reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY).obj(),
+
+        ClusterQueueWrapper("preventStarvation")
+        .resource_group(flavor_quotas("default", cpu="6"))
+        .preemption(
+            within_cluster_queue=api.PREEMPTION_LOWER_OR_NEWER_EQUAL_PRIORITY)
+        .obj(),
+
+        ClusterQueueWrapper("a_standard").cohort("with_shared_cq")
+        .resource_group(flavor_quotas("default", cpu=("1", "12")))
+        .preemption(within_cluster_queue=api.PREEMPTION_NEVER,
+                    reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY,
+                    borrow_within_cohort=bwc(0)).obj(),
+
+        ClusterQueueWrapper("b_standard").cohort("with_shared_cq")
+        .resource_group(flavor_quotas("default", cpu=("1", "12")))
+        .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                    reclaim_within_cohort=api.PREEMPTION_ANY,
+                    borrow_within_cohort=bwc(0)).obj(),
+
+        ClusterQueueWrapper("a_best_effort").cohort("with_shared_cq")
+        .resource_group(flavor_quotas("default", cpu=("1", "12")))
+        .preemption(within_cluster_queue=api.PREEMPTION_NEVER,
+                    reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY,
+                    borrow_within_cohort=bwc(0)).obj(),
+
+        ClusterQueueWrapper("b_best_effort").cohort("with_shared_cq")
+        .resource_group(flavor_quotas("default", cpu=("0", "13")))
+        .preemption(within_cluster_queue=api.PREEMPTION_NEVER,
+                    reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY,
+                    borrow_within_cohort=bwc(0)).obj(),
+
+        ClusterQueueWrapper("shared").cohort("with_shared_cq")
+        .resource_group(flavor_quotas("default", cpu="10")).obj(),
+
+        ClusterQueueWrapper("lend1").cohort("cohort-lend")
+        .resource_group(flavor_quotas("default", cpu=("6", None, "4")))
+        .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                    reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY).obj(),
+
+        ClusterQueueWrapper("lend2").cohort("cohort-lend")
+        .resource_group(flavor_quotas("default", cpu=("6", None, "2")))
+        .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                    reclaim_within_cohort=api.PREEMPTION_LOWER_PRIORITY).obj(),
+
+        ClusterQueueWrapper("a").cohort("cohort-three")
+        .resource_group(flavor_quotas("default", cpu="2", memory="2"))
+        .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                    reclaim_within_cohort=api.PREEMPTION_ANY).obj(),
+
+        ClusterQueueWrapper("b").cohort("cohort-three")
+        .resource_group(flavor_quotas("default", cpu="2", memory="2")).obj(),
+
+        ClusterQueueWrapper("c").cohort("cohort-three")
+        .resource_group(flavor_quotas("default", cpu="2", memory="2")).obj(),
+    ]
+
+
+def admitted(name, cq, priority=0, reserved_at=NOW, creation=NOW,
+             flavor="default", **requests):
+    w = (WorkloadWrapper(name).priority(priority).creation(creation))
+    w.pod_set(count=1, **requests)
+    w.reserve(cq, flavor=flavor, now=reserved_at)
+    w.wl.metadata.uid = name  # predictable candidate ordering, as the reference
+    return w.obj()
+
+
+def run_targets(cqs, admitted_wls, incoming, target_cq, assignment,
+                fair=False, strategies=None):
+    """assignment: resource -> (flavor, mode) with mode in {"fit","preempt"};
+    requests come from the incoming workload's podset totals, mirroring
+    assignment.TotalRequestsFor (reference: flavorassigner.go:101-107)."""
+    cache = Cache()
+    for f in ("default", "alpha", "beta"):
+        cache.add_or_update_resource_flavor(make_flavor(f))
+    for cq in cqs:
+        cache.add_cluster_queue(cq)
+    for wl in admitted_wls:
+        cache.add_or_update_workload(wl)
+    snapshot = cache.snapshot()
+
+    info = wlpkg.Info(incoming, cluster_queue=target_cq)
+    requests = {}
+    for psr in info.total_requests:
+        for res, qty in psr.requests.items():
+            flavor = assignment[res][0] if res in assignment else "default"
+            fr = FlavorResource(flavor, res)
+            requests[fr] = requests.get(fr, 0) + qty
+    frs_need_preemption = {FlavorResource(flv, res)
+                           for res, (flv, mode) in assignment.items()
+                           if mode == "preempt"}
+
+    preemptor = Preemptor(clock=FakeClock(NOW), enable_fair_sharing=fair,
+                          fs_strategies=parse_strategies(strategies))
+    targets = preemptor.get_targets_internal(
+        info, requests, frs_need_preemption, snapshot)
+    return {(t.workload_info.obj.metadata.name, t.reason) for t in targets}
+
+
+def incoming_wl(name="in", priority=0, creation=NOW, pod_sets=None, **requests):
+    w = WorkloadWrapper(name).priority(priority).creation(creation)
+    if pod_sets:
+        for ps_name, count, reqs in pod_sets:
+            w.pod_set(name=ps_name, count=count, **reqs)
+    else:
+        w.pod_set(count=1, **requests)
+    return w.obj()
+
+
+P = {CPU: ("default", "preempt")}
+
+
+class TestPreemptionTargets:
+    """preemption_test.go TestPreemption:284-1438."""
+
+    def test_preempt_lowest_priority(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("low", "standalone", priority=-1, cpu="2"),
+             admitted("mid", "standalone", priority=0, cpu="2"),
+             admitted("high", "standalone", priority=1, cpu="2")],
+            incoming_wl(priority=1, cpu="2"), "standalone", P)
+        assert got == {("low", IN_CQ)}
+
+    def test_preempt_multiple(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("low", "standalone", priority=-1, cpu="2"),
+             admitted("mid", "standalone", priority=0, cpu="2"),
+             admitted("high", "standalone", priority=1, cpu="2")],
+            incoming_wl(priority=1, cpu="3"), "standalone", P)
+        assert got == {("low", IN_CQ), ("mid", IN_CQ)}
+
+    def test_no_preemption_for_low_priority(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("low", "standalone", priority=-1, cpu="3"),
+             admitted("mid", "standalone", priority=0, cpu="3")],
+            incoming_wl(priority=-1, cpu="1"), "standalone", P)
+        assert got == set()
+
+    def test_not_enough_low_priority_workloads(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("low", "standalone", priority=-1, cpu="3"),
+             admitted("mid", "standalone", priority=0, cpu="3")],
+            incoming_wl(priority=0, cpu="4"), "standalone", P)
+        assert got == set()
+
+    def test_some_free_quota_preempt_low_priority(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("low", "standalone", priority=-1, cpu="1"),
+             admitted("mid", "standalone", priority=0, cpu="1"),
+             admitted("high", "standalone", priority=1, cpu="3")],
+            incoming_wl(priority=1, cpu="2"), "standalone", P)
+        assert got == {("low", IN_CQ)}
+
+    def test_minimal_set_excludes_low_priority(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("low", "standalone", priority=-1, cpu="1"),
+             admitted("mid", "standalone", priority=0, cpu="2"),
+             admitted("high", "standalone", priority=1, cpu="3")],
+            incoming_wl(priority=1, cpu="2"), "standalone", P)
+        assert got == {("mid", IN_CQ)}
+
+    def test_only_preempt_workloads_using_chosen_flavor(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("low", "standalone", priority=-1, flavor="alpha",
+                      memory="2Gi"),
+             admitted("mid", "standalone", priority=0, flavor="beta",
+                      memory="1Gi"),
+             admitted("high", "standalone", priority=1, flavor="beta",
+                      memory="1Gi")],
+            incoming_wl(priority=1, cpu="1", memory="2Gi"), "standalone",
+            {CPU: ("default", "fit"), MEM: ("beta", "preempt")})
+        assert got == {("mid", IN_CQ)}
+
+    def test_reclaim_quota_from_borrower(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("c1-low", "c1", priority=-1, cpu="3"),
+             admitted("c2-mid", "c2", priority=0, cpu="3"),
+             admitted("c2-high", "c2", priority=1, cpu="6")],
+            incoming_wl(priority=1, cpu="3"), "c1", P)
+        assert got == {("c2-mid", RECLAIM)}
+
+    def test_reclaim_with_zero_request_for_resource_at_nominal(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("c1-low", "c1", priority=-1, cpu="3", memory="3Gi"),
+             admitted("c2-mid", "c2", priority=0, cpu="3"),
+             admitted("c2-high", "c2", priority=1, cpu="6")],
+            incoming_wl(priority=1, cpu="3", memory="0"), "c1",
+            {CPU: ("default", "preempt"), MEM: ("default", "fit")})
+        assert got == {("c2-mid", RECLAIM)}
+
+    def test_no_workloads_borrowing(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("c1-high", "c1", priority=1, cpu="4"),
+             admitted("c2-low-1", "c2", priority=-1, cpu="4")],
+            incoming_wl(priority=1, cpu="4"), "c1", P)
+        assert got == set()
+
+    def test_not_enough_workloads_borrowing(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("c1-high", "c1", priority=1, cpu="4"),
+             admitted("c2-low-1", "c2", priority=-1, cpu="4"),
+             admitted("c2-low-2", "c2", priority=-1, cpu="4")],
+            incoming_wl(priority=1, cpu="4"), "c1", P)
+        assert got == set()
+
+    def test_preempt_locally_borrow_other_resources_no_cohort_candidates(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("c1-low", "c1", priority=-1, cpu="4"),
+             admitted("c2-low-1", "c2", priority=-1, cpu="4"),
+             admitted("c2-high-2", "c2", priority=1, cpu="4")],
+            incoming_wl(priority=1, cpu="4", memory="5Gi"), "c1",
+            {CPU: ("default", "preempt"), MEM: ("default", "preempt")})
+        assert got == {("c1-low", IN_CQ)}
+
+    def test_preempt_locally_and_borrow_same_resource_in_cohort(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("c1-med", "c1", priority=0, cpu="4"),
+             admitted("c1-low", "c1", priority=-1, cpu="4"),
+             admitted("c2-low-1", "c2", priority=-1, cpu="4")],
+            incoming_wl(priority=1, cpu="4"), "c1", P)
+        assert got == {("c1-low", IN_CQ)}
+
+    def test_preempt_locally_borrow_same_resource_no_borrowing_limit(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("d1-med", "d1", priority=0, cpu="4"),
+             admitted("d1-low", "d1", priority=-1, cpu="4"),
+             admitted("d2-low-1", "d2", priority=-1, cpu="4")],
+            incoming_wl(priority=1, cpu="4"), "d1", P)
+        assert got == {("d1-low", IN_CQ)}
+
+    def test_preempt_locally_borrow_other_resources_with_cohort_candidates(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("c1-med", "c1", priority=0, cpu="4"),
+             admitted("c2-low-1", "c2", priority=-1, cpu="5"),
+             admitted("c2-low-2", "c2", priority=-1, cpu="1"),
+             admitted("c2-low-3", "c2", priority=-1, cpu="1")],
+            incoming_wl(priority=1, cpu="2", memory="5Gi"), "c1",
+            {CPU: ("default", "preempt"), MEM: ("default", "preempt")})
+        assert got == {("c1-med", IN_CQ)}
+
+    def test_preempt_locally_not_borrowing_in_single_queue_cohort(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("l1-med", "l1", priority=0, cpu="4"),
+             admitted("l1-low", "l1", priority=-1, cpu="2")],
+            incoming_wl(priority=1, cpu="4"), "l1", P)
+        assert got == {("l1-med", IN_CQ)}
+
+    def test_no_reclaim_same_priority_with_lower_priority_policy(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("c1w", "c1", priority=0, cpu="2"),
+             admitted("c2-1", "c2", priority=0, cpu="4"),
+             admitted("c2-2", "c2", priority=0, cpu="4")],
+            incoming_wl(priority=0, cpu="4"), "c1", P)
+        assert got == set()
+
+    def test_reclaim_same_priority_with_any_policy(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("c1-1", "c1", priority=0, cpu="4"),
+             admitted("c1-2", "c1", priority=1, cpu="4"),
+             admitted("c2w", "c2", priority=0, cpu="2")],
+            incoming_wl(priority=0, cpu="4"), "c2", P)
+        assert got == {("c1-1", RECLAIM)}
+
+    def test_preempt_from_all_cluster_queues_in_cohort(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("c1-low", "c1", priority=-1, cpu="3"),
+             admitted("c1-mid", "c1", priority=0, cpu="2"),
+             admitted("c2-low", "c2", priority=-1, cpu="3"),
+             admitted("c2-mid", "c2", priority=0, cpu="4")],
+            incoming_wl(priority=0, cpu="4"), "c1", P)
+        assert got == {("c1-low", IN_CQ), ("c2-low", RECLAIM)}
+
+    def test_cannot_preempt_in_cq_when_policy_never(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("c2-low", "c2", priority=-1, cpu="3")],
+            incoming_wl(priority=1, cpu="4"), "c2", P)
+        assert got == set()
+
+    def test_each_podset_preempts_a_different_flavor(self):
+        cqs = preemption_fixture_cqs()
+        admitted_wls = [
+            admitted("low-alpha", "standalone", priority=-1, flavor="alpha",
+                     memory="2Gi"),
+            admitted("low-beta", "standalone", priority=-1, flavor="beta",
+                     memory="2Gi")]
+        incoming = incoming_wl(pod_sets=[
+            ("launcher", 1, {"memory": "2Gi"}),
+            ("workers", 2, {"memory": "1Gi"})])
+        # per-podset flavors: launcher->alpha, workers->beta (both Preempt)
+        cache = Cache()
+        for f in ("default", "alpha", "beta"):
+            cache.add_or_update_resource_flavor(make_flavor(f))
+        for cq in cqs:
+            cache.add_cluster_queue(cq)
+        for wl in admitted_wls:
+            cache.add_or_update_workload(wl)
+        snapshot = cache.snapshot()
+        info = wlpkg.Info(incoming, cluster_queue="standalone")
+        requests = {FlavorResource("alpha", MEM): parse_quantity("2Gi", MEM),
+                    FlavorResource("beta", MEM): parse_quantity("2Gi", MEM)}
+        frs = set(requests)
+        preemptor = Preemptor(clock=FakeClock(NOW))
+        targets = preemptor.get_targets_internal(info, requests, frs, snapshot)
+        got = {(t.workload_info.obj.metadata.name, t.reason) for t in targets}
+        assert got == {("low-alpha", IN_CQ), ("low-beta", IN_CQ)}
+
+    def test_preempt_newer_workloads_with_same_priority(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("wl1", "preventStarvation", priority=2, cpu="2"),
+             admitted("wl2", "preventStarvation", priority=1, cpu="2",
+                      reserved_at=NOW + 1),
+             admitted("wl3", "preventStarvation", priority=1, cpu="2")],
+            incoming_wl(priority=1, creation=NOW - 15, cpu="2"),
+            "preventStarvation", P)
+        assert got == {("wl2", IN_CQ)}
+
+    # --- BorrowWithinCohort (preemption_test.go:977-1136) ---
+
+    def test_bwc_preempt_lower_priority_from_other_cq_while_borrowing(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("a_best_effort_low", "a_best_effort", priority=-1,
+                      cpu="10"),
+             admitted("b_best_effort_low", "b_best_effort", priority=-1,
+                      cpu="1")],
+            incoming_wl(priority=0, cpu="10"), "a_standard", P)
+        assert got == {("a_best_effort_low", WHILE_BORROWING)}
+
+    def test_bwc_no_preempt_above_threshold_if_still_borrowing(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("b_standard_wl", "b_standard", priority=1, cpu="10")],
+            incoming_wl(priority=2, cpu="10"), "a_standard", P)
+        assert got == set()
+
+    def test_bwc_preempt_above_threshold_if_no_borrowing_after(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("b_standard_wl", "b_standard", priority=1, cpu="13")],
+            incoming_wl(priority=2, cpu="1"), "a_standard", P)
+        assert got == {("b_standard_wl", RECLAIM)}
+
+    def test_bwc_no_preempt_lower_priority_same_cq(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("a_standard_wl", "a_standard", priority=1, cpu="13")],
+            incoming_wl(priority=2, cpu="1"), "a_standard", P)
+        assert got == set()
+
+    def test_bwc_preempt_in_cq_when_no_candidates_below_threshold(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("a_standard_1", "a_standard", priority=1, cpu="10"),
+             admitted("a_standard_2", "a_standard", priority=1, cpu="1"),
+             admitted("b_standard_1", "b_standard", priority=1, cpu="1"),
+             admitted("b_standard_2", "b_standard", priority=2, cpu="1")],
+            incoming_wl(priority=3, cpu="1"), "b_standard", P)
+        assert got == {("b_standard_1", IN_CQ)}
+
+    def test_bwc_preempt_from_cq_and_other_cqs_below_threshold(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("b_standard_high", "b_standard", priority=2, cpu="10"),
+             admitted("b_standard_mid", "b_standard", priority=1, cpu="1"),
+             admitted("a_best_effort_low", "a_best_effort", priority=-1,
+                      cpu="1"),
+             admitted("a_best_effort_lower", "a_best_effort", priority=-2,
+                      cpu="1")],
+            incoming_wl(priority=2, cpu="2"), "b_standard", P)
+        assert got == {("b_standard_mid", IN_CQ),
+                       ("a_best_effort_lower", WHILE_BORROWING)}
+
+    # --- lending limits (preemption_test.go:1137-1219) ---
+
+    def test_reclaim_quota_from_lender(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("lend1-low", "lend1", priority=-1, cpu="3"),
+             admitted("lend2-mid", "lend2", priority=0, cpu="3"),
+             admitted("lend2-high", "lend2", priority=1, cpu="4")],
+            incoming_wl(priority=1, cpu="3"), "lend1", P)
+        assert got == {("lend2-mid", RECLAIM)}
+
+    def test_preempt_from_all_cqs_in_cohort_lend(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("lend1-low", "lend1", priority=-1, cpu="3"),
+             admitted("lend1-mid", "lend1", priority=0, cpu="2"),
+             admitted("lend2-low", "lend2", priority=-1, cpu="3"),
+             admitted("lend2-mid", "lend2", priority=0, cpu="4")],
+            incoming_wl(priority=0, cpu="4"), "lend1", P)
+        assert got == {("lend1-low", IN_CQ), ("lend2-low", RECLAIM)}
+
+    def test_cannot_preempt_beyond_lending_limit(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("lend2-low", "lend2", priority=-1, cpu="10")],
+            incoming_wl(priority=0, cpu="9"), "lend1", P)
+        assert got == set()
+
+    # --- exhausted-queue interplay (preemption_test.go:1220-1437) ---
+
+    def test_preempt_in_cq_when_target_exhausted_single_resource(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("a1", "a", priority=-2, cpu="1"),
+             admitted("a2", "a", priority=-2, cpu="1"),
+             admitted("a3", "a", priority=-1, cpu="1"),
+             admitted("b1", "b", priority=0, cpu="1"),
+             admitted("b2", "b", priority=0, cpu="1"),
+             admitted("b3", "b", priority=0, cpu="1")],
+            incoming_wl(priority=0, cpu="2"), "a", P)
+        assert got == {("a1", IN_CQ), ("a2", IN_CQ)}
+
+    def test_preempt_in_cq_when_target_exhausted_two_resources(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("a1", "a", priority=-2, cpu="1", memory="1"),
+             admitted("a2", "a", priority=-2, cpu="1", memory="1"),
+             admitted("a3", "a", priority=-1, cpu="1", memory="1"),
+             admitted("b1", "b", priority=0, cpu="1", memory="1"),
+             admitted("b2", "b", priority=0, cpu="1", memory="1"),
+             admitted("b3", "b", priority=0, cpu="1", memory="1")],
+            incoming_wl(priority=0, cpu="2", memory="2"), "a",
+            {CPU: ("default", "preempt"), MEM: ("default", "preempt")})
+        assert got == {("a1", IN_CQ), ("a2", IN_CQ)}
+
+    def test_preempt_in_cq_when_exhausted_for_one_resource_not_other(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("a1", "a", priority=-2, cpu="1"),
+             admitted("a2", "a", priority=-2, cpu="1"),
+             admitted("a3", "a", priority=-1, cpu="1"),
+             admitted("b1", "b", priority=0, cpu="1"),
+             admitted("b2", "b", priority=0, cpu="1"),
+             admitted("b3", "b", priority=0, cpu="1")],
+            incoming_wl(priority=0, cpu="2", memory="2"), "a",
+            {CPU: ("default", "preempt"), MEM: ("default", "preempt")})
+        assert got == {("a1", IN_CQ), ("a2", IN_CQ)}
+
+    def test_preempt_from_others_when_target_not_exhausted(self):
+        got = run_targets(
+            preemption_fixture_cqs(),
+            [admitted("a1", "a", priority=-1, cpu="1"),
+             admitted("b1", "b", priority=0, cpu="1"),
+             admitted("b2", "b", priority=0, cpu="1"),
+             admitted("b3", "b", priority=0, cpu="1"),
+             admitted("b4", "b", priority=0, cpu="1"),
+             admitted("b5", "b", priority=-1, cpu="1")],
+            incoming_wl(priority=0, cpu="2"), "a", P)
+        assert got == {("a1", IN_CQ), ("b5", RECLAIM)}
+
+
+def fair_fixture_cqs(weights=None):
+    """TestFairPreemptions base CQs (preemption_test.go:1483-1530)."""
+    weights = weights or {}
+
+    def cq(name):
+        w = (ClusterQueueWrapper(name).cohort("all")
+             .resource_group(flavor_quotas("default", cpu="3"))
+             .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                         reclaim_within_cohort=api.PREEMPTION_ANY,
+                         borrow_within_cohort=bwc(-3)))
+        if name in weights:
+            w.fair_weight(weights[name])
+        return w.obj()
+
+    preemptible = (ClusterQueueWrapper("preemptible").cohort("all")
+                   .resource_group(flavor_quotas("default", cpu="0")).obj())
+    return [cq("a"), cq("b"), cq("c"), preemptible]
+
+
+def plain_fair_cqs(weights=None):
+    """The no-borrowWithinCohort variant used by the weight cases
+    (preemption_test.go:1806-1955)."""
+    weights = weights or {}
+    out = []
+    for name in ("a", "b", "c"):
+        w = (ClusterQueueWrapper(name).cohort("all")
+             .resource_group(flavor_quotas("default", cpu="3"))
+             .preemption(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                         reclaim_within_cohort=api.PREEMPTION_ANY))
+        if name in weights:
+            w.fair_weight(weights[name])
+        out.append(w.obj())
+    return out
+
+
+def units(cq_name, prefix, n, start=1, priority=0):
+    return [admitted(f"{prefix}{i}", cq_name, priority=priority, cpu="1")
+            for i in range(start, start + n)]
+
+
+class TestFairPreemptions:
+    """preemption_test.go TestFairPreemptions:1479-1987."""
+
+    def test_reclaim_nominal_from_user_using_the_most(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            units("a", "a", 3) + units("b", "b", 5) + units("c", "c", 1),
+            incoming_wl("c_incoming", cpu="1"), "c", P, fair=True)
+        assert got == {("b1", FAIR)}
+
+    def test_reclaim_from_queue_using_less_when_latest_not_enough(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("a1", "a", cpu="3"),
+             admitted("a2", "a", cpu="1"),
+             admitted("b1", "b", cpu="2"),
+             admitted("b2", "b", cpu="3")],
+            incoming_wl("c_incoming", cpu="3"), "c", P, fair=True)
+        assert got == {("a1", FAIR)}
+
+    def test_reclaim_borrowable_quota_from_user_using_the_most(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            units("a", "a", 3) + units("b", "b", 5) + units("c", "c", 1),
+            incoming_wl("a_incoming", cpu="1"), "a", P, fair=True)
+        assert got == {("b1", FAIR)}
+
+    def test_preempt_one_from_each_cq_borrowing(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("a1", "a", cpu="0.5"),
+             admitted("a2", "a", cpu="0.5"),
+             admitted("a3", "a", cpu="3"),
+             admitted("b1", "b", cpu="0.5"),
+             admitted("b2", "b", cpu="0.5"),
+             admitted("b3", "b", cpu="3")],
+            incoming_wl("c_incoming", cpu="2"), "c", P, fair=True)
+        assert got == {("a1", FAIR), ("b1", FAIR)}
+
+    def test_cannot_preempt_when_everyone_under_nominal(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            units("a", "a", 3) + units("b", "b", 3) + units("c", "c", 3),
+            incoming_wl("c_incoming", cpu="1"), "c", P, fair=True)
+        assert got == set()
+
+    def test_cannot_preempt_when_it_would_switch_imbalance(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            units("a", "a", 3) + units("b", "b", 5),
+            incoming_wl("a_incoming", cpu="2"), "a", P, fair=True)
+        assert got == set()
+
+    def test_preempt_lower_priority_from_same_cq(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("a1_low", "a", priority=-1, cpu="1"),
+             admitted("a2_low", "a", priority=-1, cpu="1"),
+             admitted("a3", "a", cpu="1"),
+             admitted("a4", "a", cpu="1")] + units("b", "b", 5),
+            incoming_wl("a_incoming", cpu="2"), "a", P, fair=True)
+        assert got == {("a1_low", IN_CQ), ("a2_low", IN_CQ)}
+
+    def test_preempt_combination_of_same_cq_and_highest_user(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("a_low", "a", priority=-1, cpu="1"),
+             admitted("a2", "a", cpu="1"),
+             admitted("a3", "a", cpu="1")] + units("b", "b", 6),
+            incoming_wl("a_incoming", cpu="2"), "a", P, fair=True)
+        assert got == {("a_low", IN_CQ), ("b1", FAIR)}
+
+    def test_preempt_huge_workload_if_no_other_option(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("b1", "b", cpu="9")],
+            incoming_wl("a_incoming", cpu="2"), "a", P, fair=True)
+        assert got == {("b1", FAIR)}
+
+    def test_cannot_preempt_huge_if_incoming_also_huge(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("a1", "a", cpu="2"),
+             admitted("b1", "b", cpu="7")],
+            incoming_wl("a_incoming", cpu="5"), "a", P, fair=True)
+        assert got == set()
+
+    def test_cannot_preempt_2_smaller_if_incoming_huge(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("b1", "b", cpu="2"),
+             admitted("b2", "b", cpu="2"),
+             admitted("b3", "b", cpu="3")],
+            incoming_wl("a_incoming", cpu="6"), "a", P, fair=True)
+        assert got == set()
+
+    def test_preempt_from_target_and_others_even_if_over_nominal(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("a1_low", "a", priority=-1, cpu="2"),
+             admitted("a2_low", "a", priority=-1, cpu="1"),
+             admitted("b1", "b", cpu="3"),
+             admitted("b2", "b", cpu="3")],
+            incoming_wl("a_incoming", cpu="4"), "a", P, fair=True)
+        assert got == {("a1_low", IN_CQ), ("b1", FAIR)}
+
+    def test_prefer_targets_not_making_cq_biggest_share(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("b1", "b", cpu="2"),
+             admitted("b2", "b", cpu="1"),
+             admitted("b3", "b", cpu="2"),
+             admitted("c1", "c", cpu="1")],
+            incoming_wl("a_incoming", cpu="3.5"), "a", P, fair=True)
+        assert got == {("b2", FAIR)}
+
+    def test_preempt_from_different_cqs_for_smaller_max_share(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("b1", "b", cpu="2"),
+             admitted("b2", "b", cpu="2.5"),
+             admitted("c1", "c", cpu="2"),
+             admitted("c2", "c", cpu="2.5")],
+            incoming_wl("a_incoming", cpu="3.5"), "a", P, fair=True)
+        assert got == {("b1", FAIR), ("c1", FAIR)}
+
+    def test_scenario_above_does_not_flap(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("a1", "a", cpu="3.5"),
+             admitted("b2", "b", cpu="2.5"),
+             admitted("c2", "c", cpu="2.5")],
+            incoming_wl("b_incoming", cpu="2"), "b", P, fair=True)
+        assert got == set()
+
+    def test_cannot_preempt_candidate_cq_under_nominal_after_one(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("b1", "b", cpu="3"),
+             admitted("b2", "b", cpu="3"),
+             admitted("c1", "c", cpu="3")],
+            incoming_wl("a_incoming", cpu="4"), "a", P, fair=True)
+        assert got == set()
+
+    def test_workloads_under_priority_threshold_always_preemptible(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            units("a", "a", 3) + units("b", "b", 3)
+            + units("preemptible", "preemptible", 3, priority=-3),
+            incoming_wl("a_incoming", cpu="2"), "a", P, fair=True)
+        assert got == {("preemptible1", FAIR),
+                       ("preemptible2", WHILE_BORROWING)}
+
+    def test_strategy_less_than_initial_share_prefers_low_priority(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("a1", "a", cpu="3"),
+             admitted("b_low", "b", priority=0, cpu="5"),
+             admitted("b_high", "b", priority=1, cpu="1")],
+            incoming_wl("a_incoming", cpu="1"), "a", P, fair=True,
+            strategies=["LessThanInitialShare"])
+        assert got == {("b_low", FAIR)}
+
+    def test_strategy_final_share_prefers_non_transferring(self):
+        got = run_targets(
+            fair_fixture_cqs(),
+            [admitted("a1", "a", cpu="3"),
+             admitted("b_low", "b", priority=0, cpu="5"),
+             admitted("b_high", "b", priority=1, cpu="1")],
+            incoming_wl("a_incoming", cpu="1"), "a", P, fair=True,
+            strategies=["LessThanOrEqualToFinalShare"])
+        assert got == {("b_high", FAIR)}
+
+    def test_cq_with_higher_weight_can_preempt_more(self):
+        got = run_targets(
+            plain_fair_cqs(weights={"a": 2000}),
+            units("a", "a", 3) + units("b", "b", 6),
+            incoming_wl("a_incoming", cpu="2"), "a", P, fair=True)
+        assert got == {("b1", FAIR), ("b2", FAIR)}
+
+    def test_can_preempt_anything_borrowing_from_zero_weight_cq(self):
+        got = run_targets(
+            plain_fair_cqs(weights={"b": 0}),
+            units("a", "a", 3) + units("b", "b", 6),
+            incoming_wl("a_incoming", cpu="3"), "a", P, fair=True)
+        assert got == {("b1", FAIR), ("b2", FAIR), ("b3", FAIR)}
+
+    def test_cannot_preempt_nominal_from_zero_weight_cq(self):
+        got = run_targets(
+            plain_fair_cqs(weights={"b": 0})[:2],
+            units("a", "a", 3) + units("b", "b", 3),
+            incoming_wl("a_incoming", cpu="1"), "a", P, fair=True)
+        assert got == set()
+
+
+class TestCandidatesOrdering:
+    """preemption_test.go TestCandidatesOrdering:1993-2040."""
+
+    def test_ordering(self):
+        def wl(name, cq="self", priority=0, reserved_at=NOW, evicted=False,
+               reserve=True):
+            w = WorkloadWrapper(name).priority(priority).creation(NOW)
+            w.pod_set(count=1, cpu="1")
+            if reserve:
+                w.reserve(cq, now=reserved_at)
+            w.wl.metadata.uid = name
+            if evicted:
+                set_condition(w.wl.status.conditions, Condition(
+                    type=api.WORKLOAD_EVICTED, status="True",
+                    reason="Preempted", message=""), NOW)
+            return wlpkg.Info(w.obj(), cluster_queue=cq)
+
+        candidates = [
+            wl("high", priority=10),
+            wl("low", priority=-10),
+            wl("other", cq="other", priority=10),
+            wl("evicted", evicted=True, reserve=False),
+            wl("old-a", reserved_at=NOW),
+            wl("old-b", reserved_at=NOW),
+            wl("current", reserved_at=NOW + 1),
+        ]
+        preemptor = Preemptor(clock=FakeClock(NOW))
+        candidates.sort(key=preemptor._candidate_sort_key("self"))
+        got = [c.obj.metadata.name for c in candidates]
+        assert got == ["evicted", "other", "low", "current", "old-a",
+                       "old-b", "high"]

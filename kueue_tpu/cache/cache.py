@@ -56,6 +56,10 @@ class Cache:
         # per-tree sum would shrink when a tree loses members and stall
         # invalidation forever.
         self._capacity_version = 0
+        # Bumped on ResourceFlavor spec changes (taints / node labels):
+        # they alter flavor eligibility without touching any CQ quota
+        # generation, so topology-derived caches key on this too.
+        self.flavor_spec_epoch = 0
 
     def _new_cohort(self, name: str) -> CohortCache:
         cohort = CohortCache(name)
@@ -180,6 +184,7 @@ class Cache:
 
     def _refresh_flavor_dependents(self) -> set:
         self._capacity_version += 1
+        self.flavor_spec_epoch += 1
         affected = set()
         for cqc in self.hm.cluster_queues.values():
             was = cqc.active
@@ -385,6 +390,7 @@ class Cache:
                     cohort_snaps[cname].parent = parent_snap
                     parent_snap.child_cohorts.add(cohort_snaps[cname])
             snap.cohort_epoch = self.cohort_epoch
+            snap.flavor_spec_epoch = self.flavor_spec_epoch
             return snap
 
     # --- usage reporting (status/metrics) ---

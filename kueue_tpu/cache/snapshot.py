@@ -156,6 +156,7 @@ class Snapshot:
     resource_flavors: dict = field(default_factory=dict)  # name -> ResourceFlavor
     inactive_cluster_queue_sets: set = field(default_factory=set)
     cohort_epoch: int = 0  # cohort-object structure version (Cache.cohort_epoch)
+    flavor_spec_epoch: int = 0  # ResourceFlavor spec version (taints/labels)
 
     def remove_workload(self, wl: wlpkg.Info) -> None:
         """Simulate removal (reference: snapshot.go:39)."""

@@ -268,11 +268,14 @@ class Scheduler:
             return invalid_entries, valid_heads
 
         solver_entries = list(invalid_entries)
-        remaining = []
+        remaining = [w for i, w in enumerate(valid_heads)
+                     if decisions.get(i) is None]
+        # Snapshot accounting only matters when a CPU remainder (nominate /
+        # preemption) will read the snapshot after us.
+        account = bool(remaining)
         for i, w in enumerate(valid_heads):
             decision = decisions.get(i)
             if decision is None:
-                remaining.append(w)
                 continue
             assignment, admitted = decision
             e = Entry(info=w, assignment=assignment)
@@ -286,8 +289,9 @@ class Scheduler:
                 solver_entries.append(e)
                 continue
             cq = snapshot.cluster_queues[w.cluster_queue]
-            # Account on the snapshot so the CPU remainder sees it.
-            cq.add_usage(assignment.usage)
+            if account:
+                # Account on the snapshot so the CPU remainder sees it.
+                cq.add_usage(assignment.usage)
             self._wait_pods_ready_if_needed(e, timeout)
             e.status = NOMINATED
             try:

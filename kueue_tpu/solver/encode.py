@@ -16,6 +16,7 @@ so the device program is pure integer arithmetic.
 
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -73,9 +74,18 @@ class Topology:
     # Fair sharing (reference: clusterqueue.go:503-564):
     fair_weight: np.ndarray = None        # [Q] int64 milli-weight
     cohort_lendable: np.ndarray = None    # [C,R] int64 — root tree's lendable
+    group_size: np.ndarray = None         # [Q,G] int32 — flavors per group
     cq_index: dict = field(default_factory=dict)
     flavor_index: dict = field(default_factory=dict)
     resource_index: dict = field(default_factory=dict)
+    # Monotonic identity for cache invalidation: per-Info encoded rows and
+    # the eligibility cache are keyed by this token, so a topology rebuild
+    # (new generations / cohort epoch) drops every derived row at once.
+    token: int = 0
+    elig_cache: dict = field(default_factory=dict)
+
+
+_TOPO_TOKEN = itertools.count(1)
 
 
 @dataclass
@@ -123,6 +133,7 @@ def iter_cohorts(snapshot: Snapshot) -> dict:
 
 def encode_topology(snapshot: Snapshot) -> Topology:
     topo = Topology()
+    topo.token = next(_TOPO_TOKEN)
     res_set, flavor_set = set(), set()
     for cq in snapshot.cluster_queues.values():
         for rg in cq.resource_groups:
@@ -235,6 +246,15 @@ def encode_topology(snapshot: Snapshot) -> Topology:
                     if quota.borrowing_limit is not None:
                         topo.borrow_limit[qi, fi, ri] = quota.borrowing_limit
                     topo.guaranteed[qi, fi, ri] = cq.resource_node.guaranteed_quota(fr)
+    # flavors per resource group (decode needs it for LastTriedFlavorIdx
+    # exhaustion; vectorized over all admitted rows)
+    max_groups = max((len(cq.resource_groups)
+                      for cq in snapshot.cluster_queues.values()), default=1)
+    topo.group_size = np.zeros((Q, max(1, max_groups)), np.int32)
+    for qname, cq in snapshot.cluster_queues.items():
+        qi = topo.cq_index[qname]
+        for gi, rg in enumerate(cq.resource_groups):
+            topo.group_size[qi, gi] = len(rg.flavors)
     return topo
 
 
@@ -263,6 +283,60 @@ def encode_state(snapshot: Snapshot, topo: Topology) -> State:
     return state
 
 
+def _encode_one(info, snapshot: Snapshot, topo: Topology, P: int):
+    """Encode one workload's cycle-stable rows. Returns
+    (qi, requests [P,R], active [P], eligible [P,F], solvable) — or
+    qi == -1 when the CQ is unknown. Cached on the Info keyed by
+    topo.token (Info.total_requests is fixed at Info construction; the
+    queue manager builds a fresh Info on workload updates)."""
+    cq = snapshot.cluster_queues.get(info.cluster_queue)
+    if cq is None:
+        return -1, None, None, None, False
+    qi = topo.cq_index[info.cluster_queue]
+    _, F, R = topo.nominal.shape
+    requests = np.zeros((P, R), np.int64)
+    active = np.zeros(P, bool)
+    eligible = np.zeros((P, F), bool)
+    if len(info.total_requests) > P:
+        return qi, requests, active, eligible, False  # CPU fallback
+    resource_index = topo.resource_index
+    covers_pods = topo.covers_pods[qi]
+    for pi, psr in enumerate(info.total_requests):
+        reqs = dict(psr.requests)
+        if covers_pods:
+            reqs[RESOURCE_PODS] = psr.count
+        for r, v in reqs.items():
+            ri = resource_index.get(r)
+            if ri is None or topo.group_id[qi, ri] < 0:
+                return qi, requests, active, eligible, False
+            requests[pi, ri] = v
+        active[pi] = True
+        # host-side taints/affinity per flavor, memoized by pod-spec
+        # signature: identical pod shapes (the common case at scale)
+        # share one eligibility row instead of re-running the
+        # string-matching loop per workload
+        pod_spec = info.obj.spec.pod_sets[pi].template.spec
+        key = (qi, _eligibility_key(pod_spec))
+        row = topo.elig_cache.get(key)
+        if row is None:
+            row = np.zeros(F, bool)
+            for rg in cq.resource_groups:
+                for fname in rg.flavors:
+                    flavor = snapshot.resource_flavors.get(fname)
+                    if flavor is None:
+                        continue
+                    if find_untolerated_taint(flavor.spec.node_taints,
+                                              pod_spec.tolerations) is not None:
+                        continue
+                    if not flavor_selector_matches(pod_spec, rg.label_keys,
+                                                   flavor.spec.node_labels):
+                        continue
+                    row[topo.flavor_index[fname]] = True
+            topo.elig_cache[key] = row
+        eligible[pi] = row
+    return qi, requests, active, eligible, True
+
+
 def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
                      ordering: Optional[wlpkg.Ordering] = None,
                      max_podsets: int = 4) -> WorkloadBatch:
@@ -282,17 +356,25 @@ def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
     batch.solvable = np.zeros(W, bool)
     batch.start_rank = np.zeros((W, P, R), np.int32)
 
-    elig_cache: dict = {}  # (qi, pod-spec signature) -> [F] bool row
+    token = topo.token
+    priorities, timestamps = batch.priority, batch.timestamp
     for wi, info in enumerate(entries):
-        cq = snapshot.cluster_queues.get(info.cluster_queue)
-        if cq is None:
+        enc = getattr(info, "_solver_enc", None)
+        if enc is None or enc[0] != token:
+            enc = (token,) + _encode_one(info, snapshot, topo, P)
+            info._solver_enc = enc
+        _, qi, requests, active, eligible, ok = enc
+        if qi < 0:
             continue
-        qi = topo.cq_index[info.cluster_queue]
         batch.wl_cq[wi] = qi
-        batch.priority[wi] = prioritypkg.priority(info.obj)
-        batch.timestamp[wi] = ordering.queue_order_timestamp(info.obj)
-        if len(info.total_requests) > P:
-            continue  # too many podsets for this bucket: CPU fallback
+        priorities[wi] = prioritypkg.priority(info.obj)
+        timestamps[wi] = ordering.queue_order_timestamp(info.obj)
+        if not ok:
+            continue
+        batch.requests[wi] = requests
+        batch.podset_active[wi] = active
+        batch.eligible[wi] = eligible
+        batch.solvable[wi] = True
         # Flavor-fungibility resume (reference: flavorassigner.go:289-296):
         # start each resource's search after the last tried flavor, unless
         # the capacity generation moved (then restart from 0). Both the
@@ -300,6 +382,7 @@ def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
         # FlavorFungibility gate, mirroring the CPU assigner.
         la = info.last_assignment
         if la is not None:
+            cq = snapshot.cluster_queues[info.cluster_queue]
             outdated = (cq.allocatable_resource_generation
                         > la.cluster_queue_generation
                         or (cq.cohort is not None
@@ -311,46 +394,6 @@ def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
             for pi in range(min(len(info.total_requests), P)):
                 for r, ri in topo.resource_index.items():
                     batch.start_rank[wi, pi, ri] = la.next_flavor_to_try(pi, r)
-        ok = True
-        for pi, psr in enumerate(info.total_requests):
-            reqs = dict(psr.requests)
-            if topo.covers_pods[qi]:
-                reqs[RESOURCE_PODS] = psr.count
-            covered = True
-            for r, v in reqs.items():
-                ri = topo.resource_index.get(r)
-                if ri is None or topo.group_id[qi, ri] < 0:
-                    covered = False
-                    break
-                batch.requests[wi, pi, ri] = v
-            if not covered:
-                ok = False
-                break
-            batch.podset_active[wi, pi] = True
-            # host-side taints/affinity per flavor, memoized by pod-spec
-            # signature: identical pod shapes (the common case at scale)
-            # share one eligibility row instead of re-running the
-            # string-matching loop per workload
-            pod_spec = info.obj.spec.pod_sets[pi].template.spec
-            key = (qi, _eligibility_key(pod_spec))
-            row = elig_cache.get(key)
-            if row is None:
-                row = np.zeros(batch.eligible.shape[2], bool)
-                for rg in cq.resource_groups:
-                    for fname in rg.flavors:
-                        flavor = snapshot.resource_flavors.get(fname)
-                        if flavor is None:
-                            continue
-                        if find_untolerated_taint(flavor.spec.node_taints,
-                                                  pod_spec.tolerations) is not None:
-                            continue
-                        if not flavor_selector_matches(pod_spec, rg.label_keys,
-                                                       flavor.spec.node_labels):
-                            continue
-                        row[topo.flavor_index[fname]] = True
-                elig_cache[key] = row
-            batch.eligible[wi, pi] = row
-        batch.solvable[wi] = ok
     return batch
 
 

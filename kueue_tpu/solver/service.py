@@ -49,21 +49,21 @@ class BatchSolver:
         self.backend = backend
         self._topo_cache = None
         self._topo_key = None
-        self._decode_cache: dict = {}  # qi -> (group_size, prefer_nb)
 
     # --- encoding with topology caching across cycles ---
 
     def _topology(self, snapshot: Snapshot):
         # cohort_epoch: cohort re-parents / quota edits don't bump any
-        # CQ's generation but change the encoded tree.
-        key = (snapshot.cohort_epoch,) + tuple(sorted(
+        # CQ's generation but change the encoded tree. flavor_spec_epoch:
+        # ResourceFlavor taint/label edits change eligibility rows without
+        # bumping any CQ generation.
+        key = (snapshot.cohort_epoch, snapshot.flavor_spec_epoch) + tuple(sorted(
             (name, cq.allocatable_resource_generation)
             for name, cq in snapshot.cluster_queues.items()))
         if key != self._topo_key:
             self._topo_key = key
             topo = encode.encode_topology(snapshot)
             self._topo_cache = (topo, topo_to_device(topo))
-            self._decode_cache = {}
         return self._topo_cache
 
     def solve(self, snapshot: Snapshot, entries: list,
@@ -120,87 +120,109 @@ class BatchSolver:
                                             topo.cohort_root),
                     fair_sharing=fair_sharing, start_rank=start_rank)
 
-        # One batched fetch: per-array transfers each pay a full device
-        # round-trip (severe over a tunneled TPU).
+        # One execute, one sync: all outputs come from the same device
+        # program, so the first fetch pays the tunnel round trip and the
+        # rest are free.
         fetched = jax.device_get({k: result[k] for k in
                                   ("admitted", "fit", "chosen", "borrows",
                                    "chosen_borrow") if k in result})
-        admitted = np.asarray(fetched["admitted"])
-        fit = np.asarray(fetched["fit"])
-        chosen = np.asarray(fetched["chosen"])
-        borrows = np.asarray(fetched["borrows"])
-        cb = fetched.get("chosen_borrow")
-        chosen_borrow = np.asarray(cb) if cb is not None else np.zeros(0)
+        return self._decode_batch(entries, snapshot, topo, batch, fetched)
 
-        out = {}
-        for wi in range(batch.n):
-            if not fit[wi]:
-                continue  # CPU path: preemption / partial admission / status
-            out[wi] = (self._build_assignment(
-                entries[wi], snapshot, topo, chosen[wi], bool(borrows[wi]),
-                chosen_borrow[wi] if chosen_borrow.ndim == 3 else None),
-                bool(admitted[wi]))
-        return out
-
-    def _build_assignment(self, info: wlpkg.Info, snapshot: Snapshot,
-                          topo: encode.Topology, chosen_w: np.ndarray,
-                          borrows: bool,
-                          chosen_borrow_w=None) -> fa.Assignment:
+    def _decode_batch(self, entries: list, snapshot: Snapshot,
+                      topo: encode.Topology, batch, fetched: dict) -> dict:
         """Decode device output into the scheduler's Assignment form,
         including the LastTriedFlavorIdx resume state exactly as the CPU
         assigner stores it (reference: flavorassigner.go:289-324): the
         rank where the search ended, -1 when the list was exhausted
         (chosen == last flavor, or a TryNextFlavor CQ settling for a
-        borrowing fit after scanning the whole list)."""
+        borrowing fit after scanning the whole list).
+
+        All numeric work (rank, group exhaustion, borrow flags) runs as
+        one vectorized numpy pass over the admitted rows; the per-entry
+        loop only assembles the Assignment objects from Python lists."""
         from kueue_tpu.api.corev1 import RESOURCE_PODS
-        assignment = fa.Assignment(borrowing=borrows)
-        cq = snapshot.cluster_queues[info.cluster_queue]
-        assignment.last_state = wlpkg.AssignmentClusterQueueState(
-            cluster_queue_generation=cq.allocatable_resource_generation,
-            cohort_generation=(cq.cohort.allocatable_resource_generation
-                               if cq.cohort else 0))
-        qi = topo.cq_index[info.cluster_queue]
-        cached = self._decode_cache.get(qi)
-        if cached is None:
-            group_size = {}
-            for gi in topo.flavor_group[qi]:
-                if gi >= 0:
-                    group_size[int(gi)] = group_size.get(int(gi), 0) + 1
-            cached = (group_size, bool(topo.prefer_no_borrow[qi]))
-            self._decode_cache[qi] = cached
-        group_size, prefer_nb = cached
+        n = batch.n
+        fit = np.asarray(fetched["fit"])[:n]
+        idx = np.flatnonzero(fit)
+        if idx.size == 0:
+            return {}
+        admitted = np.asarray(fetched["admitted"])[:n][idx]     # [M]
+        chosen = np.asarray(fetched["chosen"])[:n][idx]          # [M,P,R]
+        borrows = np.asarray(fetched["borrows"])[:n][idx]        # [M]
+        cb = fetched.get("chosen_borrow")
+        chosen_borrow = (np.asarray(cb)[:n][idx] if cb is not None
+                         else np.zeros_like(chosen, dtype=bool))  # [M,P,R]
+        qi_arr = batch.wl_cq[idx]                                 # [M]
+
         # With FlavorFungibility off the CPU assigner never writes the
         # tried index (stays at the dataclass default 0).
         fungibility_on = features.enabled(features.FLAVOR_FUNGIBILITY)
-        for pi, psr in enumerate(info.total_requests):
-            reqs = dict(psr.requests)
-            if topo.covers_pods[qi]:
-                reqs[RESOURCE_PODS] = psr.count
-            flavors = {}
-            for r, v in reqs.items():
-                ri = topo.resource_index[r]
-                fi = int(chosen_w[pi, ri])
-                if v > 0 and fi < 0:
-                    raise AssertionError("solver admitted workload without flavor")
-                fname = topo.flavors[fi] if fi >= 0 else topo.flavors[0]
-                tried = -1 if fungibility_on else 0
-                if fi >= 0 and fungibility_on:
-                    rank = int(topo.flavor_rank[qi, fi])
-                    gi = int(topo.group_id[qi, ri])
-                    exhausted = rank == group_size.get(gi, 1) - 1
-                    if prefer_nb and chosen_borrow_w is not None \
-                            and bool(chosen_borrow_w[pi, ri]):
-                        exhausted = True  # scanned past it looking for no-borrow
-                    tried = -1 if exhausted else rank
-                flavors[r] = fa.FlavorAssignment(name=fname, mode=fa.FIT,
-                                                 tried_flavor_idx=tried)
-            ps = fa.PodSetAssignmentResult(name=psr.name, flavors=flavors,
-                                           requests=reqs, count=psr.count)
-            assignment.pod_sets.append(ps)
-            flavor_idx = {}
-            for r, fassign in flavors.items():
-                fr = FlavorResource(fassign.name, r)
-                assignment.usage[fr] = assignment.usage.get(fr, 0) + reqs[r]
-                flavor_idx[r] = fassign.tried_flavor_idx
-            assignment.last_state.last_tried_flavor_idx.append(flavor_idx)
-        return assignment
+        fi_safe = np.maximum(chosen, 0)
+        rank = topo.flavor_rank[qi_arr[:, None, None], fi_safe]   # [M,P,R]
+        gi = topo.group_id[qi_arr]                                # [M,R]
+        gsize = topo.group_size[qi_arr[:, None], np.maximum(gi, 0)]  # [M,R]
+        exhausted = rank == gsize[:, None, :] - 1
+        prefer_nb = topo.prefer_no_borrow[qi_arr]                 # [M]
+        # TryNextFlavor CQs scanned the whole list looking for a no-borrow
+        # fit before settling for this borrowing one.
+        exhausted |= prefer_nb[:, None, None] & chosen_borrow
+        if fungibility_on:
+            tried = np.where(exhausted | (chosen < 0), -1, rank)
+        else:
+            tried = np.zeros_like(rank)
+
+        chosen_l = chosen.tolist()
+        tried_l = tried.tolist()
+        borrows_l = borrows.tolist()
+        admitted_l = admitted.tolist()
+        flavor_names = topo.flavors
+        resource_index = topo.resource_index
+
+        # last_state generations per CQ, read fresh per cycle: the cohort
+        # generation is the cache's global capacity version, which moves
+        # on events (e.g. workload removal) that never rebuild the
+        # topology, so caching it across cycles would hand out stale
+        # resume state.
+        gen_cache: dict = {}
+        out = {}
+        for row, wi in enumerate(idx.tolist()):
+            info = entries[wi]
+            gens = gen_cache.get(info.cluster_queue)
+            if gens is None:
+                cq = snapshot.cluster_queues[info.cluster_queue]
+                gens = (cq.allocatable_resource_generation,
+                        cq.cohort.allocatable_resource_generation
+                        if cq.cohort else 0)
+                gen_cache[info.cluster_queue] = gens
+            assignment = fa.Assignment(borrowing=bool(borrows_l[row]))
+            assignment.last_state = wlpkg.AssignmentClusterQueueState(
+                cluster_queue_generation=gens[0], cohort_generation=gens[1])
+            covers_pods = topo.covers_pods[batch.wl_cq[wi]]
+            usage = assignment.usage
+            for pi, psr in enumerate(info.total_requests):
+                reqs = dict(psr.requests)
+                if covers_pods:
+                    reqs[RESOURCE_PODS] = psr.count
+                chosen_p = chosen_l[row][pi]
+                tried_p = tried_l[row][pi]
+                flavors = {}
+                flavor_idx = {}
+                for r, v in reqs.items():
+                    ri = resource_index[r]
+                    fi = chosen_p[ri]
+                    if v > 0 and fi < 0:
+                        raise AssertionError(
+                            "solver admitted workload without flavor")
+                    fname = flavor_names[fi] if fi >= 0 else flavor_names[0]
+                    t = tried_p[ri]
+                    flavors[r] = fa.FlavorAssignment(name=fname, mode=fa.FIT,
+                                                     tried_flavor_idx=t)
+                    flavor_idx[r] = t
+                    fr = FlavorResource(fname, r)
+                    usage[fr] = usage.get(fr, 0) + v
+                assignment.pod_sets.append(fa.PodSetAssignmentResult(
+                    name=psr.name, flavors=flavors, requests=reqs,
+                    count=psr.count))
+                assignment.last_state.last_tried_flavor_idx.append(flavor_idx)
+            out[wi] = (assignment, bool(admitted_l[row]))
+        return out

@@ -96,6 +96,8 @@ class Info:
         self.obj = wl
         self.cluster_queue = cluster_queue
         self.last_assignment: Optional[AssignmentClusterQueueState] = None
+        self._fru_cache: Optional[dict] = None
+        self._fr_keys_cache: Optional[frozenset] = None
         if wl.status.admission is not None:
             self.cluster_queue = wl.status.admission.cluster_queue
             self.total_requests = _total_requests_from_admission(wl)
@@ -120,12 +122,25 @@ class Info:
                    for ps in self.obj.spec.pod_sets)
 
     def flavor_resource_usage(self) -> dict:
-        total: dict = {}
-        for psr in self.total_requests:
-            for res, q in psr.requests.items():
-                fr = FlavorResource(psr.flavors.get(res, ""), res)
-                total[fr] = total.get(fr, 0) + q
+        """FlavorResource -> quantity, memoized: total_requests is fixed
+        at Info construction and preemption scans call this per candidate
+        per cycle."""
+        total = self._fru_cache
+        if total is None:
+            total = {}
+            for psr in self.total_requests:
+                for res, q in psr.requests.items():
+                    fr = FlavorResource(psr.flavors.get(res, ""), res)
+                    total[fr] = total.get(fr, 0) + q
+            self._fru_cache = total
         return total
+
+    def flavor_resource_keys(self) -> frozenset:
+        """The FlavorResources this workload occupies (memoized)."""
+        keys = self._fr_keys_cache
+        if keys is None:
+            keys = self._fr_keys_cache = frozenset(self.flavor_resource_usage())
+        return keys
 
 
 def _total_requests_from_pod_sets(wl: api.Workload) -> list:

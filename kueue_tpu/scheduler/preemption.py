@@ -176,16 +176,29 @@ class Preemptor:
         return candidates
 
     def _candidate_sort_key(self, cq_name: str):
-        """candidatesOrdering (reference: preemption.go:587-614)."""
+        """candidatesOrdering (reference: preemption.go:587-614). The
+        status-derived components are memoized on the Info keyed by the
+        object's resourceVersion (a candidate appears in many problems
+        per cycle when cohorts share victims)."""
         now = self.clock.now()
 
         def sort_key(c: wlpkg.Info):
-            evicted = wlpkg.is_evicted(c.obj)
+            obj = c.obj
+            rv = obj.metadata.resource_version
+            cached = getattr(c, "_cand_key_cache", None)
+            if cached is None or cached[0] != rv:
+                cond = find_condition(obj.status.conditions,
+                                      api.WORKLOAD_QUOTA_RESERVED)
+                reserved_at = (cond.last_transition_time
+                               if cond and cond.status == "True" else None)
+                cached = (rv, not wlpkg.is_evicted(obj),
+                          prioritypkg.priority(obj), reserved_at,
+                          obj.metadata.uid)
+                c._cand_key_cache = cached
+            _, not_evicted, prio, reserved_at, uid = cached
             in_cq = c.cluster_queue == cq_name
-            prio = prioritypkg.priority(c.obj)
-            cond = find_condition(c.obj.status.conditions, api.WORKLOAD_QUOTA_RESERVED)
-            reserved_at = cond.last_transition_time if cond and cond.status == "True" else now
-            return (not evicted, in_cq, prio, -reserved_at, c.obj.metadata.uid)
+            return (not_evicted, in_cq, prio,
+                    -(reserved_at if reserved_at is not None else now), uid)
 
         return sort_key
 
@@ -348,12 +361,7 @@ def cq_is_borrowing(cq: ClusterQueueSnapshot, frs_need_preemption: set) -> bool:
 
 
 def workload_uses_resources(wl: wlpkg.Info, frs_need_preemption: set) -> bool:
-    from kueue_tpu.core.resources import FlavorResource
-    for psr in wl.total_requests:
-        for res, flv in psr.flavors.items():
-            if FlavorResource(flv, res) in frs_need_preemption:
-                return True
-    return False
+    return not frs_need_preemption.isdisjoint(wl.flavor_resource_keys())
 
 
 def workload_fits(requests: dict, cq: ClusterQueueSnapshot, allow_borrowing: bool) -> bool:

@@ -116,6 +116,13 @@ class Scheduler:
         # the win; narrow cycles go through the CPU path even with a
         # solver configured (SolverConfig.min_heads; 0 = always solve).
         self.solver_min_heads = solver_min_heads
+        # Preemption work gate: the device preemptor saves roughly
+        # (CPU simulate ~12us - encode/decode ~4us) per candidate, so it
+        # must cover the marginal sync cost — the full measured dispatch
+        # floor when no fit entries dispatch this cycle, zero otherwise.
+        # solver_sync_floor_ms overrides the measured floor (tests use 0
+        # to force the device path on tiny problems).
+        self.solver_sync_floor_ms: Optional[float] = None
         self.preemptor = Preemptor(
             ordering=self.ordering,
             enable_fair_sharing=fair_sharing_enabled,
@@ -155,22 +162,12 @@ class Scheduler:
         snapshot = self.cache.snapshot()
 
         solver_entries: list = []
+        pre_entries: list = []
         if self.solver is not None and len(heads) >= self.solver_min_heads:
-            solver_entries, heads = self._solve_batch(heads, snapshot, timeout)
+            solver_entries, pre_entries, heads = self._solve_batch(
+                heads, snapshot, timeout)
 
-        # Device preemption: defer preempt-mode target selection out of
-        # nominate and solve all entries' simulations in one batched
-        # program (fairPreemptions' DRF heap stays on the CPU path).
-        # solver_min_heads gates dispatch overhead exactly as for the
-        # fit-mode batch.
-        defer_preemption = (self.solver is not None
-                            and not self.fair_sharing_enabled
-                            and len(heads) + len(solver_entries)
-                            >= self.solver_min_heads)
-        entries = self.nominate(heads, snapshot,
-                                defer_preemption=defer_preemption)
-        if defer_preemption:
-            self._solve_preemption_batch(entries, snapshot)
+        entries = pre_entries + self.nominate(heads, snapshot)
         entries.sort(key=self._entry_sort_key())
 
         preempted_workloads: set = set()
@@ -247,8 +244,19 @@ class Scheduler:
     # --- batched TPU admission (kueue_tpu.solver) ---
 
     def _solve_batch(self, heads: list, snapshot: Snapshot, timeout):
-        """Run the batched solver over the validated heads. Returns
-        (processed entries, remaining heads for the CPU path)."""
+        """Run the batched solver over the validated heads.
+
+        One device sync per cycle: the solver's host-side router (exact
+        Phase A on the local CPU backend) says which heads the device
+        will fit; the rest are CPU-nominated NOW — against the pre-cycle
+        snapshot, exactly like the reference's nominate phase
+        (scheduler.go:404-441) — and their preempt-mode target selection
+        ships in the same device execute as the fit solve.
+
+        Returns (solver entries, nominated preempt/nofit entries for the
+        main admit loop, remaining heads for post-sync CPU nomination —
+        empty unless routing was unavailable or mispredicted)."""
+        from kueue_tpu.solver import preempt as devpreempt
         valid_heads, invalid_entries = [], []
         for w in heads:
             if self.cache.is_assumed_or_admitted(w):
@@ -262,18 +270,102 @@ class Scheduler:
                 invalid_entries.append(e)
 
         try:
-            decisions = self.solver.solve(snapshot, valid_heads,
-                                          fair_sharing=self.fair_sharing_enabled)
+            plan = self.solver.prepare(snapshot, valid_heads)
+        except Exception:  # noqa: BLE001 — encode failure: CPU fallback
+            return invalid_entries, [], valid_heads
+        if plan is None:
+            return invalid_entries, [], valid_heads
+
+        # Route: entries the device won't fit get their CPU nomination
+        # (flavor assignment + preemption candidates) before the sync.
+        fit_pred = plan.fit_pred
+        if fit_pred is None:
+            pred_other = []
+        else:
+            pred_other = [w for i, w in enumerate(valid_heads)
+                          if not fit_pred[i]]
+        # fairPreemptions' DRF heap stays on the CPU path; without fair
+        # sharing, preempt-mode target selection is deferred to the device.
+        defer = not self.fair_sharing_enabled
+        pre_entries = self.nominate(pred_other, snapshot,
+                                    defer_preemption=defer)
+        pending = [e for e in pre_entries if e.preemption_targets is None]
+        for e in pending:
+            e.preemption_targets = []
+        fit_count = (len(valid_heads) - len(pred_other)
+                     if fit_pred is not None else len(valid_heads))
+        pbatch = None
+        requests_by, cq_by = {}, {}
+        if pending:
+            try:
+                problems, frs_by = [], {}
+                for i, e in enumerate(pending):
+                    requests_by[i] = e.assignment.total_requests_for(e.info)
+                    frs_by[i] = fa.flavor_resources_need_preemption(e.assignment)
+                    cq_by[i] = e.info.cluster_queue
+                    problems.extend(devpreempt.build_problems(
+                        i, e.info, requests_by[i], frs_by[i], snapshot,
+                        self.preemptor))
+                total_k = sum(len(p.candidates) for p in problems)
+                # Work gate: ~8us/candidate net device saving must cover
+                # the marginal sync (zero when fit entries dispatch anyway).
+                floor_ms = (self.solver_sync_floor_ms
+                            if self.solver_sync_floor_ms is not None
+                            else self.solver.estimated_sync_ms())
+                # The fused single-chip kernel ships preemption in the fit
+                # execute (marginal sync 0 when fit entries dispatch); the
+                # mesh path pays a separate dispatch either way.
+                shares_sync = fit_count > 0 and self.solver.mesh is None
+                marginal_sync_us = 0.0 if shares_sync else floor_ms * 1000.0
+                if problems and total_k * 8.0 > marginal_sync_us:
+                    pbatch = devpreempt.encode_problems(
+                        problems, snapshot, plan.topo, requests_by, cq_by,
+                        frs_by)
+                else:
+                    # Routing decision, not a failure: small simulations
+                    # are cheaper on the CPU preemptor.
+                    self._cpu_preempt_targets(pending, snapshot)
+                    pending = []
+            except Exception:  # noqa: BLE001 — encode failure: CPU targets
+                self.preemption_fallbacks += 1
+                pbatch = None
+                self._cpu_preempt_targets(pending, snapshot)
+                pending = []
+        if fit_count == 0 and pbatch is None:
+            # Nothing needs the device this cycle: no fit-mode entries and
+            # preemption resolved on CPU — skip the dispatch entirely.
+            return invalid_entries, pre_entries, []
+
+        try:
+            decisions, pre = self.solver.solve_prepared(
+                plan, snapshot, preempt_batch=pbatch,
+                fair_sharing=self.fair_sharing_enabled)
         except Exception:  # noqa: BLE001 — device failure: CPU fallback
-            return invalid_entries, valid_heads
+            if pending:
+                self.preemption_fallbacks += 1
+                self._cpu_preempt_targets(pending, snapshot)
+            pred_fit = [w for i, w in enumerate(valid_heads)
+                        if fit_pred is None or fit_pred[i]]
+            return invalid_entries, pre_entries, pred_fit
+
+        if pre is not None and pbatch is not None:
+            targets_by_entry = devpreempt.decode_targets(
+                pbatch, pre[0], pre[1], snapshot, cq_by)
+            for i, e in enumerate(pending):
+                e.preemption_targets = targets_by_entry.get(i, [])
+            self._retry_partial_admission(pending, snapshot)
 
         solver_entries = list(invalid_entries)
+        pre_keys = {e.info.key for e in pre_entries}
         remaining = [w for i, w in enumerate(valid_heads)
-                     if decisions.get(i) is None]
-        # Snapshot accounting only matters when a CPU remainder (nominate /
-        # preemption) will read the snapshot after us.
-        account = bool(remaining)
+                     if decisions.get(i) is None and w.key not in pre_keys]
+        # Snapshot accounting only matters when more entries (the CPU
+        # remainder or the pre-nominated preempt/nofit set) will read the
+        # snapshot after us.
+        account = bool(remaining) or bool(pre_entries)
         for i, w in enumerate(valid_heads):
+            if w.key in pre_keys:
+                continue  # CPU-nominated; decisions only cover fit routing
             decision = decisions.get(i)
             if decision is None:
                 continue
@@ -299,7 +391,27 @@ class Scheduler:
             except Exception as exc:  # noqa: BLE001
                 e.inadmissible_msg = f"Failed to admit workload: {exc}"
             solver_entries.append(e)
-        return solver_entries, remaining
+        return solver_entries, pre_entries, remaining
+
+    def _cpu_preempt_targets(self, pending: list, snapshot: Snapshot) -> None:
+        """Fallback / gate routing: resolve deferred preempt-mode entries
+        with the CPU preemptor (assignments are already computed)."""
+        for e in pending:
+            e.preemption_targets = self.preemptor.get_targets(
+                e.info, e.assignment, snapshot)
+        self._retry_partial_admission(pending, snapshot)
+
+    def _retry_partial_admission(self, pending: list, snapshot: Snapshot) -> None:
+        """No feasible target set: the CPU path would now attempt partial
+        admission (get_assignments' reducer branch)."""
+        if not features.enabled(features.PARTIAL_ADMISSION):
+            return
+        for e in pending:
+            if not e.preemption_targets and e.info.can_be_partially_admitted():
+                e.assignment, e.preemption_targets = self.get_assignments(
+                    e.info, snapshot)
+                e.inadmissible_msg = e.assignment.message()
+                e.info.last_assignment = e.assignment.last_state
 
     def _validate_head(self, w: wlpkg.Info, snapshot: Snapshot):
         """Pre-admission validation (the non-assignment part of nominate).
@@ -355,51 +467,6 @@ class Scheduler:
                         cq.dominant_resource_share_with(e.assignment.total_requests_for(w))
             entries.append(e)
         return entries
-
-    def _solve_preemption_batch(self, entries: list, snapshot: Snapshot) -> None:
-        """Resolve deferred preempt-mode entries on device in one batch
-        (kueue_tpu.solver.preempt); entries the device finds infeasible
-        fall back to the CPU path to preserve the partial-admission
-        semantics of get_assignments."""
-        from kueue_tpu.solver import preempt as devpreempt
-        pending = [e for e in entries if e.preemption_targets is None]
-        for e in pending:
-            e.preemption_targets = []
-        if not pending:
-            return
-        try:
-            problems = []
-            requests_by, frs_by, cq_by = {}, {}, {}
-            for i, e in enumerate(pending):
-                requests_by[i] = e.assignment.total_requests_for(e.info)
-                frs_by[i] = fa.flavor_resources_need_preemption(e.assignment)
-                cq_by[i] = e.info.cluster_queue
-                problems.extend(devpreempt.build_problems(
-                    i, e.info, requests_by[i], frs_by[i], snapshot,
-                    self.preemptor))
-            if problems:
-                batch = devpreempt.encode_problems(
-                    problems, snapshot, requests_by, frs_by, cq_by)
-                mask, feasible = devpreempt.solve_preemption_batch(batch)
-                decisions = devpreempt.decode_targets(batch, mask, feasible,
-                                                      snapshot, cq_by)
-                for i, e in enumerate(pending):
-                    e.preemption_targets = decisions.get(i, [])
-        except Exception:  # noqa: BLE001 — device failure: CPU fallback
-            self.preemption_fallbacks += 1
-            for e in pending:
-                e.assignment, e.preemption_targets = self.get_assignments(
-                    e.info, snapshot)
-            return
-        # No feasible target set: the CPU path would now attempt partial
-        # admission (get_assignments' reducer branch).
-        if features.enabled(features.PARTIAL_ADMISSION):
-            for e in pending:
-                if not e.preemption_targets and e.info.can_be_partially_admitted():
-                    e.assignment, e.preemption_targets = self.get_assignments(
-                        e.info, snapshot)
-                    e.inadmissible_msg = e.assignment.message()
-                    e.info.last_assignment = e.assignment.last_state
 
     def get_assignments(self, wl: wlpkg.Info, snapshot: Snapshot,
                         defer_preemption: bool = False):

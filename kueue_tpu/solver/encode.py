@@ -319,6 +319,10 @@ def _encode_one(info, snapshot: Snapshot, topo: Topology, P: int):
         key = (qi, _eligibility_key(pod_spec))
         row = topo.elig_cache.get(key)
         if row is None:
+            if len(topo.elig_cache) >= 65536:
+                # Bound growth under per-workload-unique pod shapes; rows
+                # are recomputed on demand after a reset.
+                topo.elig_cache.clear()
             row = np.zeros(F, bool)
             for rg in cq.resource_groups:
                 for fname in rg.flavors:

@@ -460,6 +460,37 @@ solve_cycle_fused = partial(
     solve_cycle_fused_impl)
 
 
+def solve_cycle_with_preempt_impl(topo, usage, cohort_usage, requests,
+                                  podset_active, wl_cq, priority, timestamp,
+                                  eligible, solvable, preempt_args: tuple,
+                                  num_podsets: int, max_rank: int,
+                                  fair_sharing: bool = False,
+                                  start_rank=None):
+    """Mixed admission + preemption cycle as ONE device program: the fused
+    fit solve plus the batched preemption target selection
+    (preempt.solve_preempt_impl) against the same pre-cycle state.
+    Preemption simulates against pre-cycle usage exactly like the
+    reference's nominate-time GetTargets (scheduler.go:404-441) — it does
+    NOT see this cycle's fit admissions, so both sub-programs are
+    independent and compile into a single execute: one device sync per
+    cycle, the dominant cost over a tunneled TPU link."""
+    from kueue_tpu.solver.preempt import solve_preempt_impl
+    out = solve_cycle_fused_impl(
+        topo, usage, cohort_usage, requests, podset_active, wl_cq, priority,
+        timestamp, eligible, solvable, num_podsets=num_podsets,
+        max_rank=max_rank, fair_sharing=fair_sharing, start_rank=start_rank)
+    targets, feasible = solve_preempt_impl(topo, usage, cohort_usage,
+                                           *preempt_args)
+    out["preempt_targets"] = targets
+    out["preempt_feasible"] = feasible
+    return out
+
+
+solve_cycle_with_preempt = partial(
+    jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing"))(
+    solve_cycle_with_preempt_impl)
+
+
 def max_rank_bound(wl_cq, cq_cohort, cohort_root) -> int:
     """Host-side static bound for solve_cycle_fused: the max number of
     batch workloads sharing one conflict domain, bucketed to a power of
@@ -556,25 +587,18 @@ def solve_cycle_cohort_parallel(topo_dev, topo_np, usage, cohort_usage,
             "cohort_usage": cohort_out}
 
 
+# Topology fields the kernels consume; topo_to_device (TPU) and the
+# service's _topo_np (local CPU router) both build their dicts from this
+# single list so they can never drift.
+TOPO_FIELDS = (
+    "cq_cohort", "nominal", "borrow_limit", "guaranteed", "offered",
+    "group_id", "flavor_group", "flavor_rank", "prefer_no_borrow",
+    "cohort_subtree", "cohort_parent", "cohort_depth", "cohort_root",
+    "cohort_guaranteed", "cohort_borrow_limit", "cq_chain", "fair_weight",
+    "cohort_lendable",
+)
+
+
 def topo_to_device(topo) -> dict:
     """numpy Topology arrays -> device dict for solve_cycle."""
-    return {
-        "cq_cohort": jnp.asarray(topo.cq_cohort),
-        "nominal": jnp.asarray(topo.nominal),
-        "borrow_limit": jnp.asarray(topo.borrow_limit),
-        "guaranteed": jnp.asarray(topo.guaranteed),
-        "offered": jnp.asarray(topo.offered),
-        "group_id": jnp.asarray(topo.group_id),
-        "flavor_group": jnp.asarray(topo.flavor_group),
-        "flavor_rank": jnp.asarray(topo.flavor_rank),
-        "prefer_no_borrow": jnp.asarray(topo.prefer_no_borrow),
-        "cohort_subtree": jnp.asarray(topo.cohort_subtree),
-        "cohort_parent": jnp.asarray(topo.cohort_parent),
-        "cohort_depth": jnp.asarray(topo.cohort_depth),
-        "cohort_root": jnp.asarray(topo.cohort_root),
-        "cohort_guaranteed": jnp.asarray(topo.cohort_guaranteed),
-        "cohort_borrow_limit": jnp.asarray(topo.cohort_borrow_limit),
-        "cq_chain": jnp.asarray(topo.cq_chain),
-        "fair_weight": jnp.asarray(topo.fair_weight),
-        "cohort_lendable": jnp.asarray(topo.cohort_lendable),
-    }
+    return {name: jnp.asarray(getattr(topo, name)) for name in TOPO_FIELDS}

@@ -1,4 +1,4 @@
-"""Device-side preemption target selection (TPU solver v2).
+"""Device-side preemption target selection (TPU solver v2/v3).
 
 Replaces the per-entry sequential simulation of the reference's
 minimalPreemptions (remove candidates in order until the preemptor fits,
@@ -14,15 +14,23 @@ Host side (cheap, O(entries x candidates) filters):
   reclaim attempt falls back to same-queue-only)
 
 Device side (the hot loop):
-- per problem: a local sub-snapshot of the entry's cohort tree
-  (CQs/cohorts re-indexed into small padded spaces, quotas/usage projected
-  onto the entry's requested FlavorResources), then a K-step scan that
-  removes candidates (with the dynamic cq-is-borrowing skip and the
-  borrowWithinCohort priority-threshold borrowing flip), checks fit after
-  each removal, and a reverse fill-back scan.
+- the problem tensors carry only GLOBAL indices (CQ, flavor, resource,
+  cohort); quotas, usage and cohort chains are gathered on device from
+  the topology/state tensors already resident for the fit solve — the
+  round-2 host-side per-problem projection (nested B x QL x RF Python
+  loops + an O(CQs x depth) cohort search) is gone
+- per problem: a K-step scan that removes candidates (with the dynamic
+  cq-is-borrowing skip and the borrowWithinCohort priority-threshold
+  borrowing flip), checks fit after each removal, then a reverse
+  fill-back scan
+- the whole thing composes with the fit solve into ONE jitted execute
+  (kernel.solve_cycle_with_preempt), so a mixed admission+preemption
+  cycle pays a single device sync — the dominant cost over a tunneled
+  TPU link.
 
 Fair-sharing preemption (fairPreemptions' DRF heap) stays on the CPU
-path; the scheduler gates this solver off when fair sharing is enabled.
+path; the scheduler routes preempt-mode entries to the CPU preemptor
+when fair sharing is enabled.
 """
 
 from __future__ import annotations
@@ -47,7 +55,7 @@ def _bucket(n: int, minimum: int = 4) -> int:
 
 @dataclass
 class PreemptionProblem:
-    """One minimal_preemptions run in local index space."""
+    """One minimal_preemptions run (global index space)."""
 
     entry_idx: int = -1
     candidates: list = field(default_factory=list)  # workload Infos, ordered
@@ -59,21 +67,27 @@ class PreemptionProblem:
 @dataclass
 class PreemptionBatch:
     problems: list = field(default_factory=list)
-    # device tensors, leading axis = problem
+    # device tensors, leading axis = problem; all indices GLOBAL
+    gq: np.ndarray = None             # [B,QL] int32 global CQ idx (-1 pad);
+                                      #   row 0 = the preemptor's CQ
+    gf: np.ndarray = None             # [B,RF] int32 global flavor idx (-1 pad)
+    gr: np.ndarray = None             # [B,RF] int32 global resource idx
+    gc: np.ndarray = None             # [B,CL] int32 global cohort idx (-1 pad)
+                                      #   — the union of the problem CQs'
+                                      #   chains, so per-lane cohort state
+                                      #   is CL-wide, not C-wide
+    chain_local: np.ndarray = None    # [B,QL,DC] int32 local cohort ids
     requests: np.ndarray = None       # [B,RF] int64
     frs_np: np.ndarray = None         # [B,RF] bool — needs-preemption frs
-    nominal: np.ndarray = None        # [B,QL,RF]
-    borrow_limit: np.ndarray = None   # [B,QL,RF]
-    guaranteed: np.ndarray = None     # [B,QL,RF]
-    usage: np.ndarray = None          # [B,QL,RF]
-    cq_chain: np.ndarray = None       # [B,QL,DC] local cohort ids
-    c_subtree: np.ndarray = None      # [B,CL,RF]
-    c_guaranteed: np.ndarray = None   # [B,CL,RF]
-    c_borrow_limit: np.ndarray = None  # [B,CL,RF]
-    c_usage: np.ndarray = None        # [B,CL,RF]
-    cand_q: np.ndarray = None         # [B,K] local cq (-1 pad)
-    cand_usage: np.ndarray = None     # [B,K,RF]
-    cand_prio: np.ndarray = None      # [B,K]
+    # Candidates are deduplicated into a row table: identical pod shapes
+    # dominate real queues, and the tunnel to the TPU is bandwidth-bound —
+    # uploading [B,K] int32 indices + a small [U] table beats uploading
+    # dense [B,K,RF] usage planes by ~10x.
+    cand_idx: np.ndarray = None       # [B,K] int32 index into the table
+                                      #   (index 0 = the padding row)
+    cand_ql: np.ndarray = None        # [U] int32 LOCAL ql slot (-1 pad row)
+    cand_usage: np.ndarray = None     # [U,RF] int64
+    cand_prio: np.ndarray = None      # [U] int32
     allow_borrowing: np.ndarray = None   # [B] bool
     threshold_active: np.ndarray = None  # [B] bool
     threshold: np.ndarray = None         # [B] int64
@@ -115,9 +129,13 @@ def build_problems(entry_idx: int, wl, requests: dict, frs_need_preemption: set,
     return problems
 
 
-def encode_problems(problems: list, snapshot, requests_by_entry: dict,
-                    frs_np_by_entry: dict, wl_cq_by_entry: dict) -> PreemptionBatch:
-    """Project each problem's cohort tree onto local padded index spaces."""
+def encode_problems(problems: list, snapshot, topo, requests_by_entry: dict,
+                    wl_cq_by_entry: dict,
+                    frs_np_by_entry: dict) -> PreemptionBatch:
+    """Problems -> global-index tensors. The only host work per problem is
+    index mapping (CQ / FlavorResource names -> topology indices) and the
+    candidate usage projection; all quota/usage/cohort math happens on
+    device against the resident topology tensors."""
     B = _bucket(max(1, len(problems)), 1)
     RF = _bucket(max(max((len(requests_by_entry[p.entry_idx]) for p in problems),
                          default=1), 1))
@@ -126,281 +144,343 @@ def encode_problems(problems: list, snapshot, requests_by_entry: dict,
                           for p in problems), default=1), 1))
     K = _bucket(max(max((len(p.candidates) for p in problems), default=1), 1))
 
-    # local cohort space: union of chains of all local CQs
-    def chain_of(cq_snap):
-        out = []
-        node = cq_snap.cohort
-        while node is not None:
-            out.append(node)
-            node = node.parent
-        return out
-
-    CL, DC = 1, 1
-    for p in problems:
-        cq_names = {wl_cq_by_entry[p.entry_idx]} | {
-            c.cluster_queue for c in p.candidates}
-        cohorts = {}
-        for name in cq_names:
-            ch = chain_of(snapshot.cluster_queues[name])
-            DC = max(DC, len(ch))
-            for c in ch:
-                cohorts[c.name] = c
-        CL = max(CL, len(cohorts))
-    CL = _bucket(CL)
-
     batch = PreemptionBatch(problems=list(problems))
+    batch.gq = np.full((B, QL), -1, np.int32)
+    batch.gf = np.full((B, RF), -1, np.int32)
+    batch.gr = np.full((B, RF), 0, np.int32)
     batch.requests = np.zeros((B, RF), np.int64)
     batch.frs_np = np.zeros((B, RF), bool)
-    batch.nominal = np.zeros((B, QL, RF), np.int64)
-    batch.borrow_limit = np.full((B, QL, RF), BIG, np.int64)
-    batch.guaranteed = np.zeros((B, QL, RF), np.int64)
-    batch.usage = np.zeros((B, QL, RF), np.int64)
-    batch.cq_chain = np.full((B, QL, DC), -1, np.int32)
-    batch.c_subtree = np.zeros((B, CL, RF), np.int64)
-    batch.c_guaranteed = np.zeros((B, CL, RF), np.int64)
-    batch.c_borrow_limit = np.full((B, CL, RF), BIG, np.int64)
-    batch.c_usage = np.zeros((B, CL, RF), np.int64)
-    batch.cand_q = np.full((B, K), -1, np.int32)
-    batch.cand_usage = np.zeros((B, K, RF), np.int64)
-    batch.cand_prio = np.zeros((B, K), np.int64)
+    batch.cand_idx = np.zeros((B, K), np.int32)
     batch.allow_borrowing = np.zeros(B, bool)
     batch.threshold_active = np.zeros(B, bool)
     batch.threshold = np.zeros(B, np.int64)
     batch.has_cohort = np.zeros(B, bool)
 
+    cq_index = topo.cq_index
+    flavor_index = topo.flavor_index
+    resource_index = topo.resource_index
+    # candidate row table; row 0 is the padding row (ql = -1)
+    table: dict = {None: 0}
+    rows = [(-1, 0, ())]
+    proj_cache: dict = {}  # (cand id, fr-sig) -> usage tuple
     for bi, p in enumerate(problems):
         ei = p.entry_idx
         requests = requests_by_entry[ei]
-        frs = list(requests)
-        fr_index = {fr: i for i, fr in enumerate(frs)}
+        frs_np = frs_np_by_entry[ei]
         preemptor_cq = wl_cq_by_entry[ei]
 
-        local_cqs = [preemptor_cq]
-        for c in p.candidates:
-            if c.cluster_queue not in local_cqs:
-                local_cqs.append(c.cluster_queue)
-        cq_index = {n: i for i, n in enumerate(local_cqs)}
-        cohort_index: dict = {}
-
-        for qn, qi in cq_index.items():
-            cq_snap = snapshot.cluster_queues[qn]
-            for ci, cobj in enumerate(chain_of(cq_snap)):
-                li = cohort_index.setdefault(cobj.name, len(cohort_index))
-                batch.cq_chain[bi, qi, ci] = li
-            for fr, i in fr_index.items():
-                quota = cq_snap.quota_for(fr)
-                batch.nominal[bi, qi, i] = quota.nominal
-                if quota.borrowing_limit is not None:
-                    batch.borrow_limit[bi, qi, i] = quota.borrowing_limit
-                batch.guaranteed[bi, qi, i] = \
-                    cq_snap.resource_node.guaranteed_quota(fr)
-                batch.usage[bi, qi, i] = cq_snap.usage_for(fr)
-        for cname, li in cohort_index.items():
-            # find the cohort snapshot object via any chain
-            cobj = None
-            for qn in local_cqs:
-                for c in chain_of(snapshot.cluster_queues[qn]):
-                    if c.name == cname:
-                        cobj = c
-                        break
-                if cobj is not None:
-                    break
-            rn = cobj.resource_node
-            for fr, i in fr_index.items():
-                batch.c_subtree[bi, li, i] = rn.subtree_quota.get(fr, 0)
-                batch.c_guaranteed[bi, li, i] = rn.guaranteed_quota(fr)
-                quota = rn.quotas.get(fr)
-                if quota is not None and quota.borrowing_limit is not None:
-                    batch.c_borrow_limit[bi, li, i] = quota.borrowing_limit
-                batch.c_usage[bi, li, i] = rn.usage.get(fr, 0)
-
-        for i, fr in enumerate(frs):
-            batch.requests[bi, i] = requests[fr]
-            batch.frs_np[bi, i] = fr in frs_np_by_entry[ei]
+        local_of = {preemptor_cq: 0}
+        batch.gq[bi, 0] = cq_index[preemptor_cq]
+        fr_slot = {}
+        for i, (fr, v) in enumerate(requests.items()):
+            fr_slot[fr] = i
+            batch.gf[bi, i] = flavor_index.get(fr.flavor, -1)
+            batch.gr[bi, i] = resource_index.get(fr.resource, 0)
+            batch.requests[bi, i] = v
+            batch.frs_np[bi, i] = fr in frs_np
+        fr_sig = tuple(fr_slot)
         for ki, cand in enumerate(p.candidates):
-            batch.cand_q[bi, ki] = cq_index[cand.cluster_queue]
-            batch.cand_prio[bi, ki] = prioritypkg.priority(cand.obj)
-            for fr, v in cand.flavor_resource_usage().items():
-                i = fr_index.get(fr)
-                if i is not None:
-                    batch.cand_usage[bi, ki, i] = v
+            ql = local_of.get(cand.cluster_queue)
+            if ql is None:
+                ql = len(local_of)
+                local_of[cand.cluster_queue] = ql
+                batch.gq[bi, ql] = cq_index[cand.cluster_queue]
+            pkey = (id(cand), fr_sig)
+            urow = proj_cache.get(pkey)
+            if urow is None:
+                vals = [0] * RF
+                for fr, v in cand.flavor_resource_usage().items():
+                    i = fr_slot.get(fr)
+                    if i is not None:
+                        vals[i] = v
+                urow = tuple(vals)
+                proj_cache[pkey] = urow
+            rkey = (ql, prioritypkg.priority(cand.obj), urow)
+            idx = table.get(rkey)
+            if idx is None:
+                idx = len(rows)
+                table[rkey] = idx
+                rows.append(rkey)
+            batch.cand_idx[bi, ki] = idx
         batch.allow_borrowing[bi] = p.allow_borrowing
         batch.threshold_active[bi] = p.threshold_active
         batch.threshold[bi] = p.threshold if p.threshold_active else 0
         batch.has_cohort[bi] = \
             snapshot.cluster_queues[preemptor_cq].cohort is not None
+    U = len(rows)
+    batch.cand_ql = np.fromiter((r[0] for r in rows), np.int32, U)
+    batch.cand_prio = np.fromiter((r[1] for r in rows), np.int32, U)
+    batch.cand_usage = np.zeros((U, RF), np.int64)
+    for u, r in enumerate(rows):
+        for i, v in enumerate(r[2]):
+            batch.cand_usage[u, i] = v
+    _localize_cohorts(batch, topo)
     return batch
 
 
+def _localize_cohorts(batch: PreemptionBatch, topo) -> None:
+    """Per problem, project the global cohort chains of its CQs onto a
+    small local id space (the union of those chains), fully vectorized:
+    the simulation state each lane carries is then [CL,RF] instead of the
+    whole [C,RF] cohort plane."""
+    B, QL = batch.gq.shape
+    DC = topo.cq_chain.shape[1]
+    q_safe = np.maximum(batch.gq, 0)
+    chains = topo.cq_chain[q_safe]                      # [B,QL,DC]
+    chains = np.where((batch.gq >= 0)[:, :, None], chains, -1)
+    SENT = np.int32(2**30)
+    flat = chains.reshape(B, QL * DC).astype(np.int32)
+    flat_s = np.where(flat < 0, SENT, flat)
+    srt = np.sort(flat_s, axis=1)                       # valid asc, SENT last
+    first = np.ones_like(srt, bool)
+    first[:, 1:] = srt[:, 1:] != srt[:, :-1]
+    first &= srt != SENT
+    counts = first.sum(axis=1)
+    CL = _bucket(max(1, int(counts.max())) if B else 1)
+    loc_sorted = np.cumsum(first, axis=1) - 1           # [B,QL*DC]
+    gc = np.full((B, CL), -1, np.int32)
+    rows = np.nonzero(first)[0]
+    gc[rows, loc_sorted[first]] = srt[first]
+    # local id of each chain entry: count of distinct valid ids < value
+    gc_cmp = np.where(gc >= 0, gc, SENT)                # [B,CL]
+    local = (gc_cmp[:, None, :] < flat_s[:, :, None]).sum(axis=2)
+    batch.chain_local = np.where(flat >= 0, local,
+                                 -1).reshape(B, QL, DC).astype(np.int32)
+    batch.gc = gc
+
+
 # --------------------------------------------------------------------------
-# Device kernel
+# Device kernel (global index space; composes with the fit solve)
 # --------------------------------------------------------------------------
 
-def _make_kernel():
+def solve_preempt_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
+                       requests, frs_np, cand_idx, cand_ql_table,
+                       cand_usage_table, cand_prio_table,
+                       allow_borrowing, threshold_active, threshold,
+                       has_cohort):
+    """Batched minimalPreemptions. All quota tensors are gathered on
+    device from the fit solve's topology/state:
+
+    - usage[Q,F,R], cohort_usage[C,F,R]: pre-cycle state (preemption
+      targets are selected in nominate, against the cycle snapshot —
+      reference scheduler.go:404-441)
+    - per problem b, FlavorResource slot i = (gf[b,i], gr[b,i]); local CQ
+      row ql maps to global CQ gq[b,ql]; its cohort chain is
+      chain_local[b,ql] in the problem's local cohort space gc[b] (the
+      union of its CQs' chains) — the per-lane simulation state is
+      [CL,RF], not the whole [C,RF] cohort plane
+
+    Returns (targets [B,K] bool, feasible [B] bool)."""
     import jax
     import jax.numpy as jnp
 
     NOLIM = 2**61
 
-    def avail_cq0(nominal, borrow_limit, guaranteed, usage, cq_chain,
-                  c_subtree, c_guar, c_bl, c_usage, has_cohort):
-        """available() for local CQ 0 (the preemptor's), walking its
-        cohort chain (reference: resource_node.go:89-104)."""
-        chain = cq_chain[0]                       # [DC]
-        DC = chain.shape[0]
-        RF = nominal.shape[1]
-        parent = jnp.zeros(RF, jnp.int64)
-        started = jnp.zeros((), bool)
-        for d in range(DC - 1, -1, -1):
-            c = chain[d]
-            valid = c >= 0
-            c_ = jnp.maximum(c, 0)
-            cu = c_usage[c_]
-            root_avail = c_subtree[c_] - cu
-            local = jnp.maximum(0, c_guar[c_] - cu)
-            cap = (c_subtree[c_] - c_guar[c_]) - jnp.maximum(0, cu - c_guar[c_]) \
-                + jnp.minimum(c_bl[c_], NOLIM // 4)
-            child = local + jnp.minimum(parent, cap)
-            new = jnp.where(started, child, root_avail)
-            parent = jnp.where(valid, new, parent)
-            started = started | valid
-        local0 = jnp.maximum(0, guaranteed[0] - usage[0])
-        cap0 = (nominal[0] - guaranteed[0]) - jnp.maximum(0, usage[0] - guaranteed[0]) \
-            + jnp.minimum(borrow_limit[0], NOLIM // 4)
-        with_cohort = local0 + jnp.minimum(parent, cap0)
-        return jnp.where(has_cohort, with_cohort, nominal[0] - usage[0])
+    def one(gq_b, gf_b, gr_b, gc_b, chain_local_b, req_b, frs_np_b,
+            cand_q_b, cand_usage_b, cand_prio_b, ab0, th_act, th,
+            has_cohort_b):
+        QL = gq_b.shape[0]
+        RF = gf_b.shape[0]
+        CL = gc_b.shape[0]
+        valid_fr = gf_b >= 0
+        gf_s = jnp.maximum(gf_b, 0)
+        q_s = jnp.maximum(gq_b, 0)                       # [QL]
 
-    def fits(requests, nominal, borrow_limit, guaranteed, usage, cq_chain,
-             c_subtree, c_guar, c_bl, c_usage, has_cohort, allow_borrowing):
-        """workload_fits (reference: preemption.go:576-585)."""
-        has_req = requests > 0
-        avail = avail_cq0(nominal, borrow_limit, guaranteed, usage, cq_chain,
-                          c_subtree, c_guar, c_bl, c_usage, has_cohort)
-        borrow_ok = allow_borrowing | \
-            jnp.all(~has_req | (usage[0] + requests <= nominal[0]))
-        return borrow_ok & jnp.all(~has_req | (requests <= avail))
+        # gathers: [QL,RF] quota planes projected onto this problem's frs
+        def plane(t):
+            return jnp.where(valid_fr[None, :], t[q_s][:, gf_s, gr_b], 0)
 
-    def remove_usage(usage, c_usage, cq_chain, guaranteed, c_guar, q, val):
-        """removeUsage bubbling (reference: resource_node.go:133-143)."""
-        stored = usage[q] - guaranteed[q]          # pre-removal
-        usage = usage.at[q].add(-val)
-        delta = jnp.minimum(val, jnp.maximum(0, stored))
-        chain = cq_chain[q]
-        DC = chain.shape[0]
-        for d in range(DC):
-            c = chain[d]
-            valid = (c >= 0) & jnp.any(delta > 0)
-            c_ = jnp.maximum(c, 0)
-            stored_c = c_usage[c_] - c_guar[c_]
-            dd = jnp.where(valid, delta, 0)
-            c_usage = c_usage.at[c_].add(-dd)
-            delta = jnp.minimum(dd, jnp.maximum(0, stored_c))
-        return usage, c_usage
+        nominal = plane(topo["nominal"])
+        guaranteed = plane(topo["guaranteed"])
+        borrow_limit = jnp.where(valid_fr[None, :],
+                                 topo["borrow_limit"][q_s][:, gf_s, gr_b],
+                                 NOLIM)
+        u0 = plane(usage)
+        chain = chain_local_b                            # [QL,DC] local ids
+        DC = chain.shape[1]
+        # one-hot chain masks, built once: dynamic-index scatters/gathers
+        # under vmap x scan lower catastrophically on TPU, so every
+        # per-candidate update below is dense one-hot arithmetic instead
+        chain_oh = (chain[:, :, None] == jnp.arange(CL)[None, None, :]) \
+            & (chain >= 0)[:, :, None]                   # [QL,DC,CL]
 
-    def add_usage(usage, c_usage, cq_chain, guaranteed, c_guar, q, val):
-        """addUsage bubbling (reference: resource_node.go:121-131)."""
-        local_avail = jnp.maximum(0, guaranteed[q] - usage[q])
-        usage = usage.at[q].add(val)
-        delta = jnp.maximum(0, val - local_avail)
-        chain = cq_chain[q]
-        DC = chain.shape[0]
-        for d in range(DC):
-            c = chain[d]
-            valid = c >= 0
-            c_ = jnp.maximum(c, 0)
-            local_c = jnp.maximum(0, c_guar[c_] - c_usage[c_])
-            dd = jnp.where(valid, delta, 0)
-            c_usage = c_usage.at[c_].add(dd)
-            delta = jnp.where(valid, jnp.maximum(0, dd - local_c), delta)
-        return usage, c_usage
+        # cohort planes [CL,RF]: this problem's cohorts x its frs
+        gc_s = jnp.maximum(gc_b, 0)
+        valid_c = (gc_b >= 0)[:, None] & valid_fr[None, :]
 
-    def solve_one(requests, frs_np, nominal, borrow_limit, guaranteed, usage,
-                  cq_chain, c_subtree, c_guar, c_bl, c_usage, cand_q,
-                  cand_usage, cand_prio, allow_borrowing0, threshold_active,
-                  threshold, has_cohort):
-        K = cand_q.shape[0]
+        def cplane(t, fill=0):
+            return jnp.where(valid_c, t[gc_s][:, gf_s, gr_b], fill)
 
-        def fits_now(u, cu, ab):
-            return fits(requests, nominal, borrow_limit, guaranteed, u,
-                        cq_chain, c_subtree, c_guar, c_bl, cu, has_cohort, ab)
+        c_subtree = cplane(topo["cohort_subtree"])
+        c_guar = cplane(topo["cohort_guaranteed"])
+        c_bl = cplane(topo["cohort_borrow_limit"], NOLIM)
+        cu0 = cplane(cohort_usage)
+
+        def oh_rows(oh, t):
+            """oh [C] bool one-hot, t [C,RF] -> t[c] as [RF] dense."""
+            return jnp.sum(jnp.where(oh[:, None], t, 0), axis=0)
+
+        def avail_cq0(u, cu):
+            """available() for local CQ 0 (the preemptor's), walking its
+            cohort chain (reference: resource_node.go:89-104). chain[0]'s
+            levels use precomputed one-hot masks chain_oh[0]."""
+            parent = jnp.zeros(RF, jnp.int64)
+            started = jnp.zeros((), bool)
+            for d in range(DC - 1, -1, -1):
+                oh = chain_oh[0, d]                      # [C]
+                ok = jnp.any(oh)
+                cuc = oh_rows(oh, cu)
+                sub = oh_rows(oh, c_subtree)
+                gua = oh_rows(oh, c_guar)
+                bl = jnp.sum(jnp.where(oh[:, None], c_bl, 0), axis=0)
+                root_avail = sub - cuc
+                local = jnp.maximum(0, gua - cuc)
+                cap = (sub - gua) - jnp.maximum(0, cuc - gua) \
+                    + jnp.minimum(bl, NOLIM // 4)
+                child = local + jnp.minimum(parent, cap)
+                new = jnp.where(started, child, root_avail)
+                parent = jnp.where(ok, new, parent)
+                started = started | ok
+            local0 = jnp.maximum(0, guaranteed[0] - u[0])
+            cap0 = (nominal[0] - guaranteed[0]) \
+                - jnp.maximum(0, u[0] - guaranteed[0]) \
+                + jnp.minimum(borrow_limit[0], NOLIM // 4)
+            with_cohort = local0 + jnp.minimum(parent, cap0)
+            return jnp.where(has_cohort_b, with_cohort, nominal[0] - u[0])
+
+        def fits(u, cu, ab):
+            """workload_fits (reference: preemption.go:576-585)."""
+            has_req = req_b > 0
+            avail = avail_cq0(u, cu)
+            borrow_ok = ab | jnp.all(~has_req | (u[0] + req_b <= nominal[0]))
+            return borrow_ok & jnp.all(~has_req | (req_b <= avail))
+
+        def remove_usage(u, cu, q_oh, q_chain_oh, val):
+            """removeUsage bubbling (reference: resource_node.go:133-143),
+            dense: q_oh [QL] one-hot CQ row, q_chain_oh [DC,C] its chain."""
+            guar_q = jnp.sum(jnp.where(q_oh[:, None], guaranteed, 0), axis=0)
+            u_q = jnp.sum(jnp.where(q_oh[:, None], u, 0), axis=0)
+            stored = u_q - guar_q                        # pre-removal
+            u = u - jnp.where(q_oh[:, None], val[None, :], 0)
+            delta = jnp.minimum(val, jnp.maximum(0, stored))
+            for d in range(DC):
+                oh = q_chain_oh[d]                       # [C]
+                ok = jnp.any(oh) & jnp.any(delta > 0)
+                stored_c = oh_rows(oh, cu) - oh_rows(oh, c_guar)
+                dd = jnp.where(ok, delta, 0)
+                cu = cu - jnp.where(oh[:, None], dd[None, :], 0)
+                delta = jnp.minimum(dd, jnp.maximum(0, stored_c))
+            return u, cu
+
+        def add_usage(u, cu, q_oh, q_chain_oh, val):
+            """addUsage bubbling (reference: resource_node.go:121-131)."""
+            guar_q = jnp.sum(jnp.where(q_oh[:, None], guaranteed, 0), axis=0)
+            u_q = jnp.sum(jnp.where(q_oh[:, None], u, 0), axis=0)
+            local_avail = jnp.maximum(0, guar_q - u_q)
+            u = u + jnp.where(q_oh[:, None], val[None, :], 0)
+            delta = jnp.maximum(0, val - local_avail)
+            for d in range(DC):
+                oh = q_chain_oh[d]
+                ok = jnp.any(oh)
+                local_c = jnp.maximum(0, oh_rows(oh, c_guar) - oh_rows(oh, cu))
+                dd = jnp.where(ok, delta, 0)
+                cu = cu + jnp.where(oh[:, None], dd[None, :], 0)
+                delta = jnp.where(ok, jnp.maximum(0, dd - local_c), delta)
+            return u, cu
+
+        K = cand_q_b.shape[0]
+        arange_ql = jnp.arange(QL)
 
         # --- forward: remove until fit (minimalPreemptions) ---
-        def fwd(carry, k):
-            u, cu, ab, done, targets = carry
-            valid = (cand_q[k] >= 0) & ~done
-            q = jnp.maximum(cand_q[k], 0)
-            in_cq = q == 0
+        def fwd(carry, xs):
+            u, cu, ab, done = carry
+            cq_k, cusage_k, cprio_k = xs
+            ok = (cq_k >= 0) & ~done
+            q_oh = arange_ql == jnp.maximum(cq_k, 0)     # [QL]
+            q_chain_oh = jnp.any(q_oh[:, None, None] & chain_oh, axis=0)
+            in_cq = cq_k == 0
             # dynamic skip: other-CQ candidate whose CQ stopped borrowing
-            borrowing_cq = jnp.any(frs_np & (u[q] > nominal[q]))
+            u_q = jnp.sum(jnp.where(q_oh[:, None], u, 0), axis=0)
+            nom_q = jnp.sum(jnp.where(q_oh[:, None], nominal, 0), axis=0)
+            borrowing_cq = jnp.any(frs_np_b & (u_q > nom_q))
             skip = (~in_cq) & ~borrowing_cq
             # borrowWithinCohort threshold: candidate at/above threshold
             # forbids borrowing for the remainder (preemption.go:252-270)
-            at_or_above = threshold_active & (~in_cq) & \
-                (cand_prio[k] >= threshold)
-            ab = ab & ~(valid & ~skip & at_or_above)
-            do = valid & ~skip
-            val = jnp.where(do, cand_usage[k], 0)
-            u2, cu2 = remove_usage(u, cu, cq_chain, guaranteed, c_guar, q, val)
-            u = jnp.where(do, u2, u)
-            cu = jnp.where(do, cu2, cu)
-            targets = targets.at[k].set(do)
-            done = done | (do & fits_now(u, cu, ab))
-            return (u, cu, ab, done, targets), None
+            at_or_above = th_act & (~in_cq) & (cprio_k >= th)
+            ab = ab & ~(ok & ~skip & at_or_above)
+            do = ok & ~skip
+            val = jnp.where(do, cusage_k, 0)
+            u, cu = remove_usage(u, cu, q_oh, q_chain_oh, val)
+            done = done | (do & fits(u, cu, ab))
+            return (u, cu, ab, done), do
 
-        init = (usage, c_usage, allow_borrowing0, jnp.zeros((), bool),
-                jnp.zeros(K, bool))
-        (u, cu, ab, done, targets), _ = jax.lax.scan(
-            fwd, init, jnp.arange(K))
+        init = (u0, cu0, ab0, jnp.zeros((), bool))
+        (u, cu, ab, done), do_seq = jax.lax.scan(
+            fwd, init, (cand_q_b, cand_usage_b, cand_prio_b))
 
         # no fit => no targets (preemption.go:300-303)
-        targets = targets & done
+        targets = do_seq & done
 
         # --- reverse: fill back (fillBackWorkloads) — skip the last-added
         # target (the one that achieved the fit) ---
         last_idx = jnp.where(done,
                              (K - 1) - jnp.argmax(targets[::-1], axis=0), -1)
 
-        def back(carry, k_rev):
-            u, cu, targets = carry
-            k = K - 1 - k_rev
-            consider = targets[k] & (k != last_idx)
-            q = jnp.maximum(cand_q[k], 0)
-            val = jnp.where(consider, cand_usage[k], 0)
-            u2, cu2 = add_usage(u, cu, cq_chain, guaranteed, c_guar, q, val)
-            still = fits_now(u2, cu2, ab)
+        def back(carry, xs):
+            u, cu = carry
+            k, cq_k, cusage_k, target_k = xs
+            consider = target_k & (k != last_idx)
+            q_oh = arange_ql == jnp.maximum(cq_k, 0)
+            q_chain_oh = jnp.any(q_oh[:, None, None] & chain_oh, axis=0)
+            val = jnp.where(consider, cusage_k, 0)
+            u2, cu2 = add_usage(u, cu, q_oh, q_chain_oh, val)
+            still = fits(u2, cu2, ab)
             keep_back = consider & still     # workload comes back
             u = jnp.where(keep_back, u2, u)
             cu = jnp.where(keep_back, cu2, cu)
-            targets = targets.at[k].set(targets[k] & ~keep_back)
-            return (u, cu, targets), None
+            return (u, cu), keep_back
 
-        (_, _, targets), _ = jax.lax.scan(back, (u, cu, targets),
-                                          jnp.arange(K))
+        ks = jnp.arange(K)
+        (_, _), kept_rev = jax.lax.scan(
+            back, (u, cu),
+            (ks[::-1], cand_q_b[::-1], cand_usage_b[::-1], targets[::-1]))
+        targets = targets & ~kept_rev[::-1]
         return targets, done
 
-    solve = jax.jit(jax.vmap(solve_one))
-    return solve
+    # expand the deduplicated candidate table on device (one gather each,
+    # outside the vmap/scan — the upload ships only indices + the table)
+    cand_q = cand_ql_table[cand_idx]          # [B,K]
+    cand_usage = cand_usage_table[cand_idx]   # [B,K,RF]
+    cand_prio = cand_prio_table[cand_idx]     # [B,K]
+    return jax.vmap(one)(gq, gf, gr, gc, chain_local, requests, frs_np,
+                         cand_q, cand_usage, cand_prio, allow_borrowing,
+                         threshold_active, threshold, has_cohort)
 
 
-_KERNEL = None
+_SOLVE_JIT = None
 
 
-def solve_preemption_batch(batch: PreemptionBatch):
-    """Returns (targets_mask [B,K] bool, feasible [B] bool)."""
-    global _KERNEL
-    import jax.numpy as jnp
-    if _KERNEL is None:
-        _KERNEL = _make_kernel()
-    args = (batch.requests, batch.frs_np, batch.nominal, batch.borrow_limit,
-            batch.guaranteed, batch.usage, batch.cq_chain, batch.c_subtree,
-            batch.c_guaranteed, batch.c_borrow_limit, batch.c_usage,
-            batch.cand_q, batch.cand_usage, batch.cand_prio,
-            batch.allow_borrowing, batch.threshold_active, batch.threshold,
-            batch.has_cohort)
+def solve_preemption_batch(topo_dev, usage, cohort_usage,
+                           batch: PreemptionBatch):
+    """Standalone dispatch (tests / CPU-free preempt cycles). Production
+    mixed cycles go through kernel.solve_cycle_with_preempt instead so
+    fit + preemption share one execute."""
+    global _SOLVE_JIT
     import jax
-    targets, feasible = jax.device_get(
-        _KERNEL(*tuple(jnp.asarray(a) for a in args)))
+    import jax.numpy as jnp
+    if _SOLVE_JIT is None:
+        _SOLVE_JIT = jax.jit(solve_preempt_impl)
+    targets, feasible = jax.device_get(_SOLVE_JIT(
+        topo_dev, jnp.asarray(usage), jnp.asarray(cohort_usage),
+        *preempt_args(batch)))
     return np.asarray(targets), np.asarray(feasible)
+
+
+def preempt_args(batch: PreemptionBatch) -> tuple:
+    return (batch.gq, batch.gf, batch.gr, batch.gc, batch.chain_local,
+            batch.requests, batch.frs_np, batch.cand_idx, batch.cand_ql,
+            batch.cand_usage, batch.cand_prio, batch.allow_borrowing,
+            batch.threshold_active, batch.threshold, batch.has_cohort)
 
 
 def decode_targets(batch: PreemptionBatch, targets_mask: np.ndarray,

@@ -33,8 +33,33 @@ import jax
 from kueue_tpu.solver.kernel import (
     max_rank_bound,
     solve_cycle_fused,
+    solve_cycle_with_preempt,
+    solve_phase_a,
     topo_to_device,
 )
+
+
+def _topo_np(topo) -> dict:
+    """The kernel's topology dict as plain numpy (for the local CPU
+    router); same field list as kernel.topo_to_device."""
+    from kueue_tpu.solver.kernel import TOPO_FIELDS
+    return {name: getattr(topo, name) for name in TOPO_FIELDS}
+
+
+class Plan:
+    """One cycle's encoded inputs + the host-side routing decision."""
+
+    def __init__(self, topo, topo_dev, state, batch, start_rank, fit_pred):
+        self.topo = topo
+        self.topo_dev = topo_dev
+        self.state = state
+        self.batch = batch
+        self.start_rank = start_rank
+        # fit_pred[i]: the router's exact Phase A fit bit for entry i —
+        # entries predicted non-fit are CPU-nominated (preempt-mode
+        # discovery) BEFORE the device sync so fit + preemption solve in
+        # one execute.
+        self.fit_pred = fit_pred
 
 
 class BatchSolver:
@@ -49,6 +74,37 @@ class BatchSolver:
         self.backend = backend
         self._topo_cache = None
         self._topo_key = None
+        self._cpu_device = None  # lazy: local XLA-CPU device for routing
+        self._sync_samples: list = []  # recent device sync costs (ms)
+
+    def estimated_sync_ms(self, default: float = 120.0) -> float:
+        """The device dispatch+sync floor: calibrated once with a trivial
+        dispatch (so the first estimate isn't a compile-inflated real
+        cycle), then refined as the MIN of observed cycle syncs — robust
+        to compile-time outliers, and a floor is a lower bound by
+        definition. Feeds the scheduler's work gates: device work must
+        save more than this to dispatch."""
+        if not self._sync_samples:
+            try:
+                self._sync_samples.append(self._calibrate_floor())
+            except Exception:  # noqa: BLE001 — backend unavailable
+                return default
+        return min(self._sync_samples)
+
+    @staticmethod
+    def _calibrate_floor() -> float:
+        import time
+        import jax.numpy as jnp
+        triv = jax.jit(lambda a: a + 1)
+        np.asarray(triv(jnp.zeros(8, jnp.int32)))  # compile
+        t0 = time.perf_counter()
+        np.asarray(triv(jnp.zeros(8, jnp.int32)))
+        return (time.perf_counter() - t0) * 1e3
+
+    def _observe_sync(self, ms: float) -> None:
+        self._sync_samples.append(ms)
+        if len(self._sync_samples) > 16:
+            self._sync_samples.pop(0)
 
     # --- encoding with topology caching across cycles ---
 
@@ -66,6 +122,137 @@ class BatchSolver:
             self._topo_cache = (topo, topo_to_device(topo))
         return self._topo_cache
 
+    def prepare(self, snapshot: Snapshot, entries: list) -> Optional[Plan]:
+        """Encode the cycle and route it: the exact Phase A fit bit is
+        computed on the LOCAL XLA-CPU backend (~1 ms at the north-star
+        shape) so the scheduler knows, before any device sync, which
+        entries need CPU preempt-mode nomination. Their preemption
+        problems then ship in the same execute as the fit solve
+        (kernel.solve_cycle_with_preempt): one device sync per cycle."""
+        if not entries:
+            return None
+        topo, topo_dev = self._topology(snapshot)
+        state = encode.encode_state(snapshot, topo)
+        batch = encode.encode_workloads(entries, snapshot, topo,
+                                        ordering=self.ordering,
+                                        max_podsets=self.max_podsets)
+        if not batch.solvable.any():
+            return None
+        start_rank = batch.start_rank if batch.start_rank.any() else None
+        fit_pred = self._route(topo, state, batch, start_rank)
+        return Plan(topo, topo_dev, state, batch, start_rank, fit_pred)
+
+    def _route(self, topo, state, batch, start_rank):
+        """Exact host-side replica of the device Phase A (same jitted
+        program, local CPU backend): integer math, so the fit bits are
+        identical to the device's. Returns [n] bool, or None when no
+        local CPU backend exists (the scheduler then nominates
+        device-rejected entries after the sync instead)."""
+        if self._cpu_device is None:
+            try:
+                self._cpu_device = jax.devices("cpu")[0]
+            except Exception:  # noqa: BLE001 — platform without CPU backend
+                self._cpu_device = False
+        if self._cpu_device is False:
+            return None
+        cached = getattr(self, "_topo_cpu", None)
+        if cached is None or cached[0] != topo.token:
+            cached = (topo.token,
+                      jax.device_put(_topo_np(topo), self._cpu_device))
+            self._topo_cpu = cached
+        with jax.default_device(self._cpu_device):
+            out = solve_phase_a(cached[1], state.usage, state.cohort_usage,
+                                batch.requests, batch.podset_active,
+                                batch.wl_cq, batch.eligible, batch.solvable,
+                                num_podsets=self.max_podsets,
+                                fair_sharing=False, start_rank=start_rank)
+            fit = np.asarray(out[0])
+        return fit[:batch.n]
+
+    def solve_prepared(self, plan: Plan, snapshot: Snapshot,
+                       preempt_batch=None, fair_sharing: bool = False):
+        """Dispatch the cycle (fit solve, plus the preemption batch when
+        present, as ONE device program), sync once, decode. Returns
+        (decisions dict, (targets_mask, feasible) or None)."""
+        topo, topo_dev, state, batch = (plan.topo, plan.topo_dev,
+                                        plan.state, plan.batch)
+        start_rank = plan.start_rank
+        entries = batch.infos
+
+        # The native ABI encodes the flat (single-level) cohort forest and
+        # no fair-share sort key, flavor-resume state, or per-resource
+        # borrow flags (needed for TryNextFlavor resume decode); those go
+        # through the jit path.
+        if (self.backend == "native" and self.mesh is None
+                and preempt_batch is None
+                and topo.cq_chain.shape[1] == 1 and not fair_sharing
+                and start_rank is None and not topo.prefer_no_borrow.any()):
+            from kueue_tpu import native
+            result = native.solve_cycle_native(
+                topo, state.usage, state.cohort_usage, batch.requests,
+                batch.podset_active, batch.wl_cq, batch.priority,
+                batch.timestamp, batch.eligible, batch.solvable)
+            return (self._decode_batch(entries, snapshot, topo, batch,
+                                       result), None)
+
+        pre = None
+        if self.mesh is not None:
+            from kueue_tpu.parallel.mesh import solve_cycle_sharded
+            result = solve_cycle_sharded(self.mesh, topo_dev, state, batch,
+                                         self.max_podsets,
+                                         fair_sharing=fair_sharing,
+                                         start_rank=start_rank)
+            if preempt_batch is not None:
+                # The sharded fit solve doesn't fuse the preemption
+                # program; pay a second dispatch (single-host mesh only).
+                from kueue_tpu.solver import preempt as devpreempt
+                pre = devpreempt.solve_preemption_batch(
+                    topo_dev, state.usage, state.cohort_usage, preempt_batch)
+            fetched = jax.device_get({k: result[k] for k in
+                                      ("admitted", "fit", "chosen", "borrows",
+                                       "chosen_borrow") if k in result})
+            return (self._decode_batch(entries, snapshot, topo, batch,
+                                       fetched), pre)
+
+        max_rank = max_rank_bound(batch.wl_cq, topo.cq_cohort,
+                                  topo.cohort_root)
+        if preempt_batch is None:
+            # fused cohort-parallel cycle: Phase A + device-built order
+            # grid + row-parallel Phase B in ONE dispatch
+            result = solve_cycle_fused(
+                topo_dev, state.usage, state.cohort_usage,
+                batch.requests, batch.podset_active, batch.wl_cq,
+                batch.priority, batch.timestamp, batch.eligible,
+                batch.solvable, num_podsets=self.max_podsets,
+                max_rank=max_rank, fair_sharing=fair_sharing,
+                start_rank=start_rank)
+            keys = ("admitted", "fit", "chosen", "borrows", "chosen_borrow")
+        else:
+            from kueue_tpu.solver import preempt as devpreempt
+            result = solve_cycle_with_preempt(
+                topo_dev, state.usage, state.cohort_usage,
+                batch.requests, batch.podset_active, batch.wl_cq,
+                batch.priority, batch.timestamp, batch.eligible,
+                batch.solvable,
+                devpreempt.preempt_args(preempt_batch),
+                num_podsets=self.max_podsets, max_rank=max_rank,
+                fair_sharing=fair_sharing, start_rank=start_rank)
+            keys = ("admitted", "fit", "chosen", "borrows", "chosen_borrow",
+                    "preempt_targets", "preempt_feasible")
+
+        # One execute, one sync: all outputs come from the same device
+        # program, so the first fetch pays the tunnel round trip and the
+        # rest are free.
+        import time
+        t0 = time.perf_counter()
+        fetched = jax.device_get({k: result[k] for k in keys if k in result})
+        self._observe_sync((time.perf_counter() - t0) * 1e3)
+        if preempt_batch is not None:
+            pre = (np.asarray(fetched["preempt_targets"]),
+                   np.asarray(fetched["preempt_feasible"]))
+        return (self._decode_batch(entries, snapshot, topo, batch, fetched),
+                pre)
+
     def solve(self, snapshot: Snapshot, entries: list,
               fair_sharing: bool = False) -> dict:
         """entries: list of workload Info. Returns
@@ -75,58 +262,12 @@ class BatchSolver:
         scheduler skips it exactly like the reference's sequential
         re-check (scheduler.go:266-273) instead of re-assigning flavors
         against post-cycle usage."""
-        if not entries:
+        plan = self.prepare(snapshot, entries)
+        if plan is None:
             return {}
-        topo, topo_dev = self._topology(snapshot)
-        state = encode.encode_state(snapshot, topo)
-        batch = encode.encode_workloads(entries, snapshot, topo,
-                                        ordering=self.ordering,
-                                        max_podsets=self.max_podsets)
-        if not batch.solvable.any():
-            return {}
-
-        result = None
-        start_rank = batch.start_rank if batch.start_rank.any() else None
-        # The native ABI encodes the flat (single-level) cohort forest and
-        # no fair-share sort key, flavor-resume state, or per-resource
-        # borrow flags (needed for TryNextFlavor resume decode); those go
-        # through the jit path.
-        if (self.backend == "native" and self.mesh is None
-                and topo.cq_chain.shape[1] == 1 and not fair_sharing
-                and start_rank is None and not topo.prefer_no_borrow.any()):
-            from kueue_tpu import native
-            result = native.solve_cycle_native(
-                topo, state.usage, state.cohort_usage, batch.requests,
-                batch.podset_active, batch.wl_cq, batch.priority,
-                batch.timestamp, batch.eligible, batch.solvable)
-        if result is None:
-            if self.mesh is not None:
-                from kueue_tpu.parallel.mesh import solve_cycle_sharded
-                result = solve_cycle_sharded(self.mesh, topo_dev, state, batch,
-                                             self.max_podsets,
-                                             fair_sharing=fair_sharing,
-                                             start_rank=start_rank)
-            else:
-                # fused cohort-parallel cycle: Phase A + device-built
-                # order grid + row-parallel Phase B in ONE dispatch; scan
-                # length = max workloads per conflict domain instead of
-                # the whole batch
-                result = solve_cycle_fused(
-                    topo_dev, state.usage, state.cohort_usage,
-                    batch.requests, batch.podset_active, batch.wl_cq,
-                    batch.priority, batch.timestamp, batch.eligible,
-                    batch.solvable, num_podsets=self.max_podsets,
-                    max_rank=max_rank_bound(batch.wl_cq, topo.cq_cohort,
-                                            topo.cohort_root),
-                    fair_sharing=fair_sharing, start_rank=start_rank)
-
-        # One execute, one sync: all outputs come from the same device
-        # program, so the first fetch pays the tunnel round trip and the
-        # rest are free.
-        fetched = jax.device_get({k: result[k] for k in
-                                  ("admitted", "fit", "chosen", "borrows",
-                                   "chosen_borrow") if k in result})
-        return self._decode_batch(entries, snapshot, topo, batch, fetched)
+        decisions, _ = self.solve_prepared(plan, snapshot,
+                                           fair_sharing=fair_sharing)
+        return decisions
 
     def _decode_batch(self, entries: list, snapshot: Snapshot,
                       topo: encode.Topology, batch, fetched: dict) -> dict:

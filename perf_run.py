@@ -49,6 +49,8 @@ def run_mode(label, scale, solver):
 
 
 def main():
+    from kueue_tpu.utils.runtime import tune_gc
+    tune_gc()  # manager-binary GC profile (applies to every measured mode)
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--out", default=None)

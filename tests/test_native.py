@@ -23,6 +23,7 @@ def build_native_env(setup):
     env = build_env(setup, solver=False)
     env.scheduler.solver = BatchSolver(backend="native")
     env.scheduler.solver_min_heads = 0
+    env.scheduler.solver_sync_floor_ms = 0
     return env
 
 

@@ -24,6 +24,7 @@ def build_env(setup, solver=False, fair_sharing=False):
     if solver:
         env.scheduler.solver = BatchSolver()
         env.scheduler.solver_min_heads = 0  # force the solver path
+        env.scheduler.solver_sync_floor_ms = 0  # force device preemption
     setup(env)
     return env
 

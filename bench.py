@@ -1,15 +1,23 @@
 """Benchmark: batched admission on TPU — honest, production-path numbers.
 
-Measures three things at the north-star shape (BASELINE.json: 2k
-ClusterQueues x 32 flavors, 2048 heads/cycle):
+Scenarios at the north-star shape (BASELINE.json: 2k ClusterQueues x 32
+flavors, 2048 heads/cycle), each run end-to-end through the full
+Scheduler.schedule cycle over the real object model (heads pop, snapshot
+deep-copy, encode, device solve, decode, admit, requeue):
 
-1. kernel: the global-scan solve_cycle AND the production
-   solve_cycle_cohort_parallel (solver-only device time),
-2. end-to-end: full Scheduler.schedule cycles with BatchSolver over the
-   real object model — heads pop, snapshot deep-copy, encode, device
-   solve, decode, admit, requeue (the number a user actually sees),
-3. a preemption-heavy cycle: admitted victims + pending preemptors,
-   resolved by the batched device preemption path vs the CPU preemptor.
+1. kernel: the global-scan solve_cycle AND the production fused kernel
+   (solver-only device time + the measured tunnel round-trip floor),
+2. e2e progressive fill (FLAGSHIP): 33 waves of flavor-sized workloads
+   drive every CQ from empty to a fully loaded 32-deep flavor list —
+   covering both the shallow regime (the sequential assigner's best
+   case) and the contention regime it degrades in,
+3. e2e shallow: the first-flavor-always-fits best case for the CPU
+   path, kept for honesty,
+4. preemption small: 4-candidate within-CQ problems — the work gate must
+   route these to the CPU preemptor (speedup ~1.0 is the win),
+5. preemption heavy: hierarchical-cohort (depth-2 chains) cohort-wide
+   reclaim with ~250-candidate problems and deep remove/fill-back —
+   the batched device preemptor's regime.
 
 Baseline: the reference's scheduler scalability harness admits 15,000
 workloads in 351.1s (BASELINE.md) ~= 42.7 admitted/s for the sequential
@@ -337,18 +345,23 @@ def _run_preempt_pair(build, name, extra):
     schedulers; assert identical evictions and report the wall times."""
     out = {}
     for label, solver in (("cpu", False), ("device", True)):
-        # warmup run compiles the bucketed shapes; the timed run rebuilds
-        # the identical scenario so the jit cache is hot
+        # warmup run compiles the bucketed shapes; each timed run rebuilds
+        # the identical scenario so the jit cache is hot. min-of-2 damps
+        # tunnel latency variance.
         sched, client = build(solver)
         sched.schedule(timeout=0)
         samples = sched.solver._sync_samples if sched.solver else None
-        sched, client = build(solver)
-        if sched.solver is not None and samples:
-            sched.solver._sync_samples = list(samples)  # carry the floor
-        t0 = time.perf_counter()
-        sched.schedule(timeout=0)
-        dt = time.perf_counter() - t0
-        out[label] = (dt, client.evicted, sched.preemption_fallbacks)
+        best = None
+        for _ in range(2):
+            sched, client = build(solver)
+            if sched.solver is not None and samples:
+                sched.solver._sync_samples = list(samples)  # carry the floor
+            t0 = time.perf_counter()
+            sched.schedule(timeout=0)
+            dt = time.perf_counter() - t0
+            if best is None or dt < best[0]:
+                best = (dt, client.evicted, sched.preemption_fallbacks)
+        out[label] = best
     (t_cpu, ev_cpu, _), (t_dev, ev_dev, fb) = out["cpu"], out["device"]
     assert ev_cpu == ev_dev and ev_dev > 0 and fb == 0, (ev_cpu, ev_dev, fb)
     log({"bench": name, **extra, "evictions": ev_dev,
@@ -386,45 +399,56 @@ def bench_preemption_small(num_cqs=256, num_cohorts=32, victims_per_cq=4):
                              {"cqs": num_cqs})
 
 
-def bench_preemption_reclaim(num_cohorts=256, cqs_per_cohort=8,
-                             victims_per_borrower=18):
-    """Reclaim-heavy preemption at the flagship shape: 2048 CQs in 256
-    cohorts; every non-lender CQ overflows its nominal quota with small
-    victims (borrowing), and a high-priority preemptor per CQ must
-    reclaim — candidate sets span the whole cohort (~126 per under-nominal
-    problem). This is where minimalPreemptions' sequential simulate /
-    fill-back (preemption.go:237-310) dominates the CPU cycle and the
-    batched device scan pays."""
+def bench_preemption_reclaim(num_roots=128, children_per_root=2,
+                             cqs_per_child=8, victims_per_borrower=36):
+    """Reclaim-heavy preemption at the flagship shape with HIERARCHICAL
+    cohorts (the v1alpha1 Cohort tree): 2048 CQs in 256 child cohorts
+    under 128 roots. Every non-lender CQ overflows its nominal quota with
+    small victims (borrowing), and a large high-priority preemptor per CQ
+    must reclaim deep — within-CQ problems remove ~16 of 18 victims,
+    under-nominal reclaim problems see ~250 candidates across the root's
+    subtree, and every removal/fill-back walks the depth-2 cohort chain.
+    This is the regime where minimalPreemptions' sequential simulate /
+    fill-back (preemption.go:237-310 + resource_node.go chain math)
+    dominates the CPU cycle and the batched device scan pays."""
     from kueue_tpu.api import kueue as api
+    from kueue_tpu.api.meta import ObjectMeta
     from kueue_tpu.solver import BatchSolver
 
-    num_cqs = num_cohorts * cqs_per_cohort
+    num_children = num_roots * children_per_root
+    num_cqs = num_children * cqs_per_child
     preemption = api.ClusterQueuePreemption(
         within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
         reclaim_within_cohort=api.PREEMPTION_ANY)
 
     def build(solver):
+        # cq{i} is in child cohort-(i % num_children); child c's parent is
+        # root-(c // children_per_root); cq0..cq{num_children-1} (one per
+        # child) are the idle lenders.
         sched, cache, queues, client, clock = build_env(
-            num_cqs, num_cohorts, ["f0"], nominal_units=8,
+            num_cqs, num_children, ["f0"], nominal_units=8,
             solver=BatchSolver() if solver else None, preemption=preemption)
+        for c in range(num_children):
+            cohort = api.Cohort(metadata=ObjectMeta(name=f"cohort-{c}",
+                                                    uid=f"co-{c}"))
+            cohort.spec.parent = f"root-{c // children_per_root}"
+            cache.add_or_update_cohort(cohort)
+        victim_milli = 9000 // victims_per_borrower
         for i in range(num_cqs):
-            # One lender per cohort (cohort of cq{i} is i % num_cohorts,
-            # so cq0..cq{num_cohorts-1} are the lenders) keeps its whole
-            # quota unused; the others borrow one victim's worth over
-            # nominal.
-            if i >= num_cohorts:
+            if i >= num_children:
                 for v in range(victims_per_borrower):
                     _admit_victim(cache, f"victim{i}-{v}", f"lq{i}",
-                                  f"cq{i}", 500, 0, float(v))
+                                  f"cq{i}", victim_milli, 0, float(v))
             queues.add_or_update_workload(
-                make_workload(f"preemptor{i}", f"lq{i}", cpu_units=4,
+                make_workload(f"preemptor{i}", f"lq{i}", cpu_units=8,
                               priority=10, creation=1000.0))
         return sched, client
 
+    reclaim_k = (cqs_per_child * children_per_root - children_per_root) \
+        * victims_per_borrower
     return _run_preempt_pair(build, "preemption_heavy_cycle",
-                             {"cqs": num_cqs,
-                              "candidates_per_reclaim":
-                              (cqs_per_cohort - 1) * victims_per_borrower})
+                             {"cqs": num_cqs, "cohort_depth": 2,
+                              "candidates_per_reclaim": reclaim_k})
 
 
 def main():

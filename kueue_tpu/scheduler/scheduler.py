@@ -296,8 +296,35 @@ class Scheduler:
                      if fit_pred is not None else len(valid_heads))
         pbatch = None
         requests_by, cq_by = {}, {}
+        floor_ms = (self.solver_sync_floor_ms
+                    if self.solver_sync_floor_ms is not None
+                    else (self.solver.estimated_sync_ms() if pending else 0.0))
+        if pending:
+            # Cheap pre-gate: an upper bound on candidate count (domain
+            # workload totals) decides whether building the candidate
+            # index is worth it at all — small simulations go straight to
+            # the CPU preemptor.
+            shares = fit_count > 0 and self.solver.mesh is None
+            marginal_sync_us = 0.0 if shares else floor_ms * 1000.0
+            sizes: dict = {}
+            bound = 0
+            for e in pending:
+                cq = snapshot.cluster_queues[e.info.cluster_queue]
+                key = (cq.cohort.root().name if cq.cohort is not None
+                       else cq.name)
+                if key not in sizes:
+                    members = (cq.cohort.root().subtree_cqs()
+                               if cq.cohort is not None else [cq])
+                    sizes[key] = sum(len(c.workloads) for c in members)
+                bound += sizes[key]
+            if bound * 8.0 <= marginal_sync_us:
+                self._cpu_preempt_targets(pending, snapshot)
+                pending = []
         if pending:
             try:
+                from kueue_tpu.solver.candidates import candidate_index
+                cand_index = candidate_index(snapshot, self.ordering,
+                                             self.clock.now())
                 problems, frs_by = [], {}
                 for i, e in enumerate(pending):
                     requests_by[i] = e.assignment.total_requests_for(e.info)
@@ -305,18 +332,13 @@ class Scheduler:
                     cq_by[i] = e.info.cluster_queue
                     problems.extend(devpreempt.build_problems(
                         i, e.info, requests_by[i], frs_by[i], snapshot,
-                        self.preemptor))
-                total_k = sum(len(p.candidates) for p in problems)
-                # Work gate: ~8us/candidate net device saving must cover
-                # the marginal sync (zero when fit entries dispatch anyway).
-                floor_ms = (self.solver_sync_floor_ms
-                            if self.solver_sync_floor_ms is not None
-                            else self.solver.estimated_sync_ms())
-                # The fused single-chip kernel ships preemption in the fit
-                # execute (marginal sync 0 when fit entries dispatch); the
-                # mesh path pays a separate dispatch either way.
-                shares_sync = fit_count > 0 and self.solver.mesh is None
-                marginal_sync_us = 0.0 if shares_sync else floor_ms * 1000.0
+                        self.preemptor, cand_index))
+                # Precise work gate: ~8us/candidate net device saving must
+                # cover the marginal sync — zero when fit entries dispatch
+                # anyway (the fused single-chip kernel ships preemption in
+                # the fit execute; the mesh path pays a separate dispatch
+                # either way).
+                total_k = sum(p.num_candidates for p in problems)
                 if problems and total_k * 8.0 > marginal_sync_us:
                     pbatch = devpreempt.encode_problems(
                         problems, snapshot, plan.topo, requests_by, cq_by,

@@ -55,13 +55,20 @@ def _bucket(n: int, minimum: int = 4) -> int:
 
 @dataclass
 class PreemptionProblem:
-    """One minimal_preemptions run (global index space)."""
+    """One minimal_preemptions run, as an ordered index selection into a
+    DomainCandidates (solver/candidates.py) — no per-candidate Python
+    objects are materialized until decode."""
 
     entry_idx: int = -1
-    candidates: list = field(default_factory=list)  # workload Infos, ordered
+    domain: object = None            # candidates.DomainCandidates
+    sel: np.ndarray = None           # ordered candidate indices into domain
     allow_borrowing: bool = True
     threshold_active: bool = False
     threshold: int = 0
+
+    @property
+    def num_candidates(self) -> int:
+        return int(self.sel.size) if self.sel is not None else 0
 
 
 @dataclass
@@ -85,9 +92,9 @@ class PreemptionBatch:
     # dense [B,K,RF] usage planes by ~10x.
     cand_idx: np.ndarray = None       # [B,K] int32 index into the table
                                       #   (index 0 = the padding row)
-    cand_ql: np.ndarray = None        # [U] int32 LOCAL ql slot (-1 pad row)
-    cand_usage: np.ndarray = None     # [U,RF] int64
-    cand_prio: np.ndarray = None      # [U] int32
+    cand_ql: np.ndarray = None        # [B,K] int16 LOCAL ql slot (-1 pad)
+    cand_usage: np.ndarray = None     # [U,RF] int64 table
+    cand_prio: np.ndarray = None      # [U] int32 table
     allow_borrowing: np.ndarray = None   # [B] bool
     threshold_active: np.ndarray = None  # [B] bool
     threshold: np.ndarray = None         # [B] int64
@@ -95,36 +102,48 @@ class PreemptionBatch:
 
 
 def build_problems(entry_idx: int, wl, requests: dict, frs_need_preemption: set,
-                   snapshot, preemptor: "cpu_preempt.Preemptor") -> list:
+                   snapshot, preemptor: "cpu_preempt.Preemptor",
+                   cand_index) -> list:
     """get_targets_internal's policy dispatch (preemption.go:116-171) as a
-    list of 1-2 PreemptionProblems (first non-empty result wins)."""
+    list of 1-2 PreemptionProblems (first non-empty result wins).
+    Candidate discovery + ordering run as mask algebra over the cycle's
+    CandidateIndex (solver/candidates.py) instead of the per-entry scan +
+    sort of the CPU oracle."""
     cq = snapshot.cluster_queues[wl.cluster_queue]
-    candidates = preemptor.find_candidates(wl.obj, cq, frs_need_preemption)
-    if not candidates:
+    domain = cand_index.domain_for(cq)
+    preemption = cq.preemption
+    wl_prio = prioritypkg.priority(wl.obj)
+    frs = frozenset(frs_need_preemption)
+    sel = domain.select(
+        cq.name, wl_prio,
+        preemptor.ordering.queue_order_timestamp(wl.obj), frs,
+        within_policy=preemption.within_cluster_queue,
+        consider_same_prio=(preemption.within_cluster_queue
+                            == api.PREEMPTION_LOWER_OR_NEWER_EQUAL_PRIORITY),
+        reclaim_policy=preemption.reclaim_within_cohort,
+        only_lower=(preemption.reclaim_within_cohort != api.PREEMPTION_ANY))
+    if sel.size == 0:
         return []
-    # candidatesOrdering — reuse the CPU oracle's key so the two paths
-    # can never diverge on ordering (preemption.go:587-614).
-    candidates.sort(key=preemptor._candidate_sort_key(cq.name))
-    same_queue = [c for c in candidates if c.cluster_queue == cq.name]
+    qi = domain.cq_index[cq.name]
+    in_cq = domain.cq_of[sel] == qi
 
-    if len(same_queue) == len(candidates):
-        return [PreemptionProblem(entry_idx, candidates, allow_borrowing=True)]
+    if bool(in_cq.all()):
+        return [PreemptionProblem(entry_idx, domain, sel,
+                                  allow_borrowing=True)]
 
     borrow_within, threshold = cpu_preempt.can_borrow_within_cohort(cq, wl.obj)
     if borrow_within:
-        cands = candidates
+        s = sel
         if not cpu_preempt.queue_under_nominal(frs_need_preemption, cq):
-            cands = [c for c in candidates
-                     if c.cluster_queue == cq.name
-                     or prioritypkg.priority(c.obj) < threshold]
-        return [PreemptionProblem(entry_idx, cands, allow_borrowing=True,
+            s = sel[in_cq | (domain.prio[sel] < threshold)]
+        return [PreemptionProblem(entry_idx, domain, s, allow_borrowing=True,
                                   threshold_active=True, threshold=threshold)]
 
     problems = []
     if cpu_preempt.queue_under_nominal(frs_need_preemption, cq):
-        problems.append(PreemptionProblem(entry_idx, candidates,
+        problems.append(PreemptionProblem(entry_idx, domain, sel,
                                           allow_borrowing=False))
-    problems.append(PreemptionProblem(entry_idx, same_queue,
+    problems.append(PreemptionProblem(entry_idx, domain, sel[in_cq],
                                       allow_borrowing=True))
     return problems
 
@@ -132,25 +151,23 @@ def build_problems(entry_idx: int, wl, requests: dict, frs_need_preemption: set,
 def encode_problems(problems: list, snapshot, topo, requests_by_entry: dict,
                     wl_cq_by_entry: dict,
                     frs_np_by_entry: dict) -> PreemptionBatch:
-    """Problems -> global-index tensors. The only host work per problem is
-    index mapping (CQ / FlavorResource names -> topology indices) and the
-    candidate usage projection; all quota/usage/cohort math happens on
-    device against the resident topology tensors."""
+    """Problems -> global-index tensors, with NO per-candidate Python:
+    candidate usage rows come from the per-domain deduplicated row tables
+    (candidates.RowsView), per-problem candidate columns are vectorized
+    gathers over the problem's index selection, and the batch-level table
+    is a concatenation of the domain tables with offsets."""
     B = _bucket(max(1, len(problems)), 1)
     RF = _bucket(max(max((len(requests_by_entry[p.entry_idx]) for p in problems),
                          default=1), 1))
-    QL = _bucket(max(max((1 + len({c.cluster_queue for c in p.candidates
-                                   if c.cluster_queue != wl_cq_by_entry[p.entry_idx]})
-                          for p in problems), default=1), 1))
-    K = _bucket(max(max((len(p.candidates) for p in problems), default=1), 1))
+    K = _bucket(max(max((p.num_candidates for p in problems), default=1), 1))
 
     batch = PreemptionBatch(problems=list(problems))
-    batch.gq = np.full((B, QL), -1, np.int32)
     batch.gf = np.full((B, RF), -1, np.int32)
     batch.gr = np.full((B, RF), 0, np.int32)
     batch.requests = np.zeros((B, RF), np.int64)
     batch.frs_np = np.zeros((B, RF), bool)
     batch.cand_idx = np.zeros((B, K), np.int32)
+    batch.cand_ql = np.full((B, K), -1, np.int16)
     batch.allow_borrowing = np.zeros(B, bool)
     batch.threshold_active = np.zeros(B, bool)
     batch.threshold = np.zeros(B, np.int64)
@@ -159,61 +176,78 @@ def encode_problems(problems: list, snapshot, topo, requests_by_entry: dict,
     cq_index = topo.cq_index
     flavor_index = topo.flavor_index
     resource_index = topo.resource_index
-    # candidate row table; row 0 is the padding row (ql = -1)
-    table: dict = {None: 0}
-    rows = [(-1, 0, ())]
-    proj_cache: dict = {}  # (cand id, fr-sig) -> usage tuple
+
+    # batch-level candidate row table: concat of per-(domain, req-frs)
+    # tables; row 0 is the padding row
+    table_usage = [np.zeros((1, RF), np.int64)]
+    table_prio = [np.zeros(1, np.int32)]
+    offsets: dict = {}
+    next_off = 1
+
+    gq_rows = []
+    max_ql = 1
     for bi, p in enumerate(problems):
         ei = p.entry_idx
         requests = requests_by_entry[ei]
         frs_np = frs_np_by_entry[ei]
         preemptor_cq = wl_cq_by_entry[ei]
+        domain = p.domain
+        req_frs = frozenset(requests)
+        rows = domain.rows_view(req_frs)
 
-        local_of = {preemptor_cq: 0}
-        batch.gq[bi, 0] = cq_index[preemptor_cq]
-        fr_slot = {}
-        for i, (fr, v) in enumerate(requests.items()):
-            fr_slot[fr] = i
+        for i, fr in enumerate(rows.slots):
             batch.gf[bi, i] = flavor_index.get(fr.flavor, -1)
             batch.gr[bi, i] = resource_index.get(fr.resource, 0)
-            batch.requests[bi, i] = v
+            batch.requests[bi, i] = requests[fr]
             batch.frs_np[bi, i] = fr in frs_np
-        fr_sig = tuple(fr_slot)
-        for ki, cand in enumerate(p.candidates):
-            ql = local_of.get(cand.cluster_queue)
-            if ql is None:
-                ql = len(local_of)
-                local_of[cand.cluster_queue] = ql
-                batch.gq[bi, ql] = cq_index[cand.cluster_queue]
-            pkey = (id(cand), fr_sig)
-            urow = proj_cache.get(pkey)
-            if urow is None:
-                vals = [0] * RF
-                for fr, v in cand.flavor_resource_usage().items():
-                    i = fr_slot.get(fr)
-                    if i is not None:
-                        vals[i] = v
-                urow = tuple(vals)
-                proj_cache[pkey] = urow
-            rkey = (ql, prioritypkg.priority(cand.obj), urow)
-            idx = table.get(rkey)
-            if idx is None:
-                idx = len(rows)
-                table[rkey] = idx
-                rows.append(rkey)
-            batch.cand_idx[bi, ki] = idx
+
+        okey = (id(domain), req_frs)
+        off = offsets.get(okey)
+        if off is None:
+            off = next_off
+            offsets[okey] = off
+            u = rows.table_usage
+            if u.shape[1] < RF:
+                u = np.pad(u, ((0, 0), (0, RF - u.shape[1])))
+            table_usage.append(u)
+            table_prio.append(rows.table_prio)
+            next_off += len(rows.table_prio)
+
+        sel = p.sel
+        k = sel.size
+        pre_qi = domain.cq_index[preemptor_cq]
+        if k:
+            batch.cand_idx[bi, :k] = off + rows.row_of[sel]
+            # problem-local CQ slots: preemptor first, then first-appearance
+            cqs = domain.cq_of[sel]
+            if cqs[0] == pre_qi and (cqs == pre_qi).all():
+                # within-CQ problem (the common case): all slot 0
+                batch.cand_ql[bi, :k] = 0
+                local_list = [pre_qi]
+            else:
+                uniq, first = np.unique(cqs, return_index=True)
+                occ = uniq[np.argsort(first)]
+                local_list = [pre_qi] + [int(c) for c in occ if c != pre_qi]
+                lut = np.full(len(domain.cq_names), -1, np.int16)
+                lut[local_list] = np.arange(len(local_list), dtype=np.int16)
+                batch.cand_ql[bi, :k] = lut[cqs]
+                max_ql = max(max_ql, len(local_list))
+        else:
+            local_list = [pre_qi]
+        gq_rows.append([cq_index[domain.cq_names[c]] for c in local_list])
+
         batch.allow_borrowing[bi] = p.allow_borrowing
         batch.threshold_active[bi] = p.threshold_active
         batch.threshold[bi] = p.threshold if p.threshold_active else 0
         batch.has_cohort[bi] = \
             snapshot.cluster_queues[preemptor_cq].cohort is not None
-    U = len(rows)
-    batch.cand_ql = np.fromiter((r[0] for r in rows), np.int32, U)
-    batch.cand_prio = np.fromiter((r[1] for r in rows), np.int32, U)
-    batch.cand_usage = np.zeros((U, RF), np.int64)
-    for u, r in enumerate(rows):
-        for i, v in enumerate(r[2]):
-            batch.cand_usage[u, i] = v
+
+    QL = _bucket(max_ql, 1)
+    batch.gq = np.full((B, QL), -1, np.int32)
+    for bi, row in enumerate(gq_rows):
+        batch.gq[bi, :len(row)] = row
+    batch.cand_usage = np.concatenate(table_usage, axis=0)
+    batch.cand_prio = np.concatenate(table_prio)
     _localize_cohorts(batch, topo)
     return batch
 
@@ -254,7 +288,7 @@ def _localize_cohorts(batch: PreemptionBatch, topo) -> None:
 # --------------------------------------------------------------------------
 
 def solve_preempt_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
-                       requests, frs_np, cand_idx, cand_ql_table,
+                       requests, frs_np, cand_idx, cand_ql,
                        cand_usage_table, cand_prio_table,
                        allow_borrowing, threshold_active, threshold,
                        has_cohort):
@@ -449,7 +483,7 @@ def solve_preempt_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
 
     # expand the deduplicated candidate table on device (one gather each,
     # outside the vmap/scan — the upload ships only indices + the table)
-    cand_q = cand_ql_table[cand_idx]          # [B,K]
+    cand_q = cand_ql.astype(jnp.int32)        # [B,K]
     cand_usage = cand_usage_table[cand_idx]   # [B,K,RF]
     cand_prio = cand_prio_table[cand_idx]     # [B,K]
     return jax.vmap(one)(gq, gf, gr, gc, chain_local, requests, frs_np,
@@ -497,14 +531,16 @@ def decode_targets(batch: PreemptionBatch, targets_mask: np.ndarray,
             out.setdefault(ei, [])
             continue
         preemptor_cq = wl_cq_by_entry[ei]
+        domain = p.domain
         targets = []
-        for ki, cand in enumerate(p.candidates):
-            if not targets_mask[bi, ki]:
-                continue
+        k = p.num_candidates
+        hit = np.flatnonzero(targets_mask[bi, :k])
+        for ki in hit.tolist():
+            cand = domain.infos[p.sel[ki]]
             if cand.cluster_queue == preemptor_cq:
                 reason = api.IN_CLUSTER_QUEUE_REASON
             elif p.threshold_active and \
-                    prioritypkg.priority(cand.obj) < p.threshold:
+                    int(domain.prio[p.sel[ki]]) < p.threshold:
                 reason = api.IN_COHORT_RECLAIM_WHILE_BORROWING_REASON
             else:
                 reason = api.IN_COHORT_RECLAMATION_REASON

@@ -97,7 +97,7 @@ def setup_core_controllers(runtime: Runtime, store: Store, queues, cache,
     def on_admission_check(event, ac, old):
         ac_r.handle_event(event, ac, old, ac_ctrl.enqueue)
         name = ac.metadata.name
-        for cq in store.list("ClusterQueue"):
+        for cq in store.list("ClusterQueue", copy_objects=False):
             checks = set(cq.spec.admission_checks) | {
                 r.name for r in cq.spec.admission_checks_strategy}
             if name in checks:
@@ -106,7 +106,7 @@ def setup_core_controllers(runtime: Runtime, store: Store, queues, cache,
     def on_resource_flavor(event, rf, old):
         rf_r.handle_event(event, rf, old, rf_ctrl.enqueue)
         name = rf.metadata.name
-        for cq in store.list("ClusterQueue"):
+        for cq in store.list("ClusterQueue", copy_objects=False):
             if any(fq.name == name for rg in cq.spec.resource_groups
                    for fq in rg.flavors):
                 cq_ctrl.enqueue(cq.metadata.name)

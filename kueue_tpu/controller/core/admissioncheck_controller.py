@@ -91,7 +91,7 @@ class ResourceFlavorReconciler:
         return None
 
     def _flavor_in_use(self, name: str) -> bool:
-        for cq in self.store.list("ClusterQueue"):
+        for cq in self.store.list("ClusterQueue", copy_objects=False):
             for rg in cq.spec.resource_groups:
                 if any(fq.name == name for fq in rg.flavors):
                     return True

@@ -12,6 +12,8 @@ pkg/controller/core/clusterqueue_controller.go:159-203 (+ status update
 
 from __future__ import annotations
 
+import copy as _copy
+
 from typing import Optional
 
 from kueue_tpu.api import kueue as api
@@ -36,7 +38,7 @@ class ClusterQueueReconciler:
         self.snapshot_max_count = snapshot_max_count
 
     def reconcile(self, key: str):
-        cq = self.store.try_get("ClusterQueue", "", key)
+        cq = self.store.try_get("ClusterQueue", "", key, copy_object=False)
         if cq is None:
             return None
         now = self.clock.now()
@@ -50,6 +52,7 @@ class ClusterQueueReconciler:
                     self.metrics.report_cluster_queue_status(key, "terminating")
                 return REQUEUE_TERMINATING_SECONDS
             if api.RESOURCE_IN_USE_FINALIZER in cq.metadata.finalizers:
+                cq = _copy.deepcopy(cq)
                 cq.metadata.finalizers.remove(api.RESOURCE_IN_USE_FINALIZER)
                 self.store.update(cq)
             return None
@@ -60,11 +63,16 @@ class ClusterQueueReconciler:
 
         # status (reference: :334-449)
         reservation_usage, admitted_usage = self.cache.usage_for_cluster_queue(key)
-        cq.status.pending_workloads = self.queues.pending(key)
-        cq.status.reserving_workloads = cqc.reserving_workloads_count()
-        cq.status.admitted_workloads = cqc.admitted_workloads_count
-        cq.status.flavors_reservation = _flavor_usage(cq.spec, reservation_usage, cqc)
-        cq.status.flavors_usage = _flavor_usage(cq.spec, admitted_usage, cqc)
+        status_obj = _copy.copy(cq)
+        status_obj.status = api.ClusterQueueStatus(
+            conditions=[_copy.copy(c) for c in cq.status.conditions],
+            fair_sharing_weighted_share=cq.status.fair_sharing_weighted_share,
+            pending_workloads=self.queues.pending(key),
+            reserving_workloads=cqc.reserving_workloads_count(),
+            admitted_workloads=cqc.admitted_workloads_count,
+            flavors_reservation=_flavor_usage(cq.spec, reservation_usage, cqc),
+            flavors_usage=_flavor_usage(cq.spec, admitted_usage, cqc))
+        cq = status_obj
 
         active = cqc.active
         if active:
@@ -77,7 +85,7 @@ class ClusterQueueReconciler:
                              message=f"Can't admit new workloads: {cqc.inactive_reason()}",
                              observed_generation=cq.metadata.generation)
         set_condition(cq.status.conditions, cond, now)
-        self.store.update(cq)
+        self.store.update_status(cq, owned_status=True)
         self.queues.set_cluster_queue_active(key, active)
 
         if self.metrics:

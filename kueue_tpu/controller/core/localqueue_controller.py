@@ -8,6 +8,8 @@ existence/active state and the LQ's own StopPolicy.
 
 from __future__ import annotations
 
+import copy as _copy
+
 from typing import Optional
 
 from kueue_tpu.api import kueue as api
@@ -29,12 +31,18 @@ class LocalQueueReconciler:
 
     def reconcile(self, key: str):
         namespace, name = key.split("/", 1)
-        lq = self.store.try_get("LocalQueue", namespace, name)
+        lq = self.store.try_get("LocalQueue", namespace, name,
+                                copy_object=False)
         if lq is None:
             return None
+        status_obj = _copy.copy(lq)
+        status_obj.status = api.LocalQueueStatus(
+            conditions=[_copy.copy(c) for c in lq.status.conditions])
+        lq = status_obj
         now = self.clock.now()
 
-        cq = self.store.try_get("ClusterQueue", "", lq.spec.cluster_queue)
+        cq = self.store.try_get("ClusterQueue", "", lq.spec.cluster_queue,
+                                copy_object=False)
         if lq.spec.stop_policy != api.STOP_POLICY_NONE:
             cond = Condition(type=api.LOCAL_QUEUE_ACTIVE, status="False",
                              reason="Stopped", message="LocalQueue is stopped",
@@ -69,7 +77,7 @@ class LocalQueueReconciler:
         else:
             lq.status.reserving_workloads = 0
             lq.status.admitted_workloads = 0
-        self.store.update(lq)
+        self.store.update_status(lq, owned_status=True)
         return None
 
     # -- watch handlers -------------------------------------------------

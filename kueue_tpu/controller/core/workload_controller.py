@@ -100,7 +100,8 @@ class WorkloadReconciler:
             if self._reconcile_deactivation(wl, now):
                 return None
 
-        lq = self.store.try_get("LocalQueue", wl.metadata.namespace, wl.spec.queue_name)
+        lq = self.store.try_get("LocalQueue", wl.metadata.namespace,
+                                wl.spec.queue_name, copy_object=False)
         lq_exists = lq is not None
         lq_active = lq_exists and lq.spec.stop_policy == api.STOP_POLICY_NONE
         if lq_exists and lq_active and _requeued_disabled_by(wl, api.EVICTED_BY_LOCAL_QUEUE_STOPPED):
@@ -112,7 +113,8 @@ class WorkloadReconciler:
 
         cq_name = self.queues.cluster_queue_for_workload(wl)
         if cq_name is not None:
-            cq = self.store.try_get("ClusterQueue", "", cq_name)
+            cq = self.store.try_get("ClusterQueue", "", cq_name,
+                                    copy_object=False)
             if cq is not None:
                 if (_requeued_disabled_by(wl, api.EVICTED_BY_CLUSTER_QUEUE_STOPPED)
                         and cq.spec.stop_policy == api.STOP_POLICY_NONE):
@@ -252,7 +254,8 @@ class WorkloadReconciler:
 
     def _reconcile_cq_active_state(self, wl: api.Workload, cq_name: str,
                                    now: float) -> bool:
-        cq = self.store.try_get("ClusterQueue", "", cq_name)
+        cq = self.store.try_get("ClusterQueue", "", cq_name,
+                                copy_object=False)
         stop = cq.spec.stop_policy if cq is not None else api.STOP_POLICY_NONE
         if wlpkg.is_admitted(wl):
             if cq is None or stop != api.HOLD_AND_DRAIN or wlpkg.is_evicted(wl):

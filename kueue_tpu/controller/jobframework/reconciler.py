@@ -435,8 +435,10 @@ class JobReconciler:
                 if ps.template.spec.priority_class_name:
                     pod_pc = ps.template.spec.priority_class_name
                     break
-        wpcs = {w.metadata.name: w for w in self.store.list("WorkloadPriorityClass")}
-        pcs = {p.metadata.name: p for p in self.store.list("PriorityClass")}
+        wpcs = {w.metadata.name: w for w in self.store.list(
+            "WorkloadPriorityClass", copy_objects=False)}
+        pcs = {p.metadata.name: p for p in self.store.list(
+            "PriorityClass", copy_objects=False)}
         source, name, value = prioritypkg.priority_from_classes(
             pod_pc, workload_priority_class_name(job), wpcs, pcs)
         wl.spec.priority_class_source = source
@@ -447,7 +449,8 @@ class JobReconciler:
         """reference: getPodSetsInfoFromStatus (:964-1000)."""
         if wl.status.admission is None:
             return []
-        flavors = {rf.metadata.name: rf for rf in self.store.list("ResourceFlavor")}
+        flavors = {rf.metadata.name: rf for rf in self.store.list(
+            "ResourceFlavor", copy_objects=False)}
         counts = {ps.name: ps.count for ps in wl.spec.pod_sets}
         infos = []
         for psa in wl.status.admission.pod_set_assignments:

@@ -14,6 +14,7 @@ from kueue_tpu.perf.generator import (
     WorkloadSet,
     default_generator_config,
     generate,
+    north_star_generator_config,
 )
 from kueue_tpu.perf.runner import RunResult, Runner
 from kueue_tpu.perf.checker import RangeSpec, check, default_rangespec

@@ -68,6 +68,28 @@ def default_generator_config() -> list:
             ])])]
 
 
+def north_star_generator_config() -> list:
+    """BASELINE.json config #5 scale: 50,000 pending workloads across
+    2,000 ClusterQueues (250 cohorts x 8 CQs); combine with
+    generate(num_flavors=32) for the 32-ResourceFlavor axis. Per CQ:
+    18 small + 5 medium + 2 large = 25 workloads, arriving in a burst
+    (short intervals) so the pending set genuinely reaches tens of
+    thousands — the regime the batched solver was built for
+    (extrapolated from default_generator_config.yaml:1-28 per
+    BASELINE.md)."""
+    return [CohortClass(class_name="cohort", count=250, queues_sets=[
+        QueueClass(
+            class_name="cq", count=8, nominal_quota=20, borrowing_limit=100,
+            workloads_sets=[
+                WorkloadSet(count=18, creation_interval_ms=100, workloads=[
+                    WorkloadClass("small", runtime_ms=200, priority=50, request=1)]),
+                WorkloadSet(count=5, creation_interval_ms=500, workloads=[
+                    WorkloadClass("medium", runtime_ms=500, priority=100, request=5)]),
+                WorkloadSet(count=2, creation_interval_ms=1200, workloads=[
+                    WorkloadClass("large", runtime_ms=1000, priority=200, request=20)]),
+            ])])]
+
+
 @dataclass
 class Arrival:
     at_s: float
@@ -90,12 +112,23 @@ class GeneratedLoad:
     cq_class: dict = field(default_factory=dict)  # cq name -> class name
 
 
-def generate(config: list, scale: float = 1.0) -> GeneratedLoad:
-    """Expand the class spec. `scale` multiplies workload counts (the
-    harness's knob for the 50k-pending scenarios)."""
+def generate(config: list, scale: float = 1.0,
+             num_flavors: int = 1) -> GeneratedLoad:
+    """Expand the class spec. `scale` multiplies workload counts;
+    `num_flavors` gives every CQ an ordered list of that many
+    ResourceFlavors, each carrying the class's full quota (the
+    32-flavor axis of the north-star shape)."""
     load = GeneratedLoad()
-    rf = api.ResourceFlavor(metadata=ObjectMeta(name=FLAVOR))
-    load.flavors.append(rf)
+    flavor_names = ([FLAVOR] if num_flavors <= 1
+                    else [f"{FLAVOR}-{i}" for i in range(num_flavors)])
+    for fname in flavor_names:
+        load.flavors.append(
+            api.ResourceFlavor(metadata=ObjectMeta(name=fname)))
+    # A resource group holds at most 16 flavors (CRD validation,
+    # clusterqueue_types.go); with more system-wide flavors each CQ gets a
+    # rotating 16-flavor window so all flavors stay in play.
+    window = min(len(flavor_names), 16)
+    cq_ordinal = 0
 
     for cohort_class in config:
         for ci in range(cohort_class.count):
@@ -110,13 +143,18 @@ def generate(config: list, scale: float = 1.0) -> GeneratedLoad:
                     cq.spec.preemption = api.ClusterQueuePreemption(
                         reclaim_within_cohort=queue_class.reclaim_within_cohort,
                         within_cluster_queue=queue_class.within_cluster_queue)
+                    start = (cq_ordinal * window) % len(flavor_names)
+                    cq_flavors = [flavor_names[(start + k) % len(flavor_names)]
+                                  for k in range(window)]
+                    cq_ordinal += 1
                     cq.spec.resource_groups = [api.ResourceGroup(
                         covered_resources=[RESOURCE],
-                        flavors=[api.FlavorQuotas(name=FLAVOR, resources=[
+                        flavors=[api.FlavorQuotas(name=fname, resources=[
                             api.ResourceQuota(
                                 name=RESOURCE,
                                 nominal_quota=queue_class.nominal_quota,
-                                borrowing_limit=queue_class.borrowing_limit)])])]
+                                borrowing_limit=queue_class.borrowing_limit)])
+                            for fname in cq_flavors])]
                     load.cluster_queues.append(cq)
                     load.cq_class[cq_name] = queue_class.class_name
                     lq = api.LocalQueue(metadata=ObjectMeta(

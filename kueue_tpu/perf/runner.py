@@ -63,6 +63,8 @@ class RunResult:
     class_stats: dict = field(default_factory=dict)   # class -> ClassStats
     cq_class_avg_usage_pct: dict = field(default_factory=dict)
     admissions_per_wall_second: float = 0.0
+    cycle_p50_ms: float = 0.0      # admission-cycle wall latency
+    cycle_p99_ms: float = 0.0
 
 
 class Runner:
@@ -76,6 +78,7 @@ class Runner:
         mgr, clock, load = self.mgr, self.clock, self.load
         result = RunResult(total=len(load.arrivals))
         start_wall = time.monotonic()
+        cycle_times: list = []
 
         for rf in load.flavors:
             mgr.store.create(rf)
@@ -172,7 +175,9 @@ class Runner:
             # schedule until this instant's admissions are exhausted
             for _ in range(1000):
                 before = result.admitted
+                c0 = time.perf_counter()
                 mgr.scheduler.schedule(timeout=0)
+                cycle_times.append(time.perf_counter() - c0)
                 mgr.run_until_idle()
                 result.cycles += 1
                 if result.admitted == before:
@@ -186,6 +191,11 @@ class Runner:
         result.wall_s = time.monotonic() - start_wall
         result.admissions_per_wall_second = (
             result.admitted / result.wall_s if result.wall_s else 0.0)
+        if cycle_times:
+            cycle_times.sort()
+            result.cycle_p50_ms = cycle_times[len(cycle_times) // 2] * 1e3
+            result.cycle_p99_ms = cycle_times[
+                min(len(cycle_times) - 1, int(len(cycle_times) * 0.99))] * 1e3
         return result
 
 

@@ -213,12 +213,15 @@ class Scheduler:
                 continue
 
             if not self.cache.pods_ready_for_all_admitted_workloads():
-                # waitForPodsReady blockAdmission (reference: scheduler.go:316-327)
+                # waitForPodsReady blockAdmission (reference: scheduler.go:316-327).
+                # Patch a clone: e.info.obj may alias the store's object
+                # (shared watch events).
+                patch = wlpkg.clone_for_status_update(e.info.obj)
                 wlpkg.unset_quota_reservation_with_condition(
-                    e.info.obj, "Waiting",
+                    patch, "Waiting",
                     "waiting for all admitted workloads to be in PodsReady condition",
                     self.clock.now())
-                self.client.patch_not_admitted(e.info.obj)
+                self.client.patch_not_admitted(patch)
                 self.cache.wait_for_pods_ready(timeout=timeout)
 
             e.status = NOMINATED
@@ -316,7 +319,9 @@ class Scheduler:
                     members = (cq.cohort.root().subtree_cqs()
                                if cq.cohort is not None else [cq])
                     sizes[key] = sum(len(c.workloads) for c in members)
-                bound += sizes[key]
+                # x2: build_problems may emit two problems per entry (the
+                # under-nominal reclaim attempt + the same-queue fallback)
+                bound += 2 * sizes[key]
             if bound * 8.0 <= marginal_sync_us:
                 self._cpu_preempt_targets(pending, snapshot)
                 pending = []
@@ -459,11 +464,14 @@ class Scheduler:
 
     def _wait_pods_ready_if_needed(self, e: Entry, timeout) -> None:
         if not self.cache.pods_ready_for_all_admitted_workloads():
+            # Patch a clone: e.info.obj may alias the store's object
+            # (shared watch events).
+            patch = wlpkg.clone_for_status_update(e.info.obj)
             wlpkg.unset_quota_reservation_with_condition(
-                e.info.obj, "Waiting",
+                patch, "Waiting",
                 "waiting for all admitted workloads to be in PodsReady condition",
                 self.clock.now())
-            self.client.patch_not_admitted(e.info.obj)
+            self.client.patch_not_admitted(patch)
             self.cache.wait_for_pods_ready(timeout=timeout)
 
     # --- nomination (reference: scheduler.go:404-441) ---

@@ -9,8 +9,9 @@ on (SURVEY.md §5 "communication backend"):
   it is only removed once the last finalizer is stripped
   (pkg/controller/core/workload_controller.go finalizer GC path).
 - watch events (ADDED/MODIFIED/DELETED) are dispatched synchronously to
-  registered handlers, carrying deep copies — handlers can't alias store
-  state, matching informer cache isolation.
+  registered handlers, carrying the stored objects themselves — the
+  client-go informer contract (shared cache pointers, read-only by
+  convention; the store never mutates a stored object in place).
 """
 
 from __future__ import annotations
@@ -90,13 +91,13 @@ class Store:
         handlers = self._watchers.get(kind, [])
         if not handlers:
             return
-        # One copy shared by all handlers for this event. Handlers treat
-        # event objects as read-only (informer-cache convention); copying
-        # per handler dominated the profile at scale.
-        obj_copy = copy.deepcopy(obj)
-        old_copy = copy.deepcopy(old) if old is not None else None
+        # Handlers receive the stored objects themselves — the client-go
+        # informer contract (shared cache pointers, read-only by
+        # convention). The store never mutates stored objects in place
+        # (writes replace them), so aliasing is safe; copying per event
+        # dominated the profile at the 2k-CQ scale.
         for handler in handlers:
-            handler(event, obj_copy, old_copy)
+            handler(event, obj, old)
 
     # -- CRUD --------------------------------------------------------------
 
@@ -119,17 +120,24 @@ class Store:
             self._notify(kind, ADDED, stored, None)
             return copy.deepcopy(stored)
 
-    def get(self, kind: str, namespace: str, name: str) -> object:
+    def get(self, kind: str, namespace: str, name: str,
+            copy_object: bool = True) -> object:
+        """copy_object=False returns the stored object itself (the
+        informer-lister contract: read-only by convention) — gating
+        lookups at the 2k-CQ scale can't afford a deep copy of a
+        16-flavor ClusterQueue spec per reconcile."""
         with self._lock:
             key = f"{namespace}/{name}" if namespace else name
             try:
-                return copy.deepcopy(self._objects[kind][key])
+                stored = self._objects[kind][key]
             except KeyError:
                 raise NotFound(f"{kind} {key} not found") from None
+            return copy.deepcopy(stored) if copy_object else stored
 
-    def try_get(self, kind: str, namespace: str, name: str):
+    def try_get(self, kind: str, namespace: str, name: str,
+                copy_object: bool = True):
         try:
-            return self.get(kind, namespace, name)
+            return self.get(kind, namespace, name, copy_object=copy_object)
         except NotFound:
             return None
 
@@ -174,6 +182,35 @@ class Store:
             self._notify(kind, MODIFIED, stored, old)
             return None
 
+    def update_status(self, obj, owned_status: bool = False) -> None:
+        """Status-subresource write (k8s /status semantics): admission
+        webhooks are NOT invoked and only `.status` is persisted — spec
+        and metadata changes on obj are ignored. A write that changes
+        nothing does not bump the RV or fire a watch event. This is what
+        keeps per-admission ClusterQueue/LocalQueue counter refreshes
+        from re-validating (and re-copying) a 16-flavor spec at the
+        2k-CQ scale."""
+        kind = kind_of(obj)
+        with self._lock:
+            key = obj_key(obj)
+            bucket = self._objects.setdefault(kind, {})
+            if key not in bucket:
+                raise NotFound(f"{kind} {key} not found")
+            old = bucket[key]
+            if obj.status == old.status:
+                return None
+            stored = copy.copy(old)
+            stored.metadata = copy.copy(old.metadata)
+            # owned_status: the caller hands over a freshly built status
+            # object (reconciler pattern) — no defensive copy needed.
+            stored.status = (obj.status if owned_status
+                             else copy.deepcopy(obj.status))
+            self._rv += 1
+            stored.metadata.resource_version = self._rv
+            bucket[key] = stored
+            self._notify(kind, MODIFIED, stored, old)
+            return None
+
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
             key = f"{namespace}/{name}" if namespace else name
@@ -195,7 +232,13 @@ class Store:
 
     def list(self, kind: str, namespace: Optional[str] = None,
              labels: Optional[dict] = None,
-             where: Optional[Callable[[object], bool]] = None) -> list:
+             where: Optional[Callable[[object], bool]] = None,
+             copy_objects: bool = True) -> list:
+        """copy_objects=False returns the stored objects themselves —
+        the informer-lister contract (client-go listers return shared
+        cache pointers, read-only by convention): callers must not
+        mutate. Deep-copying every ClusterQueue per reconcile event is
+        what made membership scans quadratic at the 2k-CQ scale."""
         with self._lock:
             out = []
             for obj in self._objects.get(kind, {}).values():
@@ -206,7 +249,7 @@ class Store:
                     continue
                 if where is not None and not where(obj):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(copy.deepcopy(obj) if copy_objects else obj)
             return out
 
     def count(self, kind: str) -> int:

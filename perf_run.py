@@ -16,14 +16,21 @@ import sys
 import time
 
 
-def run_mode(label, scale, solver):
+def run_mode(label, scale, solver, config="default"):
     from kueue_tpu.perf import (
-        Runner, check, default_generator_config, default_rangespec, generate)
-    load = generate(default_generator_config(), scale=scale)
+        Runner, check, default_generator_config, default_rangespec, generate,
+        north_star_generator_config)
+    if config == "north-star":
+        load = generate(north_star_generator_config(), scale=scale,
+                        num_flavors=32)
+    else:
+        load = generate(default_generator_config(), scale=scale)
     t0 = time.monotonic()
     result = Runner(load, solver=solver).run()
-    spec = default_rangespec()
-    violations = check(result, spec)
+    # the rangespec's queueing-dynamics bounds are calibrated for the
+    # default 15k scenario only
+    spec = default_rangespec() if config == "default" else None
+    violations = check(result, spec) if spec is not None else []
     out = {
         "mode": label,
         "scale": scale,
@@ -34,6 +41,8 @@ def run_mode(label, scale, solver):
         "wall_s": round(result.wall_s, 1),
         "virtual_makespan_s": round(result.virtual_makespan_s, 1),
         "admissions_per_wall_second": round(result.admissions_per_wall_second, 1),
+        "cycle_p50_ms": round(result.cycle_p50_ms, 1),
+        "cycle_p99_ms": round(result.cycle_p99_ms, 1),
         "class_avg_tta_s": {
             cls: round(st.avg, 2) for cls, st in result.class_stats.items()},
         "class_p99_tta_s": {
@@ -55,21 +64,33 @@ def main():
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--out", default=None)
     ap.add_argument("--modes", default="cpu,solver")
+    ap.add_argument("--config", default="default",
+                    choices=("default", "north-star"))
     args = ap.parse_args()
 
-    results = {"scenario": "reference default_generator_config "
-                           "(5 cohorts x 6 CQs, 15k workloads at scale=1)",
-               "rangespec": "reference default_rangespec queueing-dynamics "
-                            "bounds (large<=11s, medium<=90s, small<=233s avg "
-                            "TTA; cq usage>=55%)",
-               "runs": []}
+    if args.config == "north-star":
+        scenario = ("north_star_generator_config (250 cohorts x 8 CQs = "
+                    "2,000 CQs x 32 flavors, 50,000 workloads at scale=1; "
+                    "BASELINE.json config #5)")
+        rangespec = "none (no published reference bounds at this scale)"
+    else:
+        scenario = ("reference default_generator_config "
+                    "(5 cohorts x 6 CQs, 15k workloads at scale=1)")
+        rangespec = ("reference default_rangespec queueing-dynamics "
+                     "bounds (large<=11s, medium<=90s, small<=233s avg "
+                     "TTA; cq usage>=55%)")
+    results = {"scenario": scenario, "rangespec": rangespec, "runs": []}
     for mode in args.modes.split(","):
         if mode == "cpu":
-            results["runs"].append(run_mode("cpu", args.scale, None))
+            results["runs"].append(
+                run_mode("cpu", args.scale, None, config=args.config))
         elif mode == "solver":
             from kueue_tpu.solver import BatchSolver
             results["runs"].append(
-                run_mode("solver", args.scale, BatchSolver()))
+                run_mode("solver", args.scale, BatchSolver(),
+                         config=args.config))
+        else:
+            ap.error(f"unknown mode {mode!r} (expected 'cpu' or 'solver')")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(results, f, indent=1)

@@ -2,15 +2,21 @@
 
 Equivalent of the reference's cmd/kueuectl (app/cmd.go:79-90):
 create {clusterqueue,localqueue,resourceflavor}, list {clusterqueue,
-localqueue,workload,resourceflavor}, stop/resume {workload,clusterqueue,
-localqueue} (via spec.active / stopPolicy), version. The command core is
-the `Kueuectl` class over a manager's store (tests drive it directly);
-`main()` wraps it in argparse against a demo manager.
+localqueue,workload,resourceflavor,pods --for kind/name}, stop/resume
+{workload,clusterqueue,localqueue} (via spec.active / stopPolicy),
+version, plus the pass-through verbs get/describe/delete/patch/edit
+(app/passthrough/passthrough.go:33-39 — the reference delegates these to
+kubectl; here the store IS the apiserver, so they execute directly, with
+the same wl/cq/lq/rf aliases). The command core is the `Kueuectl` class
+over a manager's store (tests drive it directly); `main()` wraps it in
+argparse against a demo manager.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import json
 import sys
 from typing import Optional
 
@@ -18,6 +24,52 @@ from kueue_tpu import version as versionpkg
 from kueue_tpu.api import kueue as api
 from kueue_tpu.api.meta import ObjectMeta
 from kueue_tpu.core import workload as wlpkg
+
+# pass-through resource aliases (reference: passthrough.go:35-39)
+KIND_ALIASES = {
+    "workload": "Workload", "wl": "Workload",
+    "clusterqueue": "ClusterQueue", "cq": "ClusterQueue",
+    "localqueue": "LocalQueue", "lq": "LocalQueue",
+    "resourceflavor": "ResourceFlavor", "rf": "ResourceFlavor",
+}
+CLUSTER_SCOPED = {"ClusterQueue", "ResourceFlavor"}
+
+
+def _to_dict(obj):
+    if dataclasses.is_dataclass(obj):
+        return {f.name: _to_dict(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, dict):
+        return {k: _to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [_to_dict(v) for v in obj]
+    return obj
+
+
+def _merge_patch(target, patch: dict) -> None:
+    """RFC 7386-style merge onto a typed object tree: dict values recurse
+    into nested dataclasses / dicts, None deletes dict keys, everything
+    else replaces. Typed lists are replaced wholesale only when the patch
+    supplies plain values (the common kubectl-patch admin edits: scalars
+    like spec.active, spec.stopPolicy, labels, quotas)."""
+    for key, value in patch.items():
+        if isinstance(target, dict):
+            if value is None:
+                target.pop(key, None)
+            elif isinstance(value, dict) and isinstance(target.get(key), dict):
+                _merge_patch(target[key], value)
+            else:
+                target[key] = value
+            continue
+        if not hasattr(target, key):
+            from kueue_tpu.sim import Invalid
+            raise Invalid(f"unknown field {key!r} on {type(target).__name__}")
+        current = getattr(target, key)
+        if isinstance(value, dict) and (dataclasses.is_dataclass(current)
+                                        or isinstance(current, dict)):
+            _merge_patch(current, value)
+        else:
+            setattr(target, key, value)
 
 
 class Kueuectl:
@@ -133,6 +185,98 @@ class Kueuectl:
         lq.spec.stop_policy = api.STOP_POLICY_NONE
         self.store.update(lq)
 
+    def list_pods_for(self, for_ref: str,
+                      namespace: str = "default") -> list:
+        """`kueuectl list pods --for kind/name` (reference:
+        app/list/list_pods.go): the pods belonging to a job-framework
+        object — matched by ownerReference to the object, or, for
+        `--for pod/<name>`, the named pod's whole pod group."""
+        kind, _, name = for_ref.partition("/")
+        if not name:
+            raise ValueError("--for requires kind/name (e.g. job/my-job)")
+        kind = kind.lower()
+        pods = self.store.list("Pod", namespace=namespace)
+        if kind == "pod":
+            from kueue_tpu.controller.jobs.pod import GROUP_NAME_LABEL
+            anchor = next((p for p in pods if p.metadata.name == name), None)
+            group = (anchor.metadata.labels.get(GROUP_NAME_LABEL)
+                     if anchor is not None else None)
+            if group:
+                out = [p for p in pods
+                       if p.metadata.labels.get(GROUP_NAME_LABEL) == group]
+            else:
+                out = [anchor] if anchor is not None else []
+        else:
+            out = [p for p in pods if any(
+                o.kind.lower() == kind and o.name == name
+                for o in p.metadata.owner_references)]
+        self._print("NAME", "PHASE", "GATED")
+        for p in sorted(out, key=lambda p: p.metadata.name):
+            gated = api.ADMISSION_GATE in p.spec.scheduling_gates
+            self._print(p.metadata.name, p.status.phase, gated)
+        return out
+
+    # -- pass-through verbs (reference: app/passthrough/passthrough.go) --
+
+    def _resolve(self, kind: str, namespace: str):
+        k = KIND_ALIASES[kind.lower()]
+        ns = "" if k in CLUSTER_SCOPED else namespace
+        return k, ns
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> dict:
+        k, ns = self._resolve(kind, namespace)
+        obj = self.store.get(k, ns, name)
+        data = _to_dict(obj)
+        self._print(json.dumps(data, indent=2, default=str, sort_keys=True))
+        return data
+
+    def describe(self, kind: str, name: str,
+                 namespace: str = "default") -> dict:
+        k, ns = self._resolve(kind, namespace)
+        obj = self.store.get(k, ns, name)
+        self._print(f"Name:\t{obj.metadata.name}")
+        if ns:
+            self._print(f"Namespace:\t{ns}")
+        self._print(f"Kind:\t{k}")
+        labels = getattr(obj.metadata, "labels", {})
+        if labels:
+            self._print(f"Labels:\t{labels}")
+        status = getattr(obj, "status", None)
+        for cond in getattr(status, "conditions", []):
+            self._print(f"Condition:\t{cond.type}={cond.status}"
+                        f" ({cond.reason}): {cond.message}")
+        spec = _to_dict(obj.spec)
+        self._print("Spec:")
+        self._print(json.dumps(spec, indent=2, default=str, sort_keys=True))
+        return spec
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        k, ns = self._resolve(kind, namespace)
+        self.store.delete(k, ns, name)
+        self._print(f"{k.lower()} {name!r} deleted")
+
+    def patch(self, kind: str, name: str, patch_json: str,
+              namespace: str = "default") -> None:
+        k, ns = self._resolve(kind, namespace)
+        obj = self.store.get(k, ns, name)
+        _merge_patch(obj, json.loads(patch_json))
+        try:
+            self.store.update(obj)
+        except (AttributeError, TypeError) as exc:
+            # A merge patch replaced a typed field with plain JSON and the
+            # validation webhook tripped over it — a user error, not a bug.
+            from kueue_tpu.sim import Invalid
+            raise Invalid(f"patch produced an invalid object: {exc}") from exc
+        self._print(f"{k.lower()} {name!r} patched")
+
+    def edit(self, kind: str, name: str, namespace: str = "default",
+             stream=None) -> None:
+        """Non-interactive edit: a JSON merge patch read from stdin (the
+        reference shells out to `kubectl edit`/$EDITOR; there is no tty
+        in this runtime)."""
+        stream = stream if stream is not None else sys.stdin
+        self.patch(kind, name, stream.read(), namespace=namespace)
+
     def version(self) -> str:
         v = f"kueuectl (kueue_tpu) {versionpkg.VERSION}"
         self._print(v)
@@ -144,12 +288,26 @@ def main(argv: Optional[list] = None, manager=None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
     for verb in ("create", "list", "stop", "resume"):
         p = sub.add_parser(verb)
-        p.add_argument("kind", choices=["clusterqueue", "localqueue",
-                                        "workload", "resourceflavor"])
+        kinds = ["clusterqueue", "localqueue", "workload", "resourceflavor"]
+        if verb == "list":
+            kinds.append("pods")
+        p.add_argument("kind", choices=kinds)
         p.add_argument("name", nargs="?")
         p.add_argument("-n", "--namespace", default="default")
         p.add_argument("--cohort", default="")
         p.add_argument("--clusterqueue", default="")
+        if verb == "list":
+            p.add_argument("--for", dest="for_ref", default="",
+                           help="list pods: owning object as kind/name")
+    # pass-through verbs (reference: passthrough.go:33-39)
+    for verb in ("get", "describe", "delete", "patch", "edit"):
+        p = sub.add_parser(verb)
+        p.add_argument("kind", choices=sorted(KIND_ALIASES))
+        p.add_argument("name")
+        p.add_argument("-n", "--namespace", default="default")
+        if verb == "patch":
+            p.add_argument("-p", "--patch", required=True,
+                           help="JSON merge patch")
     sub.add_parser("version")
     args = parser.parse_args(argv)
 
@@ -171,7 +329,8 @@ def main(argv: Optional[list] = None, manager=None) -> int:
     from kueue_tpu.sim import AlreadyExists, Invalid, NotFound
     try:
         return _dispatch(ctl, args)
-    except (Invalid, AlreadyExists, NotFound) as exc:
+    except (Invalid, AlreadyExists, NotFound, ValueError,
+            json.JSONDecodeError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
 
@@ -180,9 +339,24 @@ def _dispatch(ctl: Kueuectl, args) -> int:
     if args.command == "version":
         ctl.version()
         return 0
+    if args.command in ("get", "describe", "delete", "patch", "edit"):
+        if args.command == "get":
+            ctl.get(args.kind, args.name, namespace=args.namespace)
+        elif args.command == "describe":
+            ctl.describe(args.kind, args.name, namespace=args.namespace)
+        elif args.command == "delete":
+            ctl.delete(args.kind, args.name, namespace=args.namespace)
+        elif args.command == "patch":
+            ctl.patch(args.kind, args.name, args.patch,
+                      namespace=args.namespace)
+        else:
+            ctl.edit(args.kind, args.name, namespace=args.namespace)
+        return 0
     kind = args.kind
     if args.command == "list":
-        if kind == "clusterqueue":
+        if kind == "pods":
+            ctl.list_pods_for(args.for_ref, namespace=args.namespace)
+        elif kind == "clusterqueue":
             ctl.list_cluster_queues()
         elif kind == "localqueue":
             ctl.list_local_queues(namespace=args.namespace)

@@ -138,8 +138,8 @@ class KueueManager:
 
     # -- deterministic drivers (tests / perf harness) -------------------
 
-    def run_until_idle(self) -> int:
-        return self.runtime.run_until_idle()
+    def run_until_idle(self, max_iterations: int = 10000) -> int:
+        return self.runtime.run_until_idle(max_iterations=max_iterations)
 
     def schedule_once(self) -> None:
         """One admission cycle + controller settling."""

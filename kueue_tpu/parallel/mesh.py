@@ -7,15 +7,24 @@ workloads in different domains never contend for the same quota
 (reference: all fit/borrow math walks within one cohort tree,
 pkg/cache/resource_node.go). That makes the domain the natural SPMD axis.
 
-v2 (real partitioning): ONE dispatch per cycle. Every device runs the
-cheap replicated parts (Phase A flavor assignment, the device-built
-order grid) and then scans only ITS OWN slice of the grid's domain
-columns — per-device Phase B work shrinks ~linearly with the mesh size
-(row width D/n instead of D). Distinct domains touch disjoint CQ/cohort
-state, so the per-device usage deltas combine with a single psum.
+v3 (both phases partitioned): ONE dispatch per cycle.
+
+- Phase A (the FLOP bulk: [W,F,R] flavor assignment) is sharded over the
+  WORKLOAD axis — each device assigns flavors for its W/n slice of the
+  batch against the replicated pre-cycle usage (per-workload assignment
+  is embarrassingly parallel: it reads only snapshot state), then one
+  all_gather rebuilds the full batch before the order-grid build.
+- Phase B is sharded over the conflict-domain axis — root cohorts (plus
+  a synthetic domain per cohortless CQ) are independent capacity
+  domains: workloads in different domains never contend for the same
+  quota (reference: all fit/borrow math walks within one cohort tree,
+  pkg/cache/resource_node.go), so each device scans only its own grid
+  columns and the disjoint usage deltas combine with a single psum.
 
 ICI/DCN traffic per cycle: one replicated broadcast of the batch in, one
-psum of usage deltas + admitted masks out.
+all_gather of Phase A outputs between phases, one psum of usage deltas +
+admitted masks out. Decisions are bit-identical to the single-chip path
+(differentially checked by __graft_entry__.dryrun_multichip).
 """
 
 from __future__ import annotations
@@ -58,11 +67,34 @@ def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int,
         W = requests.shape[0]
         dev = jax.lax.axis_index(axis)
 
-        # --- replicated: Phase A + admit order + domain-rank grid ---
+        # --- Phase A sharded over W: this device assigns flavors for its
+        # own workload slice against the (replicated) pre-cycle usage ---
+        w_local = -(-W // n_dev)
+        w_pad = w_local * n_dev
+
+        def wslice(a):
+            if w_pad != W:
+                pad = [(0, w_pad - W)] + [(0, 0)] * (a.ndim - 1)
+                a = jnp.pad(a, pad)
+            return jax.lax.dynamic_slice_in_dim(a, dev * w_local, w_local, 0)
+
         cohort_avail = _cohort_avail(topo_, cohort_usage)
-        fit, borrows, chosen, chosen_borrow, asg_usage = _phase_a(
-            topo_, usage, cohort_avail, requests, podset_active, wl_cq,
-            eligible, solvable, num_podsets, start_rank_)
+        fit_l, borrows_l, chosen_l, chosen_borrow_l, asg_usage_l = _phase_a(
+            topo_, usage, cohort_avail, wslice(requests),
+            wslice(podset_active), wslice(wl_cq), wslice(eligible),
+            wslice(solvable), num_podsets,
+            wslice(start_rank_) if start_rank_ is not None else None)
+
+        def gather(a):
+            out = jax.lax.all_gather(a, axis, axis=0, tiled=True)
+            return out[:W] if w_pad != W else out
+
+        # one all_gather rebuilds the full batch for the grid build
+        fit = gather(fit_l)
+        borrows = gather(borrows_l)
+        chosen = gather(chosen_l)
+        chosen_borrow = gather(chosen_borrow_l)
+        asg_usage = gather(asg_usage_l)
         share = (_drf_share(topo_, usage, asg_usage, wl_cq) if fair_sharing
                  else jnp.zeros(W, jnp.int64))
         order = jnp.lexsort((timestamp, -priority, share,

@@ -86,7 +86,7 @@ class Runner:
             mgr.store.create(cq)
         for lq in load.local_queues:
             mgr.store.create(lq)
-        mgr.run_until_idle()
+        mgr.run_until_idle(max_iterations=10_000_000)
 
         arrival_by_key = {f"{a.namespace}/{a.name}": a for a in load.arrivals}
         admitted_at: dict = {}
@@ -171,14 +171,14 @@ class Runner:
                             clock.now())
                         mgr.store.update(wl)
                         result.finished += 1
-            mgr.run_until_idle()
+            mgr.run_until_idle(max_iterations=10_000_000)
             # schedule until this instant's admissions are exhausted
             for _ in range(1000):
                 before = result.admitted
                 c0 = time.perf_counter()
                 mgr.scheduler.schedule(timeout=0)
                 cycle_times.append(time.perf_counter() - c0)
-                mgr.run_until_idle()
+                mgr.run_until_idle(max_iterations=10_000_000)
                 result.cycles += 1
                 if result.admitted == before:
                     break

@@ -35,10 +35,13 @@ BIG = np.int64(2**62)  # "no limit" encoding
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
-    """Round up to the next power of two (jit-compilation bucketing)."""
+    """Round up to the next power of FOUR (jit-compilation bucketing).
+    Coarse buckets trade padding for far fewer distinct compiled shapes —
+    over a remote-compile tunnel each new shape costs seconds, which
+    dominated the north-star run's p99 cycles."""
     b = minimum
     while b < n:
-        b *= 2
+        b *= 4
     return b
 
 

@@ -505,7 +505,7 @@ def max_rank_bound(wl_cq, cq_cohort, cohort_root) -> int:
     raw = int(np.bincount(domain).max()) if len(domain) else 1
     b = 8
     while b < raw:
-        b *= 2
+        b *= 4  # powers of four: shape-diversity control (encode._bucket)
     return b
 
 
@@ -556,7 +556,7 @@ def build_order_grid(fit, borrows, priority, timestamp, wl_cq, cq_cohort,
     raw_l = max(1, int(ranks.max()) + 1) if n else 1
     L = 8
     while L < raw_l:
-        L *= 2
+        L *= 4  # powers of four: shape-diversity control
     grid = np.full((L, D), -1, np.int32)
     grid[ranks, dom_of_sorted] = order.astype(np.int32)
     return grid

@@ -47,9 +47,10 @@ BIG = np.int64(2**61)
 
 
 def _bucket(n: int, minimum: int = 4) -> int:
+    """Powers of four: see encode._bucket — shape-diversity control."""
     b = minimum
     while b < n:
-        b *= 2
+        b *= 4
     return b
 
 

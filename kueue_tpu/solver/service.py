@@ -10,10 +10,18 @@ partial admission, detailed status messages).
 Equivalence class vs the reference: for cycles where every nominated
 entry is fit-mode, the solver's result is identical to the sequential
 scheduler (same ordering, same intra-cycle accounting — differentially
-tested in tests/test_solver.py). When preemption is involved, fit-mode
-entries are accounted before preempt-mode entries instead of interleaved
-by the global order; preemptors then run against the post-admission
-snapshot. The CPU path (solver=None) remains the strict-conformance mode.
+tested in tests/test_solver.py). In mixed cycles, ALL nomination (fit on
+device, preempt-mode on CPU, preemption targets on device) happens
+against the pre-cycle snapshot exactly like the reference's nominate
+phase — but the admit loop is split: every device fit-mode admission is
+accounted before preempt-mode entries run, instead of interleaving by
+the global borrow->share->priority->FIFO order. Consequence (pinned by
+tests/test_solver.py::TestMixedCycleEquivalenceClass): a fit-mode entry
+can consume capacity the reference would have reserved for a BLOCKED
+higher-priority preemptor (scheduler.go:245-253); the blocked preemptor
+retries next cycle. Entries with preemption targets still re-check fits
+against post-admission usage, so no over-admission is possible. The CPU
+path (solver=None) remains the strict-conformance mode.
 """
 
 from __future__ import annotations

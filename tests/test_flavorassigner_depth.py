@@ -1,11 +1,17 @@
 """Flavor-assignment depth suite.
 
-Transliteration of the reference's
+Transliteration of roughly 40 of the ~70 cases in the reference's
 pkg/scheduler/flavorassigner/flavorassigner_test.go tables
 (TestAssignFlavors:51-1976, TestReclaimBeforePriorityPreemption:1981-2131)
 driving FlavorAssigner.assign against a snapshot whose cohort aggregates
 are overridden exactly as the reference harness does
-(flavorassigner_test.go:1957-1963).
+(flavorassigner_test.go:1957-1963). Covered: fit/preempt/no-fit
+classification, borrowing & lending limits, taints/tolerations,
+node-affinity matching, multi-resource-group and pods-resource cases,
+reclaim-before-priority-preemption. Not yet transliterated:
+partial-admission x podset-reducer interplay and the LastState-dependent
+fungibility-resume cases (exercised instead by tests/test_solver.py's
+resume suites and tests/test_scheduler.py).
 """
 
 from kueue_tpu.api import kueue as api
@@ -48,13 +54,10 @@ def fixture_flavors():
 
 def fq(flavor, **resources):
     """flavor_quotas but allowing the gpu resource via 'gpu' shorthand."""
-    mapped = {}
-    for k, v in resources.items():
-        mapped[k] = v
-    out = flavor_quotas(flavor, **{k: v for k, v in mapped.items()
-                                   if k not in ("gpu",)})
-    if "gpu" in mapped:
-        spec = mapped["gpu"]
+    out = flavor_quotas(flavor, **{k: v for k, v in resources.items()
+                                   if k != "gpu"})
+    if "gpu" in resources:
+        spec = resources["gpu"]
         if isinstance(spec, tuple):
             nominal, borrowing = spec[0], spec[1] if len(spec) > 1 else None
         else:
@@ -114,8 +117,7 @@ def run_assign(cq_wrapper, pod_sets, cq_usage=None, cohort_requestable=None,
     def oracle(cq_, wl_, fr, q):
         return not cq_.borrowing_with(fr, q)
 
-    rf_specs = {name: f for name, f in flavors.items()}
-    assigner = FlavorAssigner(info, cq_snap, rf_specs,
+    assigner = FlavorAssigner(info, cq_snap, flavors,
                               enable_fair_sharing=fair, oracle=oracle)
     return assigner.assign()
 

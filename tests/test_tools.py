@@ -143,6 +143,69 @@ class TestKueuectl:
         assert mgr.store.try_get("ResourceFlavor", "", "cli-flavor") is not None
         assert cli_main(["list", "workload"], manager=mgr) == 0
 
+    def test_passthrough_get_describe(self, mgr):
+        """Pass-through verbs resolve aliases and cluster scope
+        (reference: app/passthrough/passthrough.go:33-39)."""
+        out = io.StringIO()
+        ctl = Kueuectl(mgr, out=out)
+        submit_n(mgr, 1)
+        mgr.schedule_until_settled()
+        data = ctl.get("cq", "cq")
+        assert data["metadata"]["name"] == "cq"
+        assert data["spec"]["resource_groups"]
+        spec = ctl.describe("workload", "w0", namespace="default")
+        assert spec["queue_name"] == "lq"
+        assert "Condition:\tQuotaReserved=True" in out.getvalue()
+
+    def test_passthrough_patch_edit_delete(self, mgr):
+        out = io.StringIO()
+        ctl = Kueuectl(mgr, out=out)
+        submit_n(mgr, 1)
+        mgr.schedule_until_settled()
+        # patch: deactivate the workload via a JSON merge patch
+        ctl.patch("wl", "w0", '{"spec": {"active": false}}')
+        assert not mgr.store.get("Workload", "default", "w0").spec.active
+        # edit: merge patch from a stream (non-interactive kubectl edit)
+        ctl.edit("wl", "w0", stream=io.StringIO('{"spec": {"active": true}}'))
+        assert mgr.store.get("Workload", "default", "w0").spec.active
+        # delete
+        ctl.delete("workload", "w0", namespace="default")
+        mgr.run_until_idle()
+        assert mgr.store.try_get("Workload", "default", "w0") is None
+
+    def test_passthrough_cli_entry(self, mgr, capsys):
+        submit_n(mgr, 1)
+        mgr.schedule_until_settled()
+        assert cli_main(["get", "wl", "w0"], manager=mgr) == 0
+        assert cli_main(["describe", "cq", "cq"], manager=mgr) == 0
+        assert cli_main(["patch", "wl", "w0", "-p",
+                         '{"spec": {"active": false}}'], manager=mgr) == 0
+        assert not mgr.store.get("Workload", "default", "w0").spec.active
+        assert cli_main(["get", "wl", "missing"], manager=mgr) == 1
+
+    def test_list_pods_for(self, mgr):
+        from kueue_tpu.api import corev1
+        from kueue_tpu.api.meta import OwnerReference
+        out = io.StringIO()
+        ctl = Kueuectl(mgr, out=out)
+        for i in range(2):
+            pod = corev1.Pod(metadata=ObjectMeta(
+                name=f"j-pod-{i}", namespace="default",
+                owner_references=[OwnerReference(kind="Job", name="my-job",
+                                                 uid="j1")]))
+            mgr.store.create(pod)
+        for i in range(2):
+            pod = corev1.Pod(metadata=ObjectMeta(
+                name=f"g-pod-{i}", namespace="default",
+                labels={"kueue.x-k8s.io/pod-group-name": "grp"}))
+            mgr.store.create(pod)
+        pods = ctl.list_pods_for("job/my-job")
+        assert {p.metadata.name for p in pods} == {"j-pod-0", "j-pod-1"}
+        pods = ctl.list_pods_for("pod/g-pod-0")
+        assert {p.metadata.name for p in pods} == {"g-pod-0", "g-pod-1"}
+        assert cli_main(["list", "pods", "--for", "job/my-job"],
+                        manager=mgr) == 0
+
 
 class TestImporter:
     def make_running_pod(self, name, namespace="default", cpu=500, labels=None):

@@ -30,7 +30,25 @@ Device side (the hot loop):
 
 Fair-sharing preemption (fairPreemptions' DRF heap) stays on the CPU
 path; the scheduler routes preempt-mode entries to the CPU preemptor
-when fair sharing is enabled.
+when fair sharing is enabled (correctness is covered by the fair-sharing
+differential suites through the solver-configured scheduler).
+
+Device formulation for the DRF-heap loop (next round): the greedy
+"pop max-share CQ, test strategy, remove its head candidate, recompute
+shares" loop (preemption.go:312-437) is a K-step scan like the minimal
+preemptor, with two additions per problem:
+- per local CQ, the share state decomposes as
+  share(cq) = max_r((base_borrow_other[r] + borrow_carried[r]) * 1000
+              // lendable[r]) * 1000 // fair_weight,
+  where base_borrow_other[r] (host-encoded constant) is the CQ's
+  borrowing on FlavorResources NOT carried in the problem's RF slots —
+  removals only change borrow_carried, which the kernel already tracks
+  as usage minus nominal over the carried slots;
+- each scan step picks argmax-share CQ (a dense [QL] reduction), applies
+  the strategy predicate (S2-a: preemptorNewShare <= preempteeNewShare,
+  S2-b: < preempteeOldShare — both pure share comparisons), and the
+  existing one-hot remove_usage. The second-pass S2-b retry becomes a
+  second scan over the retry mask, and fill-back is unchanged.
 """
 
 from __future__ import annotations

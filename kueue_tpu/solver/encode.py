@@ -34,14 +34,19 @@ from kueue_tpu.api.corev1 import find_untolerated_taint
 BIG = np.int64(2**62)  # "no limit" encoding
 
 
-def _bucket(n: int, minimum: int = 8) -> int:
-    """Round up to the next power of FOUR (jit-compilation bucketing).
-    Coarse buckets trade padding for far fewer distinct compiled shapes —
-    over a remote-compile tunnel each new shape costs seconds, which
-    dominated the north-star run's p99 cycles."""
+def _bucket(n: int, minimum: int = 8, factor: int = 4) -> int:
+    """Round up to the next power of `factor` (jit-compilation bucketing).
+
+    The default factor 4 is for PER-CYCLE batch dims (W, the preemption
+    problem dims): coarse buckets trade padding for far fewer distinct
+    compiled shapes — over a remote-compile tunnel each new shape costs
+    seconds, which dominated the north-star run's p99 cycles. TOPOLOGY
+    dims (Q, F, R, C) use factor 2: they change only on spec edits (a
+    full topology rebuild anyway), and tight buckets keep the per-cycle
+    usage upload small on the bandwidth-bound tunnel."""
     b = minimum
     while b < n:
-        b *= 4
+        b *= factor
     return b
 
 
@@ -152,10 +157,10 @@ def encode_topology(snapshot: Snapshot) -> Topology:
     topo.cq_index = {c: i for i, c in enumerate(topo.cq_names)}
     cohort_index = {c: i for i, c in enumerate(topo.cohort_names)}
 
-    Q = _bucket(max(1, len(topo.cq_names)), 1)
-    F = _bucket(max(1, len(topo.flavors)), 1)
-    R = _bucket(max(1, len(topo.resources)), 1)
-    C = _bucket(max(1, len(topo.cohort_names)), 1)
+    Q = _bucket(max(1, len(topo.cq_names)), 1, factor=2)
+    F = _bucket(max(1, len(topo.flavors)), 1, factor=2)
+    R = _bucket(max(1, len(topo.resources)), 1, factor=2)
+    C = _bucket(max(1, len(topo.cohort_names)), 1, factor=2)
 
     topo.cq_cohort = np.full(Q, -1, np.int32)
     topo.nominal = np.zeros((Q, F, R), np.int64)

@@ -60,16 +60,9 @@ import numpy as np
 from kueue_tpu.api import kueue as api
 from kueue_tpu.core import priority as prioritypkg
 from kueue_tpu.scheduler import preemption as cpu_preempt
+from kueue_tpu.solver.encode import _bucket  # shared shape-bucketing policy
 
 BIG = np.int64(2**61)
-
-
-def _bucket(n: int, minimum: int = 4) -> int:
-    """Powers of four: see encode._bucket — shape-diversity control."""
-    b = minimum
-    while b < n:
-        b *= 4
-    return b
 
 
 @dataclass

@@ -309,3 +309,53 @@ class TestDevicePreemptionFuzz:
                     for n, q, p, c, ts in pending_specs]
 
         assert_preemption_differential(setup, existing, workloads, cycles=2)
+
+
+class TestFairSharingThroughSolverPath:
+    """Fair-sharing preemption stays on the CPU preemptor (the DRF heap
+    is not on device yet — see solver/preempt.py), but the
+    solver-configured scheduler must route it there and produce decisions
+    identical to the CPU-only scheduler, with zero device fallbacks
+    (routing is a gate decision, not a failure)."""
+
+    def _setup(self, env):
+        env.add_flavor("default")
+        for name in ("a", "b", "c"):
+            env.add_cq(
+                ClusterQueueWrapper(name).cohort("all")
+                .preemption(
+                    within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                    reclaim_within_cohort=api.PREEMPTION_ANY)
+                .resource_group(flavor_quotas("default", cpu="3")).obj(),
+                f"lq-{name}")
+
+    def test_fair_preemption_differential(self):
+        def existing():
+            out = []
+            for i in range(3):
+                out.append(WorkloadWrapper(f"a{i}").queue("lq-a").creation(i)
+                           .pod_set(count=1, cpu=1).reserve("a").obj())
+            for i in range(5):
+                out.append(WorkloadWrapper(f"b{i}").queue("lq-b").creation(i)
+                           .pod_set(count=1, cpu=1).reserve("b").obj())
+            out.append(WorkloadWrapper("c0").queue("lq-c").creation(0)
+                       .pod_set(count=1, cpu=1).reserve("c").obj())
+            return out
+
+        def workloads():
+            # c is furthest under nominal; b borrows the most -> fair
+            # sharing reclaims from b (preemption_test.go:1532-1546)
+            return [WorkloadWrapper("c_incoming").queue("lq-c").creation(100)
+                    .pod_set(count=1, cpu=1).obj()]
+
+        cpu_env, tpu_env = run_both(self._setup, existing, workloads,
+                                    fair_sharing=True)
+        assert tpu_env.scheduler.preemption_fallbacks == 0
+        cpu_ev = set(cpu_env.client.evicted)
+        tpu_ev = set(tpu_env.client.evicted)
+        assert cpu_ev == tpu_ev and cpu_ev, (cpu_ev, tpu_ev)
+        for key in cpu_ev:
+            reasons = [c.reason
+                       for c in tpu_env.client.evicted[key].status.conditions
+                       if c.type == api.WORKLOAD_PREEMPTED]
+            assert reasons == [api.IN_COHORT_FAIR_SHARING_REASON], reasons

@@ -60,17 +60,72 @@ class Cache:
         # they alter flavor eligibility without touching any CQ quota
         # generation, so topology-derived caches key on this too.
         self.flavor_spec_epoch = 0
+        # Bumped on any change to the encoded solver TOPOLOGY (CQ set /
+        # quotas / cohort tree / flavors / activity) — deliberately NOT on
+        # workload add/remove, which only moves usage. The solver keys its
+        # topology tensors on this instead of per-CQ allocatable
+        # generations (those bump on every workload deletion purely to
+        # invalidate flavor-resume state).
+        self.topology_epoch = 0
+        # Usage journal: when enabled (by an attached solver), every
+        # usage-moving workload mutation appends (seq, kind, cq, key,
+        # usage) so device-resident solver state can be reconciled with
+        # tiny deltas instead of a full re-encode + re-upload per cycle.
+        self.usage_journal_enabled = False
+        self._journal: list = []
+        self._journal_seq = 0
+        self._journal_overflow = False
+        self._journal_cap = 200_000
 
     def _new_cohort(self, name: str) -> CohortCache:
         cohort = CohortCache(name)
         cohort.manager = self.hm
         return cohort
 
+    # --- usage journal (device-resident solver state reconciliation) ---
+
+    def enable_usage_journal(self) -> None:
+        with self._lock:
+            self.usage_journal_enabled = True
+
+    def _journal_usage(self, kind: str, cq_name: str, key: str,
+                       usage: dict) -> None:
+        """kind: 'add' | 'del'. Caller holds the lock."""
+        if not self.usage_journal_enabled:
+            return
+        self._journal_seq += 1
+        if len(self._journal) >= self._journal_cap:
+            # Bound memory if the solver stops draining; consumers see the
+            # overflow flag and fall back to a full state re-encode.
+            self._journal.clear()
+            self._journal_overflow = True
+        self._journal.append((self._journal_seq, kind, cq_name, key, usage))
+
+    def drain_usage_journal(self, upto_seq: int) -> tuple:
+        """Pop and return (entries with seq <= upto_seq, overflowed). The
+        overflow flag resets once observed."""
+        with self._lock:
+            if not self._journal or self._journal[0][0] > upto_seq:
+                entries: list = []
+            elif self._journal[-1][0] <= upto_seq:
+                entries, self._journal = self._journal, []
+            else:
+                cut = 0
+                for cut, e in enumerate(self._journal):
+                    if e[0] > upto_seq:
+                        break
+                entries = self._journal[:cut]
+                self._journal = self._journal[cut:]
+            overflow = self._journal_overflow
+            self._journal_overflow = False
+            return entries, overflow
+
     # --- ClusterQueues ---
 
     def add_cluster_queue(self, cq: api.ClusterQueue) -> ClusterQueueCache:
         with self._lock:
             self._capacity_version += 1
+            self.topology_epoch += 1
             cqc = ClusterQueueCache(cq)
             self.hm.add_cluster_queue(cqc.name, cqc)
             self.hm.update_cluster_queue_edge(cqc.name, cq.spec.cohort)
@@ -80,12 +135,30 @@ class Cache:
             self._refresh_cohort(cqc)
             return cqc
 
+    @staticmethod
+    def _topo_signature(cqc) -> tuple:
+        """The CQ fields the solver topology encodes: changes here (and
+        only here) invalidate the encoded tensors. Reconcilers re-push
+        ClusterQueues on every STATUS write; bumping the epoch on those
+        would rebuild the topology (and drop device-resident solver
+        state) every admission cycle."""
+        return (cqc.cohort_name,
+                tuple((tuple(sorted(rg.covered_resources)), tuple(rg.flavors))
+                      for rg in cqc.resource_groups),
+                tuple(sorted(cqc.resource_node.quotas.items())),
+                cqc.fair_weight,
+                cqc.flavor_fungibility.when_can_borrow,
+                cqc.active,
+                tuple(sorted((k, tuple(sorted(v)))
+                             for k, v in cqc.admission_checks.items())))
+
     def update_cluster_queue(self, cq: api.ClusterQueue) -> None:
         with self._lock:
             self._capacity_version += 1
             cqc = self.hm.cluster_queues.get(cq.metadata.name)
             if cqc is None:
                 return
+            old_sig = self._topo_signature(cqc)
             old_cohort = cqc.cohort
             cqc.update(cq)
             self.hm.update_cluster_queue_edge(cqc.name, cq.spec.cohort)
@@ -95,6 +168,8 @@ class Cache:
             if old_cohort is not None and old_cohort is not cqc.cohort:
                 update_cohort_resource_node(old_cohort)
             self._refresh_cohort(cqc)
+            if self._topo_signature(cqc) != old_sig:
+                self.topology_epoch += 1
 
     def terminate_cluster_queue(self, name: str) -> None:
         """Stop admissions while keeping the usage accounting alive until
@@ -104,10 +179,12 @@ class Cache:
             cqc = self.hm.cluster_queues.get(name)
             if cqc is not None:
                 cqc.status = TERMINATING
+                self.topology_epoch += 1
 
     def delete_cluster_queue(self, name: str) -> None:
         with self._lock:
             self._capacity_version += 1
+            self.topology_epoch += 1
             cqc = self.hm.cluster_queues.get(name)
             if cqc is None:
                 return
@@ -140,6 +217,7 @@ class Cache:
         with self._lock:
             self.cohort_epoch += 1
             self._capacity_version += 1
+            self.topology_epoch += 1
             node = self.hm.add_cohort(cohort.metadata.name)
             node.payload.resource_node.quotas = build_quotas(cohort.spec.resource_groups)
             old_root = node.payload.root()
@@ -158,6 +236,7 @@ class Cache:
         with self._lock:
             self.cohort_epoch += 1
             self._capacity_version += 1
+            self.topology_epoch += 1
             node = self.hm.cohorts.get(name)
             if node is None:
                 return
@@ -174,7 +253,13 @@ class Cache:
 
     def add_or_update_resource_flavor(self, rf: api.ResourceFlavor) -> set:
         with self._lock:
+            old = self.resource_flavors.get(rf.metadata.name)
             self.resource_flavors[rf.metadata.name] = rf
+            if old is not None and old.spec == rf.spec:
+                # No-op re-push (reconcilers re-deliver on status/metadata
+                # writes): eligibility didn't change, keep the epochs —
+                # bumping them drops solver topology + device residency.
+                return set()
             return self._refresh_flavor_dependents()
 
     def delete_resource_flavor(self, name: str) -> set:
@@ -185,6 +270,7 @@ class Cache:
     def _refresh_flavor_dependents(self) -> set:
         self._capacity_version += 1
         self.flavor_spec_epoch += 1
+        self.topology_epoch += 1
         affected = set()
         for cqc in self.hm.cluster_queues.values():
             was = cqc.active
@@ -195,9 +281,13 @@ class Cache:
 
     def add_or_update_admission_check(self, ac: api.AdmissionCheck) -> set:
         with self._lock:
-            self.admission_checks[ac.metadata.name] = AdmissionCheckEntry(
+            entry = AdmissionCheckEntry(
                 controller_name=ac.spec.controller_name,
                 active=is_condition_true(ac.status.conditions, api.ADMISSION_CHECK_ACTIVE))
+            if self.admission_checks.get(ac.metadata.name) == entry:
+                # No-op re-push: CQ activity can't change, keep the epoch.
+                return set()
+            self.admission_checks[ac.metadata.name] = entry
             return self._refresh_check_dependents()
 
     def delete_admission_check(self, name: str) -> set:
@@ -206,6 +296,7 @@ class Cache:
             return self._refresh_check_dependents()
 
     def _refresh_check_dependents(self) -> set:
+        self.topology_epoch += 1
         affected = set()
         for cqc in self.hm.cluster_queues.values():
             was = cqc.active
@@ -261,6 +352,8 @@ class Cache:
                 return False
             info = self._new_info(wl)
             cqc.add_workload(info)
+            self._journal_usage("add", cqc.name, info.key,
+                                info.flavor_resource_usage())
             if self.pods_ready_tracking and not is_condition_true(
                     wl.status.conditions, api.WORKLOAD_PODS_READY):
                 cqc.workloads_not_ready.add(info.key)
@@ -294,6 +387,8 @@ class Cache:
         if info is None:
             return False
         cqc.delete_workload(info)
+        self._journal_usage("del", cqc.name, key,
+                            info.flavor_resource_usage())
         cqc.workloads_not_ready.discard(key)
         self._capacity_version += 1  # freed capacity invalidates resume state
         return True
@@ -312,6 +407,8 @@ class Cache:
                 raise KeyError(f"cluster queue {wl.status.admission.cluster_queue} not found")
             info = self._new_info(wl)
             cqc.add_workload(info)
+            self._journal_usage("add", cqc.name, key,
+                                info.flavor_resource_usage())
             if self.pods_ready_tracking and not is_condition_true(
                     wl.status.conditions, api.WORKLOAD_PODS_READY):
                 cqc.workloads_not_ready.add(key)
@@ -391,6 +488,8 @@ class Cache:
                     parent_snap.child_cohorts.add(cohort_snaps[cname])
             snap.cohort_epoch = self.cohort_epoch
             snap.flavor_spec_epoch = self.flavor_spec_epoch
+            snap.topology_epoch = self.topology_epoch
+            snap.journal_seq = self._journal_seq
             return snap
 
     # --- usage reporting (status/metrics) ---

@@ -157,6 +157,8 @@ class Snapshot:
     inactive_cluster_queue_sets: set = field(default_factory=set)
     cohort_epoch: int = 0  # cohort-object structure version (Cache.cohort_epoch)
     flavor_spec_epoch: int = 0  # ResourceFlavor spec version (taints/labels)
+    topology_epoch: int = 0  # solver-topology version (Cache.topology_epoch)
+    journal_seq: int = 0  # usage-journal position at snapshot time
 
     def remove_workload(self, wl: wlpkg.Info) -> None:
         """Simulate removal (reference: snapshot.go:39)."""

@@ -45,6 +45,14 @@ class Manager:
     def _new_info(self, wl: api.Workload) -> wlpkg.Info:
         return wlpkg.Info(wl, excluded_resource_prefixes=self.excluded_resource_prefixes)
 
+    def any_strict_fifo(self) -> bool:
+        """True when any CQ uses StrictFIFO: its requeued head must block
+        the queue, so the scheduler may not pop the next cycle's heads
+        before the previous cycle's requeues (pipelined dispatch gate)."""
+        with self._lock:
+            return any(cqh.queueing_strategy == api.STRICT_FIFO
+                       for cqh in self.cluster_queues.values())
+
     # --- ClusterQueues ---
 
     def add_cluster_queue(self, cq: api.ClusterQueue) -> None:

@@ -409,6 +409,100 @@ def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
     return batch
 
 
+# ---------------------------------------------------------------------------
+# Device-resident state: sparse correction encoding + the host mirror
+# ---------------------------------------------------------------------------
+
+def encode_deltas(corrections: dict, topo: Topology):
+    """corrections: {(cq_name, FlavorResource) -> net delta}. Returns the
+    (dq, df, dr, dv, lvl_c, lvl_seg) tuple for kernel.apply_state_deltas,
+    or None when nothing maps onto the topology. Coords are unique per
+    level by construction (aggregation dict + np.unique)."""
+    coords = []
+    for (cq_name, fr), dv in corrections.items():
+        if dv == 0:
+            continue
+        qi = topo.cq_index.get(cq_name)
+        fi = topo.flavor_index.get(fr.flavor)
+        ri = topo.resource_index.get(fr.resource)
+        if qi is None or fi is None or ri is None:
+            continue
+        coords.append((qi, fi, ri, dv))
+    if not coords:
+        return None
+    D = _bucket(len(coords), 8)
+    dq = np.full(D, -1, np.int32)
+    df = np.zeros(D, np.int32)
+    dr = np.zeros(D, np.int32)
+    dv = np.zeros(D, np.int64)
+    arr = np.asarray(coords, np.int64)
+    n = len(coords)
+    dq[:n] = arr[:, 0]
+    df[:n] = arr[:, 1]
+    dr[:n] = arr[:, 2]
+    dv[:n] = arr[:, 3]
+
+    L = topo.cq_chain.shape[1]
+    lvl_c = np.full((L, D, 3), -1, np.int32)
+    lvl_seg = np.full((L, D), -1, np.int32)
+    # level 0 parents: the delta coords' direct cohorts; level l parents:
+    # level l-1's cohort coords' parents. Dedup per level with np.unique.
+    prev_c = np.where(dq >= 0, topo.cq_chain[np.maximum(dq, 0), 0], -1)  # [D]
+    prev_f, prev_r = df, dr
+    for lvl in range(L):
+        valid = prev_c >= 0
+        if not valid.any():
+            break
+        key = (prev_c.astype(np.int64) << 32) | \
+              (prev_f.astype(np.int64) << 16) | prev_r.astype(np.int64)
+        key = np.where(valid, key, np.int64(-1))
+        uniq, inv = np.unique(key, return_inverse=True)
+        off = 1 if uniq[0] == -1 else 0  # drop the invalid bucket
+        m = len(uniq) - off
+        lvl_c[lvl, :m, 0] = (uniq[off:] >> 32).astype(np.int32)
+        lvl_c[lvl, :m, 1] = ((uniq[off:] >> 16) & 0xFFFF).astype(np.int32)
+        lvl_c[lvl, :m, 2] = (uniq[off:] & 0xFFFF).astype(np.int32)
+        lvl_seg[lvl] = np.where(valid, inv - off, -1).astype(np.int32)
+        # next level: parents of this level's unique cohorts
+        prev_c = np.full(D, -1, np.int32)
+        prev_c[:m] = topo.cohort_parent[lvl_c[lvl, :m, 0]]
+        prev_f = np.maximum(lvl_c[lvl, :, 1], 0)
+        prev_r = np.maximum(lvl_c[lvl, :, 2], 0)
+    return dq, df, dr, dv, lvl_c, lvl_seg
+
+
+def apply_deltas_np(topo: Topology, usage: np.ndarray,
+                    cohort_usage: np.ndarray, deltas) -> None:
+    """In-place numpy twin of kernel.apply_state_deltas — keeps the host
+    mirror bit-identical to the device-resident state (the mirror feeds
+    the CPU-backend fit router and the decode path)."""
+    dq, df, dr, dv, lvl_c, lvl_seg = deltas
+    valid = dq >= 0
+    dqs = np.maximum(dq, 0)
+    dvm = np.where(valid, dv, 0)
+    old = usage[dqs, df, dr].copy()
+    np.add.at(usage, (dqs, df, dr), dvm)
+    g = topo.guaranteed[dqs, df, dr]
+    dover = np.maximum(0, old + dvm - g) - np.maximum(0, old - g)
+    D = len(dq)
+    for lvl in range(lvl_c.shape[0]):
+        seg = lvl_seg[lvl]
+        delta_l = np.zeros(D, np.int64)
+        np.add.at(delta_l, np.maximum(seg, 0), np.where(seg >= 0, dover, 0))
+        c = lvl_c[lvl, :, 0]
+        cvalid = c >= 0
+        if not cvalid.any():
+            break
+        cs = np.maximum(c, 0)
+        fs = np.maximum(lvl_c[lvl, :, 1], 0)
+        rs = np.maximum(lvl_c[lvl, :, 2], 0)
+        delta_l = np.where(cvalid, delta_l, 0)
+        oldc = cohort_usage[cs, fs, rs].copy()
+        np.add.at(cohort_usage, (cs, fs, rs), delta_l)
+        gc = topo.cohort_guaranteed[cs, fs, rs]
+        dover = np.maximum(0, oldc + delta_l - gc) - np.maximum(0, oldc - gc)
+
+
 def _eligibility_key(pod_spec) -> tuple:
     """Hashable signature of the pod-spec fields that feed flavor
     eligibility (tolerations, node selector, node affinity)."""

@@ -529,6 +529,13 @@ def preempt_args(batch: PreemptionBatch) -> tuple:
             batch.threshold_active, batch.threshold, batch.has_cohort)
 
 
+# Slots of preempt_args WITHOUT a leading problem axis (the deduplicated
+# cand_usage/cand_prio row tables) — the mesh path replicates these and
+# shards every other slot over problems; keep in lockstep with the tuple
+# above.
+PREEMPT_ARGS_REPLICATED_SLOTS = (9, 10)
+
+
 def decode_targets(batch: PreemptionBatch, targets_mask: np.ndarray,
                    feasible: np.ndarray, snapshot,
                    wl_cq_by_entry: dict) -> dict:

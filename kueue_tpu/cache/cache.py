@@ -215,6 +215,18 @@ class Cache:
         """Raises ValueError on a cycle-inducing parent edge; the quota
         update still lands and both trees stay consistent."""
         with self._lock:
+            existing = self.hm.cohorts.get(cohort.metadata.name)
+            if existing is not None:
+                # No-op re-push guard (reconcilers re-deliver on status
+                # writes): same parent + quotas -> keep the epochs, or
+                # every resync would drop the solver topology + device
+                # residency.
+                parent = (existing.parent.name
+                          if existing.parent is not None else "")
+                if parent == (cohort.spec.parent or "") \
+                        and existing.payload.resource_node.quotas \
+                        == build_quotas(cohort.spec.resource_groups):
+                    return
             self.cohort_epoch += 1
             self._capacity_version += 1
             self.topology_epoch += 1
@@ -393,9 +405,11 @@ class Cache:
         self._capacity_version += 1  # freed capacity invalidates resume state
         return True
 
-    def assume_workload(self, wl: api.Workload) -> None:
+    def assume_workload(self, wl: api.Workload,
+                        info: Optional[wlpkg.Info] = None) -> None:
         """Optimistically account for a workload before the API write
-        (reference: cache.go:546)."""
+        (reference: cache.go:546). `info` (optional) skips re-parsing the
+        admission when the caller just built it (scheduler admit path)."""
         with self._lock:
             key = wlpkg.key(wl)
             if key in self.assumed_workloads:
@@ -405,7 +419,8 @@ class Cache:
             cqc = self.hm.cluster_queues.get(wl.status.admission.cluster_queue)
             if cqc is None:
                 raise KeyError(f"cluster queue {wl.status.admission.cluster_queue} not found")
-            info = self._new_info(wl)
+            if info is None or info.obj is not wl:
+                info = self._new_info(wl)
             cqc.add_workload(info)
             self._journal_usage("add", cqc.name, key,
                                 info.flavor_resource_usage())

@@ -140,6 +140,12 @@ class SolverConfig:
     min_heads: int = 64
     device: str = ""               # "" = default jax backend
     fallback_on_error: bool = True
+    # overlap the decision fetch of cycle N with dispatch of cycle N+1
+    # (all-fit cycles; decisions land one cycle later)
+    pipeline: bool = True
+    # "adaptive": measure admitted/sec per engine and run each cycle on
+    # the faster one; "always"/"never" pin the device/CPU path
+    routing: str = "adaptive"
 
 
 @dataclass

@@ -36,7 +36,7 @@ def queue_key(wl: api.Workload) -> str:
     return f"{wl.metadata.namespace}/{wl.spec.queue_name}"
 
 
-@dataclass
+@dataclass(slots=True)
 class PodSetResources:
     name: str
     requests: dict  # resource -> total quantity for the whole podset
@@ -55,7 +55,7 @@ class PodSetResources:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class AssignmentClusterQueueState:
     """Flavor-fungibility resume state (reference: workload.go /
     flavorassigner LastTriedFlavorIdx)."""
@@ -112,6 +112,29 @@ class Info:
 
     def update(self, wl: api.Workload) -> None:
         self.obj = wl
+
+    @classmethod
+    def from_assignment(cls, wl: api.Workload, cluster_queue: str,
+                        assignment) -> "Info":
+        """Fast path for assume: the scheduler already computed the
+        per-podset requests/flavors (the admission it just wrote came
+        from them), so skip re-parsing the admission. The preset usage
+        cache also guarantees the cache journal entry equals the solver's
+        device-applied usage bit-for-bit."""
+        info = cls.__new__(cls)
+        info.obj = wl
+        info.cluster_queue = cluster_queue
+        info.last_assignment = None
+        info.total_requests = [
+            PodSetResources(
+                name=ps.name,
+                requests=dict(ps.requests),
+                count=ps.count,
+                flavors={res: f.name for res, f in (ps.flavors or {}).items()})
+            for ps in assignment.pod_sets]
+        info._fru_cache = dict(assignment.usage)
+        info._fr_keys_cache = None
+        return info
 
     @property
     def key(self) -> str:
@@ -248,6 +271,17 @@ def unset_quota_reservation_with_condition(wl: api.Workload, reason: str, messag
             observed_generation=wl.metadata.generation), now)
         changed = True
     return changed
+
+
+def pending_patch_needed(wl: api.Workload, reason: str, message: str) -> bool:
+    """Pure predicate: would unset_quota_reservation_with_condition change
+    anything? Lets the requeue path skip the status clone entirely for
+    the (dominant, at scale) already-Pending re-requeue case."""
+    if wl.status.admission is not None or is_admitted(wl):
+        return True
+    cond = find_condition(wl.status.conditions, api.WORKLOAD_QUOTA_RESERVED)
+    return (cond is None or cond.status != "False" or cond.reason != reason
+            or cond.message != message)
 
 
 def set_evicted_condition(wl: api.Workload, reason: str, message: str, now: float) -> None:

@@ -131,6 +131,13 @@ class KueueManager:
             fs_preemption_strategies=self.cfg.fair_sharing.preemption_strategies,
             clock=clock, metrics=self.metrics, solver=solver,
             solver_min_heads=self.cfg.solver.min_heads)
+        if solver is not None:
+            # Production solver wiring: pipelined dispatch + adaptive
+            # engine routing + the persistent compilation cache.
+            self.scheduler.pipeline_enabled = self.cfg.solver.pipeline
+            self.scheduler.solver_routing = self.cfg.solver.routing
+            from kueue_tpu.utils.runtime import enable_compilation_cache
+            enable_compilation_cache()
 
     def _namespace_labels(self, ns: str) -> Optional[dict]:
         obj = self.store.try_get("Namespace", "", ns)

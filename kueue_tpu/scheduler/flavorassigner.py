@@ -45,7 +45,7 @@ def mode_name(mode: int) -> str:
     return {NO_FIT: "NoFit", PREEMPT: "Preempt", FIT: "Fit"}[mode]
 
 
-@dataclass
+@dataclass(slots=True)
 class FlavorAssignment:
     name: str
     mode: int
@@ -53,7 +53,7 @@ class FlavorAssignment:
     borrow: bool = False
 
 
-@dataclass
+@dataclass(slots=True)
 class PodSetAssignmentResult:
     name: str = ""
     flavors: Optional[dict] = None  # resource -> FlavorAssignment
@@ -70,7 +70,7 @@ class PodSetAssignmentResult:
         return min(fa.mode for fa in self.flavors.values())
 
 
-@dataclass
+@dataclass(slots=True)
 class Assignment:
     pod_sets: list = field(default_factory=list)
     borrowing: bool = False

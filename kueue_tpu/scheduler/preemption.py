@@ -208,7 +208,15 @@ class Preemptor:
                          frs_need_preemption: set, candidates: list,
                          allow_borrowing_below_priority: Optional[int]) -> list:
         nominated_cq = snapshot.cluster_queues[wl.cluster_queue]
-        cq_heap = _cq_heap_from_candidates(candidates, False, snapshot)
+        # Determinized heap ties: equal-share CQs pop in order of their
+        # first candidate's position in candidatesOrdering (the reference
+        # leaves ties to binary-heap internals; the device kernel and this
+        # path share this rule so decisions stay bit-comparable).
+        first_pos: dict = {}
+        for i, c in enumerate(candidates):
+            first_pos.setdefault(c.cluster_queue, i)
+        cq_heap = _cq_heap_from_candidates(candidates, False, snapshot,
+                                           first_pos)
         new_nominated_share, _ = nominated_cq.dominant_resource_share_with(requests)
         targets: list = []
         fits = False
@@ -254,7 +262,8 @@ class Preemptor:
                     retry_candidates.append(cand_wl)
 
         if not fits and len(self.fs_strategies) > 1:
-            cq_heap = _cq_heap_from_candidates(retry_candidates, True, snapshot)
+            cq_heap = _cq_heap_from_candidates(retry_candidates, True, snapshot,
+                                               first_pos)
             while len(cq_heap) > 0 and not fits:
                 cand_cq = cq_heap.pop()
                 if self.fs_strategies[1](new_nominated_share, cand_cq.share, 0):
@@ -379,24 +388,32 @@ def queue_under_nominal(frs_need_preemption: set, cq: ClusterQueueSnapshot) -> b
 
 
 class _CandidateCQ:
-    __slots__ = ("cq", "workloads", "share")
+    __slots__ = ("cq", "workloads", "share", "order")
 
-    def __init__(self, cq, workloads, share):
+    def __init__(self, cq, workloads, share, order=0):
         self.cq = cq
         self.workloads = workloads
         self.share = share
+        self.order = order
 
 
 def _cq_heap_from_candidates(candidates: list, first_only: bool,
-                             snapshot: Snapshot) -> Heap:
-    cq_heap: Heap = Heap(key_func=lambda c: c.cq.name,
-                         less_func=lambda a, b: a.share > b.share)
+                             snapshot: Snapshot,
+                             first_pos: Optional[dict] = None) -> Heap:
+    first_pos = first_pos or {}
+    cq_heap: Heap = Heap(
+        key_func=lambda c: c.cq.name,
+        less_func=lambda a, b: (a.share > b.share
+                                or (a.share == b.share
+                                    and a.order < b.order)))
     for cand in candidates:
         existing = cq_heap.get_by_key(cand.cluster_queue)
         if existing is None:
             cq = snapshot.cluster_queues[cand.cluster_queue]
             share, _ = cq.dominant_resource_share()
-            cq_heap.push_or_update(_CandidateCQ(cq, [cand], share))
+            cq_heap.push_or_update(_CandidateCQ(
+                cq, [cand], share,
+                first_pos.get(cand.cluster_queue, 0)))
         elif not first_only:
             existing.workloads.append(cand)
     return cq_heap

@@ -18,6 +18,7 @@ path is the conformance oracle and fallback.
 from __future__ import annotations
 
 import threading
+import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -128,6 +129,15 @@ class Scheduler:
         # Snapshot handed from a pipelined fallback to the sync path when
         # no in-flight cycle was drained in between (still consistent).
         self._fallback_snapshot = None
+        # Adaptive routing (the production config): measure admitted/sec
+        # per mode (pure-CPU cycle vs device cycle) over a sliding window
+        # and run each cycle on the faster one, re-exploring the minority
+        # mode periodically. "always" pins the device path (conformance
+        # suites), "never" pins CPU.
+        self.solver_routing = "always"
+        self._route_stats = {"cpu": [], "device": []}  # (admitted, secs)
+        self._route_explore = 0
+        self._last_cycle_admitted = 0
         # Below this head count the accelerator dispatch overhead exceeds
         # the win; narrow cycles go through the CPU path even with a
         # solver configured (SolverConfig.min_heads; 0 = always solve).
@@ -181,10 +191,14 @@ class Scheduler:
                 return self._drain_pipeline()
             return KeepGoing
         start = self.clock.now()
+        wall0 = _time.perf_counter()
+        route = self._route_mode(heads)
 
-        if self._pipeline_ok(heads):
+        if route == "device" and self._pipeline_ok(heads):
             signal = self._schedule_pipelined(heads, start)
             if signal is not None:
+                self._route_record("device", self._last_cycle_admitted,
+                                   _time.perf_counter() - wall0)
                 return signal
             # Pipeline not applicable this cycle: continue on the
             # synchronous path. When an in-flight cycle was drained the
@@ -205,7 +219,7 @@ class Scheduler:
 
         solver_entries: list = []
         pre_entries: list = []
-        if self.solver is not None and len(heads) >= self.solver_min_heads:
+        if route == "device":
             solver_entries, pre_entries, heads = self._solve_batch(
                 heads, snapshot, timeout)
 
@@ -273,12 +287,17 @@ class Scheduler:
                 e.inadmissible_msg = f"Failed to admit workload: {exc}"
 
         result_success = False
+        admitted_n = 0
         entries = solver_entries + entries
         for e in entries:
             if e.status != ASSUMED:
                 self.requeue_and_update(e)
             else:
                 result_success = True
+                admitted_n += 1
+        if route in ("device", "cpu"):
+            self._route_record(route, admitted_n,
+                               _time.perf_counter() - wall0)
 
         if self.metrics is not None:
             self.metrics.admission_attempt(result_success, self.clock.now() - start)
@@ -303,6 +322,48 @@ class Scheduler:
     #   one in-flight cycle; a mispredicted entry is requeued and the next
     #   cycle runs synchronously (cooldown), where fresh state routes it
     #   to CPU preempt-mode nomination exactly like the sync path.
+
+    # --- adaptive mode routing (the production "routed system") ---
+
+    def _route_mode(self, heads: list) -> str:
+        """Which engine runs this cycle: "device" (solver path, incl.
+        pipelining), "cpu" (adaptively routed to the sequential path), or
+        "cpu-forced" (no solver / narrow cycle — not a routing sample)."""
+        if self.solver is None or len(heads) < self.solver_min_heads \
+                or self.solver_routing == "never":
+            return "cpu-forced"
+        if self.solver_routing != "adaptive":
+            return "device"
+        stats = self._route_stats
+
+        def rate(samples):
+            # Trim the slowest sample: one-off jit compiles land in a
+            # cycle's wall time and would poison the engine's estimate
+            # forever (the compile itself amortizes to zero).
+            if len(samples) >= 4:
+                samples = sorted(samples, key=lambda s: s[1])[:-1]
+            return (sum(a for a, _ in samples)
+                    / max(sum(t for _, t in samples), 1e-9))
+
+        for m in ("device", "cpu"):
+            if len(stats[m]) < 3:
+                return m
+        rates = {m: rate(stats[m]) for m in ("cpu", "device")}
+        best = "device" if rates["device"] >= rates["cpu"] else "cpu"
+        self._route_explore += 1
+        if self._route_explore % 16 == 0:
+            # keep the loser's estimate fresh: the backlog shape drifts
+            return "cpu" if best == "device" else "device"
+        return best
+
+    def _route_record(self, mode: str, admitted, secs: float) -> None:
+        if self.solver_routing != "adaptive" or admitted is None \
+                or mode not in self._route_stats:
+            return
+        lst = self._route_stats[mode]
+        lst.append((admitted, secs))
+        if len(lst) > 8:
+            lst.pop(0)
 
     def _solver_invalidate(self) -> None:
         """Duck-typed: custom solvers without residency just skip this."""
@@ -364,8 +425,24 @@ class Scheduler:
             # drain first and let the sync path rebuild from fresh state.
             self._drain_pipeline()
             return None
+        nofit_entries, nofit_idx = [], set()
+        if (plan is not None and plan.resident and plan.fit_pred is not None
+                and not plan.fit_pred.all()):
+            # Predicted non-fit entries keep the pipeline alive only when
+            # every one of them takes the device-NoFit shortcut (no
+            # preemption possible, no partial admission) — otherwise the
+            # sync path owns the mixed-cycle semantics.
+            for i, w in enumerate(plan.batch.infos):
+                if plan.fit_pred[i]:
+                    continue
+                e = self._device_nofit_entry(w, snapshot)
+                if e is None:
+                    nofit_entries = None
+                    break
+                nofit_entries.append(e)
+                nofit_idx.add(i)
         if (plan is None or not plan.resident or plan.fit_pred is None
-                or not plan.fit_pred.all()):
+                or nofit_entries is None):
             # Mixed/preempt cycle (or no router): the synchronous path
             # owns those semantics — drain and fall through; the sync
             # cycle processes these same popped heads directly. Cooldown
@@ -376,6 +453,15 @@ class Scheduler:
             if not had_inflight:
                 self._fallback_snapshot = snapshot
             return None
+        if len(nofit_idx) == len(plan.batch.infos):
+            # Whole cycle is device-proved NoFit: nothing to dispatch.
+            for e in invalid_entries:
+                self.requeue_and_update(e)
+            for e in nofit_entries:
+                self.requeue_and_update(e)
+            if self._inflight is not None:
+                return self._drain_pipeline()
+            return SlowDown
         try:
             inflight = solver.dispatch(
                 plan, fair_sharing=self.fair_sharing_enabled)
@@ -388,8 +474,11 @@ class Scheduler:
             return None
         for e in invalid_entries:
             self.requeue_and_update(e)
-        prev, self._inflight = self._inflight, (inflight, snapshot)
+        for e in nofit_entries:
+            self.requeue_and_update(e)
+        prev, self._inflight = self._inflight, (inflight, snapshot, nofit_idx)
         if prev is None:
+            self._last_cycle_admitted = None  # not a routing sample
             return KeepGoing  # first pipelined cycle: results next call
         return self._process_inflight(prev, start)
 
@@ -400,14 +489,16 @@ class Scheduler:
         return self._process_inflight(prev, self.clock.now())
 
     def _process_inflight(self, prev, start) -> SpeedSignal:
-        inflight, snapshot = prev
+        inflight, snapshot, nofit_idx = prev
         solver = self.solver
         valid_heads = inflight.plan.batch.infos
         try:
             decisions, _ = solver.collect(inflight, snapshot)
         except Exception:  # noqa: BLE001 — fetch failure: retry the heads
             self._solver_invalidate()
-            for w in valid_heads:
+            for i, w in enumerate(valid_heads):
+                if i in nofit_idx:
+                    continue  # already requeued at dispatch time
                 self.queues.requeue_workload(
                     w, RequeueReason.FAILED_AFTER_NOMINATION)
             self._pipeline_cooldown = 1
@@ -415,6 +506,8 @@ class Scheduler:
         entries = []
         any_nonfit = False
         for i, w in enumerate(valid_heads):
+            if i in nofit_idx:
+                continue  # device-NoFit: requeued at dispatch time
             decision = decisions.get(i)
             e = Entry(info=w)
             if decision is None:
@@ -445,11 +538,14 @@ class Scheduler:
         if any_nonfit:
             self._pipeline_cooldown = 1
         result_success = False
+        admitted_n = 0
         for e in entries:
             if e.status != ASSUMED:
                 self.requeue_and_update(e)
             else:
                 result_success = True
+                admitted_n += 1
+        self._last_cycle_admitted = admitted_n
         if self.metrics is not None:
             self.metrics.admission_attempt(result_success,
                                            self.clock.now() - start)
@@ -499,15 +595,39 @@ class Scheduler:
         else:
             pred_other = [w for i, w in enumerate(valid_heads)
                           if not fit_pred[i]]
-        # fairPreemptions' DRF heap stays on the CPU path; without fair
-        # sharing, preempt-mode target selection is deferred to the device.
-        defer = not self.fair_sharing_enabled
-        pre_entries = self.nominate(pred_other, snapshot,
-                                    defer_preemption=defer)
+        # Device-NoFit shortcut: Phase A already proved these entries
+        # can't fit, and a Never/Never preemption policy (with no partial
+        # admission possible) means the CPU assigner could only restate
+        # NoFit — skip its per-flavor walk entirely. Deviation: the
+        # Pending message is the batch-path generic one instead of the
+        # per-flavor reason list (the resume state is equivalent — a
+        # NoFit walk always ends exhausted, i.e. restart from rank 0).
+        nonfit_total = len(pred_other)
+        nofit_entries = []
+        if pred_other:
+            rest = []
+            for w in pred_other:
+                e = self._device_nofit_entry(w, snapshot)
+                if e is not None:
+                    nofit_entries.append(e)
+                else:
+                    rest.append(w)
+            pred_other = rest
+        # Preempt-mode target selection is deferred to the device —
+        # including fairPreemptions' DRF-heap loop (solver/fairpreempt.py)
+        # — except under a mesh with fair sharing (the sharded execute
+        # carries only the minimal-preemption program).
+        defer = not (self.fair_sharing_enabled
+                     and self.solver.mesh is not None)
+        pre_entries = nofit_entries + self.nominate(pred_other, snapshot,
+                                                    defer_preemption=defer)
         pending = [e for e in pre_entries if e.preemption_targets is None]
         for e in pending:
             e.preemption_targets = []
-        fit_count = (len(valid_heads) - len(pred_other)
+        # NB: count ALL predicted-non-fit entries (incl. the device-NoFit
+        # shortcut set), or an all-NoFit cycle would look like a fit cycle
+        # to the dispatch-skip and preemption work gates.
+        fit_count = (len(valid_heads) - nonfit_total
                      if fit_pred is not None else len(valid_heads))
         pbatch = None
         requests_by, cq_by = {}, {}
@@ -534,32 +654,60 @@ class Scheduler:
                 # x2: build_problems may emit two problems per entry (the
                 # under-nominal reclaim attempt + the same-queue fallback)
                 bound += 2 * sizes[key]
-            if bound * 8.0 <= marginal_sync_us:
+            # fairPreemptions' CPU loop only compares per-CQ share
+            # aggregates (~3us/candidate) vs the minimal preemptor's
+            # per-candidate simulation (~8us net)
+            per_cand_us = 3.0 if self.fair_sharing_enabled else 8.0
+            if bound * per_cand_us <= marginal_sync_us:
                 self._cpu_preempt_targets(pending, snapshot)
                 pending = []
+        fbatch = None
         if pending:
             try:
                 from kueue_tpu.solver.candidates import candidate_index
                 cand_index = candidate_index(snapshot, self.ordering,
                                              self.clock.now())
-                problems, frs_by = [], {}
+                problems, fair_problems, frs_by = [], [], {}
                 for i, e in enumerate(pending):
                     requests_by[i] = e.assignment.total_requests_for(e.info)
                     frs_by[i] = fa.flavor_resources_need_preemption(e.assignment)
                     cq_by[i] = e.info.cluster_queue
-                    problems.extend(devpreempt.build_problems(
-                        i, e.info, requests_by[i], frs_by[i], snapshot,
-                        self.preemptor, cand_index))
+                    if self.fair_sharing_enabled:
+                        from kueue_tpu.solver import fairpreempt
+                        mins, fairs = fairpreempt.build_fair_problems(
+                            i, e.info, requests_by[i], frs_by[i], snapshot,
+                            self.preemptor, cand_index)
+                        problems.extend(mins)
+                        fair_problems.extend(fairs)
+                    else:
+                        problems.extend(devpreempt.build_problems(
+                            i, e.info, requests_by[i], frs_by[i], snapshot,
+                            self.preemptor, cand_index))
                 # Precise work gate: ~8us/candidate net device saving must
                 # cover the marginal sync — zero when fit entries dispatch
                 # anyway (the fused single-chip kernel ships preemption in
                 # the fit execute; the mesh path pays a separate dispatch
                 # either way).
-                total_k = sum(p.num_candidates for p in problems)
-                if problems and total_k * 8.0 > marginal_sync_us:
-                    pbatch = devpreempt.encode_problems(
-                        problems, snapshot, plan.topo, requests_by, cq_by,
-                        frs_by)
+                # Per-candidate CPU cost differs by algorithm: the
+                # minimal preemptor SIMULATES per candidate (~12us, ~8us
+                # net of encode), while fairPreemptions only compares
+                # per-CQ share aggregates (~3us net) — so fair problems
+                # must clear a lower bar before the device pays.
+                total_cost_us = (sum(p.num_candidates for p in problems)
+                                 * 8.0
+                                 + sum(p.num_candidates
+                                       for p in fair_problems) * 3.0)
+                if (problems or fair_problems) \
+                        and total_cost_us > marginal_sync_us:
+                    if problems:
+                        pbatch = devpreempt.encode_problems(
+                            problems, snapshot, plan.topo, requests_by,
+                            cq_by, frs_by)
+                    if fair_problems:
+                        from kueue_tpu.solver import fairpreempt
+                        fbatch = fairpreempt.encode_fair_problems(
+                            fair_problems, snapshot, plan.topo, requests_by,
+                            cq_by, frs_by)
                 else:
                     # Routing decision, not a failure: small simulations
                     # are cheaper on the CPU preemptor.
@@ -567,18 +715,21 @@ class Scheduler:
                     pending = []
             except Exception:  # noqa: BLE001 — encode failure: CPU targets
                 self.preemption_fallbacks += 1
-                pbatch = None
+                pbatch = fbatch = None
                 self._cpu_preempt_targets(pending, snapshot)
                 pending = []
-        if fit_count == 0 and pbatch is None:
+        if fit_count == 0 and pbatch is None and fbatch is None:
             # Nothing needs the device this cycle: no fit-mode entries and
             # preemption resolved on CPU — skip the dispatch entirely.
             return invalid_entries, pre_entries, []
 
         try:
+            from kueue_tpu.solver.fairpreempt import strategy_flags
             decisions, pre = self.solver.solve_prepared(
                 plan, snapshot, preempt_batch=pbatch,
-                fair_sharing=self.fair_sharing_enabled)
+                fair_sharing=self.fair_sharing_enabled,
+                fair_batch=fbatch,
+                fs_flags=strategy_flags(self.preemptor.fs_strategies))
         except Exception:  # noqa: BLE001 — device failure: CPU fallback
             self._solver_invalidate()
             if pending:
@@ -588,9 +739,17 @@ class Scheduler:
                         if fit_pred is None or fit_pred[i]]
             return invalid_entries, pre_entries, pred_fit
 
-        if pre is not None and pbatch is not None:
-            targets_by_entry = devpreempt.decode_targets(
-                pbatch, pre[0], pre[1], snapshot, cq_by)
+        if pre is not None and (pbatch is not None or fbatch is not None):
+            targets_by_entry = {}
+            if pbatch is not None and "preempt" in pre:
+                t, f = pre["preempt"]
+                targets_by_entry.update(devpreempt.decode_targets(
+                    pbatch, t, f, snapshot, cq_by))
+            if fbatch is not None and "fair" in pre:
+                from kueue_tpu.solver import fairpreempt
+                ft, ff, frr = pre["fair"]
+                targets_by_entry.update(fairpreempt.decode_fair_targets(
+                    fbatch, ft, ff, frr, snapshot, cq_by))
             for i, e in enumerate(pending):
                 e.preemption_targets = targets_by_entry.get(i, [])
             self._retry_partial_admission(pending, snapshot)
@@ -633,6 +792,26 @@ class Scheduler:
                 self._solver_note_unapplied(w.key)
             solver_entries.append(e)
         return solver_entries, pre_entries, remaining
+
+    def _device_nofit_entry(self, w: wlpkg.Info,
+                            snapshot: Snapshot) -> Optional[Entry]:
+        """A device-proved non-fit entry whose CQ can never preempt and
+        which can't be partially admitted needs no CPU nomination: the
+        sequential assigner could only restate NoFit. Returns the ready
+        Entry, or None when the CPU path must run (preemption possible /
+        reducer-eligible)."""
+        cq = snapshot.cluster_queues[w.cluster_queue]
+        p = cq.preemption
+        if (p.within_cluster_queue != api.PREEMPTION_NEVER
+                or p.reclaim_within_cohort != api.PREEMPTION_NEVER):
+            return None
+        if features.enabled(features.PARTIAL_ADMISSION) \
+                and w.can_be_partially_admitted():
+            return None
+        e = Entry(info=w)  # empty assignment => representative NO_FIT
+        e.inadmissible_msg = ("couldn't assign flavors: insufficient quota "
+                              "(batched assignment)")
+        return e
 
     def _cpu_preempt_targets(self, pending: list, snapshot: Snapshot) -> None:
         """Fallback / gate routing: resolve deferred preempt-mode entries
@@ -789,7 +968,8 @@ class Scheduler:
         checks = wlpkg.admission_checks_for_workload(new_wl, cq.admission_checks)
         if wlpkg.has_all_checks(new_wl, checks):
             wlpkg.sync_admitted_condition(new_wl, now)
-        self.cache.assume_workload(new_wl)
+        self.cache.assume_workload(new_wl, info=wlpkg.Info.from_assignment(
+            new_wl, e.info.cluster_queue, e.assignment))
         e.status = ASSUMED
 
         def apply():
@@ -835,9 +1015,14 @@ class Scheduler:
             e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
         self.queues.requeue_workload(e.info, e.requeue_reason)
         if e.status in (NOT_NOMINATED, SKIPPED):
-            patch = wlpkg.clone_for_status_update(e.info.obj)
-            if wlpkg.unset_quota_reservation_with_condition(
-                    patch, "Pending", e.inadmissible_msg, self.clock.now()):
+            # Clone only when the Pending condition would actually change:
+            # at scale most cycles re-requeue already-Pending entries and
+            # the per-entry status clone dominated the requeue path.
+            if wlpkg.pending_patch_needed(e.info.obj, "Pending",
+                                          e.inadmissible_msg):
+                patch = wlpkg.clone_for_status_update(e.info.obj)
+                wlpkg.unset_quota_reservation_with_condition(
+                    patch, "Pending", e.inadmissible_msg, self.clock.now())
                 self.client.patch_not_admitted(patch)
             self.client.event(e.info.obj, "Normal", "Pending", e.inadmissible_msg[:1024])
 

@@ -96,6 +96,69 @@ class DomainCandidates:
         self._rows_views: dict = {}
         self._uses_masks: dict = {}
         self._borrowing_masks: dict = {}
+        self._all_frs: Optional[frozenset] = None
+        self._share_views: dict = {}
+
+    def all_frs(self) -> frozenset:
+        """Union of FlavorResources any domain candidate occupies — the
+        fair-share kernel's slot extension (removals must move every fr
+        that feeds dominantResourceShare)."""
+        if self._all_frs is None:
+            out: set = set()
+            for info in self.infos:
+                out.update(info.flavor_resource_keys())
+            self._all_frs = frozenset(out)
+        return self._all_frs
+
+    def share_view(self, slots: tuple) -> dict:
+        """Per-CQ DRF-share constants for the given slot order
+        (clusterqueue.go:503-564 decomposition): borrowing on
+        FlavorResources outside the slots is invariant during a fair
+        scan, so it ships as per-(CQ, slot-resource) constants plus a
+        ratio floor for resources with no slot at all."""
+        view = self._share_views.get(slots)
+        if view is not None:
+            return view
+        Qd = len(self.cq_snaps)
+        RF = max(1, len(slots))
+        base = np.zeros((Qd, RF), np.int64)
+        floor_ratio = np.full(Qd, -1, np.int64)
+        floor_any = np.zeros(Qd, bool)
+        weight = np.asarray([cq.fair_weight for cq in self.cq_snaps],
+                            np.int64)
+        root = (self.cq_snaps[0].cohort.root()
+                if self.cq_snaps and self.cq_snaps[0].cohort is not None
+                else None)
+        lendable_map = (root.resource_node.calculate_lendable()
+                        if root is not None else {})
+        lendable = np.asarray(
+            [lendable_map.get(fr.resource, 0) for fr in slots] or [0],
+            np.int64)
+        slot_set = set(slots)
+        slot_resources = {fr.resource for fr in slots}
+        for qi, cq in enumerate(self.cq_snaps):
+            extra: dict = {}
+            for fr, used in cq.resource_node.usage.items():
+                b = used - cq.quota_for(fr).nominal
+                if b <= 0 or fr in slot_set:
+                    continue
+                extra[fr.resource] = extra.get(fr.resource, 0) + b
+            for r, b in extra.items():
+                if r in slot_resources:
+                    for i, fr in enumerate(slots):
+                        if fr.resource == r:
+                            base[qi, i] = b
+                else:
+                    floor_any[qi] = True
+                    lr = lendable_map.get(r, 0)
+                    if lr > 0:
+                        floor_ratio[qi] = max(floor_ratio[qi],
+                                              b * 1000 // lr)
+        view = {"base_other": base, "floor_ratio": floor_ratio,
+                "floor_any": floor_any, "weight": weight,
+                "lendable": lendable}
+        self._share_views[slots] = view
+        return view
 
     def uses_mask(self, frs: frozenset) -> np.ndarray:
         """[N] bool — workloadUsesResources per candidate."""

@@ -1,0 +1,443 @@
+"""Device-side fairPreemptions (TPU solver v2, final stage).
+
+Replaces the CPU DRF-heap loop (reference:
+pkg/scheduler/preemption/preemption.go:312-437 — pop the max-dominant-
+share ClusterQueue, test the configured strategy against the preemptor's
+and preemptee's shares, remove, re-heap; then the optional second-
+strategy retry pass; then fill-back) with a batched program: every
+fair-preemption entry runs as an independent lane of a vmapped lax.scan,
+composing with the fit solve into the cycle's single device execute.
+
+Share decomposition (the design pinned in solver/preempt.py round 3):
+dominantResourceShare (clusterqueue.go:503-564) for a CQ is
+
+    max over resources r of (borrowed[r] * 1000 // lendable[r])
+        * 1000 // fair_weight
+
+where borrowed[r] sums max(0, usage[fr] - nominal[fr]) over that CQ's
+FlavorResources of resource r. The problem's RF slots carry the
+FlavorResources of the preemptor's request PLUS every FlavorResource any
+domain candidate occupies (DomainCandidates.all_frs), so removals only
+move the slot-carried terms; borrowing on FlavorResources outside the
+slots is constant during the scan and ships as host-encoded per-CQ
+constants:
+
+- base_other[QL, RF]: extra borrowed quantity on slot i's RESOURCE from
+  non-slot FlavorResources (same value on every slot of that resource),
+- floor_ratio[QL] / floor_any[QL]: the share ratio contribution (and
+  borrowing-exists bit) of resources with no slot at all.
+
+Heap-tie determinization: the reference pops equal-share CQs in an
+unspecified binary-heap order; both paths here break ties by the CQ's
+first candidate's position in candidatesOrdering (the CPU heap's
+less_func gets the same tie-break), so decisions are bit-comparable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.core import priority as prioritypkg
+from kueue_tpu.scheduler import preemption as cpu_preempt
+from kueue_tpu.solver.encode import _bucket
+from kueue_tpu.solver.preempt import (
+    PreemptionBatch,
+    PreemptionProblem,
+    make_problem_sim,
+)
+
+MAXSHARE = np.int64(2**62)
+
+# device reason codes -> API reasons (decode)
+_REASONS = (api.IN_CLUSTER_QUEUE_REASON,
+            api.IN_COHORT_FAIR_SHARING_REASON,
+            api.IN_COHORT_RECLAIM_WHILE_BORROWING_REASON)
+
+
+@dataclass
+class FairProblem(PreemptionProblem):
+    """One fairPreemptions run. Slots extend to the domain candidates'
+    FlavorResource union (share math needs every fr a removal touches)."""
+
+    extra_frs: frozenset = frozenset()
+
+
+@dataclass
+class FairBatch(PreemptionBatch):
+    """PreemptionBatch plus the DRF-share machinery."""
+
+    cand_rank: np.ndarray = None     # [B,K] int32 rank within its CQ
+    cq_count: np.ndarray = None      # [B,QL] int32 candidates per CQ
+    cq_order: np.ndarray = None      # [B,QL] int32 first-candidate position
+                                     #   in candidatesOrdering (tie-break)
+    base_other: np.ndarray = None    # [B,QL,RF] int64 non-slot borrowing
+                                     #   on the slot's resource
+    floor_ratio: np.ndarray = None   # [B,QL] int64 ratio of no-slot
+                                     #   resources (-1 = none)
+    floor_any: np.ndarray = None     # [B,QL] bool borrowing exists there
+    weight: np.ndarray = None        # [B,QL] int64 fair weight (milli)
+    lendable: np.ndarray = None      # [B,RF] int64 root lendable per slot
+
+
+def build_fair_problems(entry_idx: int, wl, requests: dict,
+                        frs_need_preemption: set, snapshot,
+                        preemptor, cand_index) -> tuple:
+    """get_targets_internal's dispatch under fair sharing
+    (preemption.go:131-172 with enableFairSharing): all-same-queue
+    entries still run minimalPreemptions; entries with cohort candidates
+    run fairPreemptions. Returns (minimal problems, fair problems)."""
+    cq = snapshot.cluster_queues[wl.cluster_queue]
+    domain = cand_index.domain_for(cq)
+    preemption = cq.preemption
+    wl_prio = prioritypkg.priority(wl.obj)
+    frs = frozenset(frs_need_preemption)
+    sel = domain.select(
+        cq.name, wl_prio,
+        preemptor.ordering.queue_order_timestamp(wl.obj), frs,
+        within_policy=preemption.within_cluster_queue,
+        consider_same_prio=(preemption.within_cluster_queue
+                            == api.PREEMPTION_LOWER_OR_NEWER_EQUAL_PRIORITY),
+        reclaim_policy=preemption.reclaim_within_cohort,
+        only_lower=(preemption.reclaim_within_cohort != api.PREEMPTION_ANY))
+    if sel.size == 0:
+        return [], []
+    qi = domain.cq_index[cq.name]
+    in_cq = domain.cq_of[sel] == qi
+    if bool(in_cq.all()):
+        return [PreemptionProblem(entry_idx, domain, sel,
+                                  allow_borrowing=True)], []
+    borrow_within, threshold = cpu_preempt.can_borrow_within_cohort(cq, wl.obj)
+    fp = FairProblem(entry_idx, domain, sel, allow_borrowing=True,
+                     threshold_active=borrow_within,
+                     threshold=threshold if borrow_within else 0,
+                     extra_frs=domain.all_frs())
+    return [], [fp]
+
+
+def encode_fair_problems(problems: list, snapshot, topo,
+                         requests_by_entry: dict, wl_cq_by_entry: dict,
+                         frs_np_by_entry: dict) -> FairBatch:
+    """Fair problems -> tensors: the PreemptionBatch layout (slots
+    extended by extra_frs) plus per-CQ share constants."""
+    from kueue_tpu.solver.preempt import encode_problems
+    base = encode_problems(problems, snapshot, topo, requests_by_entry,
+                           wl_cq_by_entry, frs_np_by_entry)
+    batch = FairBatch(**{f: getattr(base, f) for f in (
+        "problems", "gq", "gf", "gr", "gc", "chain_local", "requests",
+        "frs_np", "cand_idx", "cand_ql", "cand_usage", "cand_prio",
+        "allow_borrowing", "threshold_active", "threshold", "has_cohort")})
+    B, K = batch.cand_ql.shape
+    QL = batch.gq.shape[1]
+    RF = batch.gf.shape[1]
+    batch.cand_rank = np.full((B, K), -1, np.int32)
+    batch.cq_count = np.zeros((B, QL), np.int32)
+    batch.cq_order = np.full((B, QL), 2**30, np.int32)
+    batch.base_other = np.zeros((B, QL, RF), np.int64)
+    batch.floor_ratio = np.full((B, QL), -1, np.int64)
+    batch.floor_any = np.zeros((B, QL), bool)
+    batch.weight = np.full((B, QL), 1000, np.int64)
+    batch.lendable = np.zeros((B, RF), np.int64)
+
+    for bi, p in enumerate(problems):
+        ql = batch.cand_ql[bi]
+        k = p.num_candidates
+        if k:
+            # rank within CQ + first-appearance order, vectorized
+            q = ql[:k].astype(np.int64)
+            perm = np.argsort(q, kind="stable")
+            sq = q[perm]
+            pos = np.arange(k)
+            first = np.r_[True, sq[1:] != sq[:-1]]
+            seg_start = np.maximum.accumulate(np.where(first, pos, 0))
+            rank = np.empty(k, np.int32)
+            rank[perm] = (pos - seg_start).astype(np.int32)
+            batch.cand_rank[bi, :k] = rank
+            counts = np.bincount(q, minlength=QL)[:QL]
+            batch.cq_count[bi] = counts.astype(np.int32)
+            firsts = np.full(QL, 2**30, np.int64)
+            np.minimum.at(firsts, q, pos)
+            batch.cq_order[bi] = firsts.astype(np.int32)
+
+        domain = p.domain
+        req_frs = frozenset(requests_by_entry[p.entry_idx]) | p.extra_frs
+        slots = domain.rows_view(req_frs).slots
+        sv = domain.share_view(tuple(slots))
+        # local CQ slot ql -> domain CQ index: reconstruct from gq (the
+        # global CQ index), slot 0 = preemptor's CQ, then first appearance
+        name_by_global = {topo.cq_index[n]: n for n in domain.cq_names
+                          if n in topo.cq_index}
+        for lq in range(QL):
+            g = int(batch.gq[bi, lq])
+            if g < 0:
+                continue
+            name = name_by_global.get(g)
+            if name is None:
+                continue
+            di = domain.cq_index[name]
+            nslots = min(RF, sv["base_other"].shape[1])
+            batch.base_other[bi, lq, :nslots] = sv["base_other"][di, :nslots]
+            batch.floor_ratio[bi, lq] = sv["floor_ratio"][di]
+            batch.floor_any[bi, lq] = sv["floor_any"][di]
+            batch.weight[bi, lq] = sv["weight"][di]
+        nslots = min(RF, len(sv["lendable"]))
+        batch.lendable[bi, :nslots] = sv["lendable"][:nslots]
+    return batch
+
+
+def fair_args(batch: FairBatch) -> tuple:
+    return (batch.gq, batch.gf, batch.gr, batch.gc, batch.chain_local,
+            batch.requests, batch.frs_np, batch.cand_idx, batch.cand_ql,
+            batch.cand_usage, batch.cand_prio, batch.threshold_active,
+            batch.threshold, batch.has_cohort, batch.cand_rank,
+            batch.cq_count, batch.cq_order, batch.base_other,
+            batch.floor_ratio, batch.floor_any, batch.weight,
+            batch.lendable)
+
+
+def strategy_flags(fs_strategies: list) -> tuple:
+    """Static (strat0_is_s2a, has_retry, strat1_is_s2a) for the jit."""
+    s0 = fs_strategies[0] is cpu_preempt._strategy_s2a
+    has_retry = len(fs_strategies) > 1
+    s1 = has_retry and fs_strategies[1] is cpu_preempt._strategy_s2a
+    return (bool(s0), bool(has_retry), bool(s1))
+
+
+def solve_fair_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
+                    requests, frs_np, cand_idx, cand_ql, cand_usage_table,
+                    cand_prio_table, threshold_active, threshold, has_cohort,
+                    cand_rank, cq_count, cq_order, base_other, floor_ratio,
+                    floor_any, weight, lendable, strat: tuple):
+    """Batched fairPreemptions. Returns (targets [B,K] bool,
+    feasible [B] bool, reasons [B,K] int8)."""
+    import jax
+    import jax.numpy as jnp
+
+    strat0_s2a, has_retry, strat1_s2a = strat
+
+    def one(gq_b, gf_b, gr_b, gc_b, chain_local_b, req_b, frs_np_b,
+            cand_q_b, cand_usage_b, cand_prio_b, th_act, th, has_cohort_b,
+            rank_b, count_b, order_b, base_b, floor_b, floor_any_b,
+            weight_b, lendable_b):
+        sim = make_problem_sim(topo, usage, cohort_usage, gq_b, gf_b, gr_b,
+                               gc_b, chain_local_b, req_b, has_cohort_b)
+        QL, RF = sim["QL"], sim["RF"]
+        nominal = sim["nominal"]
+        u0, cu0 = sim["u0"], sim["cu0"]
+        chain_oh = sim["chain_oh"]
+        fits = sim["fits"]
+        remove_usage = sim["remove_usage"]
+        add_usage = sim["add_usage"]
+
+        valid_fr = gf_b >= 0
+        # same-resource incidence between slots (for per-resource sums)
+        same_res = (gr_b[:, None] == gr_b[None, :]) \
+            & valid_fr[:, None] & valid_fr[None, :]       # [RF,RF]
+        arange_ql = jnp.arange(QL)
+        valid_q = gq_b >= 0
+
+        def shares(u):
+            """dominantResourceShare per local CQ
+            (clusterqueue.go:503-564). u: [QL,RF]."""
+            borrow_fr = jnp.where(valid_fr[None, :],
+                                  jnp.maximum(0, u - nominal), 0)  # [QL,RF]
+            # per-resource sums via masked reduction (NOT a matmul: XLA's
+            # x64 rewrite can't lower an s64 dot_general on TPU)
+            borrow_res = jnp.sum(
+                jnp.where(same_res[None, :, :], borrow_fr[:, None, :], 0),
+                axis=2) + base_b
+            ratio = jnp.where((borrow_res > 0) & (lendable_b[None, :] > 0),
+                              borrow_res * 1000
+                              // jnp.maximum(lendable_b[None, :], 1),
+                              jnp.int64(-1))
+            drs = jnp.maximum(jnp.max(ratio, axis=1), floor_b)     # [QL]
+            any_b = jnp.any(borrow_res > 0, axis=1) | floor_any_b
+            share = jnp.where(any_b, drs * 1000
+                              // jnp.maximum(weight_b, 1), 0)
+            return jnp.where(weight_b == 0, MAXSHARE, share)
+
+        req_row = jnp.where(arange_ql[:, None] == 0, req_b[None, :], 0)
+
+        def nominated_share(u):
+            """share the preemptor's CQ would have WITH its requests
+            (dominant_resource_share_with, m=1)."""
+            return shares(u + req_row)[0]
+
+        K = cand_q_b.shape[0]
+        arange_k = jnp.arange(K)
+
+        def pick_cq(sh, elig):
+            """Max-share CQ; ties -> earliest first candidate in
+            candidatesOrdering (the determinized heap order)."""
+            m = jnp.max(jnp.where(elig, sh, -MAXSHARE))
+            tie = jnp.where(elig & (sh == m), order_b, 2**30)
+            return jnp.argmin(tie).astype(jnp.int32), jnp.any(elig)
+
+        # --- main DRF-heap loop: one candidate per step ---
+        def fwd(carry, t):
+            u, cu, pos, active, retry, targets, reason, step_of, done = carry
+            sh = shares(u)
+            # a CQ with no candidates left can never be popped (the CPU
+            # heap only ever holds CQs with candidates) — without this, a
+            # zero-candidate max-share preemptor CQ would stall the scan
+            qstar, any_elig = pick_cq(sh, active & valid_q
+                                      & (pos < count_b))
+            any_elig &= ~done
+            q_oh = arange_ql == qstar                      # [QL]
+            pos_q = jnp.sum(jnp.where(q_oh, pos, 0))
+            k_oh = (cand_q_b == qstar) & (rank_b == pos_q)  # [K]
+            k_valid = jnp.any(k_oh) & any_elig
+            cand_u = jnp.sum(jnp.where(k_oh[:, None], cand_usage_b, 0),
+                             axis=0)                       # [RF]
+            cand_p = jnp.sum(jnp.where(k_oh, cand_prio_b, 0))
+            own = qstar == 0
+
+            nom_share = nominated_share(u)
+            u_wo = u - jnp.where(q_oh[:, None], cand_u[None, :], 0)
+            new_cand_share = jnp.sum(jnp.where(q_oh, shares(u_wo), 0))
+            old_share = jnp.sum(jnp.where(q_oh, sh, 0))
+            if strat0_s2a:   # LessThanOrEqualToFinalShare (S2-a)
+                strat_ok = nom_share <= new_cand_share
+            else:            # LessThanInitialShare (S2-b)
+                strat_ok = nom_share < old_share
+            below = th_act & (cand_p < th)
+            passed = own | below | strat_ok
+            do = k_valid & passed
+
+            q_chain_oh = jnp.any(q_oh[:, None, None] & chain_oh, axis=0)
+            u, cu = remove_usage(u, cu, q_oh, q_chain_oh,
+                                 jnp.where(do, cand_u, 0))
+            targets = targets | (k_oh & do)
+            # reason: own -> InClusterQueue; strategy -> FairSharing;
+            # below-threshold only -> ReclaimWhileBorrowing
+            code = jnp.where(own, jnp.int8(0),
+                             jnp.where(strat_ok, jnp.int8(1), jnp.int8(2)))
+            reason = jnp.where(k_oh & do, code, reason)
+            step_of = jnp.where(k_oh & do, t, step_of)
+            retry = retry | (k_oh & k_valid & ~passed)
+            pos = pos + jnp.where(q_oh & k_valid, 1, 0)
+            exhausted_q = jnp.sum(jnp.where(q_oh, pos - count_b, 0)) >= 0
+            u_q = jnp.sum(jnp.where(q_oh[:, None], u, 0), axis=0)
+            nom_q = jnp.sum(jnp.where(q_oh[:, None], nominal, 0), axis=0)
+            borrowing_q = jnp.any(frs_np_b & (u_q > nom_q))
+            keep = jnp.where(own, ~exhausted_q,
+                             jnp.where(do, ~exhausted_q & borrowing_q,
+                                       ~exhausted_q))
+            active = jnp.where(q_oh & k_valid, keep, active)
+            done = done | (do & fits(u, cu, True))
+            return (u, cu, pos, active, retry, targets, reason, step_of,
+                    done), None
+
+        init = (u0, cu0, jnp.zeros(QL, jnp.int32),
+                jnp.ones(QL, bool), jnp.zeros(K, bool), jnp.zeros(K, bool),
+                jnp.zeros(K, jnp.int8), jnp.full(K, -1, jnp.int32),
+                jnp.zeros((), bool))
+        (u, cu, pos, active, retry, targets, reason, step_of, done), _ = \
+            jax.lax.scan(fwd, init, jnp.arange(K, dtype=jnp.int32))
+
+        # --- retry pass: second strategy, first retry candidate per CQ,
+        # shares fixed at pass entry (preemption.go:412-431) ---
+        if has_retry:
+            sh_r = shares(u)
+            nom_r = nominated_share(u)
+            BIGR = jnp.int32(2**30)
+            min_rank = jnp.min(
+                jnp.where(retry[:, None]
+                          & (cand_q_b[:, None] == arange_ql[None, :]),
+                          rank_b[:, None], BIGR), axis=0)  # [QL]
+            has_retry_q = min_rank < BIGR
+
+            def retry_step(carry, t):
+                u, cu, processed, targets, reason, step_of, done = carry
+                elig = has_retry_q & ~processed & valid_q
+                qstar, any_elig = pick_cq(sh_r, elig)
+                any_elig &= ~done
+                q_oh = arange_ql == qstar
+                k_oh = retry & (cand_q_b == qstar) \
+                    & (rank_b == jnp.sum(jnp.where(q_oh, min_rank, 0)))
+                if strat1_s2a:
+                    strat_ok = nom_r <= 0
+                else:
+                    strat_ok = nom_r < jnp.sum(jnp.where(q_oh, sh_r, 0))
+                do = any_elig & strat_ok & jnp.any(k_oh)
+                cand_u = jnp.sum(jnp.where(k_oh[:, None], cand_usage_b, 0),
+                                 axis=0)
+                q_chain_oh = jnp.any(q_oh[:, None, None] & chain_oh, axis=0)
+                u, cu = remove_usage(u, cu, q_oh, q_chain_oh,
+                                     jnp.where(do, cand_u, 0))
+                targets = targets | (k_oh & do)
+                reason = jnp.where(k_oh & do, jnp.int8(1), reason)
+                step_of = jnp.where(k_oh & do, K + t, step_of)
+                processed = processed | (q_oh & any_elig)
+                done = done | (do & fits(u, cu, True))
+                return (u, cu, processed, targets, reason, step_of,
+                        done), None
+
+            (u, cu, _, targets, reason, step_of, done), _ = jax.lax.scan(
+                retry_step, (u, cu, jnp.zeros(QL, bool), targets, reason,
+                             step_of, done),
+                jnp.arange(QL, dtype=jnp.int32))
+
+        total_steps = K + (QL if has_retry else 0)
+
+        # no fit => no targets (preemption.go:433-436)
+        feasible = done
+        targets = targets & feasible
+
+        # --- fill-back in reverse REMOVAL order, skipping the fit-maker
+        # (fill_back_workloads, preemption.go:445-457) ---
+        last_step = jnp.max(jnp.where(targets, step_of, -1))
+
+        def back(carry, s):
+            u, cu = carry
+            k_oh = targets & (step_of == s)
+            consider = jnp.any(k_oh) & (s != last_step)
+            cand_u = jnp.where(consider,
+                               jnp.sum(jnp.where(k_oh[:, None],
+                                                 cand_usage_b, 0), axis=0), 0)
+            qstar = jnp.sum(jnp.where(k_oh, cand_q_b, 0))
+            q_oh = arange_ql == qstar
+            q_chain_oh = jnp.any(q_oh[:, None, None] & chain_oh, axis=0)
+            u2, cu2 = add_usage(u, cu, q_oh, q_chain_oh, cand_u)
+            still = fits(u2, cu2, True)
+            keep_back = consider & still
+            u = jnp.where(keep_back, u2, u)
+            cu = jnp.where(keep_back, cu2, cu)
+            return (u, cu), k_oh & keep_back
+
+        steps_desc = jnp.arange(total_steps - 1, -1, -1, dtype=jnp.int32)
+        (_, _), kept = jax.lax.scan(back, (u, cu), steps_desc)
+        targets = targets & ~jnp.any(kept, axis=0)
+        return targets, feasible, reason
+
+    cand_q = cand_ql.astype(jnp.int32)
+    cand_usage = cand_usage_table[cand_idx]
+    cand_prio = cand_prio_table[cand_idx]
+    return jax.vmap(one)(gq, gf, gr, gc, chain_local, requests, frs_np,
+                         cand_q, cand_usage, cand_prio, threshold_active,
+                         threshold, has_cohort, cand_rank, cq_count,
+                         cq_order, base_other, floor_ratio, floor_any,
+                         weight, lendable)
+
+
+def decode_fair_targets(batch: FairBatch, targets_mask: np.ndarray,
+                        feasible: np.ndarray, reasons: np.ndarray,
+                        snapshot, wl_cq_by_entry: dict) -> dict:
+    """entry_idx -> list[Target] (one fair problem per entry)."""
+    out: dict = {}
+    for bi, p in enumerate(batch.problems):
+        ei = p.entry_idx
+        if not feasible[bi]:
+            out.setdefault(ei, [])
+            continue
+        targets = []
+        k = p.num_candidates
+        hit = np.flatnonzero(targets_mask[bi, :k])
+        for ki in hit.tolist():
+            cand = p.domain.infos[p.sel[ki]]
+            targets.append(cpu_preempt.Target(
+                cand, _REASONS[int(reasons[bi, ki])]))
+        out[ei] = targets
+    return out

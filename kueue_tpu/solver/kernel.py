@@ -465,29 +465,41 @@ def solve_cycle_with_preempt_impl(topo, usage, cohort_usage, requests,
                                   eligible, solvable, preempt_args: tuple,
                                   num_podsets: int, max_rank: int,
                                   fair_sharing: bool = False,
-                                  start_rank=None):
+                                  start_rank=None, fair_preempt_args=None,
+                                  fs_strategies: tuple = ()):
     """Mixed admission + preemption cycle as ONE device program: the fused
     fit solve plus the batched preemption target selection
-    (preempt.solve_preempt_impl) against the same pre-cycle state.
-    Preemption simulates against pre-cycle usage exactly like the
-    reference's nominate-time GetTargets (scheduler.go:404-441) — it does
-    NOT see this cycle's fit admissions, so both sub-programs are
-    independent and compile into a single execute: one device sync per
-    cycle, the dominant cost over a tunneled TPU link."""
+    (preempt.solve_preempt_impl, and fairpreempt.solve_fair_impl for
+    fair-sharing entries) against the same pre-cycle state. Preemption
+    simulates against pre-cycle usage exactly like the reference's
+    nominate-time GetTargets (scheduler.go:404-441) — it does NOT see
+    this cycle's fit admissions, so the sub-programs are independent and
+    compile into a single execute: one device sync per cycle, the
+    dominant cost over a tunneled TPU link."""
     from kueue_tpu.solver.preempt import solve_preempt_impl
     out = solve_cycle_fused_impl(
         topo, usage, cohort_usage, requests, podset_active, wl_cq, priority,
         timestamp, eligible, solvable, num_podsets=num_podsets,
         max_rank=max_rank, fair_sharing=fair_sharing, start_rank=start_rank)
-    targets, feasible = solve_preempt_impl(topo, usage, cohort_usage,
-                                           *preempt_args)
-    out["preempt_targets"] = targets
-    out["preempt_feasible"] = feasible
+    if preempt_args is not None:
+        targets, feasible = solve_preempt_impl(topo, usage, cohort_usage,
+                                               *preempt_args)
+        out["preempt_targets"] = targets
+        out["preempt_feasible"] = feasible
+    if fair_preempt_args is not None:
+        from kueue_tpu.solver.fairpreempt import solve_fair_impl
+        ft, ff, frs = solve_fair_impl(topo, usage, cohort_usage,
+                                      *fair_preempt_args,
+                                      strat=fs_strategies)
+        out["fair_targets"] = ft
+        out["fair_feasible"] = ff
+        out["fair_reasons"] = frs
     return out
 
 
 solve_cycle_with_preempt = partial(
-    jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing"))(
+    jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing",
+                              "fs_strategies"))(
     solve_cycle_with_preempt_impl)
 
 
@@ -650,16 +662,18 @@ def solve_cycle_resident_impl(topo, usage, cohort_usage, deltas, requests,
                               podset_active, wl_cq, priority, timestamp,
                               eligible, solvable, num_podsets: int,
                               max_rank: int, fair_sharing: bool = False,
-                              start_rank=None, preempt_args=None):
+                              start_rank=None, preempt_args=None,
+                              fair_preempt_args=None,
+                              fs_strategies: tuple = ()):
     """The device-resident production cycle: sparse correction prologue +
-    the fused fit solve (+ the batched preemption program when present),
+    the fused fit solve (+ the batched preemption programs when present),
     all ONE device program. usage/cohort_usage stay on device across
     cycles — the per-cycle host->device payload is the workload batch and
     the correction coords only."""
     if deltas is not None:
         usage, cohort_usage = apply_state_deltas_impl(
             topo, usage, cohort_usage, *deltas)
-    if preempt_args is None:
+    if preempt_args is None and fair_preempt_args is None:
         return solve_cycle_fused_impl(
             topo, usage, cohort_usage, requests, podset_active, wl_cq,
             priority, timestamp, eligible, solvable,
@@ -669,11 +683,13 @@ def solve_cycle_resident_impl(topo, usage, cohort_usage, deltas, requests,
         topo, usage, cohort_usage, requests, podset_active, wl_cq,
         priority, timestamp, eligible, solvable, preempt_args,
         num_podsets=num_podsets, max_rank=max_rank,
-        fair_sharing=fair_sharing, start_rank=start_rank)
+        fair_sharing=fair_sharing, start_rank=start_rank,
+        fair_preempt_args=fair_preempt_args, fs_strategies=fs_strategies)
 
 
 solve_cycle_resident = partial(
-    jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing"))(
+    jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing",
+                              "fs_strategies"))(
     solve_cycle_resident_impl)
 
 

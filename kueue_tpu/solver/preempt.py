@@ -28,27 +28,10 @@ Device side (the hot loop):
   cycle pays a single device sync — the dominant cost over a tunneled
   TPU link.
 
-Fair-sharing preemption (fairPreemptions' DRF heap) stays on the CPU
-path; the scheduler routes preempt-mode entries to the CPU preemptor
-when fair sharing is enabled (correctness is covered by the fair-sharing
-differential suites through the solver-configured scheduler).
-
-Device formulation for the DRF-heap loop (next round): the greedy
-"pop max-share CQ, test strategy, remove its head candidate, recompute
-shares" loop (preemption.go:312-437) is a K-step scan like the minimal
-preemptor, with two additions per problem:
-- per local CQ, the share state decomposes as
-  share(cq) = max_r((base_borrow_other[r] + borrow_carried[r]) * 1000
-              // lendable[r]) * 1000 // fair_weight,
-  where base_borrow_other[r] (host-encoded constant) is the CQ's
-  borrowing on FlavorResources NOT carried in the problem's RF slots —
-  removals only change borrow_carried, which the kernel already tracks
-  as usage minus nominal over the carried slots;
-- each scan step picks argmax-share CQ (a dense [QL] reduction), applies
-  the strategy predicate (S2-a: preemptorNewShare <= preempteeNewShare,
-  S2-b: < preempteeOldShare — both pure share comparisons), and the
-  existing one-hot remove_usage. The second-pass S2-b retry becomes a
-  second scan over the retry mask, and fill-back is unchanged.
+Fair-sharing preemption (fairPreemptions' DRF-heap loop,
+preemption.go:312-437) runs on device too — solver/fairpreempt.py builds
+on this module's problem encoding and simulation toolkit
+(make_problem_sim) and composes into the same single execute.
 """
 
 from __future__ import annotations
@@ -169,8 +152,12 @@ def encode_problems(problems: list, snapshot, topo, requests_by_entry: dict,
     gathers over the problem's index selection, and the batch-level table
     is a concatenation of the domain tables with offsets."""
     B = _bucket(max(1, len(problems)), 1)
-    RF = _bucket(max(max((len(requests_by_entry[p.entry_idx]) for p in problems),
-                         default=1), 1))
+    # Fair problems extend their slots past the request's FlavorResources
+    # (extra_frs: the frs candidate removals move — share math needs them)
+    RF = _bucket(max(max(
+        (len(frozenset(requests_by_entry[p.entry_idx])
+             | getattr(p, "extra_frs", frozenset())) for p in problems),
+        default=1), 1))
     K = _bucket(max(max((p.num_candidates for p in problems), default=1), 1))
 
     batch = PreemptionBatch(problems=list(problems))
@@ -204,13 +191,13 @@ def encode_problems(problems: list, snapshot, topo, requests_by_entry: dict,
         frs_np = frs_np_by_entry[ei]
         preemptor_cq = wl_cq_by_entry[ei]
         domain = p.domain
-        req_frs = frozenset(requests)
+        req_frs = frozenset(requests) | getattr(p, "extra_frs", frozenset())
         rows = domain.rows_view(req_frs)
 
         for i, fr in enumerate(rows.slots):
             batch.gf[bi, i] = flavor_index.get(fr.flavor, -1)
             batch.gr[bi, i] = resource_index.get(fr.resource, 0)
-            batch.requests[bi, i] = requests[fr]
+            batch.requests[bi, i] = requests.get(fr, 0)
             batch.frs_np[bi, i] = fr in frs_np
 
         okey = (id(domain), req_frs)
@@ -299,6 +286,129 @@ def _localize_cohorts(batch: PreemptionBatch, topo) -> None:
 # Device kernel (global index space; composes with the fit solve)
 # --------------------------------------------------------------------------
 
+def make_problem_sim(topo, usage, cohort_usage, gq_b, gf_b, gr_b, gc_b,
+                     chain_local_b, req_b, has_cohort_b):
+    """Per-problem simulation toolkit shared by the minimal and fair
+    preemption kernels: quota-plane gathers projected onto the problem's
+    (CQ, FlavorResource) slots, plus fits / remove_usage / add_usage
+    closures implementing the reference's resource_node math
+    (resource_node.go:89-143) with dense one-hot arithmetic (dynamic
+    scatters under vmap x scan lower catastrophically on TPU)."""
+    import jax.numpy as jnp
+
+    NOLIM = 2**61
+    QL = gq_b.shape[0]
+    RF = gf_b.shape[0]
+    CL = gc_b.shape[0]
+    valid_fr = gf_b >= 0
+    gf_s = jnp.maximum(gf_b, 0)
+    q_s = jnp.maximum(gq_b, 0)                       # [QL]
+
+    def plane(t):
+        return jnp.where(valid_fr[None, :], t[q_s][:, gf_s, gr_b], 0)
+
+    nominal = plane(topo["nominal"])
+    guaranteed = plane(topo["guaranteed"])
+    borrow_limit = jnp.where(valid_fr[None, :],
+                             topo["borrow_limit"][q_s][:, gf_s, gr_b],
+                             NOLIM)
+    u0 = plane(usage)
+    chain = chain_local_b                            # [QL,DC] local ids
+    DC = chain.shape[1]
+    chain_oh = (chain[:, :, None] == jnp.arange(CL)[None, None, :]) \
+        & (chain >= 0)[:, :, None]                   # [QL,DC,CL]
+
+    gc_s = jnp.maximum(gc_b, 0)
+    valid_c = (gc_b >= 0)[:, None] & valid_fr[None, :]
+
+    def cplane(t, fill=0):
+        return jnp.where(valid_c, t[gc_s][:, gf_s, gr_b], fill)
+
+    c_subtree = cplane(topo["cohort_subtree"])
+    c_guar = cplane(topo["cohort_guaranteed"])
+    c_bl = cplane(topo["cohort_borrow_limit"], NOLIM)
+    cu0 = cplane(cohort_usage)
+
+    def oh_rows(oh, t):
+        """oh [C] bool one-hot, t [C,RF] -> t[c] as [RF] dense."""
+        return jnp.sum(jnp.where(oh[:, None], t, 0), axis=0)
+
+    def avail_cq0(u, cu):
+        """available() for local CQ 0 (the preemptor's), walking its
+        cohort chain (reference: resource_node.go:89-104)."""
+        parent = jnp.zeros(RF, jnp.int64)
+        started = jnp.zeros((), bool)
+        for d in range(DC - 1, -1, -1):
+            oh = chain_oh[0, d]                      # [C]
+            ok = jnp.any(oh)
+            cuc = oh_rows(oh, cu)
+            sub = oh_rows(oh, c_subtree)
+            gua = oh_rows(oh, c_guar)
+            bl = jnp.sum(jnp.where(oh[:, None], c_bl, 0), axis=0)
+            root_avail = sub - cuc
+            local = jnp.maximum(0, gua - cuc)
+            cap = (sub - gua) - jnp.maximum(0, cuc - gua) \
+                + jnp.minimum(bl, NOLIM // 4)
+            child = local + jnp.minimum(parent, cap)
+            new = jnp.where(started, child, root_avail)
+            parent = jnp.where(ok, new, parent)
+            started = started | ok
+        local0 = jnp.maximum(0, guaranteed[0] - u[0])
+        cap0 = (nominal[0] - guaranteed[0]) \
+            - jnp.maximum(0, u[0] - guaranteed[0]) \
+            + jnp.minimum(borrow_limit[0], NOLIM // 4)
+        with_cohort = local0 + jnp.minimum(parent, cap0)
+        return jnp.where(has_cohort_b, with_cohort, nominal[0] - u[0])
+
+    def fits(u, cu, ab):
+        """workload_fits (reference: preemption.go:576-585)."""
+        has_req = req_b > 0
+        avail = avail_cq0(u, cu)
+        borrow_ok = ab | jnp.all(~has_req | (u[0] + req_b <= nominal[0]))
+        return borrow_ok & jnp.all(~has_req | (req_b <= avail))
+
+    def remove_usage(u, cu, q_oh, q_chain_oh, val):
+        """removeUsage bubbling (reference: resource_node.go:133-143),
+        dense: q_oh [QL] one-hot CQ row, q_chain_oh [DC,C] its chain."""
+        guar_q = jnp.sum(jnp.where(q_oh[:, None], guaranteed, 0), axis=0)
+        u_q = jnp.sum(jnp.where(q_oh[:, None], u, 0), axis=0)
+        stored = u_q - guar_q                        # pre-removal
+        u = u - jnp.where(q_oh[:, None], val[None, :], 0)
+        delta = jnp.minimum(val, jnp.maximum(0, stored))
+        for d in range(DC):
+            oh = q_chain_oh[d]                       # [C]
+            ok = jnp.any(oh) & jnp.any(delta > 0)
+            stored_c = oh_rows(oh, cu) - oh_rows(oh, c_guar)
+            dd = jnp.where(ok, delta, 0)
+            cu = cu - jnp.where(oh[:, None], dd[None, :], 0)
+            delta = jnp.minimum(dd, jnp.maximum(0, stored_c))
+        return u, cu
+
+    def add_usage(u, cu, q_oh, q_chain_oh, val):
+        """addUsage bubbling (reference: resource_node.go:121-131)."""
+        guar_q = jnp.sum(jnp.where(q_oh[:, None], guaranteed, 0), axis=0)
+        u_q = jnp.sum(jnp.where(q_oh[:, None], u, 0), axis=0)
+        local_avail = jnp.maximum(0, guar_q - u_q)
+        u = u + jnp.where(q_oh[:, None], val[None, :], 0)
+        delta = jnp.maximum(0, val - local_avail)
+        for d in range(DC):
+            oh = q_chain_oh[d]
+            ok = jnp.any(oh)
+            local_c = jnp.maximum(0, oh_rows(oh, c_guar) - oh_rows(oh, cu))
+            dd = jnp.where(ok, delta, 0)
+            cu = cu + jnp.where(oh[:, None], dd[None, :], 0)
+            delta = jnp.where(ok, jnp.maximum(0, dd - local_c), delta)
+        return u, cu
+
+    return {
+        "QL": QL, "RF": RF, "CL": CL, "DC": DC,
+        "nominal": nominal, "guaranteed": guaranteed,
+        "borrow_limit": borrow_limit, "u0": u0, "cu0": cu0,
+        "chain_oh": chain_oh, "oh_rows": oh_rows, "avail_cq0": avail_cq0,
+        "fits": fits, "remove_usage": remove_usage, "add_usage": add_usage,
+    }
+
+
 def solve_preempt_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
                        requests, frs_np, cand_idx, cand_ql,
                        cand_usage_table, cand_prio_table,
@@ -325,114 +435,15 @@ def solve_preempt_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
     def one(gq_b, gf_b, gr_b, gc_b, chain_local_b, req_b, frs_np_b,
             cand_q_b, cand_usage_b, cand_prio_b, ab0, th_act, th,
             has_cohort_b):
-        QL = gq_b.shape[0]
-        RF = gf_b.shape[0]
-        CL = gc_b.shape[0]
-        valid_fr = gf_b >= 0
-        gf_s = jnp.maximum(gf_b, 0)
-        q_s = jnp.maximum(gq_b, 0)                       # [QL]
-
-        # gathers: [QL,RF] quota planes projected onto this problem's frs
-        def plane(t):
-            return jnp.where(valid_fr[None, :], t[q_s][:, gf_s, gr_b], 0)
-
-        nominal = plane(topo["nominal"])
-        guaranteed = plane(topo["guaranteed"])
-        borrow_limit = jnp.where(valid_fr[None, :],
-                                 topo["borrow_limit"][q_s][:, gf_s, gr_b],
-                                 NOLIM)
-        u0 = plane(usage)
-        chain = chain_local_b                            # [QL,DC] local ids
-        DC = chain.shape[1]
-        # one-hot chain masks, built once: dynamic-index scatters/gathers
-        # under vmap x scan lower catastrophically on TPU, so every
-        # per-candidate update below is dense one-hot arithmetic instead
-        chain_oh = (chain[:, :, None] == jnp.arange(CL)[None, None, :]) \
-            & (chain >= 0)[:, :, None]                   # [QL,DC,CL]
-
-        # cohort planes [CL,RF]: this problem's cohorts x its frs
-        gc_s = jnp.maximum(gc_b, 0)
-        valid_c = (gc_b >= 0)[:, None] & valid_fr[None, :]
-
-        def cplane(t, fill=0):
-            return jnp.where(valid_c, t[gc_s][:, gf_s, gr_b], fill)
-
-        c_subtree = cplane(topo["cohort_subtree"])
-        c_guar = cplane(topo["cohort_guaranteed"])
-        c_bl = cplane(topo["cohort_borrow_limit"], NOLIM)
-        cu0 = cplane(cohort_usage)
-
-        def oh_rows(oh, t):
-            """oh [C] bool one-hot, t [C,RF] -> t[c] as [RF] dense."""
-            return jnp.sum(jnp.where(oh[:, None], t, 0), axis=0)
-
-        def avail_cq0(u, cu):
-            """available() for local CQ 0 (the preemptor's), walking its
-            cohort chain (reference: resource_node.go:89-104). chain[0]'s
-            levels use precomputed one-hot masks chain_oh[0]."""
-            parent = jnp.zeros(RF, jnp.int64)
-            started = jnp.zeros((), bool)
-            for d in range(DC - 1, -1, -1):
-                oh = chain_oh[0, d]                      # [C]
-                ok = jnp.any(oh)
-                cuc = oh_rows(oh, cu)
-                sub = oh_rows(oh, c_subtree)
-                gua = oh_rows(oh, c_guar)
-                bl = jnp.sum(jnp.where(oh[:, None], c_bl, 0), axis=0)
-                root_avail = sub - cuc
-                local = jnp.maximum(0, gua - cuc)
-                cap = (sub - gua) - jnp.maximum(0, cuc - gua) \
-                    + jnp.minimum(bl, NOLIM // 4)
-                child = local + jnp.minimum(parent, cap)
-                new = jnp.where(started, child, root_avail)
-                parent = jnp.where(ok, new, parent)
-                started = started | ok
-            local0 = jnp.maximum(0, guaranteed[0] - u[0])
-            cap0 = (nominal[0] - guaranteed[0]) \
-                - jnp.maximum(0, u[0] - guaranteed[0]) \
-                + jnp.minimum(borrow_limit[0], NOLIM // 4)
-            with_cohort = local0 + jnp.minimum(parent, cap0)
-            return jnp.where(has_cohort_b, with_cohort, nominal[0] - u[0])
-
-        def fits(u, cu, ab):
-            """workload_fits (reference: preemption.go:576-585)."""
-            has_req = req_b > 0
-            avail = avail_cq0(u, cu)
-            borrow_ok = ab | jnp.all(~has_req | (u[0] + req_b <= nominal[0]))
-            return borrow_ok & jnp.all(~has_req | (req_b <= avail))
-
-        def remove_usage(u, cu, q_oh, q_chain_oh, val):
-            """removeUsage bubbling (reference: resource_node.go:133-143),
-            dense: q_oh [QL] one-hot CQ row, q_chain_oh [DC,C] its chain."""
-            guar_q = jnp.sum(jnp.where(q_oh[:, None], guaranteed, 0), axis=0)
-            u_q = jnp.sum(jnp.where(q_oh[:, None], u, 0), axis=0)
-            stored = u_q - guar_q                        # pre-removal
-            u = u - jnp.where(q_oh[:, None], val[None, :], 0)
-            delta = jnp.minimum(val, jnp.maximum(0, stored))
-            for d in range(DC):
-                oh = q_chain_oh[d]                       # [C]
-                ok = jnp.any(oh) & jnp.any(delta > 0)
-                stored_c = oh_rows(oh, cu) - oh_rows(oh, c_guar)
-                dd = jnp.where(ok, delta, 0)
-                cu = cu - jnp.where(oh[:, None], dd[None, :], 0)
-                delta = jnp.minimum(dd, jnp.maximum(0, stored_c))
-            return u, cu
-
-        def add_usage(u, cu, q_oh, q_chain_oh, val):
-            """addUsage bubbling (reference: resource_node.go:121-131)."""
-            guar_q = jnp.sum(jnp.where(q_oh[:, None], guaranteed, 0), axis=0)
-            u_q = jnp.sum(jnp.where(q_oh[:, None], u, 0), axis=0)
-            local_avail = jnp.maximum(0, guar_q - u_q)
-            u = u + jnp.where(q_oh[:, None], val[None, :], 0)
-            delta = jnp.maximum(0, val - local_avail)
-            for d in range(DC):
-                oh = q_chain_oh[d]
-                ok = jnp.any(oh)
-                local_c = jnp.maximum(0, oh_rows(oh, c_guar) - oh_rows(oh, cu))
-                dd = jnp.where(ok, delta, 0)
-                cu = cu + jnp.where(oh[:, None], dd[None, :], 0)
-                delta = jnp.where(ok, jnp.maximum(0, dd - local_c), delta)
-            return u, cu
+        sim = make_problem_sim(topo, usage, cohort_usage, gq_b, gf_b, gr_b,
+                               gc_b, chain_local_b, req_b, has_cohort_b)
+        QL = sim["QL"]
+        nominal = sim["nominal"]
+        u0, cu0 = sim["u0"], sim["cu0"]
+        chain_oh = sim["chain_oh"]
+        fits = sim["fits"]
+        remove_usage = sim["remove_usage"]
+        add_usage = sim["add_usage"]
 
         K = cand_q_b.shape[0]
         arange_ql = jnp.arange(QL)

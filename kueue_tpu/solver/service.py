@@ -84,6 +84,7 @@ class InFlight:
         self.result = result          # device array dict (not fetched)
         self.keys = keys
         self.preempt_batch = preempt_batch
+        self.fair_batch = None
         self.future = None            # background fetch, when started
         self.t_dispatch = None
 
@@ -238,6 +239,15 @@ class BatchSolver:
                 and self._reconcile(snapshot, topo):
             state = encode.State(usage=rs.mirror_usage,
                                  cohort_usage=rs.mirror_cohort)
+            if len(rs.device_backlog) > 512:
+                # A huge correction set (mass completions) would mint a
+                # fresh delta-shape bucket — and each new bucket is a
+                # multi-second remote compile. The mirror IS device state
+                # + backlog, so re-upload it wholesale instead (fixed
+                # shape, ~1MB at the north-star size).
+                rs.usage_dev = None
+                rs.cohort_dev = None
+                rs.device_backlog = {}
             if rs.usage_dev is None:
                 # Not dispatched yet: the establishing upload ships the
                 # (already-corrected) mirror itself — shipping the backlog
@@ -367,10 +377,13 @@ class BatchSolver:
         return fit[:batch.n]
 
     def solve_prepared(self, plan: Plan, snapshot: Snapshot,
-                       preempt_batch=None, fair_sharing: bool = False):
-        """Dispatch the cycle (fit solve, plus the preemption batch when
+                       preempt_batch=None, fair_sharing: bool = False,
+                       fair_batch=None, fs_flags: tuple = ()):
+        """Dispatch the cycle (fit solve, plus the preemption batches when
         present, as ONE device program), sync once, decode. Returns
-        (decisions dict, (targets_mask, feasible) or None)."""
+        (decisions dict, aux) where aux is None or
+        {"preempt": (targets, feasible), "fair": (targets, feasible,
+        reasons)}."""
         topo, topo_dev, state, batch = (plan.topo, plan.topo_dev,
                                         plan.state, plan.batch)
         start_rank = plan.start_rank
@@ -400,6 +413,8 @@ class BatchSolver:
             # Preemption is FUSED into the sharded execute (the preempt
             # program replicates across the mesh while Phase A shards over
             # workloads): one dispatch, one sync (VERDICT r3 weak #6).
+            # Fair-sharing preemption stays on the CPU path under a mesh
+            # (the scheduler routes it there).
             result = solve_cycle_sharded(self.mesh, topo_dev, state, batch,
                                          self.max_podsets,
                                          fair_sharing=fair_sharing,
@@ -410,19 +425,21 @@ class BatchSolver:
                 keys += ["preempt_targets", "preempt_feasible"]
             fetched = jax.device_get({k: result[k] for k in keys
                                       if k in result})
-            pre = None
+            aux = None
             if preempt_batch is not None:
-                pre = (np.asarray(fetched["preempt_targets"]),
-                       np.asarray(fetched["preempt_feasible"]))
+                aux = {"preempt": (np.asarray(fetched["preempt_targets"]),
+                                   np.asarray(fetched["preempt_feasible"]))}
             return (self._decode_batch(entries, snapshot, topo, batch,
-                                       fetched), pre)
+                                       fetched), aux)
 
         inflight = self.dispatch(plan, preempt_batch=preempt_batch,
-                                 fair_sharing=fair_sharing)
+                                 fair_sharing=fair_sharing,
+                                 fair_batch=fair_batch, fs_flags=fs_flags)
         return self.collect(inflight, snapshot)
 
     def dispatch(self, plan: Plan, preempt_batch=None,
-                 fair_sharing: bool = False) -> InFlight:
+                 fair_sharing: bool = False, fair_batch=None,
+                 fs_flags: tuple = ()) -> InFlight:
         """Dispatch the single-chip cycle WITHOUT fetching. The returned
         InFlight's outputs are device references; collect() (or a
         background fetch via start_fetch()) brings the decisions home.
@@ -439,6 +456,10 @@ class BatchSolver:
         if preempt_batch is not None:
             from kueue_tpu.solver import preempt as devpreempt
             pargs = devpreempt.preempt_args(preempt_batch)
+        fargs = None
+        if fair_batch is not None:
+            from kueue_tpu.solver import fairpreempt
+            fargs = fairpreempt.fair_args(fair_batch)
 
         # Identity check: the plan must have been built on the CURRENT
         # ResidentState — after an invalidate + re-establish, a stale
@@ -458,7 +479,8 @@ class BatchSolver:
                 batch.priority, batch.timestamp, batch.eligible,
                 batch.solvable, num_podsets=self.max_podsets,
                 max_rank=max_rank, fair_sharing=fair_sharing,
-                start_rank=start_rank, preempt_args=pargs)
+                start_rank=start_rank, preempt_args=pargs,
+                fair_preempt_args=fargs, fs_strategies=fs_flags)
             rs.usage_dev = result["usage"]
             rs.cohort_dev = result["cohort_usage"]
             if plan.deltas is not None and plan.backlog_gen == rs.backlog_gen:
@@ -466,7 +488,7 @@ class BatchSolver:
                 rs.backlog_gen += 1
         else:
             plan.resident = False
-            if pargs is None:
+            if pargs is None and fargs is None:
                 result = solve_cycle_fused(
                     topo_dev, state.usage, state.cohort_usage,
                     batch.requests, batch.podset_active, batch.wl_cq,
@@ -481,11 +503,14 @@ class BatchSolver:
                     batch.priority, batch.timestamp, batch.eligible,
                     batch.solvable, pargs,
                     num_podsets=self.max_podsets, max_rank=max_rank,
-                    fair_sharing=fair_sharing, start_rank=start_rank)
+                    fair_sharing=fair_sharing, start_rank=start_rank,
+                    fair_preempt_args=fargs, fs_strategies=fs_flags)
 
         keys = ["admitted", "fit", "chosen", "borrows", "chosen_borrow"]
         if preempt_batch is not None:
             keys += ["preempt_targets", "preempt_feasible"]
+        if fair_batch is not None:
+            keys += ["fair_targets", "fair_feasible", "fair_reasons"]
         batch_np = (batch.requests, batch.podset_active, batch.wl_cq,
                     batch.priority, batch.timestamp, batch.eligible,
                     batch.solvable)
@@ -501,8 +526,11 @@ class BatchSolver:
             up += state.usage.nbytes + state.cohort_usage.nbytes
         if pargs is not None:
             up += sum(np.asarray(a).nbytes for a in pargs)
+        if fargs is not None:
+            up += sum(np.asarray(a).nbytes for a in fargs)
         self.last_upload_bytes = up
         inflight = InFlight(plan, result, keys, preempt_batch)
+        inflight.fair_batch = fair_batch
         inflight.t_dispatch = time.perf_counter()
         return inflight
 
@@ -534,17 +562,22 @@ class BatchSolver:
             self._observe_sync((time.perf_counter() - t0) * 1e3)
         self.last_fetch_bytes = sum(
             np.asarray(v).nbytes for v in fetched.values())
-        pre = None
+        aux = None
         if inflight.preempt_batch is not None:
-            pre = (np.asarray(fetched["preempt_targets"]),
-                   np.asarray(fetched["preempt_feasible"]))
+            aux = {"preempt": (np.asarray(fetched["preempt_targets"]),
+                               np.asarray(fetched["preempt_feasible"]))}
+        if getattr(inflight, "fair_batch", None) is not None:
+            aux = aux or {}
+            aux["fair"] = (np.asarray(fetched["fair_targets"]),
+                           np.asarray(fetched["fair_feasible"]),
+                           np.asarray(fetched["fair_reasons"]))
         # Mirror/pending updates only apply when the plan's ResidentState
         # is still the live one (not invalidated+re-established since).
         resident_ok = plan.resident and plan.rs is self._resident
         decisions = self._decode_batch(plan.batch.infos, snapshot, plan.topo,
                                        plan.batch, fetched,
                                        resident=resident_ok)
-        return decisions, pre
+        return decisions, aux
 
     def solve(self, snapshot: Snapshot, entries: list,
               fair_sharing: bool = False) -> dict:
@@ -606,12 +639,19 @@ class BatchSolver:
         else:
             tried = np.zeros_like(rank)
 
-        chosen_l = chosen.tolist()
+        # Flavor names resolved for the whole batch in one fancy-indexed
+        # gather (the per-row Python lookups dominated decode time).
+        fname_grid = np.asarray(topo.flavors, dtype=object)[fi_safe]  # [M,P,R]
+        fname_l = fname_grid.tolist()
         tried_l = tried.tolist()
+        chosen_neg = (chosen < 0).tolist()
         borrows_l = borrows.tolist()
         admitted_l = admitted.tolist()
-        flavor_names = topo.flavors
         resource_index = topo.resource_index
+        FlavorAssignmentC = fa.FlavorAssignment
+        PodSetResultC = fa.PodSetAssignmentResult
+        AssignmentC = fa.Assignment
+        StateC = wlpkg.AssignmentClusterQueueState
 
         # last_state generations per CQ, read fresh per cycle: the cohort
         # generation is the cache's global capacity version, which moves
@@ -631,8 +671,8 @@ class BatchSolver:
                         cq.cohort.allocatable_resource_generation
                         if cq.cohort else 0)
                 gen_cache[info.cluster_queue] = gens
-            assignment = fa.Assignment(borrowing=bool(borrows_l[row]))
-            assignment.last_state = wlpkg.AssignmentClusterQueueState(
+            assignment = AssignmentC(borrowing=bool(borrows_l[row]))
+            assignment.last_state = StateC(
                 cluster_queue_generation=gens[0], cohort_generation=gens[1])
             covers_pods = topo.covers_pods[batch.wl_cq[wi]]
             usage = assignment.usage
@@ -640,24 +680,24 @@ class BatchSolver:
                 reqs = dict(psr.requests)
                 if covers_pods:
                     reqs[RESOURCE_PODS] = psr.count
-                chosen_p = chosen_l[row][pi]
+                fname_p = fname_l[row][pi]
+                neg_p = chosen_neg[row][pi]
                 tried_p = tried_l[row][pi]
                 flavors = {}
                 flavor_idx = {}
                 for r, v in reqs.items():
                     ri = resource_index[r]
-                    fi = chosen_p[ri]
-                    if v > 0 and fi < 0:
+                    if v > 0 and neg_p[ri]:
                         raise AssertionError(
                             "solver admitted workload without flavor")
-                    fname = flavor_names[fi] if fi >= 0 else flavor_names[0]
+                    fname = fname_p[ri]
                     t = tried_p[ri]
-                    flavors[r] = fa.FlavorAssignment(name=fname, mode=fa.FIT,
-                                                     tried_flavor_idx=t)
+                    flavors[r] = FlavorAssignmentC(name=fname, mode=fa.FIT,
+                                                   tried_flavor_idx=t)
                     flavor_idx[r] = t
                     fr = FlavorResource(fname, r)
                     usage[fr] = usage.get(fr, 0) + v
-                assignment.pod_sets.append(fa.PodSetAssignmentResult(
+                assignment.pod_sets.append(PodSetResultC(
                     name=psr.name, flavors=flavors, requests=reqs,
                     count=psr.count))
                 assignment.last_state.last_tried_flavor_idx.append(flavor_idx)

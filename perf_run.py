@@ -58,8 +58,9 @@ def run_mode(label, scale, solver, config="default"):
 
 
 def main():
-    from kueue_tpu.utils.runtime import tune_gc
+    from kueue_tpu.utils.runtime import enable_compilation_cache, tune_gc
     tune_gc()  # manager-binary GC profile (applies to every measured mode)
+    enable_compilation_cache()  # amortize remote compiles across runs
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--out", default=None)

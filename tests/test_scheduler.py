@@ -55,13 +55,14 @@ class FakeClient(SchedulerClient):
 
 
 class Env:
-    def __init__(self, fair_sharing=False):
+    def __init__(self, fair_sharing=False, fs_strategies=None):
         self.clock = FakeClock(1000.0)
         self.cache = Cache()
         self.queues = Manager(clock=self.clock)
         self.client = FakeClient()
         self.scheduler = Scheduler(self.queues, self.cache, self.client,
-                                   clock=self.clock, fair_sharing_enabled=fair_sharing)
+                                   clock=self.clock, fair_sharing_enabled=fair_sharing,
+                                   fs_preemption_strategies=fs_strategies)
 
     def add_flavor(self, name, labels=None, taints=None):
         self.cache.add_or_update_resource_flavor(make_flavor(name, labels, taints))

@@ -19,8 +19,8 @@ from tests.test_scheduler import Env
 from tests.wrappers import ClusterQueueWrapper, WorkloadWrapper, flavor_quotas, make_local_queue
 
 
-def build_env(setup, solver=False, fair_sharing=False):
-    env = Env(fair_sharing=fair_sharing)
+def build_env(setup, solver=False, fair_sharing=False, fs_strategies=None):
+    env = Env(fair_sharing=fair_sharing, fs_strategies=fs_strategies)
     if solver:
         env.scheduler.solver = BatchSolver()
         env.scheduler.solver_min_heads = 0  # force the solver path
@@ -715,12 +715,12 @@ class TestResidentState:
         fail_once = {"left": 1}
         orig_assume = env.cache.assume_workload
 
-        def flaky_assume(wl):
+        def flaky_assume(wl, info=None):
             from kueue_tpu.core import workload as wlpkg
             if wlpkg.key(wl) == "default/w0" and fail_once["left"]:
                 fail_once["left"] -= 1
                 raise RuntimeError("injected assume failure")
-            return orig_assume(wl)
+            return orig_assume(wl, info=info)
 
         env.cache.assume_workload = flaky_assume
         for i in range(3):

@@ -189,6 +189,182 @@ class Workload:
     KIND = "Workload"
 
 
+def _clone_meta(m: ObjectMeta) -> ObjectMeta:
+    from dataclasses import replace as _r
+    return ObjectMeta(
+        name=m.name, namespace=m.namespace, uid=m.uid,
+        generation=m.generation, resource_version=m.resource_version,
+        creation_timestamp=m.creation_timestamp,
+        deletion_timestamp=m.deletion_timestamp,
+        labels=dict(m.labels), annotations=dict(m.annotations),
+        finalizers=list(m.finalizers),
+        owner_references=[_r(o) for o in m.owner_references])
+
+
+def _clone_flavor_usage(lst: list) -> list:
+    return [FlavorUsage(name=f.name,
+                        resources=[ResourceUsage(name=r.name, total=r.total,
+                                                 borrowed=r.borrowed)
+                                   for r in f.resources])
+            for f in lst]
+
+
+def clone_cluster_queue(cq: "ClusterQueue") -> "ClusterQueue":
+    """Hand-rolled deep copy (see clone_workload): ClusterQueues carry up
+    to 16 FlavorQuotas x resources in spec plus the same again in status
+    usage lists — generic deepcopy of one costs more than a whole
+    scheduling decision at the 2k-CQ scale."""
+    from dataclasses import replace as _r
+    from kueue_tpu.api.meta import LabelSelector, LabelSelectorRequirement
+    s = cq.spec
+    sel = s.namespace_selector
+    if sel is not None:
+        sel = LabelSelector(
+            match_labels=dict(sel.match_labels),
+            match_expressions=[LabelSelectorRequirement(
+                key=e.key, operator=e.operator, values=list(e.values))
+                for e in sel.match_expressions])
+    pre = s.preemption
+    pre = ClusterQueuePreemption(
+        reclaim_within_cohort=pre.reclaim_within_cohort,
+        borrow_within_cohort=(_r(pre.borrow_within_cohort)
+                              if pre.borrow_within_cohort is not None
+                              else None),
+        within_cluster_queue=pre.within_cluster_queue)
+    st = cq.status
+    return ClusterQueue(
+        metadata=_clone_meta(cq.metadata),
+        spec=ClusterQueueSpec(
+            resource_groups=[ResourceGroup(
+                covered_resources=list(rg.covered_resources),
+                flavors=[FlavorQuotas(name=fq.name,
+                                      resources=[_r(q) for q in fq.resources])
+                         for fq in rg.flavors])
+                for rg in s.resource_groups],
+            cohort=s.cohort,
+            queueing_strategy=s.queueing_strategy,
+            namespace_selector=sel,
+            flavor_fungibility=_r(s.flavor_fungibility),
+            preemption=pre,
+            admission_checks=list(s.admission_checks),
+            admission_checks_strategy=[
+                AdmissionCheckStrategyRule(name=r.name,
+                                           on_flavors=list(r.on_flavors))
+                for r in s.admission_checks_strategy],
+            fair_sharing=(_r(s.fair_sharing)
+                          if s.fair_sharing is not None else None),
+            stop_policy=s.stop_policy),
+        status=ClusterQueueStatus(
+            conditions=[_r(c) for c in st.conditions],
+            flavors_reservation=_clone_flavor_usage(st.flavors_reservation),
+            flavors_usage=_clone_flavor_usage(st.flavors_usage),
+            pending_workloads=st.pending_workloads,
+            reserving_workloads=st.reserving_workloads,
+            admitted_workloads=st.admitted_workloads,
+            fair_sharing_weighted_share=st.fair_sharing_weighted_share))
+
+
+def clone_local_queue(lq: "LocalQueue") -> "LocalQueue":
+    """Hand-rolled deep copy (see clone_workload)."""
+    from dataclasses import replace as _r
+    st = lq.status
+    return LocalQueue(
+        metadata=_clone_meta(lq.metadata),
+        spec=LocalQueueSpec(cluster_queue=lq.spec.cluster_queue,
+                            stop_policy=lq.spec.stop_policy),
+        status=LocalQueueStatus(
+            conditions=[_r(c) for c in st.conditions],
+            pending_workloads=st.pending_workloads,
+            reserving_workloads=st.reserving_workloads,
+            admitted_workloads=st.admitted_workloads,
+            flavors_reservation=_clone_flavor_usage(st.flavors_reservation),
+            flavors_usage=_clone_flavor_usage(st.flavors_usage)))
+
+
+def clone_workload(wl: Workload) -> Workload:
+    """Hand-rolled deep copy of a Workload: semantically identical to
+    copy.deepcopy but ~10x faster (no memo bookkeeping / reflection).
+    Workloads are the store's hottest kind — every reconciler read and
+    every status write copies one, which dominated the control-plane
+    profile at the 50k-workload scale. Field lists mirror the dataclasses
+    above; tests pin equality against copy.deepcopy."""
+    from dataclasses import replace as _r
+    from kueue_tpu.api.corev1 import (
+        Affinity, Container, NodeAffinity, NodeSelector,
+        NodeSelectorRequirement, NodeSelectorTerm, PodSpec, PodTemplateSpec)
+
+    def clone_pod_spec(s):
+        aff = s.affinity
+        if aff is not None:
+            na = aff.node_affinity
+            if na is not None and na.required is not None:
+                req = NodeSelector(node_selector_terms=[
+                    NodeSelectorTerm(match_expressions=[
+                        NodeSelectorRequirement(key=e.key, operator=e.operator,
+                                                values=list(e.values))
+                        for e in t.match_expressions])
+                    for t in na.required.node_selector_terms])
+                na = NodeAffinity(required=req)
+            elif na is not None:
+                na = NodeAffinity(required=None)
+            aff = Affinity(node_affinity=na)
+        return PodSpec(
+            containers=[Container(name=c.name, requests=dict(c.requests),
+                                  limits=dict(c.limits))
+                        for c in s.containers],
+            init_containers=[Container(name=c.name, requests=dict(c.requests),
+                                       limits=dict(c.limits))
+                             for c in s.init_containers],
+            node_selector=dict(s.node_selector),
+            tolerations=[_r(t) for t in s.tolerations],
+            affinity=aff,
+            priority_class_name=s.priority_class_name,
+            priority=s.priority,
+            scheduling_gates=list(s.scheduling_gates),
+            restart_policy=s.restart_policy,
+            overhead=dict(s.overhead))
+
+    st = wl.status
+    return Workload(
+        metadata=_clone_meta(wl.metadata),
+        spec=WorkloadSpec(
+            pod_sets=[PodSet(name=ps.name,
+                             template=PodTemplateSpec(
+                                 labels=dict(ps.template.labels),
+                                 annotations=dict(ps.template.annotations),
+                                 spec=clone_pod_spec(ps.template.spec)),
+                             count=ps.count, min_count=ps.min_count)
+                      for ps in wl.spec.pod_sets],
+            queue_name=wl.spec.queue_name,
+            priority_class_name=wl.spec.priority_class_name,
+            priority=wl.spec.priority,
+            priority_class_source=wl.spec.priority_class_source,
+            active=wl.spec.active),
+        status=WorkloadStatus(
+            conditions=[_r(c) for c in st.conditions],
+            admission=(Admission(
+                cluster_queue=st.admission.cluster_queue,
+                pod_set_assignments=[
+                    PodSetAssignment(name=a.name, flavors=dict(a.flavors),
+                                     resource_usage=dict(a.resource_usage),
+                                     count=a.count)
+                    for a in st.admission.pod_set_assignments])
+                if st.admission is not None else None),
+            requeue_state=(_r(st.requeue_state)
+                           if st.requeue_state is not None else None),
+            reclaimable_pods=[_r(p) for p in st.reclaimable_pods],
+            admission_checks=[AdmissionCheckState(
+                name=s.name, state=s.state, message=s.message,
+                last_transition_time=s.last_transition_time,
+                pod_set_updates=[PodSetUpdate(
+                    name=u.name, labels=dict(u.labels),
+                    annotations=dict(u.annotations),
+                    node_selector=dict(u.node_selector),
+                    tolerations=[_r(t) for t in u.tolerations])
+                    for u in s.pod_set_updates])
+                for s in st.admission_checks]))
+
+
 # --- ClusterQueue (reference: clusterqueue_types.go) ---
 
 @dataclass

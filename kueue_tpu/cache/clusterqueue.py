@@ -96,6 +96,9 @@ class ClusterQueueCache:
         self.workloads_not_ready: set = set()
         self.admitted_usage: dict = {}  # FlavorResource -> int (Admitted=True only)
         self.admitted_workloads_count = 0
+        # monotonic: bumped on every usage-moving mutation (cheap status
+        # change-detection for the CQ/LQ reconcilers at scale)
+        self.usage_version = 0
         self.allocatable_resource_generation = 0
         self.cohort: Optional[CohortCache] = None
         self.missing_flavors: list = []
@@ -192,6 +195,7 @@ class ClusterQueueCache:
         self.allocatable_resource_generation += 1
 
     def _update_usage(self, info: wlpkg.Info, sign: int) -> None:
+        self.usage_version += 1
         usage = info.flavor_resource_usage()
         for fr, q in usage.items():
             if sign > 0:
@@ -206,6 +210,7 @@ class ClusterQueueCache:
         lq_key = wlpkg.queue_key(info.obj)
         lq = self.local_queues.get(lq_key)
         if lq is not None:
+            lq.version += 1
             for fr, q in usage.items():
                 lq.usage[fr] = lq.usage.get(fr, 0) + sign * q
                 if admitted:
@@ -224,6 +229,7 @@ class LocalQueueUsage:
     admitted_usage: dict = field(default_factory=dict)
     reserving_workloads: int = 0
     admitted_workloads: int = 0
+    version: int = 0  # bumped on every mutation (change detection)
 
 
 def admission_checks_map(spec: api.ClusterQueueSpec) -> dict:

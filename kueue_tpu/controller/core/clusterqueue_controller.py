@@ -36,6 +36,7 @@ class ClusterQueueReconciler:
         self.metrics = metrics
         self.report_resource_metrics = report_resource_metrics
         self.snapshot_max_count = snapshot_max_count
+        self._last_sig: dict = {}  # cq name -> last written status inputs
 
     def reconcile(self, key: str):
         cq = self.store.try_get("ClusterQueue", "", key, copy_object=False)
@@ -61,6 +62,18 @@ class ClusterQueueReconciler:
         if cqc is None:
             return None
 
+        # Cheap change signature: skip rebuilding the full 32-flavor
+        # status object (and its no-op update_status compare) when the
+        # inputs are unchanged — at scale most CQ reconciles are fan-out
+        # echoes of unrelated admissions.
+        sig = (cq.metadata.resource_version,
+               self.queues.pending(key),
+               cqc.usage_version,
+               cqc.active)
+        if self._last_sig.get(key) == sig:
+            self.queues.update_snapshot(key, self.snapshot_max_count)
+            return None
+        self._last_sig[key] = sig
         # status (reference: :334-449)
         reservation_usage, admitted_usage = self.cache.usage_for_cluster_queue(key)
         status_obj = _copy.copy(cq)
@@ -135,6 +148,7 @@ class ClusterQueueReconciler:
         elif event == DELETED:
             self.cache.delete_cluster_queue(name)
             self.queues.delete_cluster_queue(name)
+            self._last_sig.pop(name, None)
             if self.metrics:
                 self.metrics.clear_cluster_queue_metrics(name)
             return
@@ -142,9 +156,15 @@ class ClusterQueueReconciler:
             if cq.metadata.deletion_timestamp is not None:
                 # terminating: cache flips status so no new admissions
                 self.cache.terminate_cluster_queue(name)
-            self.cache.update_cluster_queue(cq)
-            self.queues.update_cluster_queue(
-                cq, spec_updated=old is None or old.spec != cq.spec)
+            # Status-subresource writes share the stored spec object
+            # (store.update_status copies only status), so an identity
+            # check skips the cache/queue spec re-ingest for the CQ
+            # reconciler's own counter refreshes — the dominant CQ event
+            # class at scale.
+            if old is None or old.spec is not cq.spec:
+                self.cache.update_cluster_queue(cq)
+                self.queues.update_cluster_queue(
+                    cq, spec_updated=old is None or old.spec != cq.spec)
         enqueue(name)
 
 

@@ -28,6 +28,7 @@ class LocalQueueReconciler:
         self.recorder = recorder
         self.clock = clock
         self.metrics = metrics
+        self._last_sig: dict = {}  # lq key -> last written status inputs
 
     def reconcile(self, key: str):
         namespace, name = key.split("/", 1)
@@ -35,14 +36,27 @@ class LocalQueueReconciler:
                                 copy_object=False)
         if lq is None:
             return None
+        cq = self.store.try_get("ClusterQueue", "", lq.spec.cluster_queue,
+                                copy_object=False)
+        # Cheap change signature: most LQ reconciles at scale are fan-out
+        # echoes of unrelated events — skip the full status rebuild (and
+        # its no-op update_status compare) when the inputs are unchanged.
+        # The CQ's resourceVersion covers spec changes (the flavor usage
+        # rows are built from cq.spec).
+        usage0 = self.cache.local_queue_usage(lq)
+        sig = (lq.metadata.resource_version,
+               cq.metadata.resource_version if cq is not None else None,
+               self.queues.pending_workloads_in_local_queue(key),
+               self.cache.cluster_queue_active(lq.spec.cluster_queue),
+               usage0.version if usage0 is not None else None)
+        if self._last_sig.get(key) == sig:
+            return None
+        self._last_sig[key] = sig
         status_obj = _copy.copy(lq)
         status_obj.status = api.LocalQueueStatus(
             conditions=[_copy.copy(c) for c in lq.status.conditions])
         lq = status_obj
         now = self.clock.now()
-
-        cq = self.store.try_get("ClusterQueue", "", lq.spec.cluster_queue,
-                                copy_object=False)
         if lq.spec.stop_policy != api.STOP_POLICY_NONE:
             cond = Condition(type=api.LOCAL_QUEUE_ACTIVE, status="False",
                              reason="Stopped", message="LocalQueue is stopped",
@@ -95,6 +109,7 @@ class LocalQueueReconciler:
         elif event == DELETED:
             self.queues.delete_local_queue(lq)
             self.cache.delete_local_queue(lq)
+            self._last_sig.pop(key, None)
             return
         else:
             if old is not None and old.spec.cluster_queue != lq.spec.cluster_queue:

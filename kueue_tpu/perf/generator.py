@@ -69,24 +69,30 @@ def default_generator_config() -> list:
 
 
 def north_star_generator_config() -> list:
-    """BASELINE.json config #5 scale: 50,000 pending workloads across
+    """BASELINE.json config #5 scale: 50,000 PENDING workloads across
     2,000 ClusterQueues (250 cohorts x 8 CQs); combine with
-    generate(num_flavors=32) for the 32-ResourceFlavor axis. Per CQ:
-    18 small + 5 medium + 2 large = 25 workloads, arriving in a burst
-    (short intervals) so the pending set genuinely reaches tens of
-    thousands — the regime the batched solver was built for
-    (extrapolated from default_generator_config.yaml:1-28 per
-    BASELINE.md)."""
+    generate(num_flavors=32) for the 32-ResourceFlavor axis.
+
+    Quotas are sized the way the reference's harness sizes them
+    (default_generator_config.yaml:1-28: only a fraction of standing
+    demand fits at once): every workload arrives in a burst at t~0, per
+    CQ the 16-flavor window carries 1 unit of quota per flavor (16 units
+    of capacity) against 36 units of demand (18 small x1 + 5 medium x2 +
+    2 large x4), so a STANDING backlog of tens of thousands drains only
+    as completions free capacity — class time-to-admission and CQ usage
+    are non-zero and priority-ordered, and admissions assign at real
+    flavor-list depth (quota per flavor is one small workload, so the
+    sequential assigner walks deep while the batched solve stays flat)."""
     return [CohortClass(class_name="cohort", count=250, queues_sets=[
         QueueClass(
-            class_name="cq", count=8, nominal_quota=20, borrowing_limit=100,
+            class_name="cq", count=8, nominal_quota=1, borrowing_limit=8,
             workloads_sets=[
-                WorkloadSet(count=18, creation_interval_ms=100, workloads=[
+                WorkloadSet(count=18, creation_interval_ms=2, workloads=[
                     WorkloadClass("small", runtime_ms=200, priority=50, request=1)]),
-                WorkloadSet(count=5, creation_interval_ms=500, workloads=[
-                    WorkloadClass("medium", runtime_ms=500, priority=100, request=5)]),
-                WorkloadSet(count=2, creation_interval_ms=1200, workloads=[
-                    WorkloadClass("large", runtime_ms=1000, priority=200, request=20)]),
+                WorkloadSet(count=5, creation_interval_ms=2, workloads=[
+                    WorkloadClass("medium", runtime_ms=500, priority=100, request=2)]),
+                WorkloadSet(count=2, creation_interval_ms=2, workloads=[
+                    WorkloadClass("large", runtime_ms=1000, priority=200, request=4)]),
             ])])]
 
 

@@ -133,8 +133,14 @@ class Runner:
                 cqc = mgr.cache.cluster_queue(cq.metadata.name)
                 if cqc is None:
                     continue
-                nominal = cq.spec.resource_groups[0].flavors[0].resources[0].nominal_quota
-                used = cqc.resource_node.usage.get((FLAVOR, RESOURCE), 0)
+                # total across the CQ's flavor window (the 32-flavor
+                # north-star shape spreads quota over many flavors)
+                rg = cq.spec.resource_groups[0]
+                nominal = sum(rq.nominal_quota for fq in rg.flavors
+                              for rq in fq.resources
+                              if rq.name == RESOURCE)
+                used = sum(v for fr, v in cqc.resource_node.usage.items()
+                           if fr.resource == RESOURCE)
                 cls = load.cq_class[cq.metadata.name]
                 per_class.setdefault(cls, []).append(
                     100.0 * min(used, nominal) / nominal if nominal else 0.0)
@@ -172,7 +178,9 @@ class Runner:
                         mgr.store.update(wl)
                         result.finished += 1
             mgr.run_until_idle(max_iterations=10_000_000)
-            # schedule until this instant's admissions are exhausted
+            # schedule until this instant's admissions are exhausted; a
+            # pipelined dispatch admits one cycle late, so keep going
+            # while a cycle is still in flight
             for _ in range(1000):
                 before = result.admitted
                 c0 = time.perf_counter()
@@ -180,7 +188,8 @@ class Runner:
                 cycle_times.append(time.perf_counter() - c0)
                 mgr.run_until_idle(max_iterations=10_000_000)
                 result.cycles += 1
-                if result.admitted == before:
+                if result.admitted == before \
+                        and mgr.scheduler._inflight is None:
                     break
 
         result.virtual_makespan_s = clock.now()

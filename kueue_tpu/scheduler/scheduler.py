@@ -138,6 +138,7 @@ class Scheduler:
         self._route_stats = {"cpu": [], "device": []}  # (admitted, secs)
         self._route_explore = 0
         self._last_cycle_admitted = 0
+        self._drain_cost = 0.0  # pipeline-drain seconds within this cycle
         # Below this head count the accelerator dispatch overhead exceeds
         # the win; narrow cycles go through the CPU path even with a
         # solver configured (SolverConfig.min_heads; 0 = always solve).
@@ -192,13 +193,15 @@ class Scheduler:
             return KeepGoing
         start = self.clock.now()
         wall0 = _time.perf_counter()
+        self._drain_cost = 0.0
         route = self._route_mode(heads)
 
         if route == "device" and self._pipeline_ok(heads):
             signal = self._schedule_pipelined(heads, start)
             if signal is not None:
                 self._route_record("device", self._last_cycle_admitted,
-                                   _time.perf_counter() - wall0)
+                                   _time.perf_counter() - wall0
+                                   - self._drain_cost)
                 return signal
             # Pipeline not applicable this cycle: continue on the
             # synchronous path. When an in-flight cycle was drained the
@@ -297,7 +300,8 @@ class Scheduler:
                 admitted_n += 1
         if route in ("device", "cpu"):
             self._route_record(route, admitted_n,
-                               _time.perf_counter() - wall0)
+                               _time.perf_counter() - wall0
+                               - self._drain_cost)
 
         if self.metrics is not None:
             self.metrics.admission_attempt(result_success, self.clock.now() - start)
@@ -455,12 +459,15 @@ class Scheduler:
             return None
         if len(nofit_idx) == len(plan.batch.infos):
             # Whole cycle is device-proved NoFit: nothing to dispatch.
+            # Not a routing sample either — a NoFit backlog admits zero
+            # on EITHER engine, so recording it would just bias.
             for e in invalid_entries:
                 self.requeue_and_update(e)
             for e in nofit_entries:
                 self.requeue_and_update(e)
             if self._inflight is not None:
                 return self._drain_pipeline()
+            self._last_cycle_admitted = None
             return SlowDown
         try:
             inflight = solver.dispatch(
@@ -486,7 +493,17 @@ class Scheduler:
         prev, self._inflight = self._inflight, None
         if prev is None:
             return KeepGoing
-        return self._process_inflight(prev, self.clock.now())
+        t0 = _time.perf_counter()
+        sig = self._process_inflight(prev, self.clock.now())
+        dt = _time.perf_counter() - t0
+        # The drained cycle is DEVICE work even when the draining cycle
+        # was routed to CPU (exploration): record it here — and exclude
+        # it from the enclosing cycle's own sample via _drain_cost — so
+        # the router keeps a live estimate of the losing engine.
+        self._drain_cost += dt
+        self._route_record("device", self._last_cycle_admitted, dt)
+        self._last_cycle_admitted = None  # consumed; don't record twice
+        return sig
 
     def _process_inflight(self, prev, start) -> SpeedSignal:
         inflight, snapshot, nofit_idx = prev

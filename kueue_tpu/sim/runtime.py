@@ -54,7 +54,8 @@ class Controller:
     def __init__(self, name: str, reconcile: Callable[[str], object]):
         self.name = name
         self._reconcile = reconcile
-        self._queue: list[str] = []
+        from collections import deque
+        self._queue: "deque[str]" = deque()  # deque: popleft is O(1)
         self._queued: set[str] = set()
 
     def enqueue(self, key: str) -> None:
@@ -66,7 +67,7 @@ class Controller:
         return bool(self._queue)
 
     def process_one(self) -> object:
-        key = self._queue.pop(0)
+        key = self._queue.popleft()
         self._queued.discard(key)
         return key, self._reconcile(key)
 

@@ -20,7 +20,21 @@ import copy
 import threading
 from typing import Callable, Optional
 
+from kueue_tpu.api.kueue import (clone_cluster_queue, clone_local_queue,
+                                 clone_workload)
 from kueue_tpu.api.meta import Clock, REAL_CLOCK, new_uid
+
+# Hand-rolled per-kind deep clones for the hottest objects: semantically
+# identical to copy.deepcopy, ~10x faster (reconciler reads + status
+# writes copy Workloads hundreds of thousands of times at scale).
+_FAST_CLONE = {"Workload": clone_workload,
+               "ClusterQueue": clone_cluster_queue,
+               "LocalQueue": clone_local_queue}
+
+
+def _clone(obj):
+    fc = _FAST_CLONE.get(type(obj).__name__)
+    return fc(obj) if fc is not None else copy.deepcopy(obj)
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -108,7 +122,7 @@ class Store:
             bucket = self._objects.setdefault(kind, {})
             if key in bucket:
                 raise AlreadyExists(f"{kind} {key} already exists")
-            stored = copy.deepcopy(obj)
+            stored = _clone(obj)
             self._admit("CREATE", stored, None)
             if not stored.metadata.uid:
                 stored.metadata.uid = new_uid(kind.lower())
@@ -118,7 +132,7 @@ class Store:
             stored.metadata.resource_version = self._rv
             bucket[key] = stored
             self._notify(kind, ADDED, stored, None)
-            return copy.deepcopy(stored)
+            return _clone(stored)
 
     def get(self, kind: str, namespace: str, name: str,
             copy_object: bool = True) -> object:
@@ -132,7 +146,7 @@ class Store:
                 stored = self._objects[kind][key]
             except KeyError:
                 raise NotFound(f"{kind} {key} not found") from None
-            return copy.deepcopy(stored) if copy_object else stored
+            return _clone(stored) if copy_object else stored
 
     def try_get(self, kind: str, namespace: str, name: str,
                 copy_object: bool = True):
@@ -154,12 +168,19 @@ class Store:
             if key not in bucket:
                 raise NotFound(f"{kind} {key} not found")
             old = bucket[key]
+            if obj is old:
+                # In-place mutation of a shared (copy_object=False) read:
+                # old == stored would make every such write a silent
+                # no-op (no RV bump, no watch event). Fail loudly.
+                raise ValueError(
+                    f"{kind} {key}: update() with the stored object "
+                    "itself (in-place mutation of a shared read?)")
             if expect_rv is not None and old.metadata.resource_version != expect_rv:
                 raise Conflict(
                     f"{kind} {key}: resourceVersion {expect_rv} != {old.metadata.resource_version}")
-            stored = copy.deepcopy(obj)
+            stored = _clone(obj)
             if self._admission_hooks.get(kind):
-                self._admit("UPDATE", stored, copy.deepcopy(old))
+                self._admit("UPDATE", stored, _clone(old))
             stored.metadata.uid = old.metadata.uid
             stored.metadata.creation_timestamp = old.metadata.creation_timestamp
             # deletionTimestamp is apiserver-owned: preserve it across writes
@@ -197,6 +218,15 @@ class Store:
             if key not in bucket:
                 raise NotFound(f"{kind} {key} not found")
             old = bucket[key]
+            if obj is old or obj.status is old.status:
+                # A caller holding a shared pointer (copy_object=False
+                # read) wrote through it: the no-change check below would
+                # compare the status with itself and silently drop the
+                # write. Fail loudly instead — build a fresh status
+                # (owned_status) or read with a copy.
+                raise ValueError(
+                    f"{kind} {key}: status aliases the stored object "
+                    "(in-place mutation of a shared read?)")
             if obj.status == old.status:
                 return None
             stored = copy.copy(old)
@@ -220,7 +250,7 @@ class Store:
             old = bucket[key]
             if old.metadata.finalizers:
                 if old.metadata.deletion_timestamp is None:
-                    stored = copy.deepcopy(old)
+                    stored = _clone(old)
                     stored.metadata.deletion_timestamp = self._clock.now()
                     self._rv += 1
                     stored.metadata.resource_version = self._rv
@@ -249,7 +279,7 @@ class Store:
                     continue
                 if where is not None and not where(obj):
                     continue
-                out.append(copy.deepcopy(obj) if copy_objects else obj)
+                out.append(_clone(obj) if copy_objects else obj)
             return out
 
     def count(self, kind: str) -> int:

@@ -240,3 +240,136 @@ class TestLimitRange:
         spec = PodSpec(containers=[Container(name="c")])
         limitrange.apply_defaults(spec, limitrange.summarize(lr))
         assert spec.containers[0].requests["cpu"] == 250
+
+
+class TestCloneWorkload:
+    def test_matches_deepcopy_on_maximal_object(self):
+        import copy
+        from kueue_tpu.api import kueue as api
+        from kueue_tpu.api.corev1 import (
+            Affinity, Container, NodeAffinity, NodeSelector,
+            NodeSelectorRequirement, NodeSelectorTerm, PodSpec,
+            PodTemplateSpec, Toleration)
+        from kueue_tpu.api.meta import Condition, ObjectMeta, OwnerReference
+
+        wl = api.Workload(metadata=ObjectMeta(
+            name="w", namespace="ns", uid="u1", generation=3,
+            resource_version=17, creation_timestamp=1.5,
+            deletion_timestamp=9.0, labels={"a": "b"},
+            annotations={"c": "d"}, finalizers=["f1"],
+            owner_references=[OwnerReference(api_version="v1", kind="Job",
+                                             name="j", uid="ju",
+                                             controller=True)]))
+        spec = PodSpec(
+            containers=[Container(name="c", requests={"cpu": 100},
+                                  limits={"cpu": 200})],
+            init_containers=[Container(name="i", requests={"mem": 5})],
+            node_selector={"zone": "a"},
+            tolerations=[Toleration(key="k", operator="Exists", value="v",
+                                    effect="NoSchedule")],
+            affinity=Affinity(node_affinity=NodeAffinity(
+                required=NodeSelector(node_selector_terms=[
+                    NodeSelectorTerm(match_expressions=[
+                        NodeSelectorRequirement(key="x", operator="In",
+                                                values=["1", "2"])])]))),
+            priority_class_name="pc", priority=7,
+            scheduling_gates=["g"], restart_policy="Always",
+            overhead={"cpu": 1})
+        wl.spec.pod_sets = [api.PodSet(
+            name="main", count=4, min_count=2,
+            template=PodTemplateSpec(labels={"l": "1"},
+                                     annotations={"an": "2"}, spec=spec))]
+        wl.spec.queue_name = "q"
+        wl.spec.priority = 5
+        wl.spec.priority_class_name = "wpc"
+        wl.spec.priority_class_source = "kueue.x-k8s.io/workloadpriorityclass"
+        wl.spec.active = False
+        wl.status.conditions = [Condition(type="QuotaReserved", status="True",
+                                          reason="r", message="m",
+                                          last_transition_time=2.0,
+                                          observed_generation=3)]
+        wl.status.admission = api.Admission(
+            cluster_queue="cq",
+            pod_set_assignments=[api.PodSetAssignment(
+                name="main", flavors={"cpu": "f0"},
+                resource_usage={"cpu": 400}, count=4)])
+        wl.status.requeue_state = api.RequeueState(count=2, requeue_at=8.0)
+        wl.status.reclaimable_pods = [api.ReclaimablePod(name="main", count=1)]
+        wl.status.admission_checks = [api.AdmissionCheckState(
+            name="chk", state=api.CHECK_STATE_READY, message="ok",
+            last_transition_time=3.0,
+            pod_set_updates=[api.PodSetUpdate(
+                name="main", labels={"x": "y"}, annotations={"p": "q"},
+                node_selector={"n": "s"},
+                tolerations=[Toleration(key="t")])])]
+
+        clone = api.clone_workload(wl)
+        assert clone == copy.deepcopy(wl)
+        assert clone is not wl
+
+        # no aliasing anywhere: mutate every mutable corner of the clone
+        clone.metadata.labels["a"] = "zz"
+        clone.spec.pod_sets[0].template.spec.containers[0].requests["cpu"] = 1
+        clone.spec.pod_sets[0].template.spec.tolerations[0].key = "zz"
+        clone.spec.pod_sets[0].template.spec.affinity.node_affinity.required \
+            .node_selector_terms[0].match_expressions[0].values.append("3")
+        clone.status.conditions[0].status = "False"
+        clone.status.admission.pod_set_assignments[0].flavors["cpu"] = "f9"
+        clone.status.admission_checks[0].pod_set_updates[0].labels["x"] = "n"
+        clone.status.requeue_state.count = 99
+        assert wl == copy.deepcopy(wl := wl) and wl.metadata.labels["a"] == "b"
+        assert wl.spec.pod_sets[0].template.spec.containers[0].requests["cpu"] == 100
+        assert wl.status.conditions[0].status == "True"
+        assert wl.status.admission.pod_set_assignments[0].flavors["cpu"] == "f0"
+
+    def test_cq_and_lq_clones_match_deepcopy(self):
+        import copy
+        from kueue_tpu.api import kueue as api
+        from kueue_tpu.api.meta import (Condition, LabelSelector,
+                                        LabelSelectorRequirement, ObjectMeta)
+        cq = api.ClusterQueue(metadata=ObjectMeta(name="cq", uid="u",
+                                                  labels={"a": "b"}))
+        cq.spec.cohort = "co"
+        cq.spec.queueing_strategy = api.STRICT_FIFO
+        cq.spec.namespace_selector = LabelSelector(
+            match_labels={"t": "x"},
+            match_expressions=[LabelSelectorRequirement(
+                key="k", operator="In", values=["v1"])])
+        cq.spec.preemption = api.ClusterQueuePreemption(
+            reclaim_within_cohort=api.PREEMPTION_ANY,
+            borrow_within_cohort=api.BorrowWithinCohort(
+                policy=api.BORROW_WITHIN_COHORT_LOWER_PRIORITY,
+                max_priority_threshold=4),
+            within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+        cq.spec.resource_groups = [api.ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[api.FlavorQuotas(name="f0", resources=[
+                api.ResourceQuota(name="cpu", nominal_quota=5,
+                                  borrowing_limit=2, lending_limit=1)])])]
+        cq.spec.admission_checks = ["chk"]
+        cq.spec.admission_checks_strategy = [
+            api.AdmissionCheckStrategyRule(name="s", on_flavors=["f0"])]
+        cq.spec.fair_sharing = api.FairSharing(weight=500)
+        cq.status.conditions = [Condition(type="Active", status="True")]
+        cq.status.flavors_reservation = [api.FlavorUsage(
+            name="f0", resources=[api.ResourceUsage(name="cpu", total=3,
+                                                    borrowed=1)])]
+        cq.status.pending_workloads = 7
+        clone = api.clone_cluster_queue(cq)
+        assert clone == copy.deepcopy(cq)
+        clone.spec.resource_groups[0].flavors[0].resources[0].nominal_quota = 9
+        clone.status.flavors_reservation[0].resources[0].total = 0
+        clone.spec.namespace_selector.match_labels["t"] = "z"
+        assert cq.spec.resource_groups[0].flavors[0].resources[0].nominal_quota == 5
+        assert cq.status.flavors_reservation[0].resources[0].total == 3
+        assert cq.spec.namespace_selector.match_labels["t"] == "x"
+
+        lq = api.LocalQueue(metadata=ObjectMeta(name="lq", namespace="ns"))
+        lq.spec.cluster_queue = "cq"
+        lq.status.conditions = [Condition(type="Active", status="True")]
+        lq.status.flavors_usage = [api.FlavorUsage(
+            name="f0", resources=[api.ResourceUsage(name="cpu", total=2)])]
+        lclone = api.clone_local_queue(lq)
+        assert lclone == copy.deepcopy(lq)
+        lclone.status.flavors_usage[0].resources[0].total = 9
+        assert lq.status.flavors_usage[0].resources[0].total == 2

@@ -150,6 +150,10 @@ class SolverConfig:
 
 @dataclass
 class Configuration:
+    # logging verbosity (the --v flag analogue; reference wires zap
+    # through cmd/kueue/main.go): V2 cycle summaries, V5 attempts,
+    # V6 snapshot dumps
+    verbosity: int = 0
     namespace: str = DEFAULT_NAMESPACE
     manage_jobs_without_queue_name: bool = False
     client_connection: ClientConnection = field(default_factory=ClientConnection)

@@ -66,8 +66,10 @@ class ClusterQueueReconciler:
         # status object (and its no-op update_status compare) when the
         # inputs are unchanged — at scale most CQ reconciles are fan-out
         # echoes of unrelated admissions.
+        act = self.queues.cluster_queues.get(key)
         sig = (cq.metadata.resource_version,
-               self.queues.pending(key),
+               (act.pending_active(), act.pending_inadmissible())
+               if act is not None else self.queues.pending(key),
                cqc.usage_version,
                cqc.active)
         if self._last_sig.get(key) == sig:

@@ -69,6 +69,8 @@ class KueueManager:
                  registered_check_controllers: Optional[set] = None,
                  remote_clusters: Optional[dict] = None):
         self.cfg = cfgpkg.set_defaults(cfg or cfgpkg.Configuration())
+        from kueue_tpu.utils import vlog
+        vlog.set_verbosity(self.cfg.verbosity)
         self.clock = clock
         self.store = Store(clock)
         self.recorder = EventRecorder()

@@ -11,18 +11,35 @@ from __future__ import annotations
 from typing import Callable, Optional, Tuple
 
 
+def reduction_space(pod_sets: list) -> Tuple[list, list, int]:
+    """(full counts, per-podset reducible deltas, total delta) — the
+    search space both the sequential reducer and the solver's batched
+    lockstep search iterate (podset_reducer.go:29-45). Shared so the two
+    can never drift on the interpolation."""
+    full_counts = [ps.count for ps in pod_sets]
+    deltas = [ps.count - (ps.min_count if ps.min_count is not None else ps.count)
+              for ps in pod_sets]
+    return full_counts, deltas, sum(deltas)
+
+
+def counts_for_index(full_counts: list, deltas: list, total_delta: int,
+                     i: int) -> list:
+    """Proportional scaling of each PodSet at reduction index i
+    (podset_reducer.go:47-56)."""
+    return [full - (d * i) // total_delta
+            for full, d in zip(full_counts, deltas)]
+
+
 class PodSetReducer:
     def __init__(self, pod_sets: list, fits: Callable[[list], Tuple[object, bool]]):
         self.pod_sets = pod_sets
-        self.full_counts = [ps.count for ps in pod_sets]
-        self.deltas = [ps.count - (ps.min_count if ps.min_count is not None else ps.count)
-                       for ps in pod_sets]
-        self.total_delta = sum(self.deltas)
+        self.full_counts, self.deltas, self.total_delta = \
+            reduction_space(pod_sets)
         self.fits = fits
 
     def _counts_for_index(self, i: int) -> list:
-        return [full - (d * i) // self.total_delta
-                for full, d in zip(self.full_counts, self.deltas)]
+        return counts_for_index(self.full_counts, self.deltas,
+                                self.total_delta, i)
 
     def search(self) -> Tuple[Optional[object], bool]:
         """Find the largest counts that pass fits() (smallest reduction

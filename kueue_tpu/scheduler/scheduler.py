@@ -35,6 +35,7 @@ from kueue_tpu.queue import Manager, RequeueReason
 from kueue_tpu.scheduler import flavorassigner as fa
 from kueue_tpu.scheduler.podset_reducer import PodSetReducer
 from kueue_tpu.scheduler.preemption import Preemptor, Target, make_reclaim_oracle
+from kueue_tpu.utils import vlog
 from kueue_tpu.utils.wait import KeepGoing, SlowDown, SpeedSignal, until_with_backoff
 
 # entry statuses (reference: scheduler.go:355-366)
@@ -148,14 +149,20 @@ class Scheduler:
         # must cover the marginal sync cost — the full measured dispatch
         # floor when no fit entries dispatch this cycle, zero otherwise.
         # solver_sync_floor_ms overrides the measured floor (tests use 0
-        # to force the device path on tiny problems).
+        # to force the device path on tiny problems). The per-candidate
+        # CPU-cost constants are machine-dependent — tune per deployment.
         self.solver_sync_floor_ms: Optional[float] = None
+        self.preempt_cand_us = 8.0  # minimal preemptor: simulate/candidate
+        self.fair_cand_us = 3.0     # fairPreemptions: share compare/cand
         self.preemptor = Preemptor(
             ordering=self.ordering,
             enable_fair_sharing=fair_sharing_enabled,
             fs_strategies=parse_strategies(fs_preemption_strategies),
             clock=clock,
             apply_preemption=self._apply_preemption)
+        # Leveled structured logging (reference: pkg/scheduler/logging.go):
+        # V(2) cycle summaries, V(5) attempts, V(6) snapshot dumps.
+        self.log = vlog.logger("scheduler")
         # Synchronous by default; swap for async in production wiring
         # (reference: routine wrapper, scheduler.go:590).
         self.admission_routine: Callable[[Callable], None] = lambda f: f()
@@ -219,6 +226,7 @@ class Scheduler:
         self._fallback_snapshot = None
         if snapshot is None:
             snapshot = self.cache.snapshot()
+        vlog.dump_snapshot(self.log, snapshot)
 
         solver_entries: list = []
         pre_entries: list = []
@@ -292,6 +300,7 @@ class Scheduler:
         result_success = False
         admitted_n = 0
         entries = solver_entries + entries
+        vlog.dump_attempts(self.log, entries)
         for e in entries:
             if e.status != ASSUMED:
                 self.requeue_and_update(e)
@@ -302,6 +311,9 @@ class Scheduler:
             self._route_record(route, admitted_n,
                                _time.perf_counter() - wall0
                                - self._drain_cost)
+        self.log.v(2, "cycle", engine=route, heads=len(entries),
+                   admitted=admitted_n,
+                   ms=round((_time.perf_counter() - wall0) * 1e3, 1))
 
         if self.metrics is not None:
             self.metrics.admission_attempt(result_success, self.clock.now() - start)
@@ -556,6 +568,7 @@ class Scheduler:
             self._pipeline_cooldown = 1
         result_success = False
         admitted_n = 0
+        vlog.dump_attempts(self.log, entries)
         for e in entries:
             if e.status != ASSUMED:
                 self.requeue_and_update(e)
@@ -563,6 +576,8 @@ class Scheduler:
                 result_success = True
                 admitted_n += 1
         self._last_cycle_admitted = admitted_n
+        self.log.v(2, "cycle", engine="device-pipelined",
+                   heads=len(valid_heads), admitted=admitted_n)
         if self.metrics is not None:
             self.metrics.admission_attempt(result_success,
                                            self.clock.now() - start)
@@ -621,15 +636,30 @@ class Scheduler:
         # NoFit walk always ends exhausted, i.e. restart from rank 0).
         nonfit_total = len(pred_other)
         nofit_entries = []
+        partial_ws = []
         if pred_other:
             rest = []
             for w in pred_other:
                 e = self._device_nofit_entry(w, snapshot)
                 if e is not None:
                     nofit_entries.append(e)
+                elif self._batched_reducer_eligible(w, snapshot):
+                    partial_ws.append(w)
                 else:
                     rest.append(w)
             pred_other = rest
+        if partial_ws:
+            # Batched partial admission (podset_reducer.go:29-86): all
+            # entries' binary searches advance in lockstep, one Phase A
+            # batch per round on the local CPU backend, then ONE full
+            # assigner run per successful entry at its found counts.
+            entries_or_ws = self._batched_partial_admission(
+                partial_ws, plan, snapshot)
+            for item in entries_or_ws:
+                if isinstance(item, Entry):
+                    nofit_entries.append(item)
+                else:
+                    pred_other.append(item)
         # Preempt-mode target selection is deferred to the device —
         # including fairPreemptions' DRF-heap loop (solver/fairpreempt.py)
         # — except under a mesh with fair sharing (the sharded execute
@@ -674,7 +704,8 @@ class Scheduler:
             # fairPreemptions' CPU loop only compares per-CQ share
             # aggregates (~3us/candidate) vs the minimal preemptor's
             # per-candidate simulation (~8us net)
-            per_cand_us = 3.0 if self.fair_sharing_enabled else 8.0
+            per_cand_us = (self.fair_cand_us if self.fair_sharing_enabled
+                           else self.preempt_cand_us)
             if bound * per_cand_us <= marginal_sync_us:
                 self._cpu_preempt_targets(pending, snapshot)
                 pending = []
@@ -711,9 +742,10 @@ class Scheduler:
                 # per-CQ share aggregates (~3us net) — so fair problems
                 # must clear a lower bar before the device pays.
                 total_cost_us = (sum(p.num_candidates for p in problems)
-                                 * 8.0
+                                 * self.preempt_cand_us
                                  + sum(p.num_candidates
-                                       for p in fair_problems) * 3.0)
+                                       for p in fair_problems)
+                                 * self.fair_cand_us)
                 if (problems or fair_problems) \
                         and total_cost_us > marginal_sync_us:
                     if problems:
@@ -829,6 +861,53 @@ class Scheduler:
         e.inadmissible_msg = ("couldn't assign flavors: insufficient quota "
                               "(batched assignment)")
         return e
+
+    def _batched_reducer_eligible(self, w: wlpkg.Info,
+                                  snapshot: Snapshot) -> bool:
+        """Batched partial admission requires probes that can't pass via
+        preemption (Never/Never policy makes the reducer's predicate
+        pure fit — exactly what the batched Phase A evaluates)."""
+        if not features.enabled(features.PARTIAL_ADMISSION) \
+                or not w.can_be_partially_admitted():
+            return False
+        p = snapshot.cluster_queues[w.cluster_queue].preemption
+        return (p.within_cluster_queue == api.PREEMPTION_NEVER
+                and p.reclaim_within_cohort == api.PREEMPTION_NEVER)
+
+    def _batched_partial_admission(self, partial_ws: list, plan,
+                                   snapshot: Snapshot) -> list:
+        """Returns a mix of ready Entries (reduced-fit or NoFit) and raw
+        workloads to hand back to CPU nomination (fallback)."""
+        from kueue_tpu.solver.service import CPU_FALLBACK
+        try:
+            results = self.solver.batched_partial_admission(
+                plan, snapshot, partial_ws)
+        except Exception:  # noqa: BLE001 — encode failure: CPU reducer
+            results = None
+        if results is None:
+            return list(partial_ws)
+        out: list = []
+        oracle = make_reclaim_oracle(self.preemptor, snapshot)
+        for i, w in enumerate(partial_ws):
+            counts = results.get(i)
+            if counts is CPU_FALLBACK:
+                out.append(w)
+                continue
+            e = Entry(info=w)
+            if counts is None:
+                e.inadmissible_msg = ("couldn't assign flavors: "
+                                      "insufficient quota "
+                                      "(batched assignment)")
+                out.append(e)
+                continue
+            cq = snapshot.cluster_queues[w.cluster_queue]
+            assigner = fa.FlavorAssigner(w, cq, snapshot.resource_flavors,
+                                         self.fair_sharing_enabled, oracle)
+            e.assignment = assigner.assign(counts)
+            e.inadmissible_msg = e.assignment.message()
+            w.last_assignment = e.assignment.last_state
+            out.append(e)
+        return out
 
     def _cpu_preempt_targets(self, pending: list, snapshot: Snapshot) -> None:
         """Fallback / gate routing: resolve deferred preempt-mode entries

@@ -371,9 +371,13 @@ def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
     token = topo.token
     priorities, timestamps = batch.priority, batch.timestamp
     for wi, info in enumerate(entries):
+        # Keyed by (topology token, resourceVersion): a workload update
+        # that rebuilds requests without a fresh Info (e.g. reclaimable
+        # pods) must invalidate the cached rows too.
+        key = (token, info.obj.metadata.resource_version)
         enc = getattr(info, "_solver_enc", None)
-        if enc is None or enc[0] != token:
-            enc = (token,) + _encode_one(info, snapshot, topo, P)
+        if enc is None or enc[0] != key:
+            enc = (key,) + _encode_one(info, snapshot, topo, P)
             info._solver_enc = enc
         _, qi, requests, active, eligible, ok = enc
         if qi < 0:

@@ -55,6 +55,11 @@ def _topo_np(topo) -> dict:
     return {name: getattr(topo, name) for name in TOPO_FIELDS}
 
 
+# batched_partial_admission marker: this entry's probes weren't
+# encodable — run the sequential CPU reducer for it instead
+CPU_FALLBACK = object()
+
+
 class Plan:
     """One cycle's encoded inputs + the host-side routing decision."""
 
@@ -168,12 +173,30 @@ class BatchSolver:
 
     @staticmethod
     def _calibrate_floor() -> float:
+        """Measure the dispatch+sync floor with a REPRESENTATIVE program:
+        a small solve_cycle_fused (not `a+1` — over a tunneled TPU a real
+        cycle's upload/fetch measurably exceeds a trivial op's, and an
+        underestimate biases the work gates toward the device)."""
         import time
         import jax.numpy as jnp
-        triv = jax.jit(lambda a: a + 1)
-        np.asarray(triv(jnp.zeros(8, jnp.int32)))  # compile
+        from kueue_tpu.solver.kernel import solve_cycle_fused
+        from kueue_tpu.solver.synth import synth_solver_inputs
+        topo, usage, cohort_usage, wl = synth_solver_inputs(
+            num_cqs=8, num_cohorts=2, num_flavors=2, num_resources=2,
+            num_workloads=8, seed=7)
+        topo_dev = {k: jnp.asarray(v) for k, v in topo.items()}
+
+        def run():
+            out = solve_cycle_fused(
+                topo_dev, usage, cohort_usage, wl["requests"],
+                wl["podset_active"], wl["wl_cq"], wl["priority"],
+                wl["timestamp"], wl["eligible"], wl["solvable"],
+                num_podsets=1, max_rank=8)
+            return np.asarray(out["admitted"])
+
+        run()  # compile
         t0 = time.perf_counter()
-        np.asarray(triv(jnp.zeros(8, jnp.int32)))
+        run()
         return (time.perf_counter() - t0) * 1e3
 
     def _observe_sync(self, ms: float) -> None:
@@ -578,6 +601,93 @@ class BatchSolver:
                                        plan.batch, fetched,
                                        resident=resident_ok)
         return decisions, aux
+
+    def batched_partial_admission(self, plan: Plan, snapshot: Snapshot,
+                                  infos: list):
+        """Partial admission for many entries at once (VERDICT r3 ask #9;
+        reference: podset_reducer.go:29-86 run per entry per probe).
+
+        All entries' binary searches advance in LOCKSTEP: each round,
+        every active entry's probe (its PodSets scaled to the candidate
+        counts) becomes one row of a single Phase A batch evaluated on
+        the LOCAL XLA-CPU backend — exact fit bits, no tunnel — so
+        log2(delta) batched evaluations replace per-entry per-probe full
+        assigner runs. Only valid for entries whose probes cannot pass
+        via preemption (the caller restricts to Never/Never CQs, where
+        the CPU reducer's predicate degenerates to pure fit).
+
+        Returns {entry index: reduced counts list | None}, or None when
+        no local CPU backend exists (caller falls back to the CPU
+        reducer)."""
+        topo, state = plan.topo, plan.state
+
+        def shadow(info, counts):
+            s = wlpkg.Info.__new__(wlpkg.Info)
+            s.obj = info.obj
+            s.cluster_queue = info.cluster_queue
+            s.last_assignment = None
+            s._fru_cache = None
+            s._fr_keys_cache = None
+            s.total_requests = [
+                psr if psr.count == c else psr.scaled_to(c)
+                for psr, c in zip(info.total_requests, counts)]
+            return s
+
+        from kueue_tpu.scheduler.podset_reducer import (
+            counts_for_index, reduction_space)
+
+        class _Search:
+            __slots__ = ("full", "deltas", "total", "lo", "hi", "good")
+
+            def __init__(self, pod_sets):
+                # shared interpolation with the CPU PodSetReducer — the
+                # feature's contract is bit-for-bit equality with it
+                self.full, self.deltas, self.total = reduction_space(pod_sets)
+                self.lo, self.hi = 0, self.total + 1
+                self.good = None
+
+            def counts(self, i):
+                return counts_for_index(self.full, self.deltas,
+                                        self.total, i)
+
+        searches = {i: _Search(info.obj.spec.pod_sets)
+                    for i, info in enumerate(infos)}
+        out = {i: None for i in range(len(infos))}
+        for _round in range(40):  # log2(total_delta) rounds in practice
+            active = [i for i, s in searches.items()
+                      if s.total > 0 and s.lo < s.hi]
+            if not active:
+                break
+            mids = {i: (searches[i].lo + searches[i].hi) // 2
+                    for i in active}
+            shadows = [shadow(infos[i], searches[i].counts(mids[i]))
+                       for i in active]
+            batch = encode.encode_workloads(shadows, snapshot, topo,
+                                            ordering=self.ordering,
+                                            max_podsets=self.max_podsets)
+            fit = self._route(topo, state, batch, None)
+            if fit is None:
+                return None  # no local backend — CPU reducer fallback
+            solvable = batch.solvable
+            for k, i in enumerate(active):
+                s = searches[i]
+                if not solvable[k]:
+                    # unencodable probe: hand the entry to the CPU reducer
+                    s.lo = s.hi = 0
+                    s.good = None
+                    out[i] = CPU_FALLBACK
+                    continue
+                if fit[k]:
+                    s.good = mids[i]
+                    s.hi = mids[i]
+                else:
+                    s.lo = mids[i] + 1
+        for i, s in searches.items():
+            if out[i] is CPU_FALLBACK:
+                continue
+            if s.good is not None and s.lo == s.good:
+                out[i] = s.counts(s.good)
+        return out
 
     def solve(self, snapshot: Snapshot, entries: list,
               fair_sharing: bool = False) -> dict:

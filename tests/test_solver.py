@@ -831,3 +831,69 @@ class TestPipelinedEquivalence:
             outs[pipeline] = set(env.client.evicted)
         assert outs[False] == outs[True]
         assert outs[True] == {"default/victim0", "default/victim1"}
+
+
+class TestBatchedPartialAdmission:
+    """Batched partial admission (VERDICT r3 ask #9): all reducer probes
+    for all eligible entries run as lockstep Phase A batches on the local
+    CPU backend; decisions must equal the CPU scheduler's sequential
+    PodSetReducer exactly (Never/Never CQs: the probe predicate is pure
+    fit on both paths)."""
+
+    @staticmethod
+    def _setup(env):
+        env.add_flavor("default")
+        for i in range(3):
+            env.add_cq(ClusterQueueWrapper(f"cq{i}")
+                       .resource_group(flavor_quotas("default", cpu="6"))
+                       .obj(), f"lq-cq{i}")
+
+    def test_reduced_counts_match_cpu(self):
+        def workloads():
+            out = []
+            for i in range(3):
+                # 10 pods x 1 cpu vs quota 6 -> reduced to 6
+                out.append(WorkloadWrapper(f"big{i}").queue(f"lq-cq{i}")
+                           .creation(float(i))
+                           .pod_set(count=10, min_count=2, cpu=1).obj())
+            return out
+
+        envs = []
+        for solver in (False, True):
+            env = build_env(self._setup, solver=solver)
+            for w in workloads():
+                env.submit(w)
+            env.cycle()
+            envs.append(env)
+        cpu_map, dev_map = admitted_map(envs[0]), admitted_map(envs[1])
+        assert cpu_map == dev_map and cpu_map
+        # every workload actually got REDUCED (count 6, not 10)
+        for key, psas in cpu_map.items():
+            assert psas[0][1] == 6, (key, psas)
+
+    def test_infeasible_and_mixed(self):
+        """One entry reduces, one can't fit even at min_count, one fits
+        outright — identical outcomes on both paths."""
+        def workloads():
+            return [
+                WorkloadWrapper("reduce").queue("lq-cq0").creation(0.0)
+                .pod_set(count=9, min_count=3, cpu=1).obj(),
+                WorkloadWrapper("never").queue("lq-cq1").creation(1.0)
+                .pod_set(count=20, min_count=8, cpu=1).obj(),
+                WorkloadWrapper("fits").queue("lq-cq2").creation(2.0)
+                .pod_set(count=4, min_count=2, cpu=1).obj(),
+            ]
+
+        envs = []
+        for solver in (False, True):
+            env = build_env(self._setup, solver=solver)
+            for w in workloads():
+                env.submit(w)
+            env.cycle()
+            envs.append(env)
+        cpu_map, dev_map = admitted_map(envs[0]), admitted_map(envs[1])
+        assert cpu_map == dev_map
+        assert "default/reduce" in cpu_map and "default/fits" in cpu_map
+        assert "default/never" not in cpu_map
+        assert cpu_map["default/reduce"][0][1] == 6
+        assert cpu_map["default/fits"][0][1] == 4

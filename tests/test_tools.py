@@ -250,3 +250,36 @@ class TestImporter:
         assert result.imported == 1
         assert mgr.store.try_get("Workload", "default", "pod-tagged") is not None
         assert mgr.store.try_get("Workload", "default", "pod-untagged") is None
+
+
+class TestVLog:
+    def test_cycle_logging_levels(self, caplog):
+        import logging
+        from kueue_tpu.utils import vlog
+        from tests.test_scheduler import simple_env
+        from tests.wrappers import WorkloadWrapper
+        vlog.set_verbosity(6)
+        try:
+            env = simple_env()
+            env.submit(WorkloadWrapper("w").queue("lq")
+                       .pod_set(count=1, cpu="2").obj())
+            with caplog.at_level(logging.DEBUG, logger="kueue_tpu"):
+                env.cycle()
+        finally:
+            vlog.set_verbosity(0)
+        text = caplog.text
+        assert "cycle" in text and "admitted=1" in text          # V2
+        assert "attempt" in text and "workload=default/w" in text  # V5
+        assert "snapshot.clusterQueue" in text and "name=cq" in text  # V6
+
+    def test_disabled_by_default(self, caplog):
+        import logging
+        from tests.test_scheduler import simple_env
+        from tests.wrappers import WorkloadWrapper
+        env = simple_env()
+        env.submit(WorkloadWrapper("w").queue("lq")
+                   .pod_set(count=1, cpu="2").obj())
+        with caplog.at_level(logging.DEBUG, logger="kueue_tpu"):
+            env.cycle()
+        assert "snapshot.clusterQueue" not in caplog.text
+        assert "attempt" not in caplog.text

@@ -19,8 +19,14 @@ from kueue_tpu.api.meta import LabelSelector, ObjectMeta, new_uid
 
 class WorkloadWrapper:
     def __init__(self, name: str, namespace: str = "default"):
+        # Deterministic uid (NOT the global counter): candidatesOrdering
+        # tie-breaks on uid, so differential tests comparing two
+        # separately built envs need name-derived uids — counter-based
+        # ones sort differently across digit-count boundaries
+        # ("wl-100" < "wl-96" lexicographically).
         self.wl = api.Workload(metadata=ObjectMeta(
-            name=name, namespace=namespace, uid=new_uid("wl"), creation_timestamp=0.0))
+            name=name, namespace=namespace, uid=f"wl-{namespace}-{name}",
+            creation_timestamp=0.0))
 
     def queue(self, q: str) -> "WorkloadWrapper":
         self.wl.spec.queue_name = q

@@ -897,3 +897,117 @@ class TestBatchedPartialAdmission:
         assert "default/never" not in cpu_map
         assert cpu_map["default/reduce"][0][1] == 6
         assert cpu_map["default/fits"][0][1] == 4
+
+
+class TestResidencyRandomMultiCycle:
+    """Randomized MULTI-CYCLE differential for the device-resident +
+    pipelined stack: workloads arrive in waves, some admitted workloads
+    complete (cache removal -> journal corrections), quotas force
+    contention, and the pipelined solver must converge to the same final
+    admitted set and per-CQ usage as the sequential CPU scheduler."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_waves_with_completions(self, seed):
+        rng = random.Random(7000 + seed)
+        n_cohorts = rng.randint(1, 3)
+        n_cqs = rng.randint(3, 6)
+        n_flavors = rng.randint(1, 3)
+        quota = rng.choice(["4", "6", "8"])
+        waves = rng.randint(3, 5)
+
+        cq_specs = []
+        for i in range(n_cqs):
+            cohort = (f"co-{rng.randrange(n_cohorts)}"
+                      if rng.random() < 0.7 else "")
+            cq_specs.append((f"cq{i}", cohort))
+        flavors = [f"f{k}" for k in range(n_flavors)]
+
+        def setup(env):
+            for f in flavors:
+                env.add_flavor(f)
+            for name, cohort in cq_specs:
+                w = ClusterQueueWrapper(name)
+                if cohort:
+                    w = w.cohort(cohort)
+                w = w.resource_group(*[flavor_quotas(f, cpu=quota)
+                                       for f in flavors])
+                env.add_cq(w.obj(), f"lq-{name}")
+
+        plan = []  # (wave, name, cq idx, prio, cpu)
+        n = 0
+        for wave in range(waves):
+            for _ in range(rng.randint(2, 2 * n_cqs)):
+                plan.append((wave, f"w{n}", rng.randrange(n_cqs),
+                             rng.randint(0, 3),
+                             rng.choice(["1", "2", "3"])))
+                n += 1
+        # EVERY workload completes once admitted: capacity always frees
+        # again, so both engines must converge to the full admitted set
+        # (transient contention still forces parking/retries mid-run)
+        complete_after = {p[1] for p in plan}
+
+        all_cqs = {f"cq{i}" for i in range(n_cqs)}
+
+        def run(pipeline):
+            env = build_env(setup, solver=pipeline)
+            if pipeline:
+                env.scheduler.pipeline_enabled = True
+            done = set(complete_after)
+
+            def drain_completions():
+                freed = False
+                for key, wl in list(env.client.applied.items()):
+                    if wl.metadata.name in done:
+                        env.cache.delete_workload(wl)
+                        done.discard(wl.metadata.name)
+                        freed = True
+                if freed:
+                    # the workload controller's cohort flush (parked
+                    # inadmissible entries retry on freed capacity)
+                    env.queues.queue_inadmissible_workloads(all_cqs)
+
+            for wave in range(waves):
+                for (w_wave, name, qi, prio, cpu) in plan:
+                    if w_wave != wave:
+                        continue
+                    env.submit(WorkloadWrapper(name).queue(f"lq-cq{qi}")
+                               .priority(prio).creation(float(wave * 100))
+                               .pod_set(count=1, cpu=cpu).obj())
+                env.cycle()
+                drain_completions()
+            # settle until everything admitted (completions keep freeing
+            # capacity; every workload fits a CQ alone, so both engines
+            # must converge to the full set)
+            for _ in range(40):
+                if len(env.client.applied) >= n:
+                    break
+                env.cycle()
+                drain_completions()
+            for _ in range(3):  # drain the pipeline tail
+                env.cycle()
+                drain_completions()
+            return env
+
+        cpu_env = run(False)
+        dev_env = run(True)
+        cpu_map, dev_map = admitted_map(cpu_env), admitted_map(dev_env)
+        # both engines eventually admit EVERY workload (admission ORDER
+        # under completion-timing races may differ — the documented
+        # pipeline deviation — so flavor choices for multi-flavor CQs can
+        # legitimately differ too; the SET must not)
+        assert set(cpu_map) == set(dev_map), (
+            sorted(set(cpu_map) ^ set(dev_map)))
+        assert len(cpu_map) == n, (len(cpu_map), n)
+        # ...and every admission completed, so final usage is zero
+        for name, _ in cq_specs:
+            for f in flavors:
+                assert cpu_env.usage(name, flavor=f) == 0, (name, f)
+                assert dev_env.usage(name, flavor=f) == 0, (name, f)
+        # residency stayed live and the mirror tracks the device exactly
+        # (a non-empty backlog is legitimately un-dispatched state)
+        rs = dev_env.scheduler.solver._resident
+        assert rs is not None and rs.usage_dev is not None, \
+            "residency was dropped during the run"
+        if not rs.device_backlog:
+            TestResidentState._assert_mirror_matches_device(
+                TestResidentState(), dev_env.scheduler.solver)

@@ -473,18 +473,25 @@ class Cache:
 
     # --- snapshot (reference: snapshot.go:79-142) ---
 
-    def snapshot(self) -> Snapshot:
+    def snapshot(self, light: bool = False) -> Snapshot:
+        # light=True shares the cache trees instead of deep-copying (see
+        # ClusterQueueSnapshot): READ-ONLY cycles only (the pipelined
+        # all-fit path, whose usage truth is the device-resident state).
         with self._lock:
             snap = Snapshot()
+            snap.light = light
             for name, cqc in self.hm.cluster_queues.items():
                 if not cqc.active:
                     snap.inactive_cluster_queue_sets.add(name)
                     continue
-                snap.cluster_queues[name] = ClusterQueueSnapshot(cqc)
+                snap.cluster_queues[name] = ClusterQueueSnapshot(cqc,
+                                                                light=light)
             snap.resource_flavors = dict(self.resource_flavors)
             cohort_snaps: dict = {}
             for cname, node in self.hm.cohorts.items():
-                cohort_snap = CohortSnapshot(cname, node.payload.resource_node.clone())
+                cohort_snap = CohortSnapshot(
+                    cname, node.payload.resource_node if light
+                    else node.payload.resource_node.clone())
                 # The monotonic capacity version: any capacity change
                 # anywhere (including in sibling subtrees of a tree)
                 # invalidates stored flavor-resume state via a `>` check.

@@ -44,19 +44,42 @@ class CohortSnapshot:
 
 
 class ClusterQueueSnapshot:
-    def __init__(self, cq: ClusterQueueCache):
+    def __init__(self, cq: ClusterQueueCache, light: bool = False):
+        """light=True shares the cache's structures instead of cloning
+        (READ-ONLY consumers only): pipelined all-fit cycles never
+        simulate on the snapshot — they read selectors, generations and
+        admission checks — and cloning 2k resource trees per cycle was
+        measurable. Any path that mutates snapshot state (preemption
+        simulation, intra-cycle accounting) must use a full snapshot.
+
+        Thread-safety contract: light readers may race cache mutators,
+        so they may only read (a) scalar fields and (b) container fields
+        the cache replaces WHOLESALE on update (resource_groups, quotas,
+        admission_checks, selectors — see ClusterQueueCache.update);
+        in-place-mutated containers (resource_node.usage, workloads) must
+        not be iterated through a light snapshot (the solver's establish
+        path re-takes a full snapshot for exactly this reason)."""
         self.name = cq.name
         self.cohort: Optional[CohortSnapshot] = None
-        self.resource_groups = [rg.clone() for rg in cq.resource_groups]
-        self.workloads = dict(cq.workloads)
-        self.workloads_not_ready = set(cq.workloads_not_ready)
+        self.light = light
+        if light:
+            self.resource_groups = cq.resource_groups
+            self.workloads = cq.workloads
+            self.workloads_not_ready = cq.workloads_not_ready
+            self.admission_checks = cq.admission_checks
+            self.resource_node = cq.resource_node
+        else:
+            self.resource_groups = [rg.clone() for rg in cq.resource_groups]
+            self.workloads = dict(cq.workloads)
+            self.workloads_not_ready = set(cq.workloads_not_ready)
+            self.admission_checks = {k: set(v)
+                                     for k, v in cq.admission_checks.items()}
+            self.resource_node = cq.resource_node.clone()
         self.namespace_selector = cq.namespace_selector
         self.preemption = cq.preemption
         self.fair_weight = cq.fair_weight
         self.flavor_fungibility = cq.flavor_fungibility
-        self.admission_checks = {k: set(v) for k, v in cq.admission_checks.items()}
         self.allocatable_resource_generation = cq.allocatable_resource_generation
-        self.resource_node = cq.resource_node.clone()
 
     # --- hierarchicalResourceNode protocol ---
 
@@ -159,6 +182,7 @@ class Snapshot:
     flavor_spec_epoch: int = 0  # ResourceFlavor spec version (taints/labels)
     topology_epoch: int = 0  # solver-topology version (Cache.topology_epoch)
     journal_seq: int = 0  # usage-journal position at snapshot time
+    light: bool = False  # shared (not cloned) state; read-only consumers
 
     def remove_workload(self, wl: wlpkg.Info) -> None:
         """Simulate removal (reference: snapshot.go:39)."""

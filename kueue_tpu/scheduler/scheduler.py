@@ -127,9 +127,6 @@ class Scheduler:
         self.pipeline_enabled = False
         self._inflight = None  # (InFlight, snapshot)
         self._pipeline_cooldown = 0
-        # Snapshot handed from a pipelined fallback to the sync path when
-        # no in-flight cycle was drained in between (still consistent).
-        self._fallback_snapshot = None
         # Adaptive routing (the production config): measure admitted/sec
         # per mode (pure-CPU cycle vs device cycle) over a sliding window
         # and run each cycle on the faster one, re-exploring the minority
@@ -211,10 +208,10 @@ class Scheduler:
                                    - self._drain_cost)
                 return signal
             # Pipeline not applicable this cycle: continue on the
-            # synchronous path. When an in-flight cycle was drained the
-            # snapshot must be re-taken (the drain admits workloads);
-            # otherwise the pipelined attempt's snapshot is still valid
-            # and is reused below.
+            # synchronous path with a FRESH full snapshot. The pipelined
+            # attempt's snapshot is LIGHT (shares the live cache's trees)
+            # and must never be handed to the sync path, which simulates
+            # preemption and accounts usage on its snapshot.
         elif self._inflight is not None:
             # The gate closed (cooldown, StrictFIFO appeared, pipeline
             # toggled off) with a cycle still in flight: drain it BEFORE
@@ -222,10 +219,7 @@ class Scheduler:
             # invisible to nominate() and its workloads stranded.
             self._drain_pipeline()
 
-        snapshot = self._fallback_snapshot
-        self._fallback_snapshot = None
-        if snapshot is None:
-            snapshot = self.cache.snapshot()
+        snapshot = self.cache.snapshot()
         vlog.dump_snapshot(self.log, snapshot)
 
         solver_entries: list = []
@@ -408,8 +402,10 @@ class Scheduler:
         Returns None to fall back to the synchronous path (any in-flight
         cycle has been drained first)."""
         solver = self.solver
-        had_inflight = self._inflight is not None
-        snapshot = self.cache.snapshot()
+        # Light snapshot: the all-fit pipelined cycle never simulates on
+        # it (usage truth is the device-resident state); cloning 2k
+        # resource trees per cycle was a measurable share of the cycle.
+        snapshot = self.cache.snapshot(light=True)
         valid_heads, invalid_entries = [], []
         for w in heads:
             if self.cache.is_assumed_or_admitted(w):
@@ -423,8 +419,6 @@ class Scheduler:
                 invalid_entries.append(e)
         if not valid_heads:
             self._drain_pipeline()
-            if not had_inflight:
-                self._fallback_snapshot = snapshot
             return None  # sync path handles the (all-invalid) heads
         try:
             plan = solver.prepare(snapshot, valid_heads)
@@ -461,13 +455,13 @@ class Scheduler:
                 or nofit_entries is None):
             # Mixed/preempt cycle (or no router): the synchronous path
             # owns those semantics — drain and fall through; the sync
-            # cycle processes these same popped heads directly. Cooldown
-            # one cycle so sustained contention doesn't pay a discarded
-            # prepare() every cycle.
+            # cycle processes these same popped heads directly with a
+            # FRESH full snapshot (the light one here must NEVER reach
+            # the sync path: its trees alias the live cache and the sync
+            # path simulates on them). Cooldown one cycle so sustained
+            # contention doesn't pay a discarded prepare() every cycle.
             self._drain_pipeline()
             self._pipeline_cooldown = 1
-            if not had_inflight:
-                self._fallback_snapshot = snapshot
             return None
         if len(nofit_idx) == len(plan.batch.infos):
             # Whole cycle is device-proved NoFit: nothing to dispatch.
@@ -488,8 +482,6 @@ class Scheduler:
         except Exception:  # noqa: BLE001 — device failure: sync fallback
             self._solver_invalidate()
             self._drain_pipeline()
-            if not had_inflight:
-                self._fallback_snapshot = snapshot
             return None
         for e in invalid_entries:
             self.requeue_and_update(e)

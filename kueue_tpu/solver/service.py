@@ -284,7 +284,16 @@ class BatchSolver:
                     rs.device_backlog = {}
             return state, deltas, True
         # (re)establish: the snapshot is the full truth — drop any journal
-        # history up to it, encode once, upload once.
+        # history up to it, encode once, upload once. A LIGHT snapshot's
+        # usage is live (not frozen at its journal_seq), so take a fresh
+        # full snapshot for the establishing encode; if the topology
+        # moved in between (a CQ added/activated concurrently), bail out
+        # — the scheduler falls back to the CPU path this cycle and the
+        # next prepare() re-encodes against the new epoch.
+        if getattr(snapshot, "light", False):
+            snapshot = self._cache.snapshot()
+            if snapshot.topology_epoch != self._topo_key:
+                raise RuntimeError("topology moved during establish")
         self._cache.drain_usage_journal(snapshot.journal_seq)
         state = encode.encode_state(snapshot, topo)
         rs = ResidentState(topo.token)

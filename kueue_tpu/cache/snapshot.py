@@ -116,10 +116,16 @@ class ClusterQueueSnapshot:
         return all(self.available(fr) >= q for fr, q in usage.items())
 
     def add_usage(self, usage: dict) -> None:
+        if self.light:
+            # writing through a light snapshot would mutate the LIVE
+            # cache's trees — corruption, not simulation
+            raise RuntimeError("mutating a light (shared) snapshot")
         for fr, q in usage.items():
             rnode.add_usage(self, fr, q)
 
     def remove_usage(self, usage: dict) -> None:
+        if self.light:
+            raise RuntimeError("mutating a light (shared) snapshot")
         for fr, q in usage.items():
             rnode.remove_usage(self, fr, q)
 
@@ -186,11 +192,15 @@ class Snapshot:
 
     def remove_workload(self, wl: wlpkg.Info) -> None:
         """Simulate removal (reference: snapshot.go:39)."""
+        if self.light:
+            raise RuntimeError("mutating a light (shared) snapshot")
         cq = self.cluster_queues[wl.cluster_queue]
         cq.workloads.pop(wl.key, None)
         cq.remove_usage(wl.flavor_resource_usage())
 
     def add_workload(self, wl: wlpkg.Info) -> None:
+        if self.light:
+            raise RuntimeError("mutating a light (shared) snapshot")
         cq = self.cluster_queues[wl.cluster_queue]
         cq.workloads[wl.key] = wl
         cq.add_usage(wl.flavor_resource_usage())

@@ -214,6 +214,12 @@ class BatchSolver:
         # keying on them rebuilt the topology every cycle under load.
         key = snapshot.topology_epoch
         if key != self._topo_key or self._topo_cache is None:
+            if getattr(snapshot, "light", False) and self._cache is not None:
+                # topology encode iterates whole resource trees — never
+                # off a light snapshot's shared live structures; take a
+                # full (frozen) one for the rebuild
+                snapshot = self._cache.snapshot()
+                key = snapshot.topology_epoch
             self._topo_key = key
             topo = encode.encode_topology(snapshot)
             self._topo_cache = (topo, topo_to_device(topo))
@@ -233,7 +239,8 @@ class BatchSolver:
         if not entries:
             return None
         topo, topo_dev = self._topology(snapshot)
-        state, deltas, resident = self._state_for_cycle(snapshot, topo)
+        state, deltas, resident, snapshot = self._state_for_cycle(snapshot,
+                                                                  topo)
         batch = encode.encode_workloads(entries, snapshot, topo,
                                         ordering=self.ordering,
                                         max_podsets=self.max_podsets)
@@ -253,10 +260,13 @@ class BatchSolver:
 
     def _state_for_cycle(self, snapshot: Snapshot, topo):
         """Returns (state-with-mirror-arrays, encoded deltas or None,
-        resident?). Establishes residency on the first cycle (full encode
-        + upload), reconciles via the journal afterwards."""
+        resident?, the snapshot the cycle should encode against — the
+        establishing path replaces a light one with a fresh full one so
+        batch generations match the encoded usage). Establishes residency
+        on the first cycle (full encode + upload), reconciles via the
+        journal afterwards."""
         if not self.resident_capable:
-            return encode.encode_state(snapshot, topo), None, False
+            return encode.encode_state(snapshot, topo), None, False, snapshot
         rs = self._resident
         if rs is not None and rs.token == topo.token \
                 and self._reconcile(snapshot, topo):
@@ -282,7 +292,7 @@ class BatchSolver:
                           if rs.device_backlog else None)
                 if deltas is None:
                     rs.device_backlog = {}
-            return state, deltas, True
+            return state, deltas, True, snapshot
         # (re)establish: the snapshot is the full truth — drop any journal
         # history up to it, encode once, upload once. A LIGHT snapshot's
         # usage is live (not frozen at its journal_seq), so take a fresh
@@ -300,7 +310,7 @@ class BatchSolver:
         rs.mirror_usage = state.usage
         rs.mirror_cohort = state.cohort_usage
         self._resident = rs
-        return state, None, True
+        return state, None, True, snapshot
 
     def _reconcile(self, snapshot: Snapshot, topo) -> bool:
         """Drain the cache journal up to the snapshot: device admissions

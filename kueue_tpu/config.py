@@ -128,6 +128,18 @@ class Resources:
 
 
 @dataclass
+class LeaderElectionConfig:
+    """HA replica coordination (reference: cmd/kueue/main.go leader
+    election flags + config.Configuration LeaderElection; the scheduler
+    is leader-gated via NeedLeaderElection, scheduler.go:144)."""
+    leader_elect: bool = False
+    # reference default resource name (defaults.go DefaultLeaderElectionID)
+    resource_name: str = "c1f6bfd2.kueue.x-k8s.io"
+    lease_duration_seconds: float = 15.0
+    retry_period_seconds: float = 2.0
+
+
+@dataclass
 class SolverConfig:
     """TPU-solver plane wiring — new in this build (no reference analogue;
     plays the role BASELINE.json assigns to the AdmissionCheck-style solver
@@ -164,6 +176,8 @@ class Configuration:
     multi_kueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     resources: Resources = field(default_factory=Resources)
     solver: SolverConfig = field(default_factory=SolverConfig)
+    leader_election: LeaderElectionConfig = field(
+        default_factory=LeaderElectionConfig)
     feature_gates: dict[str, bool] = field(default_factory=dict)
 
 

@@ -71,7 +71,15 @@ class ClusterQueueReconciler:
                (act.pending_active(), act.pending_inadmissible())
                if act is not None else self.queues.pending(key),
                cqc.usage_version,
-               cqc.active)
+               cqc.active,
+               # Cohort-level inputs: a sibling CQ's or cohort's quota
+               # change alters this CQ's weighted share / lendable math,
+               # and the inactive message can change (different missing
+               # flavor) while `active` stays False. topology_epoch moves
+               # on spec-level changes only — not workload churn — so the
+               # fan-out-echo skip stays effective.
+               self.cache.topology_epoch,
+               cqc.inactive_reason() if not cqc.active else "")
         if self._last_sig.get(key) == sig:
             self.queues.update_snapshot(key, self.snapshot_max_count)
             return None

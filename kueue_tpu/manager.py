@@ -67,12 +67,20 @@ class KueueManager:
     def __init__(self, cfg: Optional[cfgpkg.Configuration] = None,
                  clock: Clock = REAL_CLOCK, solver=None,
                  registered_check_controllers: Optional[set] = None,
-                 remote_clusters: Optional[dict] = None):
+                 remote_clusters: Optional[dict] = None,
+                 store: Optional[Store] = None, identity: str = ""):
+        """store/identity: HA replicas share one Store (the apiserver
+        stand-in) and elect a leader over it; identity names this
+        replica in the lease (auto-generated when empty)."""
         self.cfg = cfgpkg.set_defaults(cfg or cfgpkg.Configuration())
         from kueue_tpu.utils import vlog
-        vlog.set_verbosity(self.cfg.verbosity)
+        # Don't clobber a KUEUE_TPU_V env override with the config
+        # default: the louder of config and the ENV override wins (not
+        # the mutable global — a previous manager's level must not
+        # ratchet this one's).
+        vlog.set_verbosity(max(self.cfg.verbosity, vlog.env_verbosity()))
         self.clock = clock
-        self.store = Store(clock)
+        self.store = store if store is not None else Store(clock)
         self.recorder = EventRecorder()
         self.metrics = Registry()
         self.runtime = Runtime(clock)
@@ -140,6 +148,49 @@ class KueueManager:
             self.scheduler.solver_routing = self.cfg.solver.routing
             from kueue_tpu.utils.runtime import enable_compilation_cache
             enable_compilation_cache()
+
+        # Leader election (HA): the scheduler is leader-gated — the
+        # reference's NeedLeaderElection (scheduler.go:144) — while the
+        # watch-driven caches stay live on every replica for fast
+        # failover. The elector renews through a runtime controller so
+        # deterministic drivers (run_until_idle/advance) exercise
+        # acquire/renew/expiry with the injected clock.
+        self.elector = None
+        le = self.cfg.leader_election
+        if le.leader_elect:
+            import uuid
+            from kueue_tpu.utils.leaderelection import (
+                LeaderAwareReconciler, LeaderElector)
+            self.identity = identity or f"kueue-manager-{uuid.uuid4().hex[:8]}"
+            self.elector = LeaderElector(
+                self.store, self.identity, lease_name=le.resource_name,
+                lease_duration=le.lease_duration_seconds,
+                retry_period=le.retry_period_seconds, clock=clock)
+            self.scheduler.leader_check = self.elector.is_leader
+
+            # Every reconciler becomes leader-aware: non-leader replicas
+            # delay status WRITES (requeue-after) while the watch-driven
+            # caches above stay live on every replica — the reference's
+            # leader_aware_reconciler.go:89 split. The elector itself
+            # runs as a runtime controller so the deterministic drivers
+            # exercise acquire/renew/expiry with the injected clock.
+            class _Inner:
+                def __init__(self, fn):
+                    self.reconcile = fn
+
+            for ctrl in self.runtime.controllers:
+                # Delayed by lease_duration, not retry_period: leadership
+                # can't change faster than a lease expiry, and a tight
+                # requeue would have thousands of parked keys polling a
+                # real clock on every standby replica.
+                ctrl._reconcile = LeaderAwareReconciler(
+                    _Inner(ctrl._reconcile), self.elector,
+                    requeue_seconds=le.lease_duration_seconds).reconcile
+            ctrl = self.runtime.controller(
+                "leaderelection",
+                lambda _key: (self.elector.tick(),
+                              le.retry_period_seconds)[1])
+            ctrl.enqueue("lease")
 
     def _namespace_labels(self, ns: str) -> Optional[dict]:
         obj = self.store.try_get("Namespace", "", ns)

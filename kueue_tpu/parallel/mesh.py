@@ -57,7 +57,12 @@ def make_mesh(devices=None, axis_name: str = "cohorts") -> Mesh:
 
 # Compiled sharded cycles, keyed on everything that changes the traced
 # program (argument shapes re-key through jit's own tracing cache).
-_SHARDED_CACHE: dict = {}
+# LRU-bounded: max_rank is part of the key and varies per cycle, so a
+# workload mix with many hot variants must evict one-at-a-time instead
+# of thrashing the whole cache.
+from collections import OrderedDict
+
+_SHARDED_CACHE: OrderedDict = OrderedDict()
 
 
 def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int,
@@ -73,12 +78,14 @@ def solve_cycle_sharded(mesh: Mesh, topo: dict, state, batch, num_podsets: int,
     if fn is None:
         if len(_SHARDED_CACHE) >= 16:
             # Bound executable + Mesh retention (test suites build many
-            # meshes; max_rank varies per cycle). Rebuild-on-miss is the
-            # cost of the rare eviction.
-            _SHARDED_CACHE.clear()
+            # meshes; max_rank varies per cycle): drop the least recently
+            # used entry only.
+            _SHARDED_CACHE.popitem(last=False)
         fn = _build_sharded(mesh, num_podsets, fair_sharing, max_rank,
                             preempt_args is not None)
         _SHARDED_CACHE[key] = fn
+    else:
+        _SHARDED_CACHE.move_to_end(key)
     if start_rank is None:
         start_rank = np.zeros(batch.requests.shape, np.int32)
     args = (topo, state.usage, state.cohort_usage, batch.requests,

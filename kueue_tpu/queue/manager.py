@@ -300,6 +300,11 @@ class Manager:
             cqh = self.cluster_queues.get(cq_name)
             return cqh.pending() if cqh else 0
 
+    def pending_total(self) -> int:
+        """Total pending (active + inadmissible) across all CQs."""
+        with self._lock:
+            return sum(cqh.pending() for cqh in self.cluster_queues.values())
+
     def pending_workloads_info(self, cq_name: str) -> list:
         with self._lock:
             cqh = self.cluster_queues.get(cq_name)

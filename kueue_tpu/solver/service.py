@@ -142,6 +142,15 @@ class BatchSolver:
         # Per-cycle host<->device payload accounting (bench visibility).
         self.last_upload_bytes = 0
         self.last_fetch_bytes = 0
+        # Cumulative per-phase wall time + engagement counters, reported
+        # by the perf harness (VERDICT r4 missing #4: the artifacts must
+        # show whether residency/pipelining engaged and where the cycle
+        # time goes: encode, route, dispatch, fetch, decode).
+        self.phase_s = {"encode": 0.0, "route": 0.0, "dispatch": 0.0,
+                        "fetch": 0.0, "decode": 0.0}
+        self.counters = {"prepares": 0, "dispatches": 0, "collects": 0,
+                         "resident_cycles": 0, "establishes": 0,
+                         "upload_bytes": 0, "fetch_bytes": 0}
 
     def bind_cache(self, cache) -> None:
         """Attach the scheduler's Cache: enables the usage journal that
@@ -238,16 +247,25 @@ class BatchSolver:
         re-encode + re-upload."""
         if not entries:
             return None
+        import time as _t
+        t0 = _t.perf_counter()
+        self.counters["prepares"] += 1
         topo, topo_dev = self._topology(snapshot)
         state, deltas, resident, snapshot = self._state_for_cycle(snapshot,
                                                                   topo)
+        if resident:
+            self.counters["resident_cycles"] += 1
         batch = encode.encode_workloads(entries, snapshot, topo,
                                         ordering=self.ordering,
                                         max_podsets=self.max_podsets)
         if not batch.solvable.any():
+            self.phase_s["encode"] += _t.perf_counter() - t0
             return None
         start_rank = batch.start_rank if batch.start_rank.any() else None
+        t1 = _t.perf_counter()
+        self.phase_s["encode"] += t1 - t0
         fit_pred = self._route(topo, state, batch, start_rank)
+        self.phase_s["route"] += _t.perf_counter() - t1
         plan = Plan(topo, topo_dev, state, batch, start_rank, fit_pred)
         plan.deltas = deltas
         plan.resident = resident
@@ -489,6 +507,7 @@ class BatchSolver:
         as next cycle's inputs — the upload is the workload batch plus
         sparse corrections only."""
         import time
+        t0 = time.perf_counter()
         topo, topo_dev, state, batch = (plan.topo, plan.topo_dev,
                                         plan.state, plan.batch)
         start_rank = plan.start_rank
@@ -571,9 +590,14 @@ class BatchSolver:
         if fargs is not None:
             up += sum(np.asarray(a).nbytes for a in fargs)
         self.last_upload_bytes = up
+        self.counters["dispatches"] += 1
+        self.counters["upload_bytes"] += up
+        if plan.resident and establishing:
+            self.counters["establishes"] += 1
         inflight = InFlight(plan, result, keys, preempt_batch)
         inflight.fair_batch = fair_batch
         inflight.t_dispatch = time.perf_counter()
+        self.phase_s["dispatch"] += inflight.t_dispatch - t0
         return inflight
 
     def start_fetch(self, inflight: InFlight) -> None:
@@ -602,8 +626,12 @@ class BatchSolver:
                                       for k in inflight.keys
                                       if k in inflight.result})
             self._observe_sync((time.perf_counter() - t0) * 1e3)
+        t_fetch = time.perf_counter()
+        self.phase_s["fetch"] += t_fetch - t0
+        self.counters["collects"] += 1
         self.last_fetch_bytes = sum(
             np.asarray(v).nbytes for v in fetched.values())
+        self.counters["fetch_bytes"] += self.last_fetch_bytes
         aux = None
         if inflight.preempt_batch is not None:
             aux = {"preempt": (np.asarray(fetched["preempt_targets"]),
@@ -619,6 +647,7 @@ class BatchSolver:
         decisions = self._decode_batch(plan.batch.infos, snapshot, plan.topo,
                                        plan.batch, fetched,
                                        resident=resident_ok)
+        self.phase_s["decode"] += time.perf_counter() - t_fetch
         return decisions, aux
 
     def batched_partial_admission(self, plan: Plan, snapshot: Snapshot,

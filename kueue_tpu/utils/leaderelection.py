@@ -1,0 +1,186 @@
+"""Leader election over the object store (HA manager replicas).
+
+Reference behavior being matched (not copied):
+- cmd/kueue wires controller-runtime leader election with a
+  coordination/v1 Lease; the scheduler declares NeedLeaderElection
+  (pkg/scheduler/scheduler.go:144) so only the leader runs admission
+  cycles.
+- Non-leader replicas still run READ paths, and leader-aware
+  reconcilers delegate writes until leadership is acquired, requeueing
+  with a delay instead of erroring
+  (pkg/controller/core/leader_aware_reconciler.go:89).
+
+The Lease object lives in the same Store the rest of the control plane
+uses (the apiserver stand-in), so failover semantics ride the store's
+optimistic concurrency: acquire/renew is an expect_rv update, and a
+conflicting writer simply loses the race — exactly the client-go
+leaderelection.go acquire loop's contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kueue_tpu.api.meta import REAL_CLOCK, Clock, ObjectMeta
+from kueue_tpu.sim.store import AlreadyExists, Conflict, Store
+
+LEASE_NAMESPACE = "kueue-system"
+DEFAULT_LEASE_NAME = "kueue-manager"
+DEFAULT_LEASE_DURATION = 15.0   # client-go defaults: 15s / 10s / 2s
+DEFAULT_RENEW_DEADLINE = 10.0
+DEFAULT_RETRY_PERIOD = 2.0
+
+
+@dataclass
+class LeaseSpec:
+    holder_identity: str = ""
+    lease_duration_seconds: float = DEFAULT_LEASE_DURATION
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease:
+    """coordination/v1 Lease equivalent for the sim store."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+
+class LeaderElector:
+    """Single-step acquire/renew loop (client-go leaderelection.go
+    tryAcquireOrRenew), driven by the manager's runtime: call
+    ``tick()`` every retry_period. Callbacks fire on transitions."""
+
+    def __init__(self, store: Store, identity: str,
+                 lease_name: str = DEFAULT_LEASE_NAME,
+                 lease_duration: float = DEFAULT_LEASE_DURATION,
+                 renew_deadline: float = DEFAULT_RENEW_DEADLINE,
+                 retry_period: float = DEFAULT_RETRY_PERIOD,
+                 clock: Clock = REAL_CLOCK,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None):
+        self.store = store
+        self.identity = identity
+        self.lease_name = lease_name
+        self.lease_duration = lease_duration
+        self.renew_deadline = min(renew_deadline, lease_duration)
+        self.retry_period = retry_period
+        self.clock = clock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._leading = False
+        self._last_renew = 0.0
+
+    def is_leader(self) -> bool:
+        """Leadership is only trusted within renew_deadline of the last
+        successful renew (client-go's RenewDeadline): a stalled leader
+        whose runtime wakes up AFTER another replica could have acquired
+        the lease must see itself demoted BEFORE its next tick — this
+        check is what the scheduler's leader gate reads, so the
+        dual-leader window is closed deterministically
+        (renew_deadline <= lease_duration, the earliest takeover time)."""
+        return (self._leading
+                and self.clock.now() < self._last_renew + self.renew_deadline)
+
+    def leader_identity(self) -> str:
+        lease = self.store.try_get("Lease", LEASE_NAMESPACE, self.lease_name)
+        if lease is None:
+            return ""
+        if self._expired(lease):
+            return ""
+        return lease.spec.holder_identity
+
+    def tick(self) -> bool:
+        """One acquire-or-renew attempt; returns is_leader afterwards."""
+        won = self._try_acquire_or_renew()
+        if won:
+            self._last_renew = self.clock.now()
+        if won and not self._leading:
+            self._leading = True
+            if self.on_started_leading is not None:
+                self.on_started_leading()
+        elif not won and self._leading:
+            self._leading = False
+            if self.on_stopped_leading is not None:
+                self.on_stopped_leading()
+        return self._leading
+
+    def release(self) -> None:
+        """Voluntarily give up the lease (graceful shutdown), so the
+        next replica doesn't wait out the full lease duration."""
+        if not self._leading:
+            return
+        lease = self.store.try_get("Lease", LEASE_NAMESPACE, self.lease_name)
+        if lease is not None and lease.spec.holder_identity == self.identity:
+            lease.spec.holder_identity = ""
+            lease.spec.renew_time = 0.0
+            try:
+                self.store.update(lease,
+                                  expect_rv=lease.metadata.resource_version)
+            except (Conflict, KeyError):
+                pass
+        self._leading = False
+        if self.on_stopped_leading is not None:
+            self.on_stopped_leading()
+
+    # -- internals --
+
+    def _expired(self, lease: Lease) -> bool:
+        return (not lease.spec.holder_identity
+                or self.clock.now() >= lease.spec.renew_time
+                + lease.spec.lease_duration_seconds)
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = self.clock.now()
+        lease = self.store.try_get("Lease", LEASE_NAMESPACE, self.lease_name)
+        if lease is None:
+            lease = Lease(metadata=ObjectMeta(name=self.lease_name,
+                                              namespace=LEASE_NAMESPACE),
+                          spec=LeaseSpec(
+                              holder_identity=self.identity,
+                              lease_duration_seconds=self.lease_duration,
+                              acquire_time=now, renew_time=now))
+            try:
+                self.store.create(lease)
+                return True
+            except AlreadyExists:  # lost the creation race
+                return False
+        mine = lease.spec.holder_identity == self.identity
+        if not mine and not self._expired(lease):
+            return False
+        lease.spec.renew_time = now
+        if not mine:
+            lease.spec.holder_identity = self.identity
+            lease.spec.acquire_time = now
+            lease.spec.lease_transitions += 1
+        try:
+            self.store.update(lease,
+                              expect_rv=lease.metadata.resource_version)
+        except (Conflict, KeyError):
+            return False  # a concurrent replica renewed/acquired first
+        return True
+
+
+class LeaderAwareReconciler:
+    """Wrap a reconciler so non-leader replicas delay writes instead of
+    performing them (reference: leader_aware_reconciler.go:89 — requeue
+    with RequeueAfter until this replica becomes the leader). Read-only
+    event handling stays live on every replica, keeping caches warm for
+    a fast failover."""
+
+    def __init__(self, inner, elector: LeaderElector,
+                 requeue_seconds: Optional[float] = None):
+        self.inner = inner
+        self.elector = elector
+        self.requeue_seconds = (requeue_seconds if requeue_seconds is not None
+                                else elector.retry_period)
+
+    def reconcile(self, key: str):
+        if not self.elector.is_leader():
+            return self.requeue_seconds
+        return self.inner.reconcile(key)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

@@ -50,3 +50,49 @@ def enable_compilation_cache(cache_dir: str = None) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:  # noqa: BLE001 — older jax without the knobs
         pass
+
+
+def ensure_live_backend(reexec_argv=None, timeout_s: float = 90.0) -> dict:
+    """The axon TPU tunnel can die outright (device ops hang forever in
+    native code). Probe it with a bounded thread; on timeout, re-exec
+    the given argv on the local XLA-CPU backend with a visible marker —
+    a labeled CPU-backend run beats a silent infinite hang. Returns
+    {"backend": ..., "cpu_fallback": ...} once a backend is live, so
+    harnesses can stamp every artifact they emit (VERDICT r4 ask #1:
+    perf evidence must be attributable)."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import threading
+
+    fallback = bool(os.environ.get("KUEUE_TPU_BENCH_CPU_FALLBACK"))
+    if not fallback:
+        ok = threading.Event()
+
+        def probe():
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            np.asarray(jax.jit(lambda a: a + 1)(jnp.ones(4, jnp.int32)))
+            ok.set()
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if not ok.is_set():
+            print(json.dumps({
+                "backend_probe": "accelerator tunnel unresponsive; "
+                                 "re-running on the local XLA-CPU backend "
+                                 "(numbers are NOT TPU numbers)"}),
+                file=sys.stderr, flush=True)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PALLAS_AXON_POOL_IPS"] = ""
+            env["KUEUE_TPU_BENCH_CPU_FALLBACK"] = "1"
+            sys.stdout.flush()
+            raise SystemExit(subprocess.call(
+                reexec_argv or [sys.executable] + sys.argv, env=env))
+    import jax
+    return {"backend": jax.devices()[0].platform.lower(),
+            "cpu_fallback": fallback}

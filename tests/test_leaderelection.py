@@ -1,0 +1,191 @@
+"""Leader election / HA (reference: cmd/kueue leader election wiring,
+pkg/scheduler/scheduler.go:144 NeedLeaderElection,
+pkg/controller/core/leader_aware_reconciler.go:89)."""
+
+from kueue_tpu import config as cfgpkg
+from kueue_tpu.api.meta import FakeClock
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.manager import KueueManager
+from kueue_tpu.sim.store import Store
+from kueue_tpu.utils.leaderelection import (
+    LeaderAwareReconciler,
+    LeaderElector,
+)
+
+from tests.wrappers import (
+    ClusterQueueWrapper,
+    WorkloadWrapper,
+    flavor_quotas,
+    make_flavor,
+    make_local_queue,
+)
+
+
+class TestLeaderElector:
+    def test_acquires_fresh_lease(self):
+        clock = FakeClock(100.0)
+        store = Store(clock)
+        e = LeaderElector(store, "rep-a", clock=clock)
+        assert e.tick() is True
+        assert e.is_leader()
+        assert e.leader_identity() == "rep-a"
+
+    def test_second_replica_waits_then_takes_over_on_expiry(self):
+        clock = FakeClock(100.0)
+        store = Store(clock)
+        a = LeaderElector(store, "rep-a", lease_duration=15.0, clock=clock)
+        b = LeaderElector(store, "rep-b", lease_duration=15.0, clock=clock)
+        assert a.tick()
+        assert not b.tick()
+        # a renews within the lease: b still locked out
+        clock.advance(10.0)
+        assert a.tick()
+        clock.advance(10.0)
+        assert not b.tick()
+        # a dies (stops renewing); lease expires; b takes over
+        clock.advance(15.0)
+        assert b.tick()
+        assert b.is_leader()
+        lease = store.get("Lease", "kueue-system", a.lease_name)
+        assert lease.spec.holder_identity == "rep-b"
+        assert lease.spec.lease_transitions == 1
+        # a notices it lost on its next tick
+        assert not a.tick()
+        assert not a.is_leader()
+
+    def test_release_hands_over_immediately(self):
+        clock = FakeClock(100.0)
+        store = Store(clock)
+        stopped = []
+        a = LeaderElector(store, "rep-a", clock=clock,
+                          on_stopped_leading=lambda: stopped.append(1))
+        b = LeaderElector(store, "rep-b", clock=clock)
+        assert a.tick()
+        a.release()
+        assert stopped == [1]
+        assert not a.is_leader()
+        assert b.tick()  # no need to wait out the lease duration
+
+    def test_transition_callbacks_fire_once(self):
+        clock = FakeClock(100.0)
+        store = Store(clock)
+        started = []
+        a = LeaderElector(store, "rep-a", clock=clock,
+                          on_started_leading=lambda: started.append(1))
+        assert a.tick()
+        assert a.tick()  # renewal: no second callback
+        assert started == [1]
+
+    def test_concurrent_renew_conflict_loses(self):
+        clock = FakeClock(100.0)
+        store = Store(clock)
+        a = LeaderElector(store, "rep-a", lease_duration=15.0, clock=clock)
+        b = LeaderElector(store, "rep-b", lease_duration=15.0, clock=clock)
+        assert a.tick()
+        clock.advance(20.0)  # expired: both replicas race for it
+        assert b.tick()      # b wins the store update first
+        assert not a.tick()  # a's expect_rv update conflicts
+
+
+class TestLeaderAwareReconciler:
+    def test_non_leader_requeues_leader_delegates(self):
+        clock = FakeClock(100.0)
+        store = Store(clock)
+        e = LeaderElector(store, "rep-a", retry_period=2.0, clock=clock)
+        calls = []
+
+        class Inner:
+            def reconcile(self, key):
+                calls.append(key)
+                return None
+
+        r = LeaderAwareReconciler(Inner(), e)
+        assert r.reconcile("k") == 2.0  # delayed, not executed
+        assert calls == []
+        e.tick()
+        assert r.reconcile("k") is None
+        assert calls == ["k"]
+
+
+def _ha_manager(store, clock, identity):
+    cfg = cfgpkg.Configuration()
+    cfg.leader_election.leader_elect = True
+    return KueueManager(cfg=cfg, clock=clock, store=store, identity=identity)
+
+
+class TestManagerHA:
+    def test_only_leader_schedules_and_failover_works(self):
+        clock = FakeClock(1000.0)
+        store = Store(clock)
+        m1 = _ha_manager(store, clock, "rep-1")
+        m2 = _ha_manager(store, clock, "rep-2")
+        # m1 registered its elector controller first: it wins the lease
+        m1.run_until_idle()
+        m2.run_until_idle()
+        assert m1.elector.is_leader()
+        assert not m2.elector.is_leader()
+
+        store.create(make_flavor("default"))
+        store.create(ClusterQueueWrapper("cq").resource_group(
+            flavor_quotas("default", cpu=10)).obj())
+        store.create(make_local_queue("lq", "default", "cq"))
+        m1.run_until_idle()
+        m2.run_until_idle()
+        store.create(WorkloadWrapper("w1").queue("lq")
+                     .request("cpu", "1").obj())
+        m1.run_until_idle()
+        m2.run_until_idle()
+
+        # non-leader's scheduler is gated; leader admits
+        m2.schedule_once()
+        assert not wlpkg.has_quota_reservation(
+            store.get("Workload", "default", "w1"))
+        m1.schedule_once()
+        assert wlpkg.has_quota_reservation(
+            store.get("Workload", "default", "w1"))
+
+        # failover: m1 stops renewing (crashed); after the lease expires
+        # m2's next tick takes over and its scheduler un-gates
+        store.create(WorkloadWrapper("w2").queue("lq")
+                     .request("cpu", "1").obj())
+        m2.run_until_idle()
+        clock.advance(20.0)
+        m2.advance(0.0)  # release m2's due renewal timer
+        assert m2.elector.is_leader()
+        m2.schedule_once()
+        assert wlpkg.has_quota_reservation(
+            store.get("Workload", "default", "w2"))
+
+
+class TestPipelineAbandonOnLeadershipLoss:
+    def test_inflight_cycle_abandoned_not_admitted(self):
+        """Losing the lease with a pipelined cycle in flight must NOT
+        admit its device decisions (another replica may admit the same
+        heads); the heads requeue and residency is invalidated."""
+        from kueue_tpu.solver import BatchSolver
+        from tests.test_scheduler import Env
+        from tests.wrappers import ClusterQueueWrapper, flavor_quotas
+
+        env = Env()
+        env.scheduler.solver = BatchSolver()
+        env.scheduler.solver_min_heads = 0
+        env.scheduler.pipeline_enabled = True
+        env.add_flavor("default")
+        env.add_cq(ClusterQueueWrapper("cq").resource_group(
+            flavor_quotas("default", cpu="8")).obj(), "lq")
+        env.submit(WorkloadWrapper("w0").queue("lq")
+                   .pod_set(count=1, cpu="1").obj())
+        leading = [True]
+        env.scheduler.leader_check = lambda: leading[0]
+        env.scheduler.schedule(timeout=0)  # dispatch-only cycle
+        assert env.scheduler._inflight is not None
+        leading[0] = False
+        env.scheduler.schedule(timeout=0)
+        assert env.scheduler._inflight is None
+        assert env.client.applied == {}  # decisions dropped, not applied
+        assert env.scheduler.solver._resident is None  # residency reset
+        # re-acquire: the requeued head admits through a fresh cycle
+        leading[0] = True
+        for _ in range(3):
+            env.scheduler.schedule(timeout=0)
+        assert "default/w0" in env.client.applied

@@ -37,6 +37,8 @@ class ClusterQueueReconciler:
         self.report_resource_metrics = report_resource_metrics
         self.snapshot_max_count = snapshot_max_count
         self._last_sig: dict = {}  # cq name -> last written status inputs
+        from kueue_tpu.controller.core.status_usage import FlavorUsageCache
+        self._usage_cache = FlavorUsageCache()
 
     def reconcile(self, key: str):
         cq = self.store.try_get("ClusterQueue", "", key, copy_object=False)
@@ -81,7 +83,6 @@ class ClusterQueueReconciler:
                self.cache.topology_epoch,
                cqc.inactive_reason() if not cqc.active else "")
         if self._last_sig.get(key) == sig:
-            self.queues.update_snapshot(key, self.snapshot_max_count)
             return None
         self._last_sig[key] = sig
         # status (reference: :334-449)
@@ -93,8 +94,10 @@ class ClusterQueueReconciler:
             pending_workloads=self.queues.pending(key),
             reserving_workloads=cqc.reserving_workloads_count(),
             admitted_workloads=cqc.admitted_workloads_count,
-            flavors_reservation=_flavor_usage(cq.spec, reservation_usage, cqc),
-            flavors_usage=_flavor_usage(cq.spec, admitted_usage, cqc))
+            flavors_reservation=self._usage_cache.build(
+                key, "resv", cq.spec, reservation_usage, borrowed=True),
+            flavors_usage=self._usage_cache.build(
+                key, "adm", cq.spec, admitted_usage, borrowed=True))
         cq = status_obj
 
         active = cqc.active
@@ -125,8 +128,10 @@ class ClusterQueueReconciler:
             if self.report_resource_metrics:
                 self._report_resource_metrics(cq, reservation_usage, admitted_usage)
 
-        # QueueVisibility top-N snapshot (reference: :553+)
-        self.queues.update_snapshot(key, self.snapshot_max_count)
+        # QueueVisibility top-N snapshots refresh on the manager's timed
+        # task (reference: :553+ runs them on the QueueVisibility
+        # interval, not per reconcile — a full backlog sort per status
+        # echo was a top control-plane cost at the 2k-CQ scale).
         return None
 
     def _report_resource_metrics(self, cq, reservation_usage, admitted_usage):
@@ -159,6 +164,7 @@ class ClusterQueueReconciler:
             self.cache.delete_cluster_queue(name)
             self.queues.delete_cluster_queue(name)
             self._last_sig.pop(name, None)
+            self._usage_cache.forget(name)
             if self.metrics:
                 self.metrics.clear_cluster_queue_metrics(name)
             return
@@ -182,17 +188,4 @@ def _reason_token(reason: str) -> str:
     return reason.split(":", 1)[0] if reason else "Unknown"
 
 
-def _flavor_usage(spec: api.ClusterQueueSpec, usage: dict, cqc) -> list:
-    """FlavorResource dict -> status FlavorUsage list in spec order, with
-    borrowed = usage above nominal quota (reference: :372-418)."""
-    out = []
-    for rg in spec.resource_groups:
-        for fq in rg.flavors:
-            resources = []
-            for quota in fq.resources:
-                used = usage.get((fq.name, quota.name), 0)
-                resources.append(api.ResourceUsage(
-                    name=quota.name, total=used,
-                    borrowed=max(0, used - quota.nominal_quota)))
-            out.append(api.FlavorUsage(name=fq.name, resources=resources))
-    return out
+

@@ -29,6 +29,8 @@ class LocalQueueReconciler:
         self.clock = clock
         self.metrics = metrics
         self._last_sig: dict = {}  # lq key -> last written status inputs
+        from kueue_tpu.controller.core.status_usage import FlavorUsageCache
+        self._usage_cache = FlavorUsageCache()
 
     def reconcile(self, key: str):
         namespace, name = key.split("/", 1)
@@ -86,8 +88,10 @@ class LocalQueueReconciler:
             lq.status.reserving_workloads = usage.reserving_workloads
             lq.status.admitted_workloads = usage.admitted_workloads
             if cq is not None:
-                lq.status.flavors_reservation = _lq_flavor_usage(cq.spec, usage.usage)
-                lq.status.flavors_usage = _lq_flavor_usage(cq.spec, usage.admitted_usage)
+                lq.status.flavors_reservation = self._usage_cache.build(
+                    key, "resv", cq.spec, usage.usage, borrowed=False)
+                lq.status.flavors_usage = self._usage_cache.build(
+                    key, "adm", cq.spec, usage.admitted_usage, borrowed=False)
         else:
             lq.status.reserving_workloads = 0
             lq.status.admitted_workloads = 0
@@ -110,6 +114,7 @@ class LocalQueueReconciler:
             self.queues.delete_local_queue(lq)
             self.cache.delete_local_queue(lq)
             self._last_sig.pop(key, None)
+            self._usage_cache.forget(key)
             return
         else:
             if old is not None and old.spec.cluster_queue != lq.spec.cluster_queue:
@@ -119,12 +124,4 @@ class LocalQueueReconciler:
         enqueue(key)
 
 
-def _lq_flavor_usage(cq_spec: api.ClusterQueueSpec, usage: dict) -> list:
-    out = []
-    for rg in cq_spec.resource_groups:
-        for fq in rg.flavors:
-            resources = [api.ResourceUsage(name=q.name,
-                                           total=usage.get((fq.name, q.name), 0))
-                         for q in fq.resources]
-            out.append(api.FlavorUsage(name=fq.name, resources=resources))
-    return out
+

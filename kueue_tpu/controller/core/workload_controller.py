@@ -55,9 +55,17 @@ class WorkloadReconciler:
 
     def reconcile(self, key: str):
         namespace, name = key.split("/", 1)
-        wl = self.store.try_get("Workload", namespace, name)
-        if wl is None:
+        # Shared read for the no-write early exits (most reconciles are
+        # fan-out echoes of finished/stable workloads); clone only once
+        # a mutating path is possible.
+        shared = self.store.try_get("Workload", namespace, name,
+                                    copy_object=False)
+        if shared is None:
             return None
+        if wlpkg.is_finished(shared) \
+                and shared.metadata.deletion_timestamp is None:
+            return None
+        wl = api.clone_workload(shared)
         now = self.clock.now()
 
         # orphan GC (reference: :146-148)

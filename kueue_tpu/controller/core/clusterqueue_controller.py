@@ -26,8 +26,7 @@ REQUEUE_TERMINATING_SECONDS = 1.0
 
 class ClusterQueueReconciler:
     def __init__(self, store: Store, queues, cache, recorder: EventRecorder,
-                 clock, metrics=None, report_resource_metrics: bool = False,
-                 snapshot_max_count: int = 10):
+                 clock, metrics=None, report_resource_metrics: bool = False):
         self.store = store
         self.queues = queues
         self.cache = cache
@@ -35,7 +34,6 @@ class ClusterQueueReconciler:
         self.clock = clock
         self.metrics = metrics
         self.report_resource_metrics = report_resource_metrics
-        self.snapshot_max_count = snapshot_max_count
         self._last_sig: dict = {}  # cq name -> last written status inputs
         from kueue_tpu.controller.core.status_usage import FlavorUsageCache
         self._usage_cache = FlavorUsageCache()

@@ -100,8 +100,16 @@ class Runner:
             # run will hit, so no measured cycle or router sample carries
             # a compile. Widths: the full-backlog bucket plus the drain
             # buckets.
+            # Every width bucket the drain phase will pass through
+            # (encode buckets by powers of 4 from 8), largest first.
             full = min(2048, len(load.cluster_queues))
-            widths = sorted({full, max(8, full // 4)}, reverse=True)
+            widths, b = [], 8
+            while True:
+                widths.append(b)
+                if b >= full:
+                    break
+                b *= 4
+            widths.reverse()
             # Rank buckets from the real topology: heads() pops one head
             # per CQ, so a batch's largest conflict domain is the largest
             # cohort's CQ count, bucketed the way max_rank_bound buckets

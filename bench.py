@@ -421,12 +421,15 @@ def _run_preempt_pair(build, name, extra, routed=False):
             if routed and solver:
                 sched.solver_routing = "adaptive"
                 if route_stats is not None:  # carry learned engine rates
-                    sched._route_stats = route_stats
+                    # ... including the sticky regime predictor: a fresh
+                    # scheduler predicting "fit" would re-enter mandatory
+                    # sampling for a preempt-regime scenario every build
+                    sched._route_stats, sched._last_regime = route_stats
             t0 = time.perf_counter()
             sched.schedule(timeout=0)
             dt = time.perf_counter() - t0
             if routed and solver:
-                route_stats = sched._route_stats
+                route_stats = (sched._route_stats, sched._last_regime)
             if best is None or dt < best[0]:
                 best = (dt, client.evicted, sched.preemption_fallbacks)
         out[label] = best

@@ -546,6 +546,13 @@ class Scheduler:
                 else:
                     bail = True
                     break
+        if not bail and len(pend_ws) * 4 > len(valid_heads):
+            # Preempt-dominated cycle: the pipelined-mixed machinery
+            # (full snapshot + candidate index + one-cycle eviction lag)
+            # costs more than the hidden sync buys, and the lag hurts
+            # packing. The sync path owns it — and the router decides
+            # sync-device vs CPU from there.
+            bail = True
         pmeta, pbatch = None, None
         prev_signal = None
         if not bail and pend_ws:
@@ -557,9 +564,13 @@ class Scheduler:
                 # fresh-state reference would not (over-eviction). The
                 # background fetch has been running since its dispatch,
                 # so this drain is mostly decode+admit, not a round trip.
-                prev_signal = self._drain_pipeline()
+                # sample=False: this cycle's routing sample charges the
+                # drained admissions against the FULL mixed-cycle cost.
+                prev_signal = self._drain_pipeline(sample=False)
             pmeta, pbatch, bail = self._prepare_pipelined_preempt(plan,
                                                                   pend_ws)
+            if bail:
+                self._last_cycle_admitted = None
         if bail:
             # Reducer/fair cycle (or no router, or preempt encode
             # failure): the synchronous path owns those semantics —
@@ -602,9 +613,13 @@ class Scheduler:
         prev, self._inflight = self._inflight, (inflight, snapshot,
                                                 nofit_idx, pend_idx, pmeta)
         if prev is None:
-            self._last_cycle_admitted = None  # not a routing sample
             if prev_signal is not None:
-                return prev_signal  # the mixed-cycle pre-drain's result
+                # Mixed-cycle pre-drain: _last_cycle_admitted still
+                # holds the drained admissions — schedule() charges them
+                # against THIS cycle's full wall (the sample=False
+                # contract).
+                return prev_signal
+            self._last_cycle_admitted = None  # not a routing sample
             self.cycle_counts["device-dispatch-only"] = \
                 self.cycle_counts.get("device-dispatch-only", 0) + 1
             return KeepGoing  # first pipelined cycle: results next call
@@ -674,22 +689,28 @@ class Scheduler:
             self.preemption_fallbacks += 1
             return None, None, True
 
-    def _drain_pipeline(self) -> SpeedSignal:
+    def _drain_pipeline(self, sample: bool = True) -> SpeedSignal:
+        """sample=False: the caller owns the routing sample (the mixed
+        pipelined path drains as a STEP of its own cycle and must charge
+        the drained admissions against the FULL cycle cost — recording a
+        cheap decode-only sample here made the device engine look fast
+        exactly when its cycles were slowest)."""
         prev, self._inflight = self._inflight, None
         if prev is None:
             return KeepGoing
         t0 = _time.perf_counter()
         sig = self._process_inflight(prev, self.clock.now())
-        dt = _time.perf_counter() - t0
-        # The drained cycle is DEVICE work even when the draining cycle
-        # was routed to CPU (exploration): record it here — and exclude
-        # it from the enclosing cycle's own sample via _drain_cost — so
-        # the router keeps a live estimate of the losing engine.
-        # _process_inflight already set _cycle_regime to the drained
-        # cycle's regime, so the sample lands under the right key.
-        self._drain_cost += dt
-        self._route_record("device", self._last_cycle_admitted, dt)
-        self._last_cycle_admitted = None  # consumed; don't record twice
+        if sample:
+            dt = _time.perf_counter() - t0
+            # The drained cycle is DEVICE work even when the draining
+            # cycle was routed to CPU (exploration): record it here —
+            # and exclude it from the enclosing cycle's own sample via
+            # _drain_cost — so the router keeps a live estimate of the
+            # losing engine. _process_inflight already set _cycle_regime
+            # to the drained cycle's regime.
+            self._drain_cost += dt
+            self._route_record("device", self._last_cycle_admitted, dt)
+            self._last_cycle_admitted = None  # consumed
         return sig
 
     def _process_inflight(self, prev, start) -> SpeedSignal:

@@ -43,6 +43,7 @@ def run_mode(label, scale, solver, config="default"):
         "admissions_per_wall_second": round(result.admissions_per_wall_second, 1),
         "cycle_p50_ms": round(result.cycle_p50_ms, 1),
         "cycle_p99_ms": round(result.cycle_p99_ms, 1),
+        "cycle_time_total_s": round(result.cycle_time_total_s, 1),
         "class_avg_tta_s": {
             cls: round(st.avg, 2) for cls, st in result.class_stats.items()},
         "class_p99_tta_s": {

@@ -800,12 +800,11 @@ class TestPipelinedEquivalence:
         for i in range(4):
             assert cpu.usage(f"cq{i}") == pipe.usage(f"cq{i}")
 
-    def test_preemption_rides_the_pipeline(self):
-        """A preempt-mode entry (predicted non-fit) rides the SAME
-        resident dispatch as a fused target-selection batch; its
-        evictions issue at collect time one cycle later (pipelined
-        mixed cycles, VERDICT r4 ask #4) — final evictions identical
-        to the CPU path."""
+    def test_preempt_dominated_cycle_falls_back_to_sync(self):
+        """A preempt-DOMINATED cycle (pend share > 1/4 of the batch)
+        drains the pipeline and runs the synchronous mixed cycle — the
+        pipelined-mixed machinery only pays off on fit-dominated
+        batches. Evictions identical to the CPU path either way."""
         preemption = dict(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
 
         def setup(env):
@@ -831,6 +830,8 @@ class TestPipelinedEquivalence:
             for _ in range(4):
                 env.cycle()
             outs[pipeline] = set(env.client.evicted)
+            if pipeline:  # preempt share 100%: the gate forces sync
+                assert "pipelined-preempt" not in env.scheduler.cycle_counts
         assert outs[False] == outs[True]
         assert outs[True] == {"default/victim0", "default/victim1"}
 
@@ -1100,10 +1101,15 @@ class TestPipelinedMixedEquivalence:
     @staticmethod
     def _setup(env):
         env.add_flavor("default")
-        for i in range(4):
+        # cq0/cq1 stand alone (no cohort): their preemptors can't borrow
+        # their way in and must evict within-CQ victims; cq2-7 share a
+        # cohort for the fit stream
+        for i in range(8):
+            w = ClusterQueueWrapper(f"cq{i}")
+            if i >= 2:
+                w = w.cohort("co")
             env.add_cq(
-                ClusterQueueWrapper(f"cq{i}").cohort("co")
-                .preemption(
+                w.preemption(
                     within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
                 .resource_group(flavor_quotas("default", cpu="8")).obj(),
                 f"lq-cq{i}")
@@ -1111,9 +1117,10 @@ class TestPipelinedMixedEquivalence:
     def _run(self, pipeline):
         env = build_env(self._setup, solver=pipeline)
         env.scheduler.pipeline_enabled = pipeline
-        # victims fill every CQ; then interleaved waves of fit-mode work
-        # and high-priority preemptors keep the cycles mixed
-        for i in range(4):
+        # cq0/cq1 full of victims (the preemptors' targets); cq2-7 open
+        # for the fit stream, keeping every cycle FIT-DOMINATED (pend
+        # share <= 1/4) so the pipelined-mixed path engages
+        for i in range(2):
             for v in range(2):
                 env.admit_existing(
                     WorkloadWrapper(f"victim{i}-{v}").queue(f"lq-cq{i}")
@@ -1121,11 +1128,17 @@ class TestPipelinedMixedEquivalence:
                     .pod_set(count=1, cpu="4").reserve(f"cq{i}").obj())
         n = 0
         for wave in range(3):
-            for i in range(4):
+            for i in range(2):
                 env.submit(WorkloadWrapper(f"pre{wave}-{i}")
                            .queue(f"lq-cq{i}").priority(10)
                            .creation(100.0 + n)
                            .pod_set(count=1, cpu="4").obj())
+                n += 1
+            for i in range(2, 8):
+                env.submit(WorkloadWrapper(f"fit{wave}-{i}")
+                           .queue(f"lq-cq{i}").priority(1)
+                           .creation(200.0 + n)
+                           .pod_set(count=1, cpu="2").obj())
                 n += 1
             for _ in range(3):
                 env.cycle()
@@ -1134,7 +1147,7 @@ class TestPipelinedMixedEquivalence:
                 env.cache.delete_workload(wl)
                 env.client.evicted.pop(key)
                 env.queues.queue_inadmissible_workloads(
-                    {f"cq{j}" for j in range(4)})
+                    {f"cq{j}" for j in range(8)})
             for _ in range(2):
                 env.cycle()
         for _ in range(6):  # drain
@@ -1145,9 +1158,45 @@ class TestPipelinedMixedEquivalence:
         cpu = self._run(False)
         pipe = self._run(True)
         assert set(admitted_map(cpu)) == set(admitted_map(pipe))
-        for i in range(4):
+        for i in range(8):
             assert cpu.usage(f"cq{i}") == pipe.usage(f"cq{i}")
         # the pipelined path actually engaged its mixed form
         assert pipe.scheduler.cycle_counts.get("pipelined-preempt", 0) > 0, \
             pipe.scheduler.cycle_counts
         assert pipe.scheduler.preemption_fallbacks == 0
+
+
+class TestPipelinedMixedRoutingSamples:
+    def test_mixed_cycles_feed_the_router(self):
+        """Mixed pipelined cycles must record device routing samples
+        (drained admissions charged against the full cycle wall) — a
+        sample-less mixed path would pin the adaptive router in
+        mandatory sampling forever."""
+        t = TestPipelinedMixedEquivalence()
+        env = build_env(t._setup, solver=True)
+        env.scheduler.pipeline_enabled = True
+        env.scheduler.solver_routing = "adaptive"
+        for i in range(2):
+            for v in range(2):
+                env.admit_existing(
+                    WorkloadWrapper(f"victim{i}-{v}").queue(f"lq-cq{i}")
+                    .priority(0).creation(float(v))
+                    .pod_set(count=1, cpu="4").reserve(f"cq{i}").obj())
+        for wave in range(3):
+            for i in range(2):
+                env.submit(WorkloadWrapper(f"pre{wave}-{i}")
+                           .queue(f"lq-cq{i}").priority(10)
+                           .creation(100.0 + wave * 8 + i)
+                           .pod_set(count=1, cpu="4").obj())
+            for i in range(2, 8):
+                env.submit(WorkloadWrapper(f"fit{wave}-{i}")
+                           .queue(f"lq-cq{i}").priority(1)
+                           .creation(200.0 + wave * 8 + i)
+                           .pod_set(count=1, cpu="2").obj())
+            for _ in range(4):
+                env.cycle()
+        assert env.scheduler.cycle_counts.get("pipelined-preempt", 0) > 0
+        device_samples = sum(
+            len(v) for (eng, _r), v in env.scheduler._route_stats.items()
+            if eng == "device")
+        assert device_samples > 0, env.scheduler._route_stats

@@ -280,18 +280,22 @@ def bench_kernel():
     return p50(t_cp), admitted_cp
 
 
-def _run_e2e(solver, waves, cpu_units, label, pipeline=False):
+def _run_e2e(solver, waves, cpu_units, label, pipeline=False,
+             routed=False):
     """One end-to-end run: `waves` waves of one-workload-per-CQ, full
     Scheduler.schedule cycles (heads + snapshot + nominate/solve + admit +
     requeue). Wave 0 is warmup (jit compile); waves 1.. are timed.
     The solver path runs the PRODUCTION config: device-resident state +
     pipelined dispatch (decisions land one cycle later; the drain cycles
-    at the end are included in the wall time, so throughput is honest).
+    at the end are included in the wall time, so throughput is honest)
+    + the adaptive engine router when routed=True — on a backend where
+    the device engine loses, the routed number converges to CPU parity
+    instead of paying the pinned-device tax.
     Returns (cycle times, admitted count over timed cycles)."""
     flavors = [f"f{i}" for i in range(NUM_FLAVORS)]
     sched, cache, queues, client, clock = build_env(
         NUM_CQS, NUM_COHORTS, flavors, nominal_units=40, solver=solver,
-        pipeline=pipeline)
+        pipeline=pipeline, routed=routed)
     n = 0
     for wave in range(waves):
         for i in range(NUM_CQS):
@@ -339,7 +343,7 @@ def bench_e2e_progressive():
     for label, mk in (("cpu", lambda: None), ("solver", BatchSolver)):
         times, admitted, total_admitted = _run_e2e(
             mk(), waves, cpu_units=40, label=label,
-            pipeline=(label == "solver"))
+            pipeline=(label == "solver"), routed=(label == "solver"))
         total = sum(times)
         out[label] = (times, admitted, total, total_admitted)
         log({"bench": f"e2e_progressive_fill_{label}",
@@ -371,7 +375,8 @@ def bench_e2e_shallow(cycles=5):
     for label, mk in (("solver", BatchSolver), ("cpu", lambda: None)):
         times, admitted, _ = _run_e2e(mk(), cycles + 2, cpu_units=4,
                                       label=label,
-                                      pipeline=(label == "solver"))
+                                      pipeline=(label == "solver"),
+                                      routed=(label == "solver"))
         tp50 = p50(times)
         out[label] = tp50
         log({"bench": f"e2e_shallow_{label}", "p50_ms": round(tp50 * 1e3, 1),
@@ -403,6 +408,8 @@ def _run_preempt_pair(build, name, extra, routed=False):
     carrying its learned per-engine rates across the repeat builds (a
     long-running manager's steady state): scenarios the device can't pay
     for converge to CPU speed instead of paying solver-path overhead."""
+    import gc
+    gc.collect()  # earlier rows' garbage must not land in a timed window
     out = {}
     runs = 4 if routed else 2
     for label, solver in (("cpu", False), ("device", True)):
@@ -414,7 +421,7 @@ def _run_preempt_pair(build, name, extra, routed=False):
         samples = sched.solver._sync_samples if sched.solver else None
         route_stats = None
         best = None
-        for _ in range(runs if solver else 2):
+        for _ in range(runs):  # symmetric draws: min-of-N must compare like with like
             sched, client = build(solver)
             if sched.solver is not None and samples:
                 sched.solver._sync_samples = list(samples)  # carry the floor
@@ -425,6 +432,7 @@ def _run_preempt_pair(build, name, extra, routed=False):
                     # scheduler predicting "fit" would re-enter mandatory
                     # sampling for a preempt-regime scenario every build
                     sched._route_stats, sched._last_regime = route_stats
+            gc.collect()  # a prior run's garbage must not land in this window
             t0 = time.perf_counter()
             sched.schedule(timeout=0)
             dt = time.perf_counter() - t0
@@ -448,6 +456,8 @@ def bench_fair_sharing(num_cqs=2048, num_cohorts=256, cycles=4):
     reduction of clusterqueue.go:529-564) while the CPU path computes it
     per entry in nominate. The device path runs the production config
     (resident state + pipelined dispatch — fair fit-mode cycles qualify)."""
+    import gc
+    gc.collect()  # see _run_preempt_pair
     from kueue_tpu.solver import BatchSolver
 
     out = {}
@@ -649,9 +659,13 @@ def bench_preemption_reclaim(num_roots=128, children_per_root=2,
 
     reclaim_k = (cqs_per_child * children_per_root - children_per_root) \
         * victims_per_borrower
+    # routed like every other row: the production config — on a backend
+    # where the batched scan loses (XLA-CPU fallback), the router
+    # converges to the CPU preemptor; on the TPU it keeps the device.
     return _run_preempt_pair(build, "preemption_heavy_cycle",
                              {"cqs": num_cqs, "cohort_depth": 2,
-                              "candidates_per_reclaim": reclaim_k})
+                              "candidates_per_reclaim": reclaim_k},
+                             routed=True)
 
 
 def bench_depth4_cohorts(num_cqs=2048, num_leaves=256, num_mids=128,
@@ -666,6 +680,8 @@ def bench_depth4_cohorts(num_cqs=2048, num_leaves=256, num_mids=128,
     pipeline's one in-flight wave never starves admissions."""
     from kueue_tpu.api import kueue as api
     from kueue_tpu.api.meta import ObjectMeta
+    import gc
+    gc.collect()  # see _run_preempt_pair
     from kueue_tpu.solver import BatchSolver
 
     out = {}

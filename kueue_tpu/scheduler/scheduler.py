@@ -161,6 +161,7 @@ class Scheduler:
         self.strict_after_blocked_cycles = 8
         self._blocked_preempt_streak = 0
         self._drain_cost = 0.0  # pipeline-drain seconds within this cycle
+        self._cycle_evictions = 0  # evictions issued within this cycle
         # Below this head count the accelerator dispatch overhead exceeds
         # the win; narrow cycles go through the CPU path even with a
         # solver configured (SolverConfig.min_heads; 0 = always solve).
@@ -233,6 +234,7 @@ class Scheduler:
         start = self.clock.now()
         wall0 = _time.perf_counter()
         self._drain_cost = 0.0
+        self._cycle_evictions = 0
         route = self._route_mode(heads)
         if (route == "device" and self.strict_after_blocked_cycles
                 and self._blocked_preempt_streak
@@ -254,7 +256,10 @@ class Scheduler:
                 # _process_inflight set the regime of the COLLECTED
                 # cycle (fit, or preempt for pipelined mixed) — the
                 # routing sample lands under it.
-                self._route_record("device", self._last_cycle_admitted,
+                progress = (None if self._last_cycle_admitted is None
+                            else self._last_cycle_admitted
+                            + self._cycle_evictions)
+                self._route_record("device", progress,
                                    _time.perf_counter() - wall0
                                    - self._drain_cost)
                 return signal
@@ -318,6 +323,7 @@ class Scheduler:
                     # Next attempt should try all flavors again.
                     e.info.last_assignment = None
                     preempted = self.preemptor.issue_preemptions(e.info, e.preemption_targets)
+                    self._cycle_evictions += preempted
                     if preempted:
                         e.inadmissible_msg += (f". Pending the preemption of "
                                                f"{preempted} workload(s)")
@@ -382,7 +388,10 @@ class Scheduler:
             self._blocked_preempt_streak -= 1
         self.cycle_counts[route] = self.cycle_counts.get(route, 0) + 1
         if route in ("device", "cpu"):
-            self._route_record(route, admitted_n,
+            # Progress = admissions + evictions: a pure-eviction cycle
+            # admits zero on EITHER engine, and an all-zero rate pair
+            # would pin the router to its tie-break default.
+            self._route_record(route, admitted_n + self._cycle_evictions,
                                _time.perf_counter() - wall0
                                - self._drain_cost)
         self.log.v(2, "cycle", engine=route, heads=len(entries),
@@ -699,17 +708,23 @@ class Scheduler:
         if prev is None:
             return KeepGoing
         t0 = _time.perf_counter()
+        ev0 = self._cycle_evictions
         sig = self._process_inflight(prev, self.clock.now())
         if sample:
             dt = _time.perf_counter() - t0
             # The drained cycle is DEVICE work even when the draining
             # cycle was routed to CPU (exploration): record it here —
-            # and exclude it from the enclosing cycle's own sample via
-            # _drain_cost — so the router keeps a live estimate of the
-            # losing engine. _process_inflight already set _cycle_regime
-            # to the drained cycle's regime.
+            # and exclude its time (via _drain_cost) AND its evictions
+            # from the enclosing cycle's own sample — so each engine's
+            # rate reflects only its own progress per second.
+            # _process_inflight already set _cycle_regime to the
+            # drained cycle's regime.
+            drained_ev = self._cycle_evictions - ev0
+            self._cycle_evictions = ev0
             self._drain_cost += dt
-            self._route_record("device", self._last_cycle_admitted, dt)
+            if self._last_cycle_admitted is not None:
+                self._route_record(
+                    "device", self._last_cycle_admitted + drained_ev, dt)
             self._last_cycle_admitted = None  # consumed
         return sig
 
@@ -844,6 +859,7 @@ class Scheduler:
             e.info.last_assignment = None
             n = self.preemptor.issue_preemptions(e.info,
                                                  e.preemption_targets)
+            self._cycle_evictions += n
             if n:
                 e.inadmissible_msg += (f". Pending the preemption of "
                                        f"{n} workload(s)")

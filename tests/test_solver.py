@@ -1200,3 +1200,113 @@ class TestPipelinedMixedRoutingSamples:
             len(v) for (eng, _r), v in env.scheduler._route_stats.items()
             if eng == "device")
         assert device_samples > 0, env.scheduler._route_stats
+
+
+class TestPipelinedMixedRandom:
+    """Randomized multi-cycle soak for pipelined MIXED cycles: two
+    priority bands (victims low, preemptors high) keep the preemption
+    structure deterministic while topology, quotas, counts, and arrival
+    order randomize. Both engines must converge to the same admitted
+    set, eviction set, and per-CQ usage."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_mixed_stream(self, seed):
+        rng = random.Random(9100 + seed)
+        n_pre_cqs = rng.randint(1, 2)      # stand-alone preemption CQs
+        n_fit_cqs = rng.randint(4, 7)      # cohort fit-stream CQs
+        quota = rng.choice([6, 8])
+        victims_per_cq = rng.randint(1, 2)
+        fit_waves = rng.randint(2, 3)
+
+        def setup(env):
+            env.add_flavor("default")
+            for i in range(n_pre_cqs):
+                env.add_cq(
+                    ClusterQueueWrapper(f"p{i}")
+                    .preemption(
+                        within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                    .resource_group(flavor_quotas("default", cpu=quota))
+                    .obj(), f"lq-p{i}")
+            for i in range(n_fit_cqs):
+                env.add_cq(
+                    ClusterQueueWrapper(f"f{i}").cohort("co")
+                    .resource_group(flavor_quotas("default", cpu=quota))
+                    .obj(), f"lq-f{i}")
+
+        victim_cpu = quota // victims_per_cq
+
+        # one shared plan: the rng must NOT be consumed inside run(), or
+        # the two engines would see different scenarios
+        plan: list = []
+        n = 0
+        for wave in range(fit_waves):
+            items = []
+            for i in range(n_pre_cqs):
+                items.append((f"pre{wave}-{i}", f"lq-p{i}", 10,
+                              100.0 + n, quota))
+                n += 1
+            for i in range(n_fit_cqs):
+                for _ in range(rng.randint(1, 2)):
+                    items.append((f"fit{wave}-{i}-{n}", f"lq-f{i}",
+                                  rng.randint(0, 3), 200.0 + n,
+                                  rng.choice([1, 2])))
+                    n += 1
+            plan.append(items)
+
+        def run(pipeline):
+            env = build_env(setup, solver=pipeline)
+            if pipeline:
+                env.scheduler.pipeline_enabled = True
+            processed: set = set()
+            all_cqs = ({f"p{i}" for i in range(n_pre_cqs)}
+                       | {f"f{i}" for i in range(n_fit_cqs)})
+
+            def drain():
+                # evicted victims finish AND every admitted workload
+                # completes once: capacity always frees again, so both
+                # engines must converge to the full admitted set
+                freed = False
+                for key, wl in list(env.client.evicted.items()):
+                    if key not in processed:
+                        processed.add(key)
+                        env.cache.delete_workload(wl)
+                        freed = True
+                for key, wl in list(env.client.applied.items()):
+                    if key not in processed:
+                        processed.add(key)
+                        env.cache.delete_workload(wl)
+                        freed = True
+                if freed:
+                    env.queues.queue_inadmissible_workloads(all_cqs)
+
+            for i in range(n_pre_cqs):
+                for v in range(victims_per_cq):
+                    env.admit_existing(
+                        WorkloadWrapper(f"victim{i}-{v}").queue(f"lq-p{i}")
+                        .priority(0).creation(float(v))
+                        .pod_set(count=1, cpu=victim_cpu)
+                        .reserve(f"p{i}").obj())
+            for wave in range(fit_waves):
+                for (name, lq, prio, ts, cpu) in plan[wave]:
+                    env.submit(WorkloadWrapper(name).queue(lq)
+                               .priority(prio).creation(ts)
+                               .pod_set(count=1, cpu=cpu).obj())
+                for _ in range(3):
+                    env.cycle()
+                drain()
+                for _ in range(2):
+                    env.cycle()
+            for _ in range(12):  # settle: completions keep freeing
+                env.cycle()
+                drain()
+            return env
+
+        cpu_env = run(False)
+        dev_env = run(True)
+        assert set(admitted_map(cpu_env)) == set(admitted_map(dev_env))
+        assert set(cpu_env.client.evicted) == set(dev_env.client.evicted)
+        for i in range(n_pre_cqs):
+            assert cpu_env.usage(f"p{i}") == dev_env.usage(f"p{i}")
+        for i in range(n_fit_cqs):
+            assert cpu_env.usage(f"f{i}") == dev_env.usage(f"f{i}")
+        assert dev_env.scheduler.preemption_fallbacks == 0

@@ -305,8 +305,11 @@ def _run_e2e(solver, waves, cpu_units, label, pipeline=False,
             n += 1
     # Warmup compiles the bucketed shapes; in pipelined mode the first
     # collect (one cycle after the first dispatch) pays the compile, so
-    # warm two cycles there.
-    warmup = 2 if pipeline else 1
+    # warm two cycles there. Routed runs warm five: the dispatch-only
+    # first cycle records no routing sample, and the router's mandatory
+    # per-engine samples (2 device + 2 cpu) must all land before the
+    # clock, not inside the timed p50.
+    warmup = (5 if routed else 2) if pipeline else 1
     for _ in range(warmup):
         sched.schedule(timeout=0)
     before = client.admitted
@@ -338,9 +341,13 @@ def bench_e2e_progressive():
     Measured end-to-end on both paths over the identical schedule."""
     from kueue_tpu.solver import BatchSolver
 
-    waves = NUM_FLAVORS + 2  # fills every flavor, one per cycle
     out = {}
     for label, mk in (("cpu", lambda: None), ("solver", BatchSolver)):
+        # waves = flavor depths + that label's warmup, so BOTH timed
+        # windows cover depths 1..32 (aligned shallow/deep sub-windows);
+        # waves past depth 32 can't admit, so the total-admissions
+        # equality below still holds.
+        waves = NUM_FLAVORS + (5 if label == "solver" else 1)
         times, admitted, total_admitted = _run_e2e(
             mk(), waves, cpu_units=40, label=label,
             pipeline=(label == "solver"), routed=(label == "solver"))
@@ -354,8 +361,9 @@ def bench_e2e_progressive():
              "wall_s": round(total, 2),
              "admitted_per_sec": round(admitted / total, 1)})
     t_cpu, t_dev = out["cpu"][2], out["solver"][2]
-    # Total admissions (incl. warmup) must agree; the timed windows shift
-    # by one wave under pipelining.
+    # Total admissions (incl. warmup) must agree; both labels' timed
+    # windows cover the same fill depths (waves are sized per label's
+    # warmup above).
     assert out["cpu"][3] == out["solver"][3], (out["cpu"][3], out["solver"][3])
     # throughput on the identical timed workload window
     per_sec_cpu = out["cpu"][1] / t_cpu
@@ -373,8 +381,10 @@ def bench_e2e_shallow(cycles=5):
 
     out = {}
     for label, mk in (("solver", BatchSolver), ("cpu", lambda: None)):
-        times, admitted, _ = _run_e2e(mk(), cycles + 2, cpu_units=4,
-                                      label=label,
+        times, admitted, _ = _run_e2e(mk(),
+                                      cycles + (5 if label == "solver"
+                                                else 1),
+                                      cpu_units=4, label=label,
                                       pipeline=(label == "solver"),
                                       routed=(label == "solver"))
         tp50 = p50(times)

@@ -451,12 +451,16 @@ class TestAdaptiveRouter:
     estimates; compile-inflated samples are damped by a median-rate
     estimator; exploration of a badly losing engine is backed off."""
 
-    def _sched(self):
+    @staticmethod
+    def _env():
         env = Env()
         env.scheduler.solver = object()  # routing only inspects presence
         env.scheduler.solver_min_heads = 0
         env.scheduler.solver_routing = "adaptive"
-        return env.scheduler
+        return env
+
+    def _sched(self):
+        return self._env().scheduler
 
     def test_mandatory_samples_per_regime(self):
         s = self._sched()
@@ -522,3 +526,28 @@ class TestAdaptiveRouter:
         s2._last_regime = "fit"
         routes = [s2._route_mode(heads) for _ in range(64)]
         assert routes.count("device") == 4
+
+    def test_pure_eviction_cycle_credits_progress(self):
+        """A cycle that only issues evictions must record nonzero
+        progress (admissions + evictions): an all-zero rate pair would
+        pin the router to its device tie-break in eviction-heavy
+        regimes."""
+        env2 = self._env()
+        env2.add_flavor("default")
+        env2.add_cq(ClusterQueueWrapper("cq")
+                    .preemption(
+                        within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                    .resource_group(flavor_quotas("default", cpu=4)).obj(),
+                    "lq")
+        env2.admit_existing(WorkloadWrapper("victim").queue("lq").priority(0)
+                            .pod_set(count=1, cpu="4").reserve("cq").obj())
+        env2.submit(WorkloadWrapper("pre").queue("lq").priority(10)
+                    .pod_set(count=1, cpu="4").obj())
+        s = env2.scheduler
+        s._route_stats = {("device", "fit"): [(1, 1.0), (1, 1.0)],
+                          ("cpu", "fit"): [(9, 1.0), (9, 1.0)]}
+        s._last_regime = "fit"  # router picks cpu; cycle observed preempt
+        s.schedule(timeout=0)
+        samples = s._route_stats.get(("cpu", "preempt"), [])
+        assert samples and samples[0][0] == 1, samples  # 1 eviction credited
+        assert env2.client.evicted  # the victim was evicted

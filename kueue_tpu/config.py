@@ -139,6 +139,9 @@ class LeaderElectionConfig:
     retry_period_seconds: float = 2.0
 
 
+DEFAULT_STRICT_AFTER_BLOCKED_CYCLES = 8
+
+
 @dataclass
 class SolverConfig:
     """TPU-solver plane wiring — new in this build (no reference analogue;
@@ -158,6 +161,11 @@ class SolverConfig:
     # "adaptive": measure admitted/sec per engine and run each cycle on
     # the faster one; "always"/"never" pin the device/CPU path
     routing: str = "adaptive"
+    # Starvation bound: after this many consecutive cycles with a
+    # blocked preempt-mode entry, pin strict sequential semantics
+    # (reference resourcesToReserve ordering) until it unblocks; 0
+    # disables the bound (the documented unbounded deviation)
+    strict_after_blocked_cycles: int = DEFAULT_STRICT_AFTER_BLOCKED_CYCLES
 
 
 @dataclass
@@ -233,6 +241,11 @@ def validate(cfg: Configuration) -> list[str]:
         errs.append("multiKueue.origin must be a valid label value")
     if cfg.solver.max_heads <= 0 or cfg.solver.max_flavors <= 0:
         errs.append("solver.maxHeads and solver.maxFlavors must be positive")
+    if cfg.solver.strict_after_blocked_cycles < 0:
+        errs.append("solver.strictAfterBlockedCycles must be >= 0 "
+                    "(0 disables the starvation bound)")
+    if cfg.solver.routing not in ("adaptive", "always", "never"):
+        errs.append("solver.routing must be adaptive, always, or never")
     return errs
 
 
@@ -318,6 +331,11 @@ def load(raw: dict) -> Configuration:
             min_heads=s.get("minHeads", 64),
             device=s.get("device", ""),
             fallback_on_error=s.get("fallbackOnError", True),
+            pipeline=s.get("pipeline", True),
+            routing=s.get("routing", "adaptive"),
+            strict_after_blocked_cycles=s.get(
+                "strictAfterBlockedCycles",
+                DEFAULT_STRICT_AFTER_BLOCKED_CYCLES),
         )
     cfg.feature_gates = dict(raw.get("featureGates", {}))
     cfg = set_defaults(cfg)

@@ -152,6 +152,8 @@ class KueueManager:
             # engine routing + the persistent compilation cache.
             self.scheduler.pipeline_enabled = self.cfg.solver.pipeline
             self.scheduler.solver_routing = self.cfg.solver.routing
+            self.scheduler.strict_after_blocked_cycles = \
+                self.cfg.solver.strict_after_blocked_cycles
             from kueue_tpu.utils.runtime import enable_compilation_cache
             enable_compilation_cache()
 

@@ -158,7 +158,8 @@ class Scheduler:
         # scheduler.go:443-462) until no blocked preemptor remains —
         # the preemptor then admits exactly when the reference would.
         # 0 disables the bound.
-        self.strict_after_blocked_cycles = 8
+        from kueue_tpu.config import DEFAULT_STRICT_AFTER_BLOCKED_CYCLES
+        self.strict_after_blocked_cycles = DEFAULT_STRICT_AFTER_BLOCKED_CYCLES
         self._blocked_preempt_streak = 0
         self._drain_cost = 0.0  # pipeline-drain seconds within this cycle
         self._cycle_evictions = 0  # evictions issued within this cycle

@@ -241,10 +241,12 @@ class Scheduler:
                 and self._blocked_preempt_streak
                 >= self.strict_after_blocked_cycles):
             # Starvation bound engaged: a fairness intervention, not an
-            # economics signal — "cpu-forced" keeps it out of the
-            # router's samples. Stays engaged until the blocked
-            # preemptor admits, becomes infeasible, or goes away.
-            route = "cpu-forced"
+            # economics signal — the non-routable label keeps it out of
+            # the router's samples, and the distinct name makes the
+            # bound's engagement visible in the perf artifacts. Stays
+            # engaged until the blocked preemptor admits, becomes
+            # infeasible, or goes away.
+            route = "cpu-strict"
         # Cooldown elapses per schedule() call, not per device-routed
         # call — a CPU-routed stretch must not freeze it.
         cooling = self._pipeline_cooldown > 0

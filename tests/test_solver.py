@@ -1040,7 +1040,7 @@ class TestStarvationBound:
                    .resource_group(flavor_quotas("default", cpu=10)).obj(),
                    "lq-b")
 
-    def _drive(self, strict_after, cycles=16):
+    def _drive(self, strict_after, cycles=16, with_counts=False):
         env = build_env(self._setup, solver=True)
         env.scheduler.strict_after_blocked_cycles = strict_after
         occupant = (WorkloadWrapper("occupant").queue("lq-a").priority(200)
@@ -1075,6 +1075,8 @@ class TestStarvationBound:
             if "default/preemptor" in env.client.applied:
                 admitted_cycle = i
                 break
+        if with_counts:
+            return admitted_cycle, occupant_done_at, env.scheduler.cycle_counts
         return admitted_cycle, occupant_done_at
 
     def test_unbounded_deviation_starves(self):
@@ -1083,8 +1085,10 @@ class TestStarvationBound:
 
     def test_strict_bound_admits_within_k(self):
         k = 3
-        admitted_cycle, occupant_done_at = self._drive(strict_after=k)
+        admitted_cycle, occupant_done_at, counts = self._drive(
+            strict_after=k, with_counts=True)
         assert admitted_cycle is not None
+        assert counts.get("cpu-strict", 0) > 0, counts  # bound engaged
         # blocked from cycle 0; strict mode engages after k blocked
         # cycles; one strict cycle reserves and the next admits
         assert admitted_cycle <= occupant_done_at + k + 2

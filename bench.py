@@ -70,6 +70,11 @@ def p50(times):
     return times[len(times) // 2]
 
 
+def p99(times):
+    times = sorted(times)
+    return times[min(len(times) - 1, int(len(times) * 0.99))]
+
+
 # -- object-model scenario builders (self-contained) ----------------------
 
 def make_flavor(name):
@@ -328,7 +333,60 @@ def _run_e2e(solver, waves, cpu_units, label, pipeline=False,
     if solver is not None:
         log({"bench": f"{label}_payload", "upload_bytes": solver.last_upload_bytes,
              "fetch_bytes": solver.last_fetch_bytes})
+    builds = cache.snapshot_build_s
+    if builds:
+        # snapshot-build cost as its own metric: p50/p99 per full
+        # cache.snapshot() call plus which path served each one
+        log({"bench": f"{label}_snapshot_build",
+             "p50_ms": round(p50(builds) * 1e3, 3),
+             "p99_ms": round(p99(builds) * 1e3, 3),
+             "counts": dict(cache.snapshot_stats)})
     return times, admitted, client.admitted
+
+
+def bench_snapshot_incremental(workloads_per_cq=8, deltas_per_cycle=8,
+                               iters=12):
+    """Snapshot maintenance at the flagship shape (2048 CQs x 32
+    flavors, workloads_per_cq admitted workloads each): the per-cycle
+    full deep clone (the pre-incremental cost, still the fallback path)
+    vs the journal-replay advance with a handful of workload deltas per
+    cycle (steady state). Pure host-side work — no device involved."""
+    flavors = [f"f{i}" for i in range(NUM_FLAVORS)]
+    sched, cache, queues, client, clock = build_env(
+        NUM_CQS, NUM_COHORTS, flavors, nominal_units=400)
+    for i in range(NUM_CQS):
+        for v in range(workloads_per_cq):
+            _admit_victim(cache, f"w{i}-{v}", f"lq{i}", f"cq{i}",
+                          100, 0, float(v))
+    cache.snapshot()  # establish the maintained snapshot (full build)
+    t_full, t_incr = [], []
+    churn = []
+    n = 0
+    for it in range(iters):
+        # steady-state deltas: a few admissions/completions per cycle
+        for wl in churn:
+            cache.delete_workload(wl)
+        churn = []
+        for d in range(deltas_per_cycle):
+            churn.append(_admit_victim(
+                cache, f"churn{it}-{d}", f"lq{n % NUM_CQS}",
+                f"cq{n % NUM_CQS}", 50, 0, 1000.0 + n))
+            n += 1
+        t0 = time.perf_counter()
+        cache.snapshot()
+        t_incr.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        cache._build_snapshot()
+        t_full.append(time.perf_counter() - t0)
+    assert cache.snapshot_stats["incremental"] >= iters, cache.snapshot_stats
+    speedup = p50(t_full) / max(p50(t_incr), 1e-9)
+    log({"bench": "snapshot_incremental", "cqs": NUM_CQS,
+         "flavors": NUM_FLAVORS, "workloads_per_cq": workloads_per_cq,
+         "deltas_per_cycle": deltas_per_cycle,
+         "full_clone_p50_ms": round(p50(t_full) * 1e3, 2),
+         "incremental_p50_ms": round(p50(t_incr) * 1e3, 2),
+         "speedup": round(speedup, 1)})
+    return speedup
 
 
 def bench_e2e_progressive():
@@ -409,6 +467,7 @@ def _admit_victim(cache, name, lq, cq, milli, priority, creation):
             count=1)])
     wlpkg.set_quota_reservation(wl, admission, creation)
     cache.add_or_update_workload(wl)
+    return wl
 
 
 def _run_preempt_pair(build, name, extra, routed=False):
@@ -755,6 +814,7 @@ def main():
     log({"devices": [str(d) for d in jax.devices()]})
 
     bench_kernel()
+    snapshot_speedup = bench_snapshot_incremental()
     rows = {}
     admitted_per_sec, speedup = bench_e2e_progressive()
     rows["progressive_fill"] = speedup
@@ -780,6 +840,7 @@ def main():
         "value": round(admitted_per_sec, 1),
         "unit": "workloads/s",
         "vs_baseline": round(admitted_per_sec / baseline, 2),
+        "snapshot_incremental_speedup": round(snapshot_speedup, 1),
         **BACKEND,
     }))
 

@@ -9,6 +9,7 @@ Snapshots for lock-free scheduling cycles.
 from __future__ import annotations
 
 import threading
+import time as _time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -28,6 +29,11 @@ from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.core.hierarchy import Manager as HierarchyManager
 
 
+# Journal-consumer names (see the usage-journal block in Cache.__init__).
+SNAPSHOT_CONSUMER = "snapshot"
+SOLVER_CONSUMER = "solver"
+
+
 @dataclass
 class AdmissionCheckEntry:
     controller_name: str = ""
@@ -37,7 +43,8 @@ class AdmissionCheckEntry:
 
 class Cache:
     def __init__(self, pods_ready_tracking: bool = False,
-                 excluded_resource_prefixes: Optional[list] = None):
+                 excluded_resource_prefixes: Optional[list] = None,
+                 incremental_snapshots: bool = True):
         self._lock = threading.RLock()
         self._pods_ready_cond = threading.Condition(self._lock)
         self.hm: HierarchyManager = HierarchyManager(cohort_factory=self._new_cohort)
@@ -67,15 +74,36 @@ class Cache:
         # generations (those bump on every workload deletion purely to
         # invalidate flavor-resume state).
         self.topology_epoch = 0
-        # Usage journal: when enabled (by an attached solver), every
-        # usage-moving workload mutation appends (seq, kind, cq, key,
-        # usage) so device-resident solver state can be reconciled with
-        # tiny deltas instead of a full re-encode + re-upload per cycle.
+        # Usage journal: every usage-moving workload mutation appends
+        # (seq, kind, cq, key, usage, aux) so consumers can reconcile
+        # derived state with tiny deltas instead of a full rebuild per
+        # cycle. Two consumers share it through per-consumer cursors
+        # (entries are pruned once EVERY cursor has passed them): the
+        # solver's device-resident state ("solver", registered by
+        # enable_usage_journal) and the incremental snapshot maintainer
+        # ("snapshot", registered below). kinds: 'add'/'del' move usage;
+        # 'cq'/'ready' are snapshot-replay-only records (non-structural
+        # ClusterQueue updates, pods-ready flips) with usage=None.
         self.usage_journal_enabled = False
         self._journal: list = []
         self._journal_seq = 0
-        self._journal_overflow = False
         self._journal_cap = 200_000
+        self._journal_cursors: dict = {}  # consumer -> consumed-up-to seq
+        self._journal_overflowed: set = set()  # consumers that lost entries
+        self._journal_aux_stripped = 0  # aux dropped for seqs <= this
+        # Incremental snapshot maintenance (see incremental.py and
+        # SNAPSHOTS.md): keep one persistent full Snapshot advanced by
+        # journal replay instead of deep-cloning 2k CQ trees per cycle.
+        self._maintainer = None
+        if incremental_snapshots:
+            from kueue_tpu.cache.incremental import SnapshotMaintainer
+            self._maintainer = SnapshotMaintainer(self)
+            self._journal_cursors[SNAPSHOT_CONSUMER] = 0
+            self.usage_journal_enabled = True
+        # Snapshot-build accounting (perf/bench visibility): which path
+        # served each full snapshot() and how long the build took.
+        self.snapshot_stats = {"full": 0, "incremental": 0, "light": 0}
+        self.snapshot_build_s: list = []
 
     def _new_cohort(self, name: str) -> CohortCache:
         cohort = CohortCache(name)
@@ -87,37 +115,86 @@ class Cache:
     def enable_usage_journal(self) -> None:
         with self._lock:
             self.usage_journal_enabled = True
+            self._journal_cursors.setdefault(SOLVER_CONSUMER,
+                                             self._journal_seq)
 
     def _journal_usage(self, kind: str, cq_name: str, key: str,
-                       usage: dict) -> None:
-        """kind: 'add' | 'del'. Caller holds the lock."""
+                       usage: Optional[dict], aux=None) -> None:
+        """kind: 'add' | 'del' (usage-moving, consumed by solver AND
+        snapshot maintainer) | 'cq' | 'ready' (snapshot-replay-only,
+        usage=None). aux: (Info, not_ready) for 'add' entries so snapshot
+        replay can reconstruct workload maps. Caller holds the lock."""
         if not self.usage_journal_enabled:
             return
+        if self._maintainer is None:
+            aux = None  # only snapshot replay reads it
         self._journal_seq += 1
-        if len(self._journal) >= self._journal_cap:
-            # Bound memory if the solver stops draining; consumers see the
-            # overflow flag and fall back to a full state re-encode.
-            self._journal.clear()
-            self._journal_overflow = True
-        self._journal.append((self._journal_seq, kind, cq_name, key, usage))
+        self._journal.append((self._journal_seq, kind, cq_name, key,
+                              usage, aux))
+        if len(self._journal) > self._journal_cap:
+            # Bound memory when a consumer stops draining: only the
+            # laggards lose their backlog (and see the overflow flag on
+            # their next drain, falling back to a full rebuild); an
+            # actively-draining consumer keeps its pending entries.
+            for name, cur in self._journal_cursors.items():
+                if self._journal_seq - cur > self._journal_cap:
+                    self._journal_overflowed.add(name)
+                    self._journal_cursors[name] = self._journal_seq
+            self._prune_journal_locked()
 
-    def drain_usage_journal(self, upto_seq: int) -> tuple:
-        """Pop and return (entries with seq <= upto_seq, overflowed). The
-        overflow flag resets once observed."""
+    def _prune_journal_locked(self) -> None:
+        """Drop entries every registered consumer has consumed. Seqs are
+        contiguous (+1 per append, pruned only from the front), so list
+        index == seq - first_seq."""
+        if not self._journal:
+            return
+        if not self._journal_cursors:
+            self._journal.clear()
+            return
+        low = min(self._journal_cursors.values())
+        first = self._journal[0][0]
+        if low >= first:
+            del self._journal[:low - first + 1]
+        # Entries the snapshot maintainer has consumed can never be read
+        # for replay again — drop their aux payload so a lagging solver
+        # consumer doesn't pin deleted workloads' Info objects (full pod
+        # sets/conditions) for up to a journal-cap of entries. Each
+        # entry is stripped at most once (amortized O(1) per append).
+        if not self._journal:
+            return
+        snap_cur = self._journal_cursors.get(SNAPSHOT_CONSUMER)
+        if snap_cur is None:
+            return
+        first = self._journal[0][0]
+        upto = min(snap_cur, self._journal[-1][0])
+        for seq in range(max(self._journal_aux_stripped + 1, first),
+                         upto + 1):
+            entry = self._journal[seq - first]
+            if entry[5] is not None:
+                self._journal[seq - first] = entry[:5] + (None,)
+        self._journal_aux_stripped = max(self._journal_aux_stripped, upto)
+
+    def drain_usage_journal(self, upto_seq: int,
+                            consumer: str = "solver") -> tuple:
+        """Return (entries with cursor < seq <= upto_seq, overflowed) for
+        `consumer` and advance its cursor; the overflow flag resets once
+        observed. Entries stay visible to the other registered consumers
+        until everyone's cursor has passed them — draining for one
+        consumer never loses entries for another."""
         with self._lock:
-            if not self._journal or self._journal[0][0] > upto_seq:
-                entries: list = []
-            elif self._journal[-1][0] <= upto_seq:
-                entries, self._journal = self._journal, []
-            else:
-                cut = 0
-                for cut, e in enumerate(self._journal):
-                    if e[0] > upto_seq:
-                        break
-                entries = self._journal[:cut]
-                self._journal = self._journal[cut:]
-            overflow = self._journal_overflow
-            self._journal_overflow = False
+            cursor = self._journal_cursors.get(consumer, 0)
+            upto = min(upto_seq, self._journal_seq)
+            entries: list = []
+            if self._journal and upto >= self._journal[0][0]:
+                first = self._journal[0][0]
+                lo = max(0, cursor - first + 1)
+                hi = upto - first + 1
+                if hi > lo:
+                    entries = self._journal[lo:hi]
+            self._journal_cursors[consumer] = max(cursor, upto)
+            overflow = consumer in self._journal_overflowed
+            self._journal_overflowed.discard(consumer)
+            self._prune_journal_locked()
             return entries, overflow
 
     # --- ClusterQueues ---
@@ -170,6 +247,11 @@ class Cache:
             self._refresh_cohort(cqc)
             if self._topo_signature(cqc) != old_sig:
                 self.topology_epoch += 1
+            else:
+                # Non-structural update (namespace selector, preemption
+                # policy, fungibility knobs): invisible to every epoch,
+                # so snapshot replay must refresh this CQ explicitly.
+                self._journal_usage("cq", cqc.name, "", None)
 
     def terminate_cluster_queue(self, name: str) -> None:
         """Stop admissions while keeping the usage accounting alive until
@@ -364,10 +446,12 @@ class Cache:
                 return False
             info = self._new_info(wl)
             cqc.add_workload(info)
+            not_ready = (self.pods_ready_tracking and not is_condition_true(
+                wl.status.conditions, api.WORKLOAD_PODS_READY))
             self._journal_usage("add", cqc.name, info.key,
-                                info.flavor_resource_usage())
-            if self.pods_ready_tracking and not is_condition_true(
-                    wl.status.conditions, api.WORKLOAD_PODS_READY):
+                                info.flavor_resource_usage(),
+                                (info, not_ready))
+            if not_ready:
                 cqc.workloads_not_ready.add(info.key)
             self._pods_ready_cond.notify_all()
             return True
@@ -422,10 +506,12 @@ class Cache:
             if info is None or info.obj is not wl:
                 info = self._new_info(wl)
             cqc.add_workload(info)
+            not_ready = (self.pods_ready_tracking and not is_condition_true(
+                wl.status.conditions, api.WORKLOAD_PODS_READY))
             self._journal_usage("add", cqc.name, key,
-                                info.flavor_resource_usage())
-            if self.pods_ready_tracking and not is_condition_true(
-                    wl.status.conditions, api.WORKLOAD_PODS_READY):
+                                info.flavor_resource_usage(),
+                                (info, not_ready))
+            if not_ready:
                 cqc.workloads_not_ready.add(key)
             self.assumed_workloads[key] = cqc.name
 
@@ -461,7 +547,9 @@ class Cache:
         with self._lock:
             key = wlpkg.key(wl)
             for cqc in self.hm.cluster_queues.values():
-                cqc.workloads_not_ready.discard(key)
+                if key in cqc.workloads_not_ready:
+                    cqc.workloads_not_ready.discard(key)
+                    self._journal_usage("ready", cqc.name, key, None)
             self._pods_ready_cond.notify_all()
 
     def wait_for_pods_ready(self, timeout: Optional[float] = None) -> bool:
@@ -477,6 +565,32 @@ class Cache:
         # light=True shares the cache trees instead of deep-copying (see
         # ClusterQueueSnapshot): READ-ONLY cycles only (the pipelined
         # all-fit path, whose usage truth is the device-resident state).
+        # Full snapshots go through the incremental maintainer when one
+        # is attached: the persistent snapshot is advanced by journal
+        # replay and handed out under copy-on-write (SNAPSHOTS.md)
+        # instead of deep-cloning every CQ's trees per cycle.
+        with self._lock:
+            if light:
+                self.snapshot_stats["light"] += 1
+                return self._build_snapshot(light=True)
+            t0 = _time.perf_counter()
+            if self._maintainer is not None:
+                snap, mode = self._maintainer.advance()
+            else:
+                snap, mode = self._build_snapshot(), "full"
+            self.snapshot_stats[mode] += 1
+            if len(self.snapshot_build_s) >= (1 << 20):
+                # Bound the sample buffer on very long runs; late samples
+                # (steady state) are the ones the percentiles should
+                # reflect anyway.
+                del self.snapshot_build_s[:1 << 19]
+            self.snapshot_build_s.append(_time.perf_counter() - t0)
+            return snap
+
+    def _build_snapshot(self, light: bool = False) -> Snapshot:
+        """From-scratch snapshot construction (the full deep clone, or
+        the shared-tree light view). The incremental maintainer uses the
+        same building blocks; this stays the equivalence oracle."""
         with self._lock:
             snap = Snapshot()
             snap.light = light

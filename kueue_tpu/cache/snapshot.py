@@ -18,6 +18,13 @@ from kueue_tpu.core.resources import FlavorResource
 
 
 class CohortSnapshot:
+    # Copy-on-write flag (incremental snapshots, see incremental.py):
+    # marks a handout shell sharing the maintainer's usage node until
+    # first mutation. The class-level default keeps plain deep-clone
+    # snapshots zero-cost; the maintainer tracks which of ITS containers
+    # are shared with handouts on its own side (name sets, not flags).
+    _shared = False
+
     def __init__(self, name: str, resource_node: rnode.ResourceNode):
         self.name = name
         self.resource_node = resource_node
@@ -25,6 +32,22 @@ class CohortSnapshot:
         self.child_cohorts: set = set()  # direct CohortSnapshot children
         self.parent: Optional["CohortSnapshot"] = None
         self.allocatable_resource_generation = 0
+
+    def clone_shell(self) -> "CohortSnapshot":
+        """Shallow copy-on-write view for an incremental-snapshot
+        handout: shares the usage node until first mutation (the tree
+        wiring — members/parent/child_cohorts — is rebuilt per handout
+        so each snapshot's cohort graph is self-contained)."""
+        shell = CohortSnapshot.__new__(CohortSnapshot)
+        shell.name = self.name
+        shell.resource_node = self.resource_node
+        shell.members = set()
+        shell.child_cohorts = set()
+        shell.parent = None
+        shell.allocatable_resource_generation = \
+            self.allocatable_resource_generation
+        shell._shared = True
+        return shell
 
     def parent_node(self) -> Optional["CohortSnapshot"]:
         return self.parent
@@ -44,6 +67,13 @@ class CohortSnapshot:
 
 
 class ClusterQueueSnapshot:
+    # Copy-on-write flag, as on CohortSnapshot. The maintainer stamps
+    # _shared=True onto its master objects once, so the hot handout loop
+    # (incremental.py:_handout) propagates it through a plain __dict__
+    # copy — masters are never mutated through add_usage, so the flag is
+    # only ever honored on handed-out shells.
+    _shared = False
+
     def __init__(self, cq: ClusterQueueCache, light: bool = False):
         """light=True shares the cache's structures instead of cloning
         (READ-ONLY consumers only): pipelined all-fit cycles never
@@ -80,6 +110,23 @@ class ClusterQueueSnapshot:
         self.fair_weight = cq.fair_weight
         self.flavor_fungibility = cq.flavor_fungibility
         self.allocatable_resource_generation = cq.allocatable_resource_generation
+
+    def _materialize(self) -> None:
+        """First mutation of a copy-on-write shell: privatize this CQ's
+        containers and the cohort chain's usage nodes, so preemption
+        simulation and intra-cycle accounting never write through to the
+        maintainer's persistent snapshot. Bounds per-cycle cloning to
+        the CQs a cycle actually touches. resource_groups and
+        admission_checks stay shared — no cycle path mutates them."""
+        self.workloads = dict(self.workloads)
+        self.workloads_not_ready = set(self.workloads_not_ready)
+        self.resource_node = self.resource_node.clone()
+        self._shared = False
+        cohort = self.cohort
+        while cohort is not None and cohort._shared:
+            cohort.resource_node = cohort.resource_node.clone()
+            cohort._shared = False
+            cohort = cohort.parent
 
     # --- hierarchicalResourceNode protocol ---
 
@@ -120,12 +167,16 @@ class ClusterQueueSnapshot:
             # writing through a light snapshot would mutate the LIVE
             # cache's trees — corruption, not simulation
             raise RuntimeError("mutating a light (shared) snapshot")
+        if self._shared:
+            self._materialize()
         for fr, q in usage.items():
             rnode.add_usage(self, fr, q)
 
     def remove_usage(self, usage: dict) -> None:
         if self.light:
             raise RuntimeError("mutating a light (shared) snapshot")
+        if self._shared:
+            self._materialize()
         for fr, q in usage.items():
             rnode.remove_usage(self, fr, q)
 
@@ -195,6 +246,8 @@ class Snapshot:
         if self.light:
             raise RuntimeError("mutating a light (shared) snapshot")
         cq = self.cluster_queues[wl.cluster_queue]
+        if cq._shared:
+            cq._materialize()
         cq.workloads.pop(wl.key, None)
         cq.remove_usage(wl.flavor_resource_usage())
 
@@ -202,5 +255,7 @@ class Snapshot:
         if self.light:
             raise RuntimeError("mutating a light (shared) snapshot")
         cq = self.cluster_queues[wl.cluster_queue]
+        if cq._shared:
+            cq._materialize()
         cq.workloads[wl.key] = wl
         cq.add_usage(wl.flavor_resource_usage())

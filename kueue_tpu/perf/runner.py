@@ -27,6 +27,11 @@ from kueue_tpu.manager import KueueManager
 from kueue_tpu.perf.generator import FLAVOR, GeneratedLoad, RESOURCE
 
 
+def _percentile(sorted_samples: list, q: float) -> float:
+    return sorted_samples[min(len(sorted_samples) - 1,
+                              int(q * len(sorted_samples)))]
+
+
 @dataclass
 class ClassStats:
     times_to_admission: list = field(default_factory=list)
@@ -76,6 +81,12 @@ class RunResult:
     pipelined_hit_rate: Optional[float] = None
     solver_phase_s: dict = field(default_factory=dict)
     solver_counters: dict = field(default_factory=dict)
+    # Snapshot-build attribution (incremental journal-replay snapshots):
+    # per-snapshot build latency and which path served each call
+    # (incremental advance vs full rebuild vs light view).
+    snapshot_build_p50_ms: float = 0.0
+    snapshot_build_p99_ms: float = 0.0
+    snapshot_counts: dict = field(default_factory=dict)
 
 
 class Runner:
@@ -265,9 +276,13 @@ class Runner:
         if cycle_times:
             result.cycle_time_total_s = sum(cycle_times)
             cycle_times.sort()
-            result.cycle_p50_ms = cycle_times[len(cycle_times) // 2] * 1e3
-            result.cycle_p99_ms = cycle_times[
-                min(len(cycle_times) - 1, int(len(cycle_times) * 0.99))] * 1e3
+            result.cycle_p50_ms = _percentile(cycle_times, 0.50) * 1e3
+            result.cycle_p99_ms = _percentile(cycle_times, 0.99) * 1e3
+        builds = sorted(mgr.cache.snapshot_build_s)
+        if builds:
+            result.snapshot_build_p50_ms = _percentile(builds, 0.50) * 1e3
+            result.snapshot_build_p99_ms = _percentile(builds, 0.99) * 1e3
+        result.snapshot_counts = dict(mgr.cache.snapshot_stats)
         return result
 
 

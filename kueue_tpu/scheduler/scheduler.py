@@ -370,17 +370,25 @@ class Scheduler:
             for e in entries) else "fit"
         self._cycle_regime = regime
         self._last_regime = regime
-        # A preempt-mode entry that stayed un-admitted this cycle is
-        # blocked (no feasible targets yet): feed the starvation bound.
-        # A cycle with NO preempt-mode entry leaves the streak alone —
-        # a blocked preemptor parks inadmissible between capacity
-        # releases, and arrival-only cycles in between must not reset
-        # the evidence of its starvation. While the bound is engaged, a
-        # preempt-less strict cycle bleeds the streak off instead, so a
-        # vanished preemptor releases strict mode within ~K cycles.
+        # A preempt-mode entry is blocked only when it found NO feasible
+        # targets (the reserve-capacity branch): feed the starvation
+        # bound. An entry that selected targets is PROGRESSING — it
+        # issued evictions (PENDING_PREEMPTION) or lost an intra-cycle
+        # race (overlap/fit skip) that resolves by itself; counting
+        # either as blocked let healthy preemption churn ratchet the
+        # streak to the bound and pin device-routed cycles to cpu-strict
+        # (ADVICE r5 medium). This mirrors _collect_pipelined_preempt,
+        # which sets blocked_any only for target-less entries. A cycle
+        # with NO preempt-mode entry leaves the streak alone — a blocked
+        # preemptor parks inadmissible between capacity releases, and
+        # arrival-only cycles in between must not reset the evidence of
+        # its starvation. While the bound is engaged, a preempt-less
+        # strict cycle bleeds the streak off instead, so a vanished
+        # preemptor releases strict mode within ~K cycles.
         blocked = any(
             e.status != ASSUMED
             and e.assignment.representative_mode() == fa.PREEMPT
+            and not e.preemption_targets
             for e in entries)
         if blocked:
             self._blocked_preempt_streak += 1

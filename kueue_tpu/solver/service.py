@@ -426,7 +426,8 @@ class BatchSolver:
             self._resident = None
             return False
         corr: dict = {}
-        for _seq, kind, cq_name, key, usage in entries:
+        for entry in entries:
+            kind, cq_name, key, usage = entry[1], entry[2], entry[3], entry[4]
             if kind == "add":
                 p = rs.pending.pop(key, None)
                 if p is not None:
@@ -439,8 +440,12 @@ class BatchSolver:
                         k = (pcq, fr)
                         corr[k] = corr.get(k, 0) - v
                 sign = 1
-            else:
+            elif kind == "del":
                 sign = -1
+            else:
+                # snapshot-replay-only records ('cq' scalar refresh,
+                # 'ready' flips): no usage movement, nothing to mirror
+                continue
             for fr, v in usage.items():
                 k = (cq_name, fr)
                 corr[k] = corr.get(k, 0) + sign * v

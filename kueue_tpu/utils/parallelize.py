@@ -21,6 +21,12 @@ DEFAULT_WORKERS = 8
 
 _pool = None
 _pool_lock = threading.Lock()
+# Re-entrancy marker: set while a chunk runs ON a shared-pool worker. A
+# nested until(workers>1) from inside a worker could otherwise exhaust
+# the bounded pool (every thread blocked on futures that have no free
+# thread to run) and deadlock; nested calls degrade to the sequential
+# path instead.
+_in_pool_worker = threading.local()
 
 
 def _shared_pool() -> ThreadPoolExecutor:
@@ -42,22 +48,35 @@ def _run_chunk(fn: Callable[[int], None], lo: int, hi: int, errs: list,
                 errs.append(e)
 
 
+def _run_chunk_pooled(fn: Callable[[int], None], lo: int, hi: int,
+                      errs: list, errs_lock) -> None:
+    _in_pool_worker.active = True
+    try:
+        _run_chunk(fn, lo, hi, errs, errs_lock)
+    finally:
+        _in_pool_worker.active = False
+
+
 def until(n: int, fn: Callable[[int], None],
           workers: int = DEFAULT_WORKERS) -> None:
     """Run fn(i) for every i in range(n), at most `workers` at a time
     (one contiguous chunk per worker, like the reference's
     workqueue-chunked Until). All items are attempted even when some
     fail (errgroup-with-collect semantics), then the first exception is
-    re-raised — identically in the sequential and parallel paths."""
+    re-raised — identically in the sequential and parallel paths.
+    Re-entrant calls from inside a pool worker run sequentially (the
+    shared bounded pool cannot safely nest — see _in_pool_worker)."""
     errs: list = []
     errs_lock = threading.Lock()
     workers = min(workers, DEFAULT_WORKERS, n)
+    if getattr(_in_pool_worker, "active", False):
+        workers = 1
     if n <= 1 or workers <= 1:
         _run_chunk(fn, 0, n, errs, errs_lock)
     else:
         pool = _shared_pool()
         chunk = (n + workers - 1) // workers
-        futures = [pool.submit(_run_chunk, fn, lo, min(lo + chunk, n),
+        futures = [pool.submit(_run_chunk_pooled, fn, lo, min(lo + chunk, n),
                                errs, errs_lock)
                    for lo in range(0, n, chunk)]
         for f in futures:

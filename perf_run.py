@@ -61,6 +61,12 @@ def run_mode(label, scale, solver, config="default"):
                                else None),
         "solver_phase_s": result.solver_phase_s,
         "solver_counters": result.solver_counters,
+        # snapshot-build cost as its own metric (incremental
+        # journal-replay snapshots): p50/p99 per cache.snapshot() call
+        # plus which path (incremental/full/light) served each one
+        "snapshot_build_p50_ms": round(result.snapshot_build_p50_ms, 3),
+        "snapshot_build_p99_ms": round(result.snapshot_build_p99_ms, 3),
+        "snapshot_counts": result.snapshot_counts,
     }
     print(json.dumps(out), file=sys.stderr, flush=True)
     return out

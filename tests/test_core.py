@@ -405,3 +405,39 @@ class TestParallelize:
         with pytest.raises(ValueError):
             parallelize.until(30, fn, workers=8)
         assert len(attempted) == 30
+
+    def test_nested_until_inside_workers_does_not_deadlock(self):
+        # ADVICE r5 low: a nested until(workers>1) from inside a shared-
+        # pool worker could exhaust the bounded 8-thread pool (every
+        # thread blocked on futures with no free thread to run them).
+        # The re-entrancy guard degrades nested calls to the sequential
+        # path; run under a watchdog so a regression fails instead of
+        # hanging the suite.
+        import threading
+
+        from kueue_tpu.utils import parallelize
+        inner_runs = []
+        lock = threading.Lock()
+
+        def outer(i):
+            def inner(j):
+                with lock:
+                    inner_runs.append((i, j))
+            parallelize.until(4, inner, workers=4)
+
+        done = threading.Event()
+        failure = []
+
+        def drive():
+            try:
+                parallelize.until(16, outer, workers=8)
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                failure.append(exc)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+        assert done.wait(timeout=30), "nested until() deadlocked"
+        assert not failure, failure
+        assert len(inner_runs) == 16 * 4

@@ -551,3 +551,89 @@ class TestAdaptiveRouter:
         samples = s._route_stats.get(("cpu", "preempt"), [])
         assert samples and samples[0][0] == 1, samples  # 1 eviction credited
         assert env2.client.evicted  # the victim was evicted
+
+
+class TestStarvationPredicateChurn:
+    """ADVICE r5 medium: sustained HEALTHY preemption churn — entries
+    that issue evictions every cycle (PENDING_PREEMPTION) — must not
+    ratchet _blocked_preempt_streak to the strict-cycle bound. The sync
+    path's blocked predicate excludes progressing preemptors, mirroring
+    _collect_pipelined_preempt's reset-on-progress."""
+
+    def test_eviction_churn_keeps_streak_at_zero(self):
+        env = Env()
+        env.add_flavor("default")
+        env.add_cq(ClusterQueueWrapper("cq")
+                   .preemption(
+                       within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                   "lq")
+        sched = env.scheduler
+        sched.strict_after_blocked_cycles = 4
+        for i in range(10):
+            victim = (WorkloadWrapper(f"victim{i}").queue("lq").priority(0)
+                      .pod_set(count=1, cpu="10").reserve("cq").obj())
+            env.admit_existing(victim)
+            env.submit(WorkloadWrapper(f"preemptor{i}").queue("lq")
+                       .priority(100).creation(float(i))
+                       .pod_set(count=1, cpu="10").obj())
+            env.cycle()  # issues the eviction: progress, not starvation
+            assert f"default/victim{i}" in env.client.evicted, i
+            assert sched._blocked_preempt_streak == 0, (
+                i, sched._blocked_preempt_streak)
+            # the eviction completes and the preemptor admits
+            env.cache.delete_workload(victim)
+            env.queues.queue_inadmissible_workloads({"cq"})
+            env.cycle()
+            admitted = env.client.applied.pop(f"default/preemptor{i}", None)
+            assert admitted is not None, i
+            env.cache.delete_workload(admitted)  # completes before round i+1
+            assert sched._blocked_preempt_streak \
+                < sched.strict_after_blocked_cycles, i
+        # churn never engaged the strict-cycle bound
+        assert "cpu-strict" not in sched.cycle_counts, sched.cycle_counts
+
+    def test_overlap_skipped_preemptor_is_not_blocked(self):
+        # two preemptors select the SAME victim: the first issues the
+        # eviction, the second is _set_skipped with overlapping targets.
+        # Both are progressing (the skip resolves by itself next cycle)
+        # — neither may feed the starvation bound, mirroring the
+        # pipelined collector where an overlap skip never sets
+        # blocked_any.
+        env = Env()
+        env.add_flavor("default")
+        env.add_cq(ClusterQueueWrapper("cq")
+                   .preemption(
+                       within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                   "lq")
+        env.admit_existing(WorkloadWrapper("victim").queue("lq").priority(0)
+                           .pod_set(count=1, cpu="10").reserve("cq").obj())
+        for name, ts in (("pre-a", 1.0), ("pre-b", 2.0)):
+            env.submit(WorkloadWrapper(name).queue("lq").priority(100)
+                       .creation(ts).pod_set(count=1, cpu="10").obj())
+        env.cycle()
+        assert "default/victim" in env.client.evicted
+        assert env.scheduler._blocked_preempt_streak == 0
+
+    def test_truly_blocked_preemptor_still_feeds_the_bound(self):
+        # the fix must not weaken the bound: a preemptor with NO feasible
+        # targets (all candidates at higher priority) stays blocked and
+        # the streak still ratchets
+        env = Env()
+        env.add_flavor("default")
+        env.add_cq(ClusterQueueWrapper("cq")
+                   .preemption(
+                       within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                   "lq")
+        env.admit_existing(WorkloadWrapper("occupant").queue("lq")
+                           .priority(200).pod_set(count=1, cpu="10")
+                           .reserve("cq").obj())
+        env.submit(WorkloadWrapper("preemptor").queue("lq").priority(100)
+                   .creation(1.0).pod_set(count=1, cpu="10").obj())
+        sched = env.scheduler
+        for i in range(3):
+            env.cycle()
+            env.queues.queue_inadmissible_workloads({"cq"})
+            assert sched._blocked_preempt_streak == i + 1

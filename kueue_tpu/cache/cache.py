@@ -572,6 +572,17 @@ class Cache:
         with self._lock:
             if light:
                 self.snapshot_stats["light"] += 1
+                if self._maintainer is not None:
+                    # Periodic background advance: a long pipelined
+                    # all-fit stretch takes only light snapshots, so the
+                    # snapshot consumer's journal backlog would hit the
+                    # cursor cap and pay a surprise full rebuild on the
+                    # next sync cycle. Catch up (replay, no handout)
+                    # once the backlog passes half the cap.
+                    backlog = self._journal_seq - self._journal_cursors.get(
+                        SNAPSHOT_CONSUMER, 0)
+                    if backlog > self._journal_cap // 2:
+                        self._maintainer.catch_up()
                 return self._build_snapshot(light=True)
             t0 = _time.perf_counter()
             if self._maintainer is not None:
@@ -586,6 +597,20 @@ class Cache:
                 del self.snapshot_build_s[:1 << 19]
             self.snapshot_build_s.append(_time.perf_counter() - t0)
             return snap
+
+    def release_snapshot(self, snap: Snapshot) -> None:
+        """Optional hint that the caller will never read or mutate
+        `snap` again: the incremental maintainer may then recycle its
+        un-materialized copy-on-write shells into the NEXT handout,
+        skipping the O(CQs) shell rebuild per cycle. Safe to omit —
+        unreleased snapshots are simply never reused. Releasing a
+        snapshot that is still read afterwards is a caller bug (its
+        shells may start reflecting a newer cycle)."""
+        if getattr(snap, "light", False):
+            return
+        with self._lock:
+            if self._maintainer is not None:
+                self._maintainer.release(snap)
 
     def _build_snapshot(self, light: bool = False) -> Snapshot:
         """From-scratch snapshot construction (the full deep clone, or

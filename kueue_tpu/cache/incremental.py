@@ -56,15 +56,35 @@ class SnapshotMaintainer:
         # on demand.
         self._fresh_cqs: set = set()
         self._fresh_cohorts: set = set()
+        # Shell recycling (see release()): the latest handout, returned
+        # by its consumer, whose un-materialized CQ shells the next
+        # handout may reuse instead of re-allocating O(CQs) objects.
+        self._handout_gen = 0
+        self._reusable = None  # (handout gen, its cluster_queues dict)
         # Engagement counters (perf artifacts / the smoke test assert
         # that steady-state cycles take the incremental path).
         self.full_rebuilds = 0
         self.incremental_advances = 0
+        self.background_advances = 0
+        self.shell_reuses = 0
 
     def advance(self) -> tuple:
         """Bring the persistent snapshot up to the cache's current state
         and return (handout snapshot, "incremental" | "full"). Caller
         holds the cache lock."""
+        mode = self._sync()
+        return self._handout(self._epochs), mode
+
+    def catch_up(self) -> None:
+        """Background advance WITHOUT a handout: drains and replays the
+        journal so a long light-snapshot-only stretch (pipelined all-fit
+        cycles) cannot overflow the snapshot consumer's cursor cap and
+        pay a surprise full rebuild at the next sync cycle. Caller holds
+        the cache lock."""
+        self.background_advances += 1
+        self._sync()
+
+    def _sync(self) -> str:
         cache = self._cache
         epochs = (cache.cohort_epoch, cache.flavor_spec_epoch,
                   cache.topology_epoch)
@@ -76,12 +96,10 @@ class SnapshotMaintainer:
             self._rebuild()
             self._epochs = epochs
             self.full_rebuilds += 1
-            mode = "full"
-        else:
-            self._replay(entries)
-            self.incremental_advances += 1
-            mode = "incremental"
-        return self._handout(epochs), mode
+            return "full"
+        self._replay(entries)
+        self.incremental_advances += 1
+        return "incremental"
 
     # --- full rebuild (the epoch/overflow fallback) ---
 
@@ -218,6 +236,15 @@ class SnapshotMaintainer:
 
     # --- copy-on-write handout ---
 
+    def release(self, snap: Snapshot) -> None:
+        """The consumer is done with this handout (it will never read or
+        mutate it again): its un-materialized shells become candidates
+        for recycling into the NEXT handout. Only the latest handout
+        qualifies — an older one would hand back shells whose master
+        state has since been re-shared with a newer snapshot."""
+        if getattr(snap, "_handout_gen", -1) == self._handout_gen:
+            self._reusable = (self._handout_gen, snap.cluster_queues)
+
     def _handout(self, epochs: tuple) -> Snapshot:
         cache = self._cache
         snap = Snapshot()
@@ -226,6 +253,19 @@ class SnapshotMaintainer:
         snap.journal_seq = cache._journal_seq
         snap.resource_flavors = dict(cache.resource_flavors)
         snap.inactive_cluster_queue_sets = set(self._inactive)
+        # Shells released back from the previous handout (release()):
+        # one whose master was untouched since (not in _fresh_cqs) and
+        # that its cycle never materialized (_shared still True) is
+        # VALUE-identical to the fresh __dict__ copy we would build — so
+        # recycle the object and skip the allocation + copy. Everything
+        # else (replayed masters, materialized shells) is rebuilt.
+        prev_cqs = None
+        if self._reusable is not None \
+                and self._reusable[0] == self._handout_gen:
+            prev_cqs = self._reusable[1]
+        self._reusable = None
+        self._handout_gen += 1
+        snap._handout_gen = self._handout_gen
         cohort_shells: dict = {}
         for cname, cohort in self._cohorts.items():
             # The monotonic capacity version (see Cache.snapshot's full
@@ -244,15 +284,22 @@ class SnapshotMaintainer:
         snap_cqs = snap.cluster_queues
         new = ClusterQueueSnapshot.__new__
         cls = ClusterQueueSnapshot
+        fresh_cqs = self._fresh_cqs
         for name, mcq in self._cqs.items():
-            shell = new(cls)
-            d = shell.__dict__
-            d.update(mcq.__dict__)
-            cohort = d["cohort"]
+            shell = prev_cqs.get(name) if prev_cqs is not None else None
+            if shell is not None and shell._shared \
+                    and name not in fresh_cqs:
+                self.shell_reuses += 1
+            else:
+                shell = new(cls)
+                shell.__dict__.update(mcq.__dict__)
+            cohort = mcq.cohort
             if cohort is not None:
                 cohort_shell = cohort_shells[cohort.name]
-                d["cohort"] = cohort_shell
+                shell.cohort = cohort_shell
                 cohort_shell.members.add(shell)
+            else:
+                shell.cohort = None
             snap_cqs[name] = shell
         # Everything just handed out is shared again: master-side COW
         # re-privatizes on demand. Hidden masters never ship, so they

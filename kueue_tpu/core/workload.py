@@ -91,6 +91,12 @@ def pod_sets_counts_after_reclaim(wl: api.Workload) -> dict:
 class Info:
     """Pre-processed view of a Workload (reference: workload.Info)."""
 
+    # Class-level defaults so partially-constructed instances
+    # (from_assignment, the partial-admission shadow probes) resolve the
+    # lazy caches without per-path initialization.
+    _key_cache = None
+    _arena_slot = -1  # encode-arena slot hint (solver/arena.py)
+
     def __init__(self, wl: api.Workload, cluster_queue: str = "",
                  excluded_resource_prefixes: Optional[list] = None):
         self.obj = wl
@@ -138,7 +144,14 @@ class Info:
 
     @property
     def key(self) -> str:
-        return key(self.obj)
+        # Memoized: namespace/name are fixed for an Info's lifetime
+        # (update() only ever swaps in the same workload's new object),
+        # and the f-string build showed up in every per-entry hot loop
+        # (arena ensure, preemption scans, requeue bookkeeping).
+        k = self._key_cache
+        if k is None:
+            k = self._key_cache = key(self.obj)
+        return k
 
     def can_be_partially_admitted(self) -> bool:
         return any(ps.count > (ps.min_count if ps.min_count is not None else ps.count)

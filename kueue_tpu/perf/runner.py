@@ -87,6 +87,10 @@ class RunResult:
     snapshot_build_p50_ms: float = 0.0
     snapshot_build_p99_ms: float = 0.0
     snapshot_counts: dict = field(default_factory=dict)
+    # Encode-phase attribution (workload encode arena): per-prepare()
+    # batch-assembly latency.
+    encode_p50_ms: float = 0.0
+    encode_p99_ms: float = 0.0
 
 
 class Runner:
@@ -138,9 +142,13 @@ class Runner:
             while b < max(members.values() or [1]):
                 b *= 4
             try:
+                # expected_pending pre-sizes the encode arena (no mid-run
+                # growth → stable gather shapes) and warms the
+                # arena-resident kernel variants.
                 self.solver.warm(self.mgr.cache.snapshot(),
                                  widths=tuple(widths), max_ranks=(b, b * 4),
-                                 deltas_buckets=(8,))
+                                 deltas_buckets=(8,),
+                                 expected_pending=len(load.arrivals))
             except Exception:  # noqa: BLE001 — warmup is best-effort
                 pass
 
@@ -283,6 +291,10 @@ class Runner:
             result.snapshot_build_p50_ms = _percentile(builds, 0.50) * 1e3
             result.snapshot_build_p99_ms = _percentile(builds, 0.99) * 1e3
         result.snapshot_counts = dict(mgr.cache.snapshot_stats)
+        encodes = sorted(getattr(self.solver, "encode_samples", None) or [])
+        if encodes:
+            result.encode_p50_ms = _percentile(encodes, 0.50) * 1e3
+            result.encode_p99_ms = _percentile(encodes, 0.99) * 1e3
         return result
 
 

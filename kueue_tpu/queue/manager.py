@@ -41,6 +41,25 @@ class Manager:
         self.excluded_resource_prefixes = excluded_resource_prefixes or []
         self._stopped = False
         self.snapshots: dict = {}  # cq name -> list of pending workloads (visibility)
+        # Workload delta feed (solver encode arena): every pending-set
+        # mutation that can change a workload's encoded rows notifies
+        # the registered listeners, so derived per-workload state is
+        # maintained by deltas instead of rescanned per cycle.
+        self._workload_listeners: list = []
+
+    def add_workload_listener(self, cb: Callable[[str, str], None]) -> None:
+        """Register cb(kind, key): 'upsert' = the workload was added or
+        its object replaced (any derived encoding is stale); 'del' = it
+        left the pending set. Called under the manager lock — listeners
+        must only enqueue, never block or call back into the manager.
+        Requeues of an unchanged Info deliberately do NOT notify: the
+        common per-cycle requeue churn must keep derived rows valid."""
+        with self._lock:
+            self._workload_listeners.append(cb)
+
+    def _notify(self, kind: str, key: str) -> None:
+        for cb in self._workload_listeners:
+            cb(kind, key)
 
     def _new_info(self, wl: api.Workload) -> wlpkg.Info:
         return wlpkg.Info(wl, excluded_resource_prefixes=self.excluded_resource_prefixes)
@@ -101,6 +120,7 @@ class Manager:
                 if wl.spec.queue_name != lq.metadata.name or wlpkg.has_quota_reservation(wl):
                     continue
                 items.items[wlpkg.key(wl)] = self._new_info(wl)
+                self._notify("upsert", wlpkg.key(wl))
             cqh = self.cluster_queues.get(items.cluster_queue)
             if cqh is not None:
                 added = False
@@ -120,6 +140,14 @@ class Manager:
                 for info in items.items.values():
                     old_cq.delete(info.obj)
             items.cluster_queue = lq.spec.cluster_queue
+            # The target ClusterQueue changed: every member's encoded
+            # rows are keyed to the old CQ — invalidate the arena rows
+            # (feed) AND the per-Info oracle cache, which keys only on
+            # (topo token, resourceVersion) and would otherwise serve
+            # the old CQ's row.
+            for info in items.items.values():
+                info._solver_enc = None
+                self._notify("upsert", info.key)
             new_cq = self.cluster_queues.get(items.cluster_queue)
             if new_cq is not None:
                 added = False
@@ -135,9 +163,10 @@ class Manager:
             if items is None:
                 return
             cqh = self.cluster_queues.get(items.cluster_queue)
-            if cqh is not None:
-                for info in items.items.values():
+            for info in items.items.values():
+                if cqh is not None:
                     cqh.delete(info.obj)
+                self._notify("del", info.key)
 
     # --- workload flow ---
 
@@ -152,6 +181,7 @@ class Manager:
         info = self._new_info(wl)
         info.cluster_queue = items.cluster_queue
         items.items[info.key] = info
+        self._notify("upsert", info.key)
         cqh = self.cluster_queues.get(items.cluster_queue)
         if cqh is None:
             return False
@@ -172,7 +202,8 @@ class Manager:
     def _delete_workload_locked(self, wl: api.Workload) -> None:
         items = self.local_queues.get(wlpkg.queue_key(wl))
         if items is not None:
-            items.items.pop(wlpkg.key(wl), None)
+            if items.items.pop(wlpkg.key(wl), None) is not None:
+                self._notify("del", wlpkg.key(wl))
             cqh = self.cluster_queues.get(items.cluster_queue)
             if cqh is not None:
                 cqh.delete(wl)

@@ -118,6 +118,10 @@ class Scheduler:
             # Device-resident solver state: the cache journal reconciles
             # it across cycles (no per-cycle state re-encode/upload).
             solver.bind_cache(cache)
+        if solver is not None and hasattr(solver, "bind_queues"):
+            # Workload encode arena: the queue manager's delta feed
+            # maintains per-workload encoded rows across cycles.
+            solver.bind_queues(queues)
         # Pipelined dispatch: overlap the decision fetch of cycle N with
         # head-pop + encode + dispatch of cycle N+1 (all-fit cycles only;
         # see _schedule_pipelined for the semantics). Off by default —
@@ -227,6 +231,9 @@ class Scheduler:
             # Solvers attached after construction (tests, tools) still get
             # the journal-backed device-resident state.
             self.solver.bind_cache(self.cache)
+        if (self.solver is not None and hasattr(self.solver, "bind_queues")
+                and getattr(self.solver, "_queues", None) is None):
+            self.solver.bind_queues(self.queues)
         heads = self.queues.heads(timeout=timeout)
         if not heads:
             if self._inflight is not None:
@@ -361,6 +368,7 @@ class Scheduler:
             else:
                 result_success = True
                 admitted_n += 1
+                self._solver_release_workload(e.info.key)
         # Observed regime of this cycle feeds the regime-keyed router:
         # the sample lands under what the cycle WAS, and the next
         # cycle's engine choice predicts it will look the same.
@@ -398,6 +406,9 @@ class Scheduler:
                 >= self.strict_after_blocked_cycles > 0:
             self._blocked_preempt_streak -= 1
         self.cycle_counts[route] = self.cycle_counts.get(route, 0) + 1
+        # The cycle is done with its snapshot: the incremental maintainer
+        # may recycle un-materialized shells into the next handout.
+        self.cache.release_snapshot(snapshot)
         if route in ("device", "cpu"):
             # Progress = admissions + evictions: a pure-eviction cycle
             # admits zero on EITHER engine, and an all-zero rate pair
@@ -492,6 +503,13 @@ class Scheduler:
         note = getattr(self.solver, "note_unapplied", None)
         if note is not None:
             note(key)
+
+    def _solver_release_workload(self, key: str) -> None:
+        """Admitted workloads leave the pending set without a queue-
+        manager delete: recycle their encode-arena slot."""
+        rel = getattr(self.solver, "release_workload", None)
+        if rel is not None:
+            rel(key)
 
     def _pipeline_ok(self, heads: list) -> bool:
         s = self.solver
@@ -655,6 +673,8 @@ class Scheduler:
         if prev is None:
             return
         inflight, _snapshot, nofit_idx, _pend_idx, _pmeta = prev
+        if _pmeta is not None:
+            self.cache.release_snapshot(_pmeta[2])
         for i, w in enumerate(inflight.plan.batch.infos):
             if i in nofit_idx:
                 continue  # already requeued at dispatch time
@@ -688,6 +708,7 @@ class Scheduler:
             for e in ready:
                 self.requeue_and_update(e)
             if not pending:
+                self.cache.release_snapshot(full_snap)
                 return None, None, False
             cand_index = candidate_index(full_snap, self.ordering,
                                          self.clock.now())
@@ -804,6 +825,7 @@ class Scheduler:
             else:
                 result_success = True
                 admitted_n += 1
+                self._solver_release_workload(e.info.key)
         self._last_cycle_admitted = admitted_n
         self.cycle_counts["device-pipelined"] = \
             self.cycle_counts.get("device-pipelined", 0) + 1
@@ -880,6 +902,8 @@ class Scheduler:
                 self._blocked_preempt_streak + 1 if blocked_any else 0)
             self.cycle_counts["pipelined-preempt"] = \
                 self.cycle_counts.get("pipelined-preempt", 0) + 1
+        # The deferred nomination snapshot's late mutations are done.
+        self.cache.release_snapshot(full_snap)
         return pending
 
     # --- batched TPU admission (kueue_tpu.solver) ---

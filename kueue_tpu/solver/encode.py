@@ -33,6 +33,12 @@ from kueue_tpu.api.corev1 import find_untolerated_taint
 
 BIG = np.int64(2**62)  # "no limit" encoding
 
+# Eligibility-cache bound: at the cap, the OLDEST half (insertion order)
+# is evicted instead of clearing wholesale — a churn-heavy cycle then
+# re-primes only cold rows rather than stampeding a full recompute of
+# every hot row at once.
+ELIG_CACHE_CAP = 65536
+
 
 def _bucket(n: int, minimum: int = 8, factor: int = 4) -> int:
     """Round up to the next power of `factor` (jit-compilation bucketing).
@@ -319,34 +325,95 @@ def _encode_one(info, snapshot: Snapshot, topo: Topology, P: int):
                 return qi, requests, active, eligible, False
             requests[pi, ri] = v
         active[pi] = True
-        # host-side taints/affinity per flavor, memoized by pod-spec
-        # signature: identical pod shapes (the common case at scale)
-        # share one eligibility row instead of re-running the
-        # string-matching loop per workload
-        pod_spec = info.obj.spec.pod_sets[pi].template.spec
-        key = (qi, _eligibility_key(pod_spec))
-        row = topo.elig_cache.get(key)
-        if row is None:
-            if len(topo.elig_cache) >= 65536:
-                # Bound growth under per-workload-unique pod shapes; rows
-                # are recomputed on demand after a reset.
-                topo.elig_cache.clear()
-            row = np.zeros(F, bool)
-            for rg in cq.resource_groups:
-                for fname in rg.flavors:
-                    flavor = snapshot.resource_flavors.get(fname)
-                    if flavor is None:
-                        continue
-                    if find_untolerated_taint(flavor.spec.node_taints,
-                                              pod_spec.tolerations) is not None:
-                        continue
-                    if not flavor_selector_matches(pod_spec, rg.label_keys,
-                                                   flavor.spec.node_labels):
-                        continue
-                    row[topo.flavor_index[fname]] = True
-            topo.elig_cache[key] = row
-        eligible[pi] = row
+        eligible[pi] = eligibility_row(info, pi, qi, cq, snapshot, topo)
     return qi, requests, active, eligible, True
+
+
+def eligibility_row(info, pi: int, qi: int, cq, snapshot: Snapshot,
+                    topo: Topology) -> np.ndarray:
+    """Host-side taints/affinity per flavor for one podset, memoized by
+    pod-spec signature: identical pod shapes (the common case at scale)
+    share one eligibility row instead of re-running the string-matching
+    loop per workload. Shared by the oracle and the encode arena."""
+    pod_spec = info.obj.spec.pod_sets[pi].template.spec
+    key = (qi, _eligibility_key(pod_spec))
+    row = topo.elig_cache.get(key)
+    if row is not None:
+        # Move-to-end on hit: the oldest-half eviction then drops the
+        # LEAST-RECENTLY-USED half, so a permanently-hot shared row
+        # (the dominant pod shape) survives every cap trip. Row encodes
+        # are already O(changed), so the two dict ops are noise.
+        del topo.elig_cache[key]
+        topo.elig_cache[key] = row
+        return row
+    if len(topo.elig_cache) >= ELIG_CACHE_CAP:
+        _evict_oldest_half(topo.elig_cache)
+    F = topo.nominal.shape[1]
+    row = np.zeros(F, bool)
+    for rg in cq.resource_groups:
+        for fname in rg.flavors:
+            flavor = snapshot.resource_flavors.get(fname)
+            if flavor is None:
+                continue
+            if find_untolerated_taint(flavor.spec.node_taints,
+                                      pod_spec.tolerations) is not None:
+                continue
+            if not flavor_selector_matches(pod_spec, rg.label_keys,
+                                           flavor.spec.node_labels):
+                continue
+            row[topo.flavor_index[fname]] = True
+    topo.elig_cache[key] = row
+    return row
+
+
+def _evict_oldest_half(cache: dict) -> None:
+    """Bound growth under per-workload-unique pod shapes. dicts preserve
+    insertion order and eligibility_row moves entries to the end on
+    every hit, so the first half is the least recently used."""
+    for k in list(itertools.islice(cache, len(cache) // 2)):
+        del cache[k]
+
+
+def fill_start_ranks(start_rank: np.ndarray, entries: list, solvable,
+                     snapshot: Snapshot, topo: Topology, P: int) -> None:
+    """Flavor-fungibility resume positions for the batch (reference:
+    flavorassigner.go:289-296) — the one genuinely per-cycle encode
+    input (capacity generations move between cycles). Shared by the
+    from-scratch oracle and the arena assembler.
+
+    Writes only the stored (podset, resource) entries instead of the old
+    per-workload P x R double loop: absent resources and podsets resolve
+    to next_flavor_to_try == 0, the array default, so the output is
+    bit-identical. The outdated-generation check clears
+    info.last_assignment exactly like the sequential assigner."""
+    import operator
+    gen_cache: dict = {}
+    resource_index = topo.resource_index
+    cqs = snapshot.cluster_queues
+    # C-level attribute walk: most heads have no resume state, and the
+    # per-entry getattr loop was measurable at 2048 heads.
+    las = map(operator.attrgetter("last_assignment"), entries)
+    for wi, la in enumerate(las):
+        if la is None or not solvable[wi]:
+            continue
+        info = entries[wi]
+        gens = gen_cache.get(info.cluster_queue)
+        if gens is None:
+            cq = cqs[info.cluster_queue]
+            gens = (cq.allocatable_resource_generation,
+                    cq.cohort.allocatable_resource_generation
+                    if cq.cohort is not None else None)
+            gen_cache[info.cluster_queue] = gens
+        if gens[0] > la.cluster_queue_generation \
+                or (gens[1] is not None and gens[1] > la.cohort_generation):
+            info.last_assignment = None  # capacity moved: restart from 0
+            continue
+        n_ps = min(len(info.total_requests), P)
+        for pi, tried in enumerate(la.last_tried_flavor_idx[:n_ps]):
+            for r, idx in tried.items():
+                ri = resource_index.get(r)
+                if ri is not None and idx >= 0:
+                    start_rank[wi, pi, ri] = idx + 1
 
 
 def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
@@ -391,25 +458,11 @@ def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
         batch.podset_active[wi] = active
         batch.eligible[wi] = eligible
         batch.solvable[wi] = True
-        # Flavor-fungibility resume (reference: flavorassigner.go:289-296):
-        # start each resource's search after the last tried flavor, unless
-        # the capacity generation moved (then restart from 0). Both the
-        # outdated check and the resume apply regardless of the
-        # FlavorFungibility gate, mirroring the CPU assigner.
-        la = info.last_assignment
-        if la is not None:
-            cq = snapshot.cluster_queues[info.cluster_queue]
-            outdated = (cq.allocatable_resource_generation
-                        > la.cluster_queue_generation
-                        or (cq.cohort is not None
-                            and cq.cohort.allocatable_resource_generation
-                            > la.cohort_generation))
-            if outdated:
-                info.last_assignment = la = None
-        if la is not None:
-            for pi in range(min(len(info.total_requests), P)):
-                for r, ri in topo.resource_index.items():
-                    batch.start_rank[wi, pi, ri] = la.next_flavor_to_try(pi, r)
+    # Flavor-fungibility resume: both the outdated check and the resume
+    # apply regardless of the FlavorFungibility gate, mirroring the CPU
+    # assigner.
+    fill_start_ranks(batch.start_rank, entries, batch.solvable, snapshot,
+                     topo, P)
     return batch
 
 

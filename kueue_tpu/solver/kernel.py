@@ -693,6 +693,80 @@ solve_cycle_resident = partial(
     solve_cycle_resident_impl)
 
 
+# ---------------------------------------------------------------------------
+# Workload encode arena: device-resident batch rows, gathered by slot
+# ---------------------------------------------------------------------------
+#
+# With the host-side encode arena (solver/arena.py) every pending
+# workload's encoded rows live in a stable slot; the device keeps a twin
+# of the arena arrays, so the per-cycle host->device payload shrinks to
+# (a) the slot index array for this cycle's heads and (b) a bucketed
+# scatter of the rows that changed since the last dispatch — instead of
+# the full padded [W,P,R]/[W,P,F] batch upload.
+
+# The arena ABI (field list) is owned by solver/arena.py — the host
+# twin and the kernel build from the same tuple so they can never
+# drift. arena.py has no jax dependency, so this import is acyclic.
+from kueue_tpu.solver.arena import ARENA_FIELDS  # noqa: E402
+
+
+def scatter_arena_rows_impl(arena: dict, upd_slots, upd_rows: dict):
+    """Scatter this dispatch's changed rows into the device arena twin.
+    upd_slots pads with an out-of-range index so mode="drop" ignores the
+    padding lanes. A SEPARATE program from the solve on purpose: its
+    shape key is (row bucket, arena capacity) only — fused into the
+    solve it multiplied every solve variant by every row bucket."""
+    return {name: arena[name].at[upd_slots].set(upd_rows[name],
+                                                mode="drop")
+            for name in ARENA_FIELDS}
+
+
+scatter_arena_rows = jax.jit(scatter_arena_rows_impl)
+
+
+def gather_arena_impl(arena: dict, slots):
+    """[W]-padded slot indices (-1 = padding) -> the batch tensors,
+    bit-identical to the host-assembled padded batch (padding rows are
+    all-zero / False)."""
+    s = jnp.maximum(slots, 0)
+    valid = slots >= 0
+    requests = jnp.where(valid[:, None, None], arena["requests"][s], 0)
+    podset_active = arena["podset_active"][s] & valid[:, None]
+    wl_cq = jnp.where(valid, arena["wl_cq"][s], 0)
+    priority = jnp.where(valid, arena["priority"][s], 0)
+    timestamp = jnp.where(valid, arena["timestamp"][s], 0.0)
+    eligible = arena["eligible"][s] & valid[:, None, None]
+    solvable = arena["solvable"][s] & valid
+    return (requests, podset_active, wl_cq, priority, timestamp, eligible,
+            solvable)
+
+
+def solve_cycle_resident_arena_impl(topo, usage, cohort_usage, deltas,
+                                    arena, slots,
+                                    num_podsets: int, max_rank: int,
+                                    fair_sharing: bool = False,
+                                    start_rank=None, preempt_args=None,
+                                    fair_preempt_args=None,
+                                    fs_strategies: tuple = ()):
+    """The arena-resident production cycle: gather the head slots from
+    the device arena twin into the batch tensors, then run the resident
+    solve — one device program, with no per-cycle batch upload (changed
+    rows arrive via the separate scatter_arena_rows prologue)."""
+    batch = gather_arena_impl(arena, slots)
+    return solve_cycle_resident_impl(
+        topo, usage, cohort_usage, deltas, *batch,
+        num_podsets=num_podsets, max_rank=max_rank,
+        fair_sharing=fair_sharing, start_rank=start_rank,
+        preempt_args=preempt_args, fair_preempt_args=fair_preempt_args,
+        fs_strategies=fs_strategies)
+
+
+solve_cycle_resident_arena = partial(
+    jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing",
+                              "fs_strategies"))(
+    solve_cycle_resident_arena_impl)
+
+
 # Topology fields the kernels consume; topo_to_device (TPU) and the
 # service's _topo_np (local CPU router) both build their dicts from this
 # single list so they can never drift.

@@ -38,10 +38,12 @@ from kueue_tpu.scheduler import flavorassigner as fa
 from kueue_tpu.solver import encode
 import jax
 
+from kueue_tpu.solver.arena import WorkloadArena
 from kueue_tpu.solver.kernel import (
     max_rank_bound,
     solve_cycle_fused,
     solve_cycle_resident,
+    solve_cycle_resident_arena,
     solve_cycle_with_preempt,
     solve_phase_a,
     topo_to_device,
@@ -79,6 +81,7 @@ class Plan:
         self.backlog_gen = -1     # residency generation the deltas cover
         self.resident = False     # dispatch through the resident kernel
         self.rs = None            # the ResidentState this plan was built on
+        self.slots = None         # arena slots for batch.infos (arena path)
 
 
 class InFlight:
@@ -139,6 +142,15 @@ class BatchSolver:
         self._cache = None  # bound Cache (usage journal source)
         self._resident: Optional[ResidentState] = None
         self._fetch_pool = None  # lazy: background-fetch executor
+        # Workload encode arena (solver/arena.py): persistent per-workload
+        # encoded rows, maintained by the queue manager's delta feed.
+        # Engaged only once a Manager is bound (bind_queues) — without
+        # the feed there is no invalidation source for in-place object
+        # updates, so unbound callers keep the from-scratch encode.
+        self._arena = WorkloadArena(max_podsets)
+        self._queues = None
+        # Per-cycle encode-phase latency samples (perf: encode_ms p50/p99).
+        self.encode_samples: list = []
         # Per-cycle host<->device payload accounting (bench visibility).
         self.last_upload_bytes = 0
         self.last_fetch_bytes = 0
@@ -160,6 +172,21 @@ class BatchSolver:
         self._cache = cache
         if self.mesh is None and self.backend == "jit":
             cache.enable_usage_journal()
+
+    def bind_queues(self, queues) -> None:
+        """Attach the queue Manager's workload delta feed: the encode
+        arena's rows are invalidated/freed by deltas instead of being
+        rebuilt per cycle. Idempotent."""
+        if self._queues is queues:
+            return
+        self._queues = queues
+        queues.add_workload_listener(self._arena.note)
+
+    def release_workload(self, key: str) -> None:
+        """Scheduler hook: the workload was admitted (it holds quota and
+        leaves the pending set without a queue-manager delete), so its
+        arena slot can be recycled."""
+        self._arena.release(key)
 
     @property
     def resident_capable(self) -> bool:
@@ -215,7 +242,8 @@ class BatchSolver:
 
     def warm(self, snapshot: Snapshot, widths=(2048,),
              max_ranks=(8, 32, 128, 512), deltas_buckets=(8,),
-             fair_sharing: bool = False) -> int:
+             fair_sharing: bool = False,
+             expected_pending: Optional[int] = None) -> int:
         """Precompile (or load from the persistent cache) the fit-path
         kernel variants for the shape buckets a run will hit, BEFORE the
         measured clock starts (VERDICT r4 weak #7 / ask #3: un-amortized
@@ -240,6 +268,18 @@ class BatchSolver:
         usage = jnp.zeros((Q, F, R), jnp.int64)
         cohort_usage = jnp.zeros((max(C, 1), F, R), jnp.int64)
         warmed = 0
+        arena_dev = None
+        if expected_pending is not None:
+            # Pre-size the arena so the run never pays mid-run growth
+            # (growth drops the device twin and mints a fresh gather
+            # shape), and warm the arena-resident kernel at that shape.
+            from kueue_tpu.solver.arena import ARENA_FIELDS
+            self._arena.reserve(expected_pending, topo)
+            if self._arena.cap:
+                arena_dev = {
+                    name: jnp.zeros(getattr(self._arena, name).shape,
+                                    getattr(self._arena, name).dtype)
+                    for name in ARENA_FIELDS}
         for width in widths:
             W = _bucket(max(1, width))
             P = self.max_podsets
@@ -287,12 +327,38 @@ class BatchSolver:
                                       np.zeros(dlt, np.int64),
                                       np.full((L, dlt, 3), -1, np.int32),
                                       np.full((L, dlt), -1, np.int32))
-                        out = solve_cycle_resident(
-                            topo_dev, usage, cohort_usage, deltas, *args,
+                        if arena_dev is None:
+                            out = solve_cycle_resident(
+                                topo_dev, usage, cohort_usage, deltas,
+                                *args, num_podsets=P, max_rank=max_rank,
+                                fair_sharing=fair_sharing, start_rank=sr)
+                            out["admitted"].block_until_ready()
+                            warmed += 1
+                            continue
+                        # With the arena bound, the plain resident kernel
+                        # is never dispatched — warm the arena-gather
+                        # variant instead.
+                        slots_w = np.full(W, -1, np.int32)
+                        out = solve_cycle_resident_arena(
+                            topo_dev, usage, cohort_usage, deltas,
+                            arena_dev, slots_w,
                             num_podsets=P, max_rank=max_rank,
                             fair_sharing=fair_sharing, start_rank=sr)
                         out["admitted"].block_until_ready()
                         warmed += 1
+        if arena_dev is not None:
+            # The changed-row scatter program: one compile per row
+            # bucket at this arena capacity (shape-independent of the
+            # solve variants by design).
+            from kueue_tpu.solver.arena import _UPD_BUCKETS
+            from kueue_tpu.solver.kernel import scatter_arena_rows
+            for D in _UPD_BUCKETS:
+                upd_slots = np.full(D, self._arena.cap, np.int32)
+                upd_rows = {name: np.zeros((D,) + a.shape[1:], a.dtype)
+                            for name, a in arena_dev.items()}
+                out = scatter_arena_rows(arena_dev, upd_slots, upd_rows)
+                out["solvable"].block_until_ready()
+                warmed += 1
         return warmed
 
     # --- encoding with topology caching across cycles ---
@@ -337,18 +403,32 @@ class BatchSolver:
                                                                   topo)
         if resident:
             self.counters["resident_cycles"] += 1
-        batch = encode.encode_workloads(entries, snapshot, topo,
-                                        ordering=self.ordering,
-                                        max_podsets=self.max_podsets)
-        if not batch.solvable.any():
-            self.phase_s["encode"] += _t.perf_counter() - t0
-            return None
-        start_rank = batch.start_rank if batch.start_rank.any() else None
+        slots = None
+        if self._queues is not None:
+            # Arena path: O(changed) row encodes + a vectorized gather
+            # instead of the per-head reassembly loop.
+            self._arena.begin_cycle(topo)
+            batch, slots = self._arena.assemble(entries, snapshot, topo,
+                                                self.ordering,
+                                                self.max_podsets)
+            self.counters["arena_rows_encoded"] = self._arena.encoded_rows
+            self.counters["arena_gathers"] = self._arena.gathers
+        else:
+            batch = encode.encode_workloads(entries, snapshot, topo,
+                                            ordering=self.ordering,
+                                            max_podsets=self.max_podsets)
         t1 = _t.perf_counter()
         self.phase_s["encode"] += t1 - t0
+        if len(self.encode_samples) >= (1 << 20):
+            del self.encode_samples[: 1 << 19]
+        self.encode_samples.append(t1 - t0)
+        if not batch.solvable.any():
+            return None
+        start_rank = batch.start_rank if batch.start_rank.any() else None
         fit_pred = self._route(topo, state, batch, start_rank)
         self.phase_s["route"] += _t.perf_counter() - t1
         plan = Plan(topo, topo_dev, state, batch, start_rank, fit_pred)
+        plan.slots = slots
         plan.deltas = deltas
         plan.resident = resident
         if resident:
@@ -495,6 +575,9 @@ class BatchSolver:
 
     def invalidate_resident(self) -> None:
         self._resident = None
+        # The arena twin may hold rows from an aborted dispatch whose
+        # dirty-set was already cleared: force a full re-upload.
+        self._arena.drop_device()
 
     def _route(self, topo, state, batch, start_rank):
         """Exact host-side replica of the device Phase A (same jitted
@@ -616,19 +699,39 @@ class BatchSolver:
         if plan.resident and plan.rs is not rs:
             plan.resident = False
         establishing = rs is None or rs.usage_dev is None
+        arena_bytes = None
         if plan.resident and rs is not None and rs.token == topo.token:
             usage_in = (rs.usage_dev if rs.usage_dev is not None
                         else state.usage)
             cohort_in = (rs.cohort_dev if rs.cohort_dev is not None
                          else state.cohort_usage)
-            result = solve_cycle_resident(
-                topo_dev, usage_in, cohort_in, plan.deltas,
-                batch.requests, batch.podset_active, batch.wl_cq,
-                batch.priority, batch.timestamp, batch.eligible,
-                batch.solvable, num_podsets=self.max_podsets,
-                max_rank=max_rank, fair_sharing=fair_sharing,
-                start_rank=start_rank, preempt_args=pargs,
-                fair_preempt_args=fargs, fs_strategies=fs_flags)
+            if plan.slots is not None:
+                # Arena-resident dispatch: the batch rows already live on
+                # device — ship only the head slot indices plus a sparse
+                # scatter of the rows that changed since the last
+                # dispatch (applied to the twin by prepare_device), and
+                # gather on device.
+                arena_dev, up_nbytes = self._arena.prepare_device()
+                W = batch.requests.shape[0]
+                slots_w = np.full(W, -1, np.int32)
+                slots_w[:batch.n] = plan.slots
+                arena_bytes = up_nbytes + slots_w.nbytes
+                result = solve_cycle_resident_arena(
+                    topo_dev, usage_in, cohort_in, plan.deltas,
+                    arena_dev, slots_w,
+                    num_podsets=self.max_podsets, max_rank=max_rank,
+                    fair_sharing=fair_sharing, start_rank=start_rank,
+                    preempt_args=pargs, fair_preempt_args=fargs,
+                    fs_strategies=fs_flags)
+            else:
+                result = solve_cycle_resident(
+                    topo_dev, usage_in, cohort_in, plan.deltas,
+                    batch.requests, batch.podset_active, batch.wl_cq,
+                    batch.priority, batch.timestamp, batch.eligible,
+                    batch.solvable, num_podsets=self.max_podsets,
+                    max_rank=max_rank, fair_sharing=fair_sharing,
+                    start_rank=start_rank, preempt_args=pargs,
+                    fair_preempt_args=fargs, fs_strategies=fs_flags)
             rs.usage_dev = result["usage"]
             rs.cohort_dev = result["cohort_usage"]
             if plan.deltas is not None and plan.backlog_gen == rs.backlog_gen:
@@ -659,10 +762,15 @@ class BatchSolver:
             keys += ["preempt_targets", "preempt_feasible"]
         if fair_batch is not None:
             keys += ["fair_targets", "fair_feasible", "fair_reasons"]
-        batch_np = (batch.requests, batch.podset_active, batch.wl_cq,
-                    batch.priority, batch.timestamp, batch.eligible,
-                    batch.solvable)
-        up = sum(a.nbytes for a in batch_np if isinstance(a, np.ndarray))
+        if arena_bytes is not None:
+            # Arena dispatch: the batch never shipped — only the slot
+            # index array and the changed-row scatter did.
+            up = arena_bytes
+        else:
+            batch_np = (batch.requests, batch.podset_active, batch.wl_cq,
+                        batch.priority, batch.timestamp, batch.eligible,
+                        batch.solvable)
+            up = sum(a.nbytes for a in batch_np if isinstance(a, np.ndarray))
         if start_rank is not None:
             up += start_rank.nbytes
         if plan.resident:

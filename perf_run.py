@@ -67,6 +67,11 @@ def run_mode(label, scale, solver, config="default"):
         "snapshot_build_p50_ms": round(result.snapshot_build_p50_ms, 3),
         "snapshot_build_p99_ms": round(result.snapshot_build_p99_ms, 3),
         "snapshot_counts": result.snapshot_counts,
+        # encode-phase cost as its own metric (workload encode arena):
+        # p50/p99 per solver prepare() — O(changed) row re-encodes plus
+        # the vectorized slot gather
+        "encode_p50_ms": round(result.encode_p50_ms, 3),
+        "encode_p99_ms": round(result.encode_p99_ms, 3),
     }
     print(json.dumps(out), file=sys.stderr, flush=True)
     return out

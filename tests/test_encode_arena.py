@@ -1,0 +1,353 @@
+"""Workload encode arena: arena-assembled batches must be bit-identical
+to a from-scratch encode_workloads (the equivalence oracle), across
+resource_version bumps, topology token bumps, slot reuse after delete,
+>P-podset CPU-fallback rows, unknown-CQ rows and flavor-resume state.
+Also pins the arena slot lifecycle (queue-manager delta feed, admission
+release), the eligibility-cache half-eviction, and the scheduler-level
+arena engagement.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import FakeClock
+from kueue_tpu.cache import Cache
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.queue import Manager
+from kueue_tpu.solver import encode
+from kueue_tpu.solver.arena import WorkloadArena
+from tests.wrappers import (
+    ClusterQueueWrapper, WorkloadWrapper, flavor_quotas, make_flavor,
+    make_local_queue)
+
+BATCH_FIELDS = ("requests", "podset_active", "wl_cq", "priority",
+                "timestamp", "eligible", "solvable", "start_rank")
+
+
+def _assert_batches_equal(a, b, msg=""):
+    assert a.n == b.n, msg
+    for name in BATCH_FIELDS:
+        va, vb = getattr(a, name), getattr(b, name)
+        assert np.array_equal(va, vb), f"{msg}: batch.{name} diverged"
+
+
+def _fresh_batch(entries, snapshot, topo, ordering, max_podsets):
+    """The oracle: from-scratch encode with every per-Info cache and
+    resume-state side effect isolated from the arena path."""
+    resumes = [info.last_assignment for info in entries]
+    for info in entries:
+        info.__dict__.pop("_solver_enc", None)
+    batch = encode.encode_workloads(entries, snapshot, topo,
+                                    ordering=ordering,
+                                    max_podsets=max_podsets)
+    for info, la in zip(entries, resumes):
+        # fill_start_ranks may null an outdated resume on the first pass;
+        # restore so the arena pass sees the identical input state.
+        if info.last_assignment is None and la is not None:
+            info.last_assignment = la
+    return batch
+
+
+class ArenaEnv:
+    """Cache + queue Manager + arena wired through the delta feed, the
+    way the BatchSolver binds them in production."""
+
+    def __init__(self, num_cqs=4, flavors=("f0", "f1"), max_podsets=2):
+        self.clock = FakeClock(1000.0)
+        self.cache = Cache()
+        self.queues = Manager(clock=self.clock)
+        self.ordering = wlpkg.Ordering()
+        self.max_podsets = max_podsets
+        self.arena = WorkloadArena(max_podsets)
+        self.queues.add_workload_listener(self.arena.note)
+        self.flavors = list(flavors)
+        for f in self.flavors:
+            # Tainted odd flavors: eligibility rows differ per toleration
+            taints = None
+            if int(f[1:]) % 2:
+                from kueue_tpu.api.corev1 import Taint
+                taints = [Taint(key="spot", value="true",
+                                effect="NoSchedule")]
+            self.cache.add_or_update_resource_flavor(
+                make_flavor(f, taints=taints))
+        self.num_cqs = 0
+        for _ in range(num_cqs):
+            self.add_cq()
+
+    def add_cq(self):
+        i = self.num_cqs
+        self.num_cqs += 1
+        cq = (ClusterQueueWrapper(f"cq{i}")
+              .cohort(f"cohort-{i % 2}")
+              .resource_group(*[flavor_quotas(f, cpu="10")
+                                for f in self.flavors]).obj())
+        self.cache.add_cluster_queue(cq)
+        self.queues.add_cluster_queue(cq)
+        self.queues.add_local_queue(make_local_queue(f"lq{i}", "default",
+                                                     f"cq{i}"))
+
+    def submit(self, wl):
+        assert self.queues.add_or_update_workload(wl)
+
+    def infos(self):
+        out = {}
+        for items in self.queues.local_queues.values():
+            out.update(items.items)
+        return out
+
+    def topo(self):
+        snapshot = self.cache.snapshot()
+        return snapshot, encode.encode_topology(snapshot)
+
+    def both_batches(self, entries, snapshot, topo):
+        self.arena.begin_cycle(topo)
+        arena_batch, slots = self.arena.assemble(
+            entries, snapshot, topo, self.ordering, self.max_podsets)
+        fresh = _fresh_batch(entries, snapshot, topo, self.ordering,
+                             self.max_podsets)
+        return arena_batch, fresh, slots
+
+
+def _make_wl(env, name, rng):
+    i = rng.randrange(env.num_cqs)
+    w = (WorkloadWrapper(name).queue(f"lq{i}")
+         .priority(rng.randrange(-2, 3))
+         .creation(float(rng.randrange(10_000))))
+    npods = rng.choice([1, 1, 1, 2, env.max_podsets + 1])  # sometimes >P
+    for p in range(npods):
+        w.pod_set(name=f"ps{p}", count=rng.randrange(1, 3),
+                  cpu=str(rng.randrange(1, 5)))
+        if rng.random() < 0.5:
+            w.toleration("spot", "true")
+    w.wl.metadata.resource_version = 1
+    return w.obj()
+
+
+class TestArenaEquivalence:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_randomized_arena_matches_fresh(self, seed):
+        rng = random.Random(seed)
+        env = ArenaEnv(num_cqs=4, max_podsets=2)
+        live: dict = {}  # name -> Workload
+        n = 0
+        for cycle in range(10):
+            # churn: arrivals, updates (rv bump + changed requests),
+            # deletions (slot free + reuse), occasional topology bumps
+            for _ in range(rng.randrange(1, 6)):
+                name = f"w{n}"
+                n += 1
+                wl = _make_wl(env, name, rng)
+                live[name] = wl
+                env.submit(wl)
+            for name in rng.sample(sorted(live), min(2, len(live))):
+                if rng.random() < 0.5:
+                    wl = _make_wl(env, name, rng)
+                    wl.metadata.resource_version = \
+                        live[name].metadata.resource_version + 1
+                    live[name] = wl
+                    env.submit(wl)
+                else:
+                    env.queues.delete_workload(live.pop(name))
+            if cycle in (4, 7):
+                env.add_cq()  # topology epoch bump -> new topo token
+            snapshot, topo = env.topo()
+            infos = env.infos()
+            if not infos:
+                continue
+            entries = [infos[k] for k in rng.sample(sorted(infos),
+                                                    rng.randrange(
+                                                        1, len(infos) + 1))]
+            # flavor-resume state on a few entries (start_rank input)
+            for info in rng.sample(entries, min(2, len(entries))):
+                info.last_assignment = wlpkg.AssignmentClusterQueueState(
+                    last_tried_flavor_idx=[{"cpu": rng.choice([-1, 0, 1])}],
+                    cluster_queue_generation=10**9,  # never outdated
+                    cohort_generation=10**9)
+            arena_batch, fresh, _ = env.both_batches(entries, snapshot, topo)
+            _assert_batches_equal(arena_batch, fresh,
+                                  f"seed={seed} cycle={cycle}")
+
+    def test_unknown_cq_row_matches_oracle(self):
+        env = ArenaEnv(num_cqs=2)
+        wl = (WorkloadWrapper("w0").queue("lq0").pod_set(cpu="1").obj())
+        info = wlpkg.Info(wl)
+        info.cluster_queue = "no-such-cq"
+        snapshot, topo = env.topo()
+        arena_batch, fresh, _ = env.both_batches([info], snapshot, topo)
+        _assert_batches_equal(arena_batch, fresh)
+        assert not arena_batch.solvable[0]
+
+    def test_resource_version_bump_reencodes(self):
+        # The (token, resourceVersion) key is enforced via object
+        # identity: a bump always rides a fresh Workload object (store
+        # clone semantics), and the queue manager wraps it in a fresh
+        # Info + fires the upsert feed — the row must re-encode.
+        env = ArenaEnv(num_cqs=1)
+        wl = WorkloadWrapper("w0").queue("lq0").pod_set(cpu="2").obj()
+        wl.metadata.resource_version = 1
+        env.submit(wl)
+        snapshot, topo = env.topo()
+        info = env.infos()["default/w0"]
+        env.arena.begin_cycle(topo)
+        env.arena.assemble([info], snapshot, topo, env.ordering, 2)
+        before = env.arena.encoded_rows
+        env.arena.assemble([info], snapshot, topo, env.ordering, 2)
+        assert env.arena.encoded_rows == before  # unchanged row: no work
+        wl2 = WorkloadWrapper("w0").queue("lq0").pod_set(cpu="5").obj()
+        wl2.metadata.resource_version = 2
+        env.submit(wl2)
+        info2 = env.infos()["default/w0"]
+        batch, _ = env.arena.assemble([info2], snapshot, topo,
+                                      env.ordering, 2)
+        assert env.arena.encoded_rows == before + 1
+        assert batch.requests[0].max() == 5000
+
+    def test_manager_upsert_reencodes_in_place_rebuild(self):
+        # A requests rebuild that keeps the same Info AND obj must be
+        # re-pushed through the Manager (the reclaimable-pods controller
+        # path does): the upsert feed invalidates the row.
+        env = ArenaEnv(num_cqs=1)
+        wl = WorkloadWrapper("w0").queue("lq0").pod_set(cpu="2").obj()
+        env.submit(wl)
+        snapshot, topo = env.topo()
+        info = env.infos()["default/w0"]
+        env.arena.begin_cycle(topo)
+        env.arena.assemble([info], snapshot, topo, env.ordering, 2)
+        before = env.arena.encoded_rows
+        info.total_requests[0].requests["cpu"] = 7000
+        env.submit(wl)  # manager re-push -> upsert feed
+        info2 = env.infos()["default/w0"]
+        batch, _ = env.arena.assemble([info2], snapshot, topo,
+                                      env.ordering, 2)
+        assert env.arena.encoded_rows == before + 1
+        fresh = _fresh_batch([info2], snapshot, topo, env.ordering, 2)
+        _assert_batches_equal(batch, fresh)
+
+    def test_delete_frees_slot_and_reuse(self):
+        env = ArenaEnv(num_cqs=1)
+        w0 = WorkloadWrapper("w0").queue("lq0").pod_set(cpu="1").obj()
+        env.submit(w0)
+        snapshot, topo = env.topo()
+        info0 = env.infos()["default/w0"]
+        env.arena.begin_cycle(topo)
+        _, slots0 = env.arena.assemble([info0], snapshot, topo,
+                                       env.ordering, 2)
+        env.queues.delete_workload(w0)
+        w1 = WorkloadWrapper("w1").queue("lq0").pod_set(cpu="3").obj()
+        env.submit(w1)
+        info1 = env.infos()["default/w1"]
+        batch, slots1 = env.arena.assemble([info1], snapshot, topo,
+                                           env.ordering, 2)
+        assert slots1[0] == slots0[0]  # recycled slot
+        assert "default/w0" not in env.arena.slot_of
+        fresh = _fresh_batch([info1], snapshot, topo, env.ordering, 2)
+        _assert_batches_equal(batch, fresh)
+
+    def test_admission_release_recycles_slot(self):
+        env = ArenaEnv(num_cqs=1)
+        wl = WorkloadWrapper("w0").queue("lq0").pod_set(cpu="1").obj()
+        env.submit(wl)
+        snapshot, topo = env.topo()
+        info = env.infos()["default/w0"]
+        env.arena.begin_cycle(topo)
+        env.arena.assemble([info], snapshot, topo, env.ordering, 2)
+        assert "default/w0" in env.arena.slot_of
+        env.arena.release("default/w0")
+        env.arena._drain()
+        assert "default/w0" not in env.arena.slot_of
+        assert env.arena.free
+
+    def test_topology_token_bump_invalidates_all_rows(self):
+        env = ArenaEnv(num_cqs=2)
+        wl = WorkloadWrapper("w0").queue("lq0").pod_set(cpu="1").obj()
+        env.submit(wl)
+        snapshot, topo = env.topo()
+        info = env.infos()["default/w0"]
+        env.arena.begin_cycle(topo)
+        env.arena.assemble([info], snapshot, topo, env.ordering, 2)
+        before = env.arena.encoded_rows
+        env.add_cq()  # epoch bump
+        snapshot2, topo2 = env.topo()
+        assert topo2.token != topo.token
+        arena_batch, fresh, _ = env.both_batches([info], snapshot2, topo2)
+        assert env.arena.encoded_rows == before + 1  # re-encoded once
+        _assert_batches_equal(arena_batch, fresh)
+
+
+class TestEligibilityCacheEviction:
+    def test_evicts_oldest_half_not_all(self):
+        cache = {i: i for i in range(10)}
+        encode._evict_oldest_half(cache)
+        assert sorted(cache) == [5, 6, 7, 8, 9]
+        cache[3] = 3  # re-primed row lands at the tail, surviving eviction
+        encode._evict_oldest_half(cache)
+        assert list(cache) == [8, 9, 3]
+
+    def test_hit_refreshes_recency(self):
+        # eligibility_row moves entries to the tail on every hit, so the
+        # oldest-half eviction drops the LEAST-RECENTLY-USED half — a
+        # permanently-hot shared row survives cap trips.
+        env = ArenaEnv(num_cqs=1, flavors=("f0",))
+        wl = WorkloadWrapper("w0").queue("lq0").pod_set(cpu="1").obj()
+        env.submit(wl)
+        w2 = WorkloadWrapper("w1").queue("lq0").pod_set(cpu="1")
+        w2.node_selector("zone", "a")  # distinct eligibility signature
+        env.submit(w2.obj())
+        snapshot, topo = env.topo()
+        infos = env.infos()
+        cq = snapshot.cluster_queues["cq0"]
+        qi = topo.cq_index["cq0"]
+        encode.eligibility_row(infos["default/w0"], 0, qi, cq, snapshot,
+                               topo)
+        encode.eligibility_row(infos["default/w1"], 0, qi, cq, snapshot,
+                               topo)
+        first = next(iter(topo.elig_cache))
+        # hit the older entry: it must move behind the newer one
+        encode.eligibility_row(infos["default/w0"], 0, qi, cq, snapshot,
+                               topo)
+        assert len(topo.elig_cache) == 2
+        assert list(topo.elig_cache)[-1] == first
+
+
+class TestSchedulerArenaIntegration:
+    def test_scheduler_cycles_engage_arena_and_match_cpu(self):
+        from kueue_tpu.solver import BatchSolver
+        from tests.test_scheduler import Env
+
+        def build(solver):
+            env = Env()
+            if solver:
+                env.scheduler.solver = BatchSolver()
+                env.scheduler.solver_min_heads = 0
+            env.add_flavor("default")
+            for i in range(4):
+                env.add_cq(ClusterQueueWrapper(f"cq{i}").cohort("co")
+                           .resource_group(
+                               flavor_quotas("default", cpu="4")).obj(),
+                           f"lq{i}")
+            return env
+
+        admitted = {}
+        for solver in (False, True):
+            env = build(solver)
+            n = 0
+            for wave in range(3):
+                for i in range(4):
+                    env.submit(WorkloadWrapper(f"w{wave}-{i}")
+                               .queue(f"lq{i}").priority(n % 3)
+                               .creation(float(n)).pod_set(cpu="2").obj())
+                    n += 1
+                env.cycle()
+            env.cycle()
+            admitted[solver] = sorted(env.client.applied)
+            if solver:
+                arena = env.scheduler.solver._arena
+                assert arena.gathers > 0
+                # steady-state cycles re-encode only changed rows: after
+                # the first sight of each workload, requeued heads ride
+                # their cached slots
+                assert arena.encoded_rows <= n
+        assert admitted[False] == admitted[True]

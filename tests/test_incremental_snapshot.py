@@ -346,6 +346,91 @@ class TestCopyOnWriteContract:
         assert root.resource_node.usage.get(FR, 0) == 12000
 
 
+class TestShellReuse:
+    def test_released_handout_shells_are_recycled(self):
+        cache = build_cache()
+        cache.add_or_update_workload(admitted_workload("w1", "cq0", 2))
+        s1 = check(cache, "initial")
+        cache.release_snapshot(s1)
+        shells1 = dict(s1.cluster_queues)
+        cache.add_or_update_workload(admitted_workload("w2", "cq1", 3))
+        s2 = check(cache, "after release")  # equal to a fresh rebuild
+        m = cache._maintainer
+        assert m.shell_reuses > 0
+        # untouched CQs keep the recycled objects; the replayed one is
+        # rebuilt so the released snapshot's frozen view stays... gone —
+        # it was RELEASED; identity reuse is the whole point:
+        assert s2.cluster_queues["cq2"] is shells1["cq2"]
+        assert s2.cluster_queues["cq1"] is not shells1["cq1"]
+
+    def test_materialized_shells_are_not_recycled(self):
+        cache = build_cache()
+        cache.add_or_update_workload(admitted_workload("w1", "cq0", 2))
+        s1 = cache.snapshot()
+        s1.cluster_queues["cq3"].add_usage({FR: 1000})  # cycle accounting
+        cache.release_snapshot(s1)
+        old_cq3 = s1.cluster_queues["cq3"]
+        s2 = check(cache, "after materialized release")
+        assert s2.cluster_queues["cq3"] is not old_cq3
+        assert s2.cluster_queues["cq3"].usage_for(FR) == 0
+
+    def test_unreleased_handouts_are_never_reused(self):
+        cache = build_cache()
+        s1 = cache.snapshot()
+        s2 = check(cache, "no release")
+        for name in s1.cluster_queues:
+            assert s2.cluster_queues[name] is not s1.cluster_queues[name]
+
+    def test_stale_release_is_ignored(self):
+        cache = build_cache()
+        s1 = cache.snapshot()
+        cache.snapshot()  # a newer handout exists
+        cache.release_snapshot(s1)  # stale: must not enter the pool
+        s3 = check(cache, "after stale release")
+        for name in s1.cluster_queues:
+            assert s3.cluster_queues[name] is not s1.cluster_queues[name]
+
+    def test_reuse_through_scheduler_cycles(self):
+        # the scheduler releases its sync-cycle snapshot, so steady-state
+        # cycles recycle shells — and decisions stay correct
+        from tests.test_scheduler import Env
+        env = Env()
+        env.add_flavor("default")
+        for c in range(3):
+            env.add_cq(ClusterQueueWrapper(f"cq{c}")
+                       .resource_group(flavor_quotas("default", cpu="100"))
+                       .obj(), f"lq{c}")
+        for i in range(4):
+            # only cq0 is touched per cycle: cq1/cq2 shells recycle
+            env.submit(WorkloadWrapper(f"w{i}").queue("lq0")
+                       .pod_set(count=1, cpu="1").obj())
+            env.cycle()
+            assert f"default/w{i}" in env.client.applied
+        assert env.cache._maintainer.shell_reuses > 0
+
+
+class TestBackgroundAdvance:
+    def test_light_stretch_catches_up_before_cursor_overflow(self):
+        # A long pipelined all-fit stretch takes only light snapshots;
+        # the journal backlog passing half the cap must trigger a
+        # background replay so the next sync snapshot is still served
+        # incrementally (no surprise full rebuild).
+        cache = build_cache()
+        check(cache, "establish")
+        cache._journal_cap = 40
+        m = cache._maintainer
+        wls = []
+        for i in range(60):  # > cap journal entries, light-only stretch
+            wl = admitted_workload(f"bg{i}", f"cq{i % 3}", 1)
+            cache.add_or_update_workload(wl)
+            wls.append(wl)
+            cache.snapshot(light=True)
+        assert m.background_advances > 0
+        full_before = m.full_rebuilds
+        check(cache, "sync after light stretch")
+        assert m.full_rebuilds == full_before  # incremental, not rebuild
+
+
 class TestIncrementalSmoke:
     def test_three_cycle_steady_state_takes_the_incremental_path(self):
         # a 3-cycle steady-state scheduler run: exactly one full build

@@ -141,6 +141,15 @@ class LeaderElectionConfig:
 
 DEFAULT_STRICT_AFTER_BLOCKED_CYCLES = 8
 
+# Device-fault containment defaults (kueue_tpu/resilience) — single
+# source for both the dataclass defaults and load()'s fallbacks.
+DEFAULT_WATCHDOG_SAFETY_FACTOR = 20.0
+DEFAULT_WATCHDOG_MIN_DEADLINE_S = 1.0
+DEFAULT_WATCHDOG_MAX_DEADLINE_S = 30.0
+DEFAULT_BREAKER_FAULT_THRESHOLD = 3
+DEFAULT_BREAKER_BACKOFF_BASE_S = 1.0
+DEFAULT_BREAKER_BACKOFF_MAX_S = 60.0
+
 
 @dataclass
 class SolverConfig:
@@ -166,6 +175,23 @@ class SolverConfig:
     # (reference resourcesToReserve ordering) until it unblocks; 0
     # disables the bound (the documented unbounded deviation)
     strict_after_blocked_cycles: int = DEFAULT_STRICT_AFTER_BLOCKED_CYCLES
+    # Device-fault containment (kueue_tpu/resilience/RESILIENCE.md).
+    # Watchdog: every device round trip carries a deadline of
+    # (estimated device cycle seconds) x safety factor, clamped to
+    # [min, max] — a collect past it is abandoned instead of blocking
+    # the cycle on a wedged tunnel. min guards a sub-ms local-backend
+    # estimate against GC-pause false positives; max is also the
+    # no-estimate cold-start deadline (a first cycle may carry a
+    # multi-second remote compile).
+    watchdog_safety_factor: float = DEFAULT_WATCHDOG_SAFETY_FACTOR
+    watchdog_min_deadline_s: float = DEFAULT_WATCHDOG_MIN_DEADLINE_S
+    watchdog_max_deadline_s: float = DEFAULT_WATCHDOG_MAX_DEADLINE_S
+    # Breaker: this many CONSECUTIVE device faults pin cycles to the
+    # CPU fallback (route "cpu-breaker") until a half-open probe — after
+    # exponential backoff from base to max, with jitter — succeeds.
+    breaker_fault_threshold: int = DEFAULT_BREAKER_FAULT_THRESHOLD
+    breaker_backoff_base_s: float = DEFAULT_BREAKER_BACKOFF_BASE_S
+    breaker_backoff_max_s: float = DEFAULT_BREAKER_BACKOFF_MAX_S
 
 
 @dataclass
@@ -246,6 +272,19 @@ def validate(cfg: Configuration) -> list[str]:
                     "(0 disables the starvation bound)")
     if cfg.solver.routing not in ("adaptive", "always", "never"):
         errs.append("solver.routing must be adaptive, always, or never")
+    if cfg.solver.watchdog_safety_factor <= 0 \
+            or cfg.solver.watchdog_min_deadline_s <= 0 \
+            or cfg.solver.watchdog_max_deadline_s \
+            < cfg.solver.watchdog_min_deadline_s:
+        errs.append("solver.watchdog: safetyFactor and minDeadline must be "
+                    "positive with maxDeadline >= minDeadline")
+    if cfg.solver.breaker_fault_threshold < 1:
+        errs.append("solver.breakerFaultThreshold must be >= 1")
+    if cfg.solver.breaker_backoff_base_s <= 0 \
+            or cfg.solver.breaker_backoff_max_s \
+            < cfg.solver.breaker_backoff_base_s:
+        errs.append("solver.breakerBackoff: base must be positive and "
+                    "max >= base")
     return errs
 
 
@@ -336,6 +375,18 @@ def load(raw: dict) -> Configuration:
             strict_after_blocked_cycles=s.get(
                 "strictAfterBlockedCycles",
                 DEFAULT_STRICT_AFTER_BLOCKED_CYCLES),
+            watchdog_safety_factor=s.get(
+                "watchdogSafetyFactor", DEFAULT_WATCHDOG_SAFETY_FACTOR),
+            watchdog_min_deadline_s=s.get(
+                "watchdogMinDeadline", DEFAULT_WATCHDOG_MIN_DEADLINE_S),
+            watchdog_max_deadline_s=s.get(
+                "watchdogMaxDeadline", DEFAULT_WATCHDOG_MAX_DEADLINE_S),
+            breaker_fault_threshold=s.get(
+                "breakerFaultThreshold", DEFAULT_BREAKER_FAULT_THRESHOLD),
+            breaker_backoff_base_s=s.get(
+                "breakerBackoffBase", DEFAULT_BREAKER_BACKOFF_BASE_S),
+            breaker_backoff_max_s=s.get(
+                "breakerBackoffMax", DEFAULT_BREAKER_BACKOFF_MAX_S),
         )
     cfg.feature_gates = dict(raw.get("featureGates", {}))
     cfg = set_defaults(cfg)

@@ -154,6 +154,28 @@ class KueueManager:
             self.scheduler.solver_routing = self.cfg.solver.routing
             self.scheduler.strict_after_blocked_cycles = \
                 self.cfg.solver.strict_after_blocked_cycles
+            # Device-fault containment (kueue_tpu/resilience): watchdog
+            # deadlines + circuit breaker from the solver config, and
+            # fault/trip/recovery events onto the sim event recorder so
+            # the outage timeline is visible in the artifacts.
+            from kueue_tpu.resilience.breaker import CircuitBreaker
+            from kueue_tpu.resilience.watchdog import DispatchWatchdog
+            s = self.cfg.solver
+            self.scheduler.watchdog = DispatchWatchdog(
+                safety_factor=s.watchdog_safety_factor,
+                min_deadline_s=s.watchdog_min_deadline_s,
+                max_deadline_s=s.watchdog_max_deadline_s)
+            self.scheduler.breaker = CircuitBreaker(
+                threshold=s.breaker_fault_threshold,
+                backoff_base_s=s.breaker_backoff_base_s,
+                backoff_max_s=s.breaker_backoff_max_s)
+            self.scheduler.on_fault = (
+                lambda kind, msg: self.recorder.system_event(
+                    "Warning" if kind != "breaker-closed" else "Normal",
+                    {"fault": "DeviceFault",
+                     "breaker-open": "BreakerOpen",
+                     "breaker-closed": "BreakerClosed"}.get(kind, kind),
+                    msg))
             from kueue_tpu.utils.runtime import enable_compilation_cache
             enable_compilation_cache()
 

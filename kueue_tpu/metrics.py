@@ -233,6 +233,23 @@ class Registry:
             "kueue_cluster_queue_weighted_share",
             "Maximum weighted borrowed share (0 = within nominal quota)",
             ["cluster_queue"])
+        # Device-fault containment (kueue_tpu/resilience; no reference
+        # analogue): solver-path faults, watchdog timeouts, breaker
+        # trips, and how long the last outage took to recover.
+        self.device_faults_total = Counter(
+            "kueue_solver_device_faults_total",
+            "Device-path faults by site (dispatch|collect|solve|prepare)",
+            ["site"])
+        self.dispatch_timeouts_total = Counter(
+            "kueue_solver_dispatch_timeouts_total",
+            "Device collects abandoned by the dispatch watchdog deadline")
+        self.breaker_trips_total = Counter(
+            "kueue_solver_breaker_trips_total",
+            "Circuit-breaker trips (device route suspended to cpu-breaker)")
+        self.fault_recovery_cycles = Gauge(
+            "kueue_solver_fault_recovery_cycles",
+            "Cycles from the last breaker trip until the device route "
+            "was restored by a successful half-open probe")
         self._all = [v for v in vars(self).values() if isinstance(v, _Metric)]
 
     # --- report helpers (reference: metrics.go:262-400) ---
@@ -262,6 +279,17 @@ class Registry:
 
     def preemption_skips(self, cq: str, count: int) -> None:
         self.admission_cycle_preemption_skips.set(count, cluster_queue=cq)
+
+    def device_fault(self, site: str, timeout: bool = False,
+                     tripped: bool = False) -> None:
+        self.device_faults_total.inc(site=site)
+        if timeout:
+            self.dispatch_timeouts_total.inc()
+        if tripped:
+            self.breaker_trips_total.inc()
+
+    def fault_recovered(self, cycles: int) -> None:
+        self.fault_recovery_cycles.set(cycles)
 
     def report_pending_workloads(self, cq: str, active: int, inadmissible: int) -> None:
         self.pending_workloads.set(active, cluster_queue=cq, status=PENDING_STATUS_ACTIVE)

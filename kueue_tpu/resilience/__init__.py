@@ -1,0 +1,36 @@
+"""Device-fault containment for the solver hot path (RESILIENCE.md).
+
+Three cooperating pieces:
+
+- faultinject: seedable, scripted fault injection at named sites
+  wrapping device dispatch, in-flight collect, the resident-arena
+  scatter and the solver's journal replay — zero-cost when disabled.
+- watchdog: per-dispatch deadlines derived from the router's
+  regime-keyed rate estimates x a safety factor; a timed-out collect
+  abandons the in-flight result instead of blocking the cycle forever.
+- breaker: a circuit breaker fed by watchdog timeouts and dispatch
+  exceptions; N consecutive faults pin cycles to the CPU fallback
+  (route "cpu-breaker") until a half-open probe with exponential
+  backoff + jitter re-admits the device path.
+"""
+
+from kueue_tpu.resilience.breaker import (  # noqa: F401
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
+from kueue_tpu.resilience.faultinject import (  # noqa: F401
+    DeviceFault,
+    FaultInjector,
+    InjectedFault,
+    SITE_COLLECT,
+    SITE_DISPATCH,
+    SITE_REPLAY,
+    SITE_SCATTER,
+    SITES,
+)
+from kueue_tpu.resilience.watchdog import (  # noqa: F401
+    DispatchTimeout,
+    DispatchWatchdog,
+)

@@ -1,0 +1,186 @@
+"""Seedable fault injection for the solver's device path.
+
+Named injection sites wrap the four places a flaky or vanished
+accelerator can hurt the admission cycle (see RESILIENCE.md):
+
+- ``device_dispatch``  — kernel dispatch (BatchSolver.dispatch)
+- ``device_collect``   — the in-flight result fetch (BatchSolver.collect)
+- ``arena_scatter``    — the encode arena's changed-row device scatter
+- ``journal_replay``   — the solver's residency journal reconcile
+
+Each site can, per a deterministic scripted schedule, RAISE (a dead
+tunnel / XLA error), DELAY (a wedged ``device_get`` — the watchdog's
+regime), or CORRUPT the payload passing through it. Corruption is
+applied by the call site's own ``corrupt=`` callable, so every site
+scrambles exactly the data that crosses it; the containment contract
+(which corruptions the system must detect vs. deny conservatively) is
+documented per site in RESILIENCE.md.
+
+The default is OFF at zero cost: every hook is a module-level
+``site(...)`` call that returns immediately while no injector is
+installed (one global ``is None`` check — the ``device_fault_recovery``
+bench row pins the disabled-path overhead at <1% of a cycle).
+
+Schedules are deterministic. ``FaultInjector({site: {hit: action}})``
+fires ``action`` on the hit-th time the site is reached (0-based);
+``FaultInjector.scripted(seed, ...)`` derives such a schedule from a
+seeded RNG so randomized chaos runs are exactly reproducible.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+SITE_DISPATCH = "device_dispatch"
+SITE_COLLECT = "device_collect"
+SITE_SCATTER = "arena_scatter"
+SITE_REPLAY = "journal_replay"
+SITES = (SITE_DISPATCH, SITE_COLLECT, SITE_SCATTER, SITE_REPLAY)
+
+RAISE = "raise"
+DELAY = "delay"
+CORRUPT = "corrupt"
+ACTIONS = (RAISE, DELAY, CORRUPT)
+
+
+class DeviceFault(RuntimeError):
+    """A contained device-path failure: dispatch/collect raised, the
+    watchdog timed out, or output validation caught corruption. The
+    scheduler feeds these to the circuit breaker; host-side encode bugs
+    deliberately do NOT subclass this."""
+
+
+class InjectedFault(DeviceFault):
+    """Raised by a ``raise`` action at an injection site."""
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(f"injected fault at {site} (hit {hit})")
+        self.site = site
+        self.hit = hit
+
+
+class FaultInjector:
+    """A scripted schedule of faults, keyed (site, hit index).
+
+    ``schedule``: {site: {hit_index: action}} where action is ``RAISE``,
+    ``CORRUPT``, or ``(DELAY, seconds)``. Hit indices are 0-based per
+    site and count every time the site is reached while this injector
+    is installed.
+    """
+
+    def __init__(self, schedule: Optional[dict] = None):
+        self.schedule: dict = {}
+        for site, hits in (schedule or {}).items():
+            if site not in SITES:
+                raise ValueError(f"unknown injection site {site!r}")
+            self.schedule[site] = dict(hits)
+        self._lock = threading.Lock()
+        self.hits: dict = {s: 0 for s in SITES}     # site -> times reached
+        self.fired: dict = {s: 0 for s in SITES}    # site -> faults fired
+        self.log: list = []                          # (site, hit, action)
+
+    @classmethod
+    def scripted(cls, seed: int, horizon: int = 64,
+                 rates: Optional[dict] = None,
+                 delay_s: float = 0.0) -> "FaultInjector":
+        """A reproducible randomized schedule: for each site, each of
+        the first ``horizon`` hits independently faults with the site's
+        rate (default 0.2). Which action fires is drawn from the
+        actions valid at that site (DELAY only where a deadline can
+        catch it, CORRUPT only where a payload crosses). Same seed =>
+        same schedule, regardless of execution interleaving."""
+        rng = random.Random(seed)
+        valid = {
+            SITE_DISPATCH: (RAISE, (DELAY, delay_s)) if delay_s else (RAISE,),
+            SITE_COLLECT: ((RAISE, CORRUPT, (DELAY, delay_s)) if delay_s
+                           else (RAISE, CORRUPT)),
+            SITE_SCATTER: (RAISE, CORRUPT),
+            SITE_REPLAY: (RAISE,),
+        }
+        schedule: dict = {}
+        for site in SITES:
+            rate = (rates or {}).get(site, 0.2)
+            hits = {}
+            for i in range(horizon):
+                if rng.random() < rate:
+                    hits[i] = rng.choice(valid[site])
+            if hits:
+                schedule[site] = hits
+        return cls(schedule)
+
+    def _next(self, site: str):
+        with self._lock:
+            hit = self.hits[site]
+            self.hits[site] = hit + 1
+            action = self.schedule.get(site, {}).get(hit)
+            if action is not None:
+                self.fired[site] += 1
+                self.log.append((site, hit, action))
+            return hit, action
+
+    @property
+    def total_fired(self) -> int:
+        return sum(self.fired.values())
+
+
+# The one global the disabled path reads; module attribute access is
+# the entire per-site cost when no injector is installed.
+_active: Optional[FaultInjector] = None
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    global _active
+    _active = injector
+    return injector
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _active
+
+
+class installed:
+    """Context manager: install an injector for the block's duration."""
+
+    def __init__(self, injector: FaultInjector):
+        self.injector = injector
+
+    def __enter__(self) -> FaultInjector:
+        return install(self.injector)
+
+    def __exit__(self, *exc) -> None:
+        uninstall()
+
+
+def site(name: str, payload=None,
+         corrupt: Optional[Callable] = None):
+    """The injection hook. Returns ``payload`` (possibly corrupted).
+
+    With no injector installed this is a single global load + compare —
+    the zero-cost default. With one installed: a RAISE action raises
+    InjectedFault, ``(DELAY, s)`` sleeps ``s`` (simulating a wedged
+    device call — the watchdog deadline is expected to fire), CORRUPT
+    returns ``corrupt(payload)`` (or the payload untouched when the
+    call site passed no corruptor — e.g. raise-only sites)."""
+    inj = _active
+    if inj is None:
+        return payload
+    hit, action = inj._next(name)
+    if action is None:
+        return payload
+    if action == RAISE:
+        raise InjectedFault(name, hit)
+    if action == CORRUPT:
+        return corrupt(payload) if corrupt is not None else payload
+    kind, seconds = action
+    if kind != DELAY:
+        raise ValueError(f"unknown injected action {action!r}")
+    time.sleep(seconds)
+    return payload

@@ -1,0 +1,60 @@
+"""Dispatch watchdog: deadlines for the device round trip.
+
+Round 5 lost its whole measurement window to a dead accelerator tunnel
+that surfaced as an indefinitely blocked ``device_get``. The watchdog
+bounds that wait: every dispatched cycle carries a deadline derived
+from what a device cycle ACTUALLY costs here — the adaptive router's
+regime-keyed rate samples (median observed device cycle seconds for the
+predicted regime), falling back to the solver's measured sync floor —
+times a configurable safety factor. A collect that misses its deadline
+raises ``DispatchTimeout`` (a ``DeviceFault``): the scheduler abandons
+the in-flight result, invalidates device-resident state (host mirrors
+are the truth; the device twin is a cache), requeues the heads, and
+records the fault with the circuit breaker.
+
+The floor ``min_deadline_s`` keeps an optimistic estimate (a warm
+sub-millisecond local backend) from turning scheduler GC pauses into
+false timeouts; estimates are cycle-scale (~100 ms over a TPU tunnel),
+so the default factor gives seconds of headroom while still catching a
+wedged tunnel ~3 orders of magnitude before a human would.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_tpu.resilience.faultinject import DeviceFault
+
+
+class DispatchTimeout(DeviceFault):
+    """The in-flight collect missed its deadline; the result was
+    abandoned (the fetch thread is orphaned — Python cannot cancel a
+    blocked device call, only stop waiting for it)."""
+
+    def __init__(self, deadline_s: float, waited_s: float):
+        super().__init__(
+            f"dispatch collect exceeded its {deadline_s * 1e3:.0f}ms "
+            f"deadline (waited {waited_s * 1e3:.0f}ms)")
+        self.deadline_s = deadline_s
+        self.waited_s = waited_s
+
+
+class DispatchWatchdog:
+    def __init__(self, safety_factor: float = 20.0,
+                 min_deadline_s: float = 1.0,
+                 max_deadline_s: float = 30.0):
+        if safety_factor <= 0 or min_deadline_s <= 0:
+            raise ValueError("watchdog factor and floor must be positive")
+        self.safety_factor = safety_factor
+        self.min_deadline_s = min_deadline_s
+        self.max_deadline_s = max_deadline_s
+
+    def deadline_s(self, estimate_s: Optional[float]) -> float:
+        """Deadline for one dispatch+collect, given the best available
+        estimate of a healthy device cycle's wall seconds (None when no
+        sample exists yet — first cycles get the max: a cold cycle may
+        legitimately carry a multi-second remote compile)."""
+        if estimate_s is None or estimate_s <= 0:
+            return self.max_deadline_s
+        d = estimate_s * self.safety_factor
+        return min(max(d, self.min_deadline_s), self.max_deadline_s)

@@ -32,6 +32,9 @@ from kueue_tpu.core import priority as prioritypkg
 from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.core.resources import container_limits_violations
 from kueue_tpu.queue import Manager, RequeueReason
+from kueue_tpu.resilience.breaker import CLOSED, CircuitBreaker
+from kueue_tpu.resilience.faultinject import DeviceFault
+from kueue_tpu.resilience.watchdog import DispatchTimeout, DispatchWatchdog
 from kueue_tpu.scheduler import flavorassigner as fa
 from kueue_tpu.scheduler.podset_reducer import PodSetReducer
 from kueue_tpu.scheduler.preemption import Preemptor, Target, make_reclaim_oracle
@@ -165,6 +168,22 @@ class Scheduler:
         from kueue_tpu.config import DEFAULT_STRICT_AFTER_BLOCKED_CYCLES
         self.strict_after_blocked_cycles = DEFAULT_STRICT_AFTER_BLOCKED_CYCLES
         self._blocked_preempt_streak = 0
+        self._preemptless_cycles = 0  # consecutive cycles w/o preempt mode
+        # Device-fault containment (kueue_tpu/resilience): the watchdog
+        # derives a deadline for every device round trip from the
+        # router's regime-keyed rate estimates (falling back to the
+        # measured sync floor); the breaker, fed by watchdog timeouts
+        # and dispatch/collect exceptions, pins cycles to the CPU
+        # fallback ("cpu-breaker" — excluded from router samples like
+        # "cpu-strict") after N consecutive faults and re-admits the
+        # device path through half-open probes with backed-off jitter.
+        self.breaker = CircuitBreaker()
+        self.watchdog: Optional[DispatchWatchdog] = DispatchWatchdog()
+        self.solver_faults = 0          # device faults observed (total)
+        self._cycle_faults = 0          # device faults within this cycle
+        # Optional observer hook (the manager wires it to the sim event
+        # recorder): on_fault(kind, message) for fault/trip/recovery.
+        self.on_fault: Optional[Callable[[str, str], None]] = None
         self._drain_cost = 0.0  # pipeline-drain seconds within this cycle
         self._cycle_evictions = 0  # evictions issued within this cycle
         # Below this head count the accelerator dispatch overhead exceeds
@@ -243,6 +262,9 @@ class Scheduler:
         wall0 = _time.perf_counter()
         self._drain_cost = 0.0
         self._cycle_evictions = 0
+        self._cycle_faults = 0
+        collects0 = getattr(self.solver, "counters", {}).get("collects", 0) \
+            if self.solver is not None else 0
         route = self._route_mode(heads)
         if (route == "device" and self.strict_after_blocked_cycles
                 and self._blocked_preempt_streak
@@ -254,6 +276,18 @@ class Scheduler:
             # engaged until the blocked preemptor admits, becomes
             # infeasible, or goes away.
             route = "cpu-strict"
+        if route == "device" \
+                and not self.breaker.allow_device(self.clock.now()):
+            # Breaker open: pin the cycle to the CPU fallback under a
+            # distinct route name — a containment intervention, not an
+            # economics signal, so (like cpu-strict) it never lands in
+            # the router's samples. The CPU sequential path carries full
+            # reference semantics, so correctness is unaffected.
+            # Consulted only AFTER the strict gate: allow_device()
+            # consumes the half-open probe, and a probe admitted on a
+            # cycle another gate then routes off-device would leave the
+            # breaker wedged in HALF_OPEN with no outcome ever recorded.
+            route = "cpu-breaker"
         # Cooldown elapses per schedule() call, not per device-routed
         # call — a CPU-routed stretch must not freeze it.
         cooling = self._pipeline_cooldown > 0
@@ -272,6 +306,7 @@ class Scheduler:
                 self._route_record("device", progress,
                                    _time.perf_counter() - wall0
                                    - self._drain_cost)
+                self._note_device_cycle(collects0)
                 return signal
             # Pipeline not applicable this cycle: continue on the
             # synchronous path with a FRESH full snapshot. The pipelined
@@ -386,12 +421,16 @@ class Scheduler:
         # either as blocked let healthy preemption churn ratchet the
         # streak to the bound and pin device-routed cycles to cpu-strict
         # (ADVICE r5 medium). This mirrors _collect_pipelined_preempt,
-        # which sets blocked_any only for target-less entries. A cycle
-        # with NO preempt-mode entry leaves the streak alone — a blocked
-        # preemptor parks inadmissible between capacity releases, and
-        # arrival-only cycles in between must not reset the evidence of
-        # its starvation. While the bound is engaged, a preempt-less
-        # strict cycle bleeds the streak off instead, so a vanished
+        # which sets blocked_any only for target-less entries. Cycles
+        # with NO preempt-mode entry at all: a blocked preemptor parks
+        # inadmissible between capacity releases, so a SHORT arrival-
+        # only stretch (up to the bound) keeps the starvation evidence
+        # intact — but past that grace the evidence decays one cycle at
+        # a time (never a wholesale reset), so it cannot carry over to
+        # an UNRELATED later preemptor after the original one vanished
+        # (ADVICE r5 follow-up), while a parked preemptor that re-heaps
+        # within the grace still accumulates toward the bound. While
+        # the bound is ENGAGED the decay is immediate, so a vanished
         # preemptor releases strict mode within ~K cycles.
         blocked = any(
             e.status != ASSUMED
@@ -400,12 +439,19 @@ class Scheduler:
             for e in entries)
         if blocked:
             self._blocked_preempt_streak += 1
+            self._preemptless_cycles = 0
         elif regime == "preempt":
             self._blocked_preempt_streak = 0  # preemptors made progress
-        elif self._blocked_preempt_streak \
-                >= self.strict_after_blocked_cycles > 0:
-            self._blocked_preempt_streak -= 1
+            self._preemptless_cycles = 0
+        elif self._blocked_preempt_streak > 0:
+            self._preemptless_cycles += 1
+            bound = self.strict_after_blocked_cycles
+            engaged = bound and self._blocked_preempt_streak >= bound
+            if engaged or self._preemptless_cycles > max(bound, 1):
+                self._blocked_preempt_streak -= 1
         self.cycle_counts[route] = self.cycle_counts.get(route, 0) + 1
+        if route == "device":
+            self._note_device_cycle(collects0)
         # The cycle is done with its snapshot: the incremental maintainer
         # may recycle un-materialized shells into the next handout.
         self.cache.release_snapshot(snapshot)
@@ -499,6 +545,91 @@ class Scheduler:
         if inval is not None:
             inval()
 
+    # --- device-fault containment (kueue_tpu/resilience) ---
+
+    def _solver_fault(self, where: str, exc: BaseException) -> None:
+        """A device fault (dispatch/collect exception, watchdog timeout,
+        detected corruption): count it, feed the breaker, and drop the
+        device-resident state — the host mirrors are the truth and the
+        device twin is a rebuildable cache, so invalidation is always
+        safe and makes the next device cycle re-establish from a fresh
+        full snapshot."""
+        self.solver_faults += 1
+        self._cycle_faults += 1
+        tripped = self.breaker.record_fault(self.clock.now())
+        if self.metrics is not None:
+            self.metrics.device_fault(
+                where, timeout=isinstance(exc, DispatchTimeout),
+                tripped=tripped)
+        self.log.v(2, "solver.fault", where=where, error=repr(exc)[:200],
+                   breaker=self.breaker.state,
+                   consecutive=self.breaker.consecutive_faults)
+        if self.on_fault is not None:
+            self.on_fault("fault", f"{where}: {exc}")
+            if tripped:
+                self.on_fault("breaker-open",
+                              f"device route suspended after {where}: {exc}")
+        self._solver_invalidate()
+
+    def _prepare_failed(self, exc: BaseException) -> None:
+        """prepare()/encode failures are host-side unless a fault site
+        or device error surfaced through them (journal-replay injection,
+        a dead backend raising mid-encode): only DeviceFaults feed the
+        breaker — a host encode bug tripping the breaker would mask
+        itself behind the CPU fallback."""
+        if isinstance(exc, DeviceFault):
+            self._solver_fault("prepare", exc)
+        else:
+            self._solver_invalidate()
+
+    def _note_device_cycle(self, collects_before: int) -> None:
+        """A device-routed cycle ended. A completed collect with no
+        fault recorded is a breaker success (closes a half-open probe);
+        a cycle that never round-tripped (work gates sent everything to
+        the CPU preemptor, dispatch-only pipeline fill) proves nothing —
+        a pending probe is re-armed instead of being consumed."""
+        if self._cycle_faults:
+            return
+        c = getattr(self.solver, "counters", None)
+        if c is not None and c.get("collects", 0) <= collects_before:
+            self.breaker.probe_inconclusive(self.clock.now())
+            return
+        if self.breaker.record_success(self.clock.now()):
+            if self.metrics is not None:
+                self.metrics.fault_recovered(
+                    self.breaker.last_recovery_cycles)
+            self.log.v(2, "solver.breakerClosed",
+                       recovery_cycles=self.breaker.last_recovery_cycles)
+            if self.on_fault is not None:
+                self.on_fault(
+                    "breaker-closed",
+                    f"device route restored after "
+                    f"{self.breaker.last_recovery_cycles} cycle(s)")
+
+    def _dispatch_deadline(self) -> Optional[float]:
+        """Watchdog deadline for this cycle's device round trip: the
+        median observed device cycle seconds for the predicted regime
+        (the router's rate samples), falling back to the solver's
+        measured sync floor, x the watchdog's safety factor. None when
+        the watchdog is disabled."""
+        if self.watchdog is None:
+            return None
+        est = None
+        samples = (self._route_stats.get(("device", self._last_regime))
+                   or self._route_stats.get(("device", "fit")))
+        if samples:
+            secs = sorted(t for _a, t in samples)
+            est = secs[len(secs) // 2]
+        else:
+            sync = getattr(self.solver, "_sync_samples", None)
+            if sync:
+                # Recent-window MAX, not the sync floor: the floor is a
+                # best-case MIN by construction, and a deadline keyed on
+                # it would turn a legitimately heavy (but healthy) cycle
+                # into a spurious timeout.
+                est = max(sync) / 1e3  # samples are milliseconds
+        return self.watchdog.deadline_s(est)
+
     def _solver_note_unapplied(self, key: str) -> None:
         note = getattr(self.solver, "note_unapplied", None)
         if note is not None:
@@ -513,7 +644,11 @@ class Scheduler:
 
     def _pipeline_ok(self, heads: list) -> bool:
         s = self.solver
+        # Breaker not CLOSED => the cycle is a half-open probe: it must
+        # run synchronously so its outcome is known by cycle end (a
+        # pipelined dispatch wouldn't resolve until the NEXT cycle).
         return (s is not None and self.pipeline_enabled
+                and self.breaker.state == CLOSED
                 and getattr(s, "resident_capable", False)
                 and not self.cache.pods_ready_tracking
                 and len(heads) >= self.solver_min_heads
@@ -544,8 +679,8 @@ class Scheduler:
             return None  # sync path handles the (all-invalid) heads
         try:
             plan = solver.prepare(snapshot, valid_heads)
-        except Exception:  # noqa: BLE001 — encode failure: sync fallback
-            self._solver_invalidate()
+        except Exception as exc:  # noqa: BLE001 — encode: sync fallback
+            self._prepare_failed(exc)
             plan = None
         prev = self._inflight
         if (plan is not None and plan.resident and prev is not None
@@ -638,10 +773,12 @@ class Scheduler:
         try:
             inflight = solver.dispatch(
                 plan, fair_sharing=self.fair_sharing_enabled,
-                preempt_batch=pbatch)
+                preempt_batch=pbatch, deadline_s=self._dispatch_deadline())
             solver.start_fetch(inflight)
-        except Exception:  # noqa: BLE001 — device failure: sync fallback
-            self._solver_invalidate()
+        except Exception as exc:  # noqa: BLE001 — device: sync fallback
+            self._solver_fault("dispatch", exc)
+            if pmeta is not None:
+                self.cache.release_snapshot(pmeta[2])
             self._drain_pipeline()
             return None
         for e in invalid_entries:
@@ -766,8 +903,14 @@ class Scheduler:
         valid_heads = inflight.plan.batch.infos
         try:
             decisions, aux = solver.collect(inflight, snapshot)
-        except Exception:  # noqa: BLE001 — fetch failure: retry the heads
-            self._solver_invalidate()
+        except Exception as exc:  # noqa: BLE001 — fetch: retry the heads
+            # Watchdog timeouts land here too: the in-flight result is
+            # abandoned (never decoded), residency is invalidated, the
+            # heads re-heap — the cycle completes instead of blocking
+            # on a wedged device_get.
+            self._solver_fault("collect", exc)
+            if pmeta is not None:
+                self.cache.release_snapshot(pmeta[2])
             for i, w in enumerate(valid_heads):
                 if i in nofit_idx:
                     continue  # already requeued at dispatch time
@@ -900,6 +1043,7 @@ class Scheduler:
         if pending:
             self._blocked_preempt_streak = (
                 self._blocked_preempt_streak + 1 if blocked_any else 0)
+            self._preemptless_cycles = 0
             self.cycle_counts["pipelined-preempt"] = \
                 self.cycle_counts.get("pipelined-preempt", 0) + 1
         # The deferred nomination snapshot's late mutations are done.
@@ -936,8 +1080,8 @@ class Scheduler:
 
         try:
             plan = self.solver.prepare(snapshot, valid_heads)
-        except Exception:  # noqa: BLE001 — encode failure: CPU fallback
-            self._solver_invalidate()
+        except Exception as exc:  # noqa: BLE001 — encode: CPU fallback
+            self._prepare_failed(exc)
             return invalid_entries, [], valid_heads
         if plan is None:
             return invalid_entries, [], valid_heads
@@ -1101,9 +1245,10 @@ class Scheduler:
                 plan, snapshot, preempt_batch=pbatch,
                 fair_sharing=self.fair_sharing_enabled,
                 fair_batch=fbatch,
-                fs_flags=strategy_flags(self.preemptor.fs_strategies))
-        except Exception:  # noqa: BLE001 — device failure: CPU fallback
-            self._solver_invalidate()
+                fs_flags=strategy_flags(self.preemptor.fs_strategies),
+                deadline_s=self._dispatch_deadline())
+        except Exception as exc:  # noqa: BLE001 — device: CPU fallback
+            self._solver_fault("solve", exc)
             if pending:
                 self.preemption_fallbacks += 1
                 self._cpu_preempt_targets(pending, snapshot)

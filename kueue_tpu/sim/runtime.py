@@ -43,6 +43,13 @@ class EventRecorder:
         key = f"{meta.namespace}/{meta.name}" if meta.namespace else meta.name
         self.events.append(Event(key, type(obj).__name__, etype, reason, message))
 
+    def system_event(self, etype: str, reason: str, message: str) -> None:
+        """An event about the control plane itself rather than a stored
+        object (device faults, breaker trips/recoveries): no object key,
+        kind "Scheduler" — chaos tooling and operators read the outage
+        timeline from these."""
+        self.events.append(Event("", "Scheduler", etype, reason, message))
+
     def by_reason(self, reason: str) -> list[Event]:
         return [e for e in self.events if e.reason == reason]
 

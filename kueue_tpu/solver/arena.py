@@ -37,6 +37,7 @@ import numpy as np
 
 from kueue_tpu.api.corev1 import RESOURCE_PODS
 from kueue_tpu.core import priority as prioritypkg
+from kueue_tpu.resilience import faultinject
 from kueue_tpu.solver import encode
 
 # The host/device twin field list — the arena ABI, owned here and
@@ -52,6 +53,19 @@ ARENA_FIELDS = ("requests", "podset_active", "wl_cq", "priority",
 # re-uploads the twin wholesale (one fixed shape, cheaper than minting
 # per-size compiles).
 _UPD_BUCKETS = (8, 512)
+
+
+def _scramble_rows(upd_rows: dict) -> dict:
+    """The scatter site's CORRUPT action: requests inflated past any
+    real quota. Conservative by construction — a corrupted row can only
+    fail Phase A on device (deny), and denied heads fall through to the
+    CPU nomination oracle, so the admitted set stays correct while the
+    twin is poisoned; recovery is the wholesale re-upload after the
+    next recorded fault or residency reset (see RESILIENCE.md)."""
+    out = dict(upd_rows)
+    out["requests"] = np.full_like(upd_rows["requests"], 1 << 40)
+    return out
+
 
 class WorkloadArena:
     def __init__(self, max_podsets: int = 4):
@@ -401,5 +415,15 @@ class WorkloadArena:
             upd_rows[name] = arr
             nbytes += arr.nbytes
         self.row_uploads += len(rows)
+        # Injection site: a raise is a failed upload (the dispatch
+        # error path owns it); CORRUPT mangles the rows in transit.
+        # The corruptor inflates requests past any quota — mangled rows
+        # can only DENY on device, never admit, so a corruption that
+        # evades detection degrades those rows to the CPU fallback path
+        # instead of poisoning decisions; any recorded fault drops the
+        # twin wholesale (drop_device) and the next dispatch re-uploads
+        # from the host arrays, which faults never touch.
+        upd_rows = faultinject.site(faultinject.SITE_SCATTER, upd_rows,
+                                    corrupt=_scramble_rows)
         self.dev = scatter_arena_rows(self.dev, upd_slots, upd_rows)
         return self.dev, nbytes

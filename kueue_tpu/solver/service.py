@@ -34,8 +34,12 @@ from kueue_tpu import features
 from kueue_tpu.cache.snapshot import Snapshot
 from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.core.resources import FlavorResource
+from kueue_tpu.resilience import faultinject
+from kueue_tpu.resilience.faultinject import DeviceFault
+from kueue_tpu.resilience.watchdog import DispatchTimeout
 from kueue_tpu.scheduler import flavorassigner as fa
 from kueue_tpu.solver import encode
+from kueue_tpu.utils import vlog
 import jax
 
 from kueue_tpu.solver.arena import WorkloadArena
@@ -60,6 +64,48 @@ def _topo_np(topo) -> dict:
 # batched_partial_admission marker: this entry's probes weren't
 # encodable — run the sequential CPU reducer for it instead
 CPU_FALLBACK = object()
+
+
+def _scramble_fetched(fetched: dict) -> dict:
+    """The collect site's CORRUPT action: garbage decision arrays, as a
+    bit-flipped fetch would produce. Deliberately invariant-violating
+    (admitted rows without the fit bit) — the containment contract is
+    that detectable garbage is caught by _validate_fetched; see
+    RESILIENCE.md for why undetectable corruption is out of the fault
+    model."""
+    out = dict(fetched)
+    out["admitted"] = np.ones_like(np.asarray(fetched["admitted"]))
+    out["fit"] = np.zeros_like(np.asarray(fetched["fit"]))
+    return out
+
+
+# The specific phrasings jax uses for a MISSING backend/platform (the
+# legitimate probe-failure shapes). Deliberately narrow: a generic
+# "device"/"backend" substring would also match genuine runtime device
+# failures ("failed to sync device stream"), re-swallowing exactly the
+# faults the narrowed probes exist to surface.
+_EXPECTED_BACKEND_MSGS = (
+    "unknown backend",                  # jax.devices("nope")
+    "backend 'cpu' failed to initialize",
+    "unable to initialize backend",
+    "no visible",                       # "no visible TPU devices"
+    "not found in the list of known platforms",
+)
+
+
+def _expected_backend_error(exc: BaseException) -> bool:
+    """Backend probes (local XLA-CPU router, calibration dispatch)
+    legitimately fail on platforms without that backend — jax surfaces
+    those as ImportError or a RuntimeError with a known missing-backend
+    message. Anything else is a real fault that must not be silently
+    swallowed (ISSUE 3 satellite: the blanket ``except Exception``
+    probes hid genuine device failures)."""
+    if isinstance(exc, ImportError):
+        return True
+    if isinstance(exc, RuntimeError):
+        msg = str(exc).lower()
+        return any(w in msg for w in _EXPECTED_BACKEND_MSGS)
+    return False
 
 
 class Plan:
@@ -95,6 +141,7 @@ class InFlight:
         self.fair_batch = None
         self.future = None            # background fetch, when started
         self.t_dispatch = None
+        self.deadline_s = None        # watchdog bound on the round trip
 
 
 class ResidentState:
@@ -162,7 +209,10 @@ class BatchSolver:
                         "fetch": 0.0, "decode": 0.0}
         self.counters = {"prepares": 0, "dispatches": 0, "collects": 0,
                          "resident_cycles": 0, "establishes": 0,
-                         "upload_bytes": 0, "fetch_bytes": 0}
+                         "upload_bytes": 0, "fetch_bytes": 0,
+                         "dispatch_timeouts": 0, "backend_probe_faults": 0,
+                         "validation_faults": 0}
+        self.log = vlog.logger("solver")
 
     def bind_cache(self, cache) -> None:
         """Attach the scheduler's Cache: enables the usage journal that
@@ -203,9 +253,24 @@ class BatchSolver:
         if not self._sync_samples:
             try:
                 self._sync_samples.append(self._calibrate_floor())
-            except Exception:  # noqa: BLE001 — backend unavailable
+            except Exception as exc:  # noqa: BLE001 — classified below
+                self._note_backend_error("calibrate_floor", exc)
                 return default
         return min(self._sync_samples)
+
+    def _note_backend_error(self, where: str, exc: BaseException) -> None:
+        """Classify a backend-probe failure: a missing backend is an
+        expected environment shape (V4 note only), anything else is a
+        real fault — counted and surfaced instead of silently swallowed
+        (the probe still falls back; the scheduler hot path must not
+        crash on it)."""
+        if _expected_backend_error(exc):
+            self.log.v(4, "solver.backendUnavailable", where=where,
+                       error=repr(exc))
+            return
+        self.counters["backend_probe_faults"] += 1
+        self.log.error("solver.backendProbeFault", where=where,
+                       error=repr(exc))
 
     @staticmethod
     def _calibrate_floor() -> float:
@@ -264,7 +329,11 @@ class BatchSolver:
         from kueue_tpu.solver.encode import _bucket
         topo, topo_dev = self._topology(snapshot)
         Q, F, R = topo.nominal.shape
-        C = len(topo.cohort_names)
+        # The BUCKETED cohort dim (what encode_state allocates) — the
+        # raw cohort count warmed wrong-shape programs that a real
+        # cycle never hit, a silent miss until the narrowed backend
+        # probes surfaced the shape error (ISSUE 3 satellite).
+        C = topo.cohort_subtree.shape[0]
         usage = jnp.zeros((Q, F, R), jnp.int64)
         cohort_usage = jnp.zeros((max(C, 1), F, R), jnp.int64)
         warmed = 0
@@ -307,8 +376,8 @@ class BatchSolver:
                 self._route(topo, state, b, None)
                 self._route(topo, state, b, start_rank)  # resume variant
                 warmed += 2
-            except Exception:  # noqa: BLE001 — no local CPU backend
-                pass
+            except Exception as exc:  # noqa: BLE001 — classified below
+                self._note_backend_error("warm_route", exc)
             for max_rank in max_ranks:
                 for sr in (None, start_rank):
                     out = solve_cycle_fused(
@@ -499,6 +568,12 @@ class BatchSolver:
         becomes a sparse correction applied to the mirror now and shipped
         to the device at the next dispatch. False = residency must be
         dropped (journal overflow)."""
+        # Injection site: a replay fault propagates out of prepare();
+        # the scheduler drops residency and the cycle re-establishes
+        # from a fresh full snapshot (host truth) — by construction no
+        # partial replay can linger in the mirror, because the mirror is
+        # only mutated after the whole drain below succeeds.
+        faultinject.site(faultinject.SITE_REPLAY)
         rs = self._resident
         entries, overflow = self._cache.drain_usage_journal(
             snapshot.journal_seq)
@@ -588,7 +663,8 @@ class BatchSolver:
         if self._cpu_device is None:
             try:
                 self._cpu_device = jax.devices("cpu")[0]
-            except Exception:  # noqa: BLE001 — platform without CPU backend
+            except Exception as exc:  # noqa: BLE001 — classified below
+                self._note_backend_error("route_cpu_device", exc)
                 self._cpu_device = False
         if self._cpu_device is False:
             return None
@@ -608,12 +684,14 @@ class BatchSolver:
 
     def solve_prepared(self, plan: Plan, snapshot: Snapshot,
                        preempt_batch=None, fair_sharing: bool = False,
-                       fair_batch=None, fs_flags: tuple = ()):
+                       fair_batch=None, fs_flags: tuple = (),
+                       deadline_s: Optional[float] = None):
         """Dispatch the cycle (fit solve, plus the preemption batches when
         present, as ONE device program), sync once, decode. Returns
         (decisions dict, aux) where aux is None or
         {"preempt": (targets, feasible), "fair": (targets, feasible,
-        reasons)}."""
+        reasons)}. deadline_s bounds the device round trip (watchdog):
+        a collect past it raises DispatchTimeout instead of blocking."""
         topo, topo_dev, state, batch = (plan.topo, plan.topo_dev,
                                         plan.state, plan.batch)
         start_rank = plan.start_rank
@@ -664,12 +742,14 @@ class BatchSolver:
 
         inflight = self.dispatch(plan, preempt_batch=preempt_batch,
                                  fair_sharing=fair_sharing,
-                                 fair_batch=fair_batch, fs_flags=fs_flags)
+                                 fair_batch=fair_batch, fs_flags=fs_flags,
+                                 deadline_s=deadline_s)
         return self.collect(inflight, snapshot)
 
     def dispatch(self, plan: Plan, preempt_batch=None,
                  fair_sharing: bool = False, fair_batch=None,
-                 fs_flags: tuple = ()) -> InFlight:
+                 fs_flags: tuple = (),
+                 deadline_s: Optional[float] = None) -> InFlight:
         """Dispatch the single-chip cycle WITHOUT fetching. The returned
         InFlight's outputs are device references; collect() (or a
         background fetch via start_fetch()) brings the decisions home.
@@ -678,6 +758,9 @@ class BatchSolver:
         sparse corrections only."""
         import time
         t0 = time.perf_counter()
+        # Injection site: a raise here is exactly a dead-tunnel dispatch
+        # error — the scheduler's device-failure handler owns it.
+        faultinject.site(faultinject.SITE_DISPATCH)
         topo, topo_dev, state, batch = (plan.topo, plan.topo_dev,
                                         plan.state, plan.batch)
         start_rank = plan.start_rank
@@ -791,6 +874,7 @@ class BatchSolver:
             self.counters["establishes"] += 1
         inflight = InFlight(plan, result, keys, preempt_batch)
         inflight.fair_batch = fair_batch
+        inflight.deadline_s = deadline_s
         inflight.t_dispatch = time.perf_counter()
         self.phase_s["dispatch"] += inflight.t_dispatch - t0
         return inflight
@@ -798,29 +882,116 @@ class BatchSolver:
     def start_fetch(self, inflight: InFlight) -> None:
         """Begin fetching the cycle's outputs on a background thread so
         the tunnel round trip overlaps host work (pipelined dispatch)."""
+        d = {k: inflight.result[k] for k in inflight.keys
+             if k in inflight.result}
+        inflight.future = self._fetch_pool_submit(jax.device_get, d)
+
+    def _validate_fetched(self, plan: Plan, fetched: dict) -> None:
+        """Cheap output-invariant check on the fetched decision arrays
+        (a few [W]-bool ops): a solve whose results violate them is
+        corrupt — raise DeviceFault so the scheduler invalidates the
+        (possibly poisoned) device-resident state and the heads retry
+        on fresh state instead of turning garbage into admissions.
+        Corruption that only DENIES (fit bits flipped off) is safe
+        without detection: denied entries fall through to the CPU
+        nomination path, which is the conformance oracle. See
+        RESILIENCE.md §corruption containment."""
+        n = plan.batch.n
+        fit = fetched.get("fit")
+        admitted = fetched.get("admitted")
+        if fit is None or admitted is None \
+                or np.asarray(fit).shape[0] < n \
+                or np.asarray(admitted).shape[0] < n:
+            self.counters["validation_faults"] += 1
+            raise DeviceFault("solve output missing/short decision arrays")
+        fit = np.asarray(fit)[:n].astype(bool)
+        admitted = np.asarray(admitted)[:n].astype(bool)
+        if bool(np.any(admitted & ~fit)):
+            self.counters["validation_faults"] += 1
+            raise DeviceFault("solve output corrupt: admitted without fit")
+        if bool(np.any(fit & ~plan.batch.solvable[:n])):
+            self.counters["validation_faults"] += 1
+            raise DeviceFault("solve output corrupt: fit on unsolvable row")
+
+    def _fetch_pool_submit(self, fn, *args):
         if self._fetch_pool is None:
             from concurrent.futures import ThreadPoolExecutor
             self._fetch_pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="solver-fetch")
-        d = {k: inflight.result[k] for k in inflight.keys
-             if k in inflight.result}
-        inflight.future = self._fetch_pool.submit(jax.device_get, d)
+        return self._fetch_pool.submit(fn, *args)
+
+    def _abandon_fetch(self) -> None:
+        """A fetch missed its deadline: orphan the worker (Python cannot
+        cancel a blocked device call — only stop waiting for it) and
+        mint a fresh pool so the next fetch isn't queued behind the
+        wedged one."""
+        pool, self._fetch_pool = self._fetch_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     def collect(self, inflight: InFlight, snapshot: Snapshot):
         """Fetch (or join the background fetch), decode, and update the
-        residency bookkeeping. Returns (decisions, preemption or None)."""
+        residency bookkeeping. Returns (decisions, preemption or None).
+
+        With a deadline (inflight.deadline_s, stamped at dispatch), the
+        fetch is BOUNDED: it runs on the background pool and a result
+        that hasn't landed deadline seconds after dispatch raises
+        DispatchTimeout — the in-flight device arrays are abandoned and
+        the caller invalidates residency (host mirrors are the truth;
+        the device twin is a rebuildable cache), instead of the cycle
+        blocking forever on a wedged tunnel."""
         import time
+        from concurrent.futures import TimeoutError as FetchTimeout
         plan = inflight.plan
         t0 = time.perf_counter()
-        if inflight.future is not None:
-            # Background fetch: the wait here is NOT the sync floor (the
-            # round trip overlapped host work) — don't feed the gates.
-            fetched = inflight.future.result()
+        deadline = inflight.deadline_s
+        future, sync_fetch = inflight.future, inflight.future is None
+        if sync_fetch and deadline is not None:
+            # Synchronous cycle under a deadline: route the device_get
+            # through the pool so the wait is interruptible.
+            d = {k: inflight.result[k] for k in inflight.keys
+                 if k in inflight.result}
+            future = self._fetch_pool_submit(jax.device_get, d)
+        if future is not None:
+            if deadline is not None:
+                remaining = deadline - (time.perf_counter()
+                                        - inflight.t_dispatch)
+                try:
+                    fetched = future.result(timeout=max(0.0, remaining))
+                except FetchTimeout:
+                    self._abandon_fetch()
+                    self.counters["dispatch_timeouts"] += 1
+                    waited = time.perf_counter() - inflight.t_dispatch
+                    raise DispatchTimeout(deadline, waited) from None
+            else:
+                fetched = future.result()
         else:
             fetched = jax.device_get({k: inflight.result[k]
                                       for k in inflight.keys
                                       if k in inflight.result})
+        if sync_fetch:
+            # The wait IS the sync floor only on a synchronous cycle (a
+            # background fetch's round trip overlapped host work).
             self._observe_sync((time.perf_counter() - t0) * 1e3)
+        # Injection site: CORRUPT scrambles the fetched decision arrays
+        # (caught by the invariant validation below), DELAY models a
+        # fetch that landed only after the deadline.
+        fetched = faultinject.site(faultinject.SITE_COLLECT, fetched,
+                                   corrupt=_scramble_fetched)
+        if deadline is not None:
+            # Bounds collect-INTERNAL time only (the fetch wait + any
+            # injected delay). Deliberately not time-since-dispatch: in
+            # a pipelined cycle, legitimate host work between dispatch
+            # and collect must not turn a completed fetch into a
+            # spurious timeout — the genuine-hang bound is the
+            # result(timeout=remaining) above, whose budget does count
+            # from dispatch because the background fetch ran that
+            # whole time.
+            waited = time.perf_counter() - t0
+            if waited > deadline:
+                self.counters["dispatch_timeouts"] += 1
+                raise DispatchTimeout(deadline, waited)
+        self._validate_fetched(plan, fetched)
         t_fetch = time.perf_counter()
         self.phase_s["fetch"] += t_fetch - t0
         self.counters["collects"] += 1

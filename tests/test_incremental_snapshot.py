@@ -431,6 +431,80 @@ class TestBackgroundAdvance:
         assert m.full_rebuilds == full_before  # incremental, not rebuild
 
 
+class TestJournalOverflowRaces:
+    """Multi-consumer journal overflow racing release_snapshot (ISSUE 3
+    satellite): an overflow landing while the maintainer advances in
+    the background must cost exactly ONE full rebuild, a laggard
+    consumer's overflow must not disturb the other consumer, released
+    shells must never be recycled across a rebuild, and double-release
+    of the same handout must be a guarded no-op."""
+
+    def test_overflow_during_catch_up_rebuilds_exactly_once(self):
+        cache = build_cache()
+        cache.enable_usage_journal()  # a second (solver) consumer
+        check(cache, "establish")
+        cache._journal_cap = 10
+        m = cache._maintainer
+        full_before = m.full_rebuilds
+        # A burst past the cap with NO snapshot in between: both
+        # consumers' cursors are overrun, flagged, and force-advanced —
+        # then more entries accumulate behind the advanced cursor so
+        # the light snapshot's backlog check fires catch_up while the
+        # overflow flag is still pending: the maintainer consumes ITS
+        # flag there (one rebuild, no handout).
+        wls = [admitted_workload(f"r{i}", f"cq{i % 6}", 1)
+               for i in range(18)]
+        for wl in wls:
+            cache.add_or_update_workload(wl)
+        cache.snapshot(light=True)
+        assert m.full_rebuilds == full_before + 1
+        # The next sync snapshot replays incrementally — the overflow
+        # was consumed exactly once, not re-observed.
+        cache.add_or_update_workload(admitted_workload("post", "cq1", 2))
+        check(cache, "post-overflow sync")
+        assert m.full_rebuilds == full_before + 1
+        # The laggard solver consumer sees ITS overflow exactly once,
+        # independently of the maintainer's.
+        _, overflow = cache.drain_usage_journal(cache._journal_seq,
+                                                consumer="solver")
+        assert overflow
+        _, overflow = cache.drain_usage_journal(cache._journal_seq,
+                                                consumer="solver")
+        assert not overflow
+
+    def test_released_shells_are_not_recycled_across_a_rebuild(self):
+        cache = build_cache()
+        s1 = cache.snapshot()
+        cache.release_snapshot(s1)
+        cache._journal_cap = 4
+        for i in range(10):  # overflow: the next sync must full-rebuild
+            cache.add_or_update_workload(
+                admitted_workload(f"x{i}", "cq0", 1))
+        full_before = cache._maintainer.full_rebuilds
+        s2 = check(cache, "post-overflow")
+        assert cache._maintainer.full_rebuilds == full_before + 1
+        # Every master was rebuilt: the released shells are stale and
+        # none may be recycled into the post-rebuild handout.
+        for name in s1.cluster_queues:
+            assert s2.cluster_queues[name] is not s1.cluster_queues[name], \
+                name
+
+    def test_double_release_same_handout_is_a_guarded_noop(self):
+        cache = build_cache()
+        cache.add_or_update_workload(admitted_workload("w1", "cq0", 2))
+        s1 = cache.snapshot()
+        cache.release_snapshot(s1)
+        cache.release_snapshot(s1)  # double release: guarded no-op
+        s2 = check(cache, "after double release")
+        # the recycled pool was consumed by s2; releasing s1 AGAIN (now
+        # stale by generation) must not resurrect its shells
+        cache.release_snapshot(s1)
+        s3 = check(cache, "after stale re-release")
+        for name in s3.cluster_queues:
+            assert s3.cluster_queues[name] is not s2.cluster_queues[name], \
+                name
+
+
 class TestIncrementalSmoke:
     def test_three_cycle_steady_state_takes_the_incremental_path(self):
         # a 3-cycle steady-state scheduler run: exactly one full build

@@ -1,0 +1,403 @@
+"""Device-fault containment (kueue_tpu/resilience): the breaker state
+machine, watchdog deadline derivation, the injection layer, and their
+scheduler integration — device faults fall back to the CPU oracle with
+identical decisions, N consecutive faults pin cycles to the distinct
+"cpu-breaker" route (excluded from router samples), and a backed-off
+half-open probe restores the device path. See RESILIENCE.md.
+"""
+
+import pytest
+
+from kueue_tpu.metrics import Registry
+from kueue_tpu.resilience import faultinject
+from kueue_tpu.resilience.breaker import (
+    CLOSED, HALF_OPEN, OPEN, CircuitBreaker)
+from kueue_tpu.resilience.faultinject import (
+    DeviceFault, FaultInjector, InjectedFault, SITE_COLLECT, SITE_DISPATCH,
+    SITE_REPLAY, SITE_SCATTER)
+from kueue_tpu.resilience.watchdog import DispatchTimeout, DispatchWatchdog
+from kueue_tpu.solver import BatchSolver
+from tests.test_solver import admitted_map, build_env
+from tests.wrappers import ClusterQueueWrapper, WorkloadWrapper, flavor_quotas
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faultinject.uninstall()
+
+
+class TestFaultInjector:
+    def test_disabled_is_identity(self):
+        assert faultinject.active() is None
+        payload = {"x": 1}
+        assert faultinject.site(SITE_DISPATCH) is None
+        assert faultinject.site(SITE_COLLECT, payload) is payload
+
+    def test_scripted_schedules_are_seed_deterministic(self):
+        a = FaultInjector.scripted(42, delay_s=0.01)
+        b = FaultInjector.scripted(42, delay_s=0.01)
+        c = FaultInjector.scripted(43, delay_s=0.01)
+        assert a.schedule == b.schedule
+        assert a.schedule != c.schedule
+
+    def test_actions_fire_per_hit_index(self):
+        inj = FaultInjector({SITE_DISPATCH: {1: faultinject.RAISE},
+                             SITE_COLLECT: {0: faultinject.CORRUPT}})
+        with faultinject.installed(inj):
+            faultinject.site(SITE_DISPATCH)  # hit 0: clean
+            with pytest.raises(InjectedFault) as exc:
+                faultinject.site(SITE_DISPATCH)  # hit 1: fires
+            assert exc.value.site == SITE_DISPATCH and exc.value.hit == 1
+            out = faultinject.site(SITE_COLLECT, {"v": 1},
+                                   corrupt=lambda p: {"v": -p["v"]})
+            assert out == {"v": -1}
+            # corrupt with no corruptor at the call site: pass-through
+            p = object()
+            assert faultinject.site(SITE_COLLECT, p) is p \
+                or inj.schedule[SITE_COLLECT].get(1) is None
+        assert inj.fired[SITE_DISPATCH] == 1
+        assert inj.total_fired >= 2
+        assert faultinject.active() is None  # context manager uninstalled
+
+    def test_unknown_site_rejected(self):
+        with pytest.raises(ValueError):
+            FaultInjector({"not_a_site": {0: faultinject.RAISE}})
+
+    def test_injected_fault_is_a_device_fault(self):
+        assert issubclass(InjectedFault, DeviceFault)
+        assert issubclass(DispatchTimeout, DeviceFault)
+
+
+class TestCircuitBreaker:
+    def test_trips_after_consecutive_faults_only(self):
+        b = CircuitBreaker(threshold=3, backoff_base_s=2.0)
+        assert b.allow_device(0)
+        b.record_fault(0)
+        b.record_fault(0)
+        b.record_success(0)  # success resets the consecutive count
+        b.record_fault(1)
+        b.record_fault(1)
+        assert b.state == CLOSED and b.trips == 0
+        assert b.record_fault(1) is True  # third consecutive: trips
+        assert b.state == OPEN and b.trips == 1
+
+    def test_backoff_gates_the_probe_then_success_closes(self):
+        b = CircuitBreaker(threshold=1, backoff_base_s=2.0, jitter=0.0)
+        b.record_fault(10.0)
+        assert b.state == OPEN
+        assert not b.allow_device(11.0)   # within backoff
+        assert b.allow_device(12.0)       # backoff elapsed: the probe
+        assert b.state == HALF_OPEN
+        assert not b.allow_device(12.0)   # one probe at a time
+        assert b.record_success(12.0) is True
+        assert b.state == CLOSED and b.recoveries == 1
+        # blocked cycle at t=11 + the probe itself
+        assert b.last_recovery_cycles == 3
+
+    def test_failed_probe_doubles_backoff_to_the_cap(self):
+        b = CircuitBreaker(threshold=1, backoff_base_s=1.0,
+                           backoff_max_s=3.0, jitter=0.0)
+        b.record_fault(0.0)
+        assert b.allow_device(1.0)
+        b.record_fault(1.0)               # failed probe: backoff 2s
+        assert not b.allow_device(2.5)
+        assert b.allow_device(3.0)
+        b.record_fault(3.0)               # failed probe: backoff 3s (cap)
+        assert not b.allow_device(5.5)
+        assert b.allow_device(6.0)
+        b.record_success(6.0)
+        assert b.state == CLOSED
+        # recovery resets the backoff to base
+        b.record_fault(7.0)
+        assert b.allow_device(8.0)
+
+    def test_jitter_is_seed_deterministic(self):
+        def retry_at(seed):
+            b = CircuitBreaker(threshold=1, backoff_base_s=1.0,
+                               jitter=0.5, seed=seed)
+            b.record_fault(0.0)
+            return b._retry_at
+        assert retry_at(7) == retry_at(7)
+        assert 1.0 <= retry_at(7) <= 1.5
+
+    def test_failed_probe_counts_as_a_trip(self):
+        # HALF_OPEN -> OPEN is a trip: self.trips must agree with the
+        # breaker_trips_total metric the scheduler increments on every
+        # tripped=True record_fault.
+        b = CircuitBreaker(threshold=1, backoff_base_s=1.0, jitter=0.0)
+        b.record_fault(0.0)
+        assert b.trips == 1
+        assert b.allow_device(1.5)
+        assert b.record_fault(1.5) is True  # failed probe
+        assert b.trips == 2
+
+    def test_inconclusive_probe_rearms(self):
+        b = CircuitBreaker(threshold=1, backoff_base_s=1.0, jitter=0.0)
+        b.record_fault(0.0)
+        assert b.allow_device(1.5)
+        b.probe_inconclusive(1.5)  # the cycle never touched the device
+        assert b.state == OPEN
+        assert b.allow_device(1.5)  # probe immediately re-armed
+        b.record_success(1.5)
+        assert b.state == CLOSED
+
+
+class TestWatchdog:
+    def test_deadline_derivation(self):
+        w = DispatchWatchdog(safety_factor=10.0, min_deadline_s=0.5,
+                             max_deadline_s=30.0)
+        assert w.deadline_s(None) == 30.0       # no estimate: cold max
+        assert w.deadline_s(0.001) == 0.5       # clamped to the floor
+        assert w.deadline_s(0.1) == 1.0
+        assert w.deadline_s(100.0) == 30.0      # clamped to the cap
+
+    def test_invalid_config_rejected(self):
+        with pytest.raises(ValueError):
+            DispatchWatchdog(safety_factor=0)
+
+
+def _fault_env(setup=None, threshold=3, min_heads=0):
+    """Solver-enabled Env with a tight, deterministic breaker."""
+    def default_setup(env):
+        env.add_flavor("default")
+        env.add_cq(ClusterQueueWrapper("cq")
+                   .resource_group(flavor_quotas("default", cpu="100"))
+                   .obj(), "lq")
+    env = build_env(setup or default_setup, solver=True)
+    env.scheduler.solver_min_heads = min_heads
+    env.scheduler.breaker = CircuitBreaker(threshold=threshold,
+                                           backoff_base_s=2.0, jitter=0.0)
+    env.scheduler.metrics = Registry()
+    return env
+
+
+class TestSchedulerFaultContainment:
+    def test_dispatch_fault_falls_back_to_cpu_same_decisions(self):
+        env = _fault_env()
+        env.submit(WorkloadWrapper("w").queue("lq")
+                   .pod_set(count=1, cpu="2").obj())
+        inj = faultinject.install(
+            FaultInjector({SITE_DISPATCH: {0: faultinject.RAISE}}))
+        env.cycle()
+        faultinject.uninstall()
+        # The CPU fallback admitted the head in the SAME cycle.
+        assert "default/w" in admitted_map(env)
+        assert inj.fired[SITE_DISPATCH] == 1
+        s = env.scheduler
+        assert s.solver_faults == 1
+        assert s.breaker.consecutive_faults == 1
+        assert s.breaker.state == CLOSED  # below threshold
+        assert s.metrics.device_faults_total.value(site="solve") == 1
+
+    def test_replay_fault_reestablishes_residency(self):
+        env = _fault_env()
+        env.submit(WorkloadWrapper("w0").queue("lq")
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()  # establishes residency
+        assert env.scheduler.solver._resident is not None
+        env.submit(WorkloadWrapper("w1").queue("lq")
+                   .pod_set(count=1, cpu="2").obj())
+        faultinject.install(
+            FaultInjector({SITE_REPLAY: {0: faultinject.RAISE}}))
+        env.cycle()  # replay fault -> prepare fails -> CPU fallback
+        faultinject.uninstall()
+        assert "default/w1" in admitted_map(env)
+        assert env.scheduler.solver_faults == 1
+        env.submit(WorkloadWrapper("w2").queue("lq")
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()  # residency re-establishes from a fresh snapshot
+        assert "default/w2" in admitted_map(env)
+        assert env.scheduler.solver._resident is not None
+
+    def test_corrupted_collect_is_detected_not_admitted(self):
+        env = _fault_env()
+        env.submit(WorkloadWrapper("w").queue("lq")
+                   .pod_set(count=1, cpu="2").obj())
+        faultinject.install(
+            FaultInjector({SITE_COLLECT: {0: faultinject.CORRUPT}}))
+        env.cycle()
+        faultinject.uninstall()
+        s = env.scheduler
+        assert s.solver.counters["validation_faults"] == 1
+        assert s.solver_faults == 1
+        # garbage decisions never became admissions; the head retries
+        # and admits on fresh state
+        env.cycle()
+        assert "default/w" in admitted_map(env)
+
+    def test_watchdog_timeout_abandons_the_collect(self):
+        env = _fault_env()
+        s = env.scheduler
+        s.watchdog = DispatchWatchdog(safety_factor=1.0,
+                                      min_deadline_s=0.05,
+                                      max_deadline_s=0.1)
+        env.submit(WorkloadWrapper("w").queue("lq")
+                   .pod_set(count=1, cpu="2").obj())
+        faultinject.install(FaultInjector(
+            {SITE_COLLECT: {0: (faultinject.DELAY, 0.3)}}))
+        env.cycle()  # the hang outlives the 0.1s deadline
+        faultinject.uninstall()
+        assert s.solver.counters["dispatch_timeouts"] == 1
+        assert s.solver_faults == 1
+        assert s.metrics.dispatch_timeouts_total.value() == 1
+        assert s.solver._resident is None  # residency invalidated
+        # the abandoned cycle's heads re-heap and admit on retry
+        env.cycle()
+        assert "default/w" in admitted_map(env)
+
+    def test_breaker_trips_routes_cpu_breaker_and_recovers(self):
+        env = _fault_env(threshold=2)
+        s = env.scheduler
+        faultinject.install(FaultInjector(
+            {SITE_DISPATCH: {0: faultinject.RAISE, 1: faultinject.RAISE}}))
+        for i in range(2):
+            env.submit(WorkloadWrapper(f"w{i}").queue("lq")
+                       .creation(float(i)).pod_set(count=1, cpu="2").obj())
+            env.cycle()
+            assert f"default/w{i}" in admitted_map(env)  # CPU fallback
+        assert s.breaker.state == OPEN and s.breaker.trips == 1
+        assert s.metrics.breaker_trips_total.value() == 1
+        # Open breaker: cycles pinned to the cpu-breaker route (clock
+        # has not advanced past the backoff).
+        env.submit(WorkloadWrapper("w2").queue("lq").creation(2.0)
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        assert "default/w2" in admitted_map(env)
+        assert s.cycle_counts.get("cpu-breaker") == 1
+        # cpu-breaker cycles are containment, not economics: no router
+        # sample may land under either engine for them
+        assert not s._route_stats
+        # Backoff elapses -> half-open probe on the device route (the
+        # injector's schedule is exhausted, so the probe succeeds).
+        env.clock.advance(10.0)
+        env.submit(WorkloadWrapper("w3").queue("lq").creation(3.0)
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        faultinject.uninstall()
+        assert "default/w3" in admitted_map(env)
+        assert s.breaker.state == CLOSED
+        assert s.breaker.recoveries == 1
+        assert s.metrics.fault_recovery_cycles.value() \
+            == s.breaker.last_recovery_cycles > 0
+
+    def test_failed_probe_reopens_with_longer_backoff(self):
+        env = _fault_env(threshold=1)
+        s = env.scheduler
+        faultinject.install(FaultInjector(
+            {SITE_DISPATCH: {0: faultinject.RAISE, 1: faultinject.RAISE}}))
+        env.submit(WorkloadWrapper("w0").queue("lq").creation(0.0)
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()  # fault 0: trips (threshold 1)
+        assert s.breaker.state == OPEN
+        env.clock.advance(3.0)  # past base backoff: probe admitted
+        env.submit(WorkloadWrapper("w1").queue("lq").creation(1.0)
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()  # probe faults too (hit 1): reopen, doubled backoff
+        faultinject.uninstall()
+        assert s.breaker.state == OPEN
+        assert "default/w1" in admitted_map(env)  # still admitted via CPU
+        env.clock.advance(3.0)  # 3 < doubled 4s backoff: still blocked
+        env.submit(WorkloadWrapper("w2").queue("lq").creation(2.0)
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        assert s.cycle_counts.get("cpu-breaker", 0) >= 1
+        assert s.breaker.state == OPEN
+        env.clock.advance(2.0)  # now past it: clean probe closes
+        env.submit(WorkloadWrapper("w3").queue("lq").creation(3.0)
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        assert s.breaker.state == CLOSED
+
+    def test_strict_bound_does_not_consume_the_probe(self):
+        # The starvation bound and the breaker can engage together
+        # (blocked preemptors accumulate during an outage). A cycle the
+        # strict gate routes off-device must NOT consume the half-open
+        # probe: allow_device() transitioning OPEN->HALF_OPEN with no
+        # device cycle to record an outcome would wedge the breaker in
+        # HALF_OPEN forever (every later allow_device returns False).
+        env = _fault_env(threshold=1)
+        s = env.scheduler
+        faultinject.install(
+            FaultInjector({SITE_DISPATCH: {0: faultinject.RAISE}}))
+        env.submit(WorkloadWrapper("w0").queue("lq").creation(0.0)
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()  # trips (threshold 1)
+        faultinject.uninstall()
+        assert s.breaker.state == OPEN
+        env.clock.advance(10.0)  # past the backoff: a probe is due
+        # starvation bound engaged: the strict gate claims the cycle
+        s.strict_after_blocked_cycles = 2
+        s._blocked_preempt_streak = 2
+        env.submit(WorkloadWrapper("w1").queue("lq").creation(1.0)
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        assert s.cycle_counts.get("cpu-strict") == 1
+        assert s.breaker.state == OPEN  # probe NOT consumed
+        # bound released: the probe runs on the device and recovers
+        s._blocked_preempt_streak = 0
+        env.submit(WorkloadWrapper("w2").queue("lq").creation(2.0)
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        assert s.breaker.state == CLOSED
+        assert s.breaker.recoveries == 1
+
+    def test_pipelined_collect_timeout_requeues_heads(self):
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(ClusterQueueWrapper("cq")
+                       .resource_group(flavor_quotas("default", cpu="100"))
+                       .obj(), "lq")
+        env = _fault_env(setup)
+        s = env.scheduler
+        s.pipeline_enabled = True
+        s.watchdog = DispatchWatchdog(safety_factor=1.0,
+                                      min_deadline_s=0.05,
+                                      max_deadline_s=0.1)
+        for i in range(3):
+            env.submit(WorkloadWrapper(f"w{i}").queue("lq")
+                       .creation(float(i)).pod_set(count=1, cpu="2").obj())
+        faultinject.install(FaultInjector(
+            {SITE_COLLECT: {0: (faultinject.DELAY, 0.3)}}))
+        for _ in range(8):  # dispatch, hung collect, recovery cycles
+            env.cycle()
+        faultinject.uninstall()
+        assert s.solver.counters["dispatch_timeouts"] >= 1
+        # no deadlock, nothing lost: every head admitted eventually
+        assert {f"default/w{i}" for i in range(3)} <= set(admitted_map(env))
+
+
+class TestBackendProbeNarrowing:
+    """ISSUE 3 satellite: the blanket except-Exception backend probes
+    must classify — expected backend-unavailable errors stay quiet,
+    anything else lands in the fault counter (and vlog) instead of
+    being silently swallowed."""
+
+    def test_expected_backend_error_stays_quiet(self, monkeypatch):
+        solver = BatchSolver()
+        import jax
+
+        def boom(*a, **k):
+            raise RuntimeError("Backend 'cpu' failed to initialize")
+        monkeypatch.setattr(jax, "devices", boom)
+        assert solver._route(None, None, None, None) is None
+        assert solver.counters["backend_probe_faults"] == 0
+
+    def test_unexpected_probe_error_is_counted(self, monkeypatch):
+        solver = BatchSolver()
+        import jax
+
+        def boom(*a, **k):
+            raise ValueError("boom")
+        monkeypatch.setattr(jax, "devices", boom)
+        assert solver._route(None, None, None, None) is None
+        assert solver.counters["backend_probe_faults"] == 1
+
+    def test_calibration_failure_returns_default_and_counts(self,
+                                                            monkeypatch):
+        solver = BatchSolver()
+        monkeypatch.setattr(
+            BatchSolver, "_calibrate_floor",
+            staticmethod(lambda: (_ for _ in ()).throw(ValueError("x"))))
+        assert solver.estimated_sync_ms(default=77.0) == 77.0
+        assert solver.counters["backend_probe_faults"] == 1

@@ -637,3 +637,94 @@ class TestStarvationPredicateChurn:
             env.cycle()
             env.queues.queue_inadmissible_workloads({"cq"})
             assert sched._blocked_preempt_streak == i + 1
+
+    def test_stale_streak_decays_on_preempt_less_cycles(self):
+        # ADVICE r5 follow-up: after the blocked preemptor VANISHES, the
+        # accumulated evidence decays one cycle at a time once the
+        # preempt-less stretch outlives the grace window (the bound) —
+        # never a wholesale reset, and never within the grace, so a
+        # parked preemptor that re-heaps on capacity releases keeps
+        # accumulating while the evidence can't carry over to an
+        # unrelated preemptor long after the original one vanished.
+        env = Env()
+        env.add_flavor("default")
+        env.add_cq(ClusterQueueWrapper("cq")
+                   .preemption(
+                       within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                   "lq")
+        # a second CQ with free capacity keeps preempt-less cycles busy
+        env.add_cq(ClusterQueueWrapper("side")
+                   .resource_group(flavor_quotas("default", cpu="100"))
+                   .obj(), "lq-side")
+        env.admit_existing(WorkloadWrapper("occupant").queue("lq")
+                           .priority(200).pod_set(count=1, cpu="10")
+                           .reserve("cq").obj())
+        pre = (WorkloadWrapper("preemptor").queue("lq").priority(100)
+               .creation(1.0).pod_set(count=1, cpu="10").obj())
+        env.submit(pre)
+        sched = env.scheduler
+        sched.strict_after_blocked_cycles = 4  # grace == 4 cycles
+        for _ in range(3):  # ratchet the evidence (stays sub-bound)
+            env.cycle()
+            env.queues.queue_inadmissible_workloads({"cq"})
+        assert sched._blocked_preempt_streak == 3
+        env.queues.delete_workload(pre)  # the preemptor vanishes
+        n = 0
+
+        def fit_cycle():
+            nonlocal n
+            env.submit(WorkloadWrapper(f"fit{n}").queue("lq-side")
+                       .creation(10.0 + n).pod_set(count=1, cpu="1").obj())
+            env.cycle()
+            n += 1
+
+        for _ in range(4):  # within the grace: evidence intact
+            fit_cycle()
+            assert sched._blocked_preempt_streak == 3
+        for want in (2, 1, 0):  # past the grace: decay, not reset
+            fit_cycle()
+            assert sched._blocked_preempt_streak == want
+        assert sched._blocked_preempt_streak == 0
+
+    def test_sparse_reattempts_still_reach_the_bound(self):
+        # The decay grace must not defeat the bound: a preemptor that
+        # re-attempts only every other cycle (capacity releases are
+        # sparse) still accumulates, because arrival-only gaps shorter
+        # than the grace leave the streak untouched.
+        env = Env()
+        env.add_flavor("default")
+        env.add_cq(ClusterQueueWrapper("cq")
+                   .preemption(
+                       within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                   .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                   "lq")
+        env.add_cq(ClusterQueueWrapper("side")
+                   .resource_group(flavor_quotas("default", cpu="100"))
+                   .obj(), "lq-side")
+        env.admit_existing(WorkloadWrapper("occupant").queue("lq")
+                           .priority(200).pod_set(count=1, cpu="10")
+                           .reserve("cq").obj())
+        env.submit(WorkloadWrapper("preemptor").queue("lq").priority(100)
+                   .creation(1.0).pod_set(count=1, cpu="10").obj())
+        sched = env.scheduler
+        sched.strict_after_blocked_cycles = 3
+        n = 0
+        for i in range(3):
+            # a capacity-release event re-heaps the parked preemptor
+            env.queues.queue_inadmissible_workloads({"cq"})
+            env.cycle()  # blocked attempt (then parks inadmissible again)
+            assert sched._blocked_preempt_streak == i + 1, i
+            if i == 2:
+                break  # bound reached; engaged-mode bleed takes over
+            # two arrival-only cycles between attempts (< grace of 3):
+            # sub-bound evidence must survive the gap untouched
+            for _ in range(2):
+                env.submit(WorkloadWrapper(f"fit{n}").queue("lq-side")
+                           .creation(10.0 + n).pod_set(count=1, cpu="1")
+                           .obj())
+                env.cycle()
+                n += 1
+            assert sched._blocked_preempt_streak == i + 1, i
+        assert sched._blocked_preempt_streak \
+            >= sched.strict_after_blocked_cycles

@@ -1,0 +1,174 @@
+"""Chaos driver: a seeded fault schedule end-to-end (ISSUE 3 tooling).
+
+Runs the FULL control plane (KueueManager: sim store, controllers,
+webhooks, scheduler + solver) twice over an identical arrival schedule
+— once clean, once with a seeded fault schedule installed at every
+resilience injection site (dispatch raise, collect hang/corruption,
+arena-scatter corruption, journal-replay faults) for the first
+`inject_cycles` admission cycles — then verifies the chaos run
+
+- never deadlocked (both runs settle within a bounded cycle count),
+- converged to the clean run's exact admitted workload set, and
+- surfaced its outage timeline as Scheduler system events.
+
+Prints one JSON line per run plus a final verdict line; exits non-zero
+on divergence. Deterministic for a given seed (FakeClock + seeded
+schedule + seeded breaker jitter).
+
+Usage: python tools/chaos_run.py [seed] [inject_cycles]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kueue_tpu import config as cfgpkg  # noqa: E402
+from kueue_tpu.api import kueue as api  # noqa: E402
+from kueue_tpu.api.corev1 import (  # noqa: E402
+    Container, PodSpec, PodTemplateSpec)
+from kueue_tpu.api.meta import FakeClock, LabelSelector, ObjectMeta  # noqa: E402
+from kueue_tpu.core import workload as wlpkg  # noqa: E402
+from kueue_tpu.manager import KueueManager  # noqa: E402
+from kueue_tpu.resilience import faultinject  # noqa: E402
+from kueue_tpu.resilience.faultinject import FaultInjector  # noqa: E402
+from kueue_tpu.solver import BatchSolver  # noqa: E402
+
+NUM_CQS = 6
+WAVES = 5
+MAX_CYCLES = 120
+
+
+def make_objects():
+    rf = api.ResourceFlavor(metadata=ObjectMeta(name="f0", uid="rf-f0"))
+    out = [rf]
+    for i in range(NUM_CQS):
+        cq = api.ClusterQueue(metadata=ObjectMeta(name=f"cq{i}",
+                                                  uid=f"cq-{i}"))
+        cq.spec.namespace_selector = LabelSelector()
+        cq.spec.cohort = f"cohort-{i % 2}"
+        cq.spec.resource_groups.append(api.ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[api.FlavorQuotas(name="f0", resources=[
+                api.ResourceQuota(name="cpu", nominal_quota=8000)])]))
+        lq = api.LocalQueue(metadata=ObjectMeta(
+            name=f"lq{i}", namespace="default", uid=f"lq-{i}"))
+        lq.spec.cluster_queue = f"cq{i}"
+        out += [cq, lq]
+    return out
+
+
+def make_workload(wave, i, n):
+    wl = api.Workload(metadata=ObjectMeta(
+        name=f"w{wave}-{i}", namespace="default", uid=f"wl-{wave}-{i}",
+        creation_timestamp=float(n)))
+    wl.spec.queue_name = f"lq{i}"
+    wl.spec.pod_sets.append(api.PodSet(
+        name="main", count=1, template=PodTemplateSpec(spec=PodSpec(
+            containers=[Container(name="c", requests={"cpu": 2000})]))))
+    return wl
+
+
+def admitted_keys(mgr):
+    return sorted(wlpkg.key(wl) for wl in mgr.store.list("Workload")
+                  if wlpkg.has_quota_reservation(wl))
+
+
+def run(seed: int, inject_cycles: int, chaotic: bool) -> dict:
+    cfg = cfgpkg.Configuration()
+    cfg.solver.enable = True
+    cfg.solver.min_heads = 0
+    cfg.solver.watchdog_safety_factor = 2.0
+    cfg.solver.watchdog_min_deadline_s = 0.1
+    # Cold cycles legitimately carry a jit compile: the no-estimate
+    # deadline must clear it, while warm deadlines (estimate x factor)
+    # drop to ~0.1s so the injected 0.2s hangs reliably trip.
+    cfg.solver.watchdog_max_deadline_s = 2.0
+    cfg.solver.breaker_fault_threshold = 2
+    cfg.solver.breaker_backoff_base_s = 2.0
+    cfg.solver.breaker_backoff_max_s = 8.0
+    clock = FakeClock(1000.0)
+    mgr = KueueManager(cfg=cfg, clock=clock, solver=BatchSolver())
+    mgr.scheduler.breaker._rng.seed(seed)  # deterministic jitter
+    for obj in make_objects():
+        mgr.store.create(obj)
+    mgr.run_until_idle(max_iterations=1_000_000)
+
+    injector = (FaultInjector.scripted(seed, horizon=64, delay_s=0.2)
+                if chaotic else None)
+    if injector is not None:
+        faultinject.install(injector)
+    n = 0
+    settled = 0
+    cycles = 0
+    deadlocked = True
+    try:
+        for cycle in range(MAX_CYCLES):
+            if injector is not None and cycle == inject_cycles:
+                faultinject.uninstall()
+            if cycle < WAVES:  # trickled arrivals keep the arena churning
+                for i in range(NUM_CQS):
+                    mgr.store.create(make_workload(cycle, i, n))
+                    n += 1
+                mgr.run_until_idle(max_iterations=1_000_000)
+            before = len(admitted_keys(mgr))
+            mgr.scheduler.schedule(timeout=0)
+            mgr.run_until_idle(max_iterations=1_000_000)
+            clock.advance(1.0)
+            cycles = cycle + 1
+            progressed = len(admitted_keys(mgr)) > before
+            injecting = injector is not None and cycle < inject_cycles
+            busy = (progressed or injecting
+                    or mgr.scheduler._inflight is not None)
+            settled = 0 if busy else settled + 1
+            if settled >= 3:
+                deadlocked = False
+                break
+    finally:
+        faultinject.uninstall()
+
+    s = mgr.scheduler
+    return {
+        "mode": "chaos" if chaotic else "clean",
+        "seed": seed,
+        "cycles": cycles,
+        "deadlocked": deadlocked,
+        "admitted": admitted_keys(mgr),
+        "solver_faults": s.solver_faults,
+        "fired": dict(injector.fired) if injector else {},
+        "breaker": {"state": s.breaker.state, "trips": s.breaker.trips,
+                    "recoveries": s.breaker.recoveries,
+                    "last_recovery_cycles": s.breaker.last_recovery_cycles},
+        "cycle_counts": dict(s.cycle_counts),
+        "dispatch_timeouts": s.solver.counters["dispatch_timeouts"],
+        "events": [f"{e.type}/{e.reason}: {e.message}"
+                   for e in mgr.recorder.events if e.kind == "Scheduler"],
+    }
+
+
+def main():
+    seed = int(sys.argv[1]) if len(sys.argv) > 1 else 1234
+    inject_cycles = int(sys.argv[2]) if len(sys.argv) > 2 else 12
+    clean = run(seed, inject_cycles, chaotic=False)
+    chaos = run(seed, inject_cycles, chaotic=True)
+    for r in (clean, chaos):
+        print(json.dumps({**r, "admitted": len(r["admitted"]),
+                          "events": r["events"][:8]}), file=sys.stderr)
+    ok = (not clean["deadlocked"] and not chaos["deadlocked"]
+          and clean["admitted"] == chaos["admitted"])
+    print(json.dumps({
+        "tool": "chaos_run", "seed": seed, "ok": ok,
+        "admitted": len(chaos["admitted"]),
+        "faults_fired": sum(chaos["fired"].values()),
+        "solver_faults": chaos["solver_faults"],
+        "breaker_trips": chaos["breaker"]["trips"],
+        "recovery_cycles": chaos["breaker"]["last_recovery_cycles"],
+        "chaos_cycles": chaos["cycles"], "clean_cycles": clean["cycles"],
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -560,6 +560,86 @@ def bench_device_fault_recovery(num_cqs=256, num_cohorts=32, burst=3,
     return recovery_cycles
 
 
+def bench_trace_overhead(num_cqs=256, num_cohorts=32, spans_per_cycle=16):
+    """Cycle flight recorder (kueue_tpu/obs): pin the cost contract.
+    Disabled, a span/annotate hook is one attribute load + is-None
+    compare (like the faultinject sites) — asserted <=1% of a fault-free
+    cycle p50; enabled, span capture is a tuple append into the open
+    trace — also asserted <=1%. Then runs recorded cycles end-to-end and
+    checks the traces are well-formed (route/heads/spans present, ring
+    bounded)."""
+    import timeit
+
+    from kueue_tpu.obs import FlightRecorder
+    from kueue_tpu.solver import BatchSolver
+
+    flavors = ["f0"]
+    sched, cache, queues, client, clock = build_env(
+        num_cqs, num_cohorts, flavors, nominal_units=400,
+        solver=BatchSolver())
+    sched.recorder = FlightRecorder(enabled=False)
+    n = 0
+
+    def submit_wave():
+        nonlocal n
+        for i in range(num_cqs):
+            wl = make_workload(f"w{n}", f"lq{i}", cpu_units=2,
+                               creation=float(n))
+            queues.add_or_update_workload(wl)
+            n += 1
+
+    def cycle():
+        sched.schedule(timeout=0)
+        clock.advance(1.0)
+
+    for _ in range(2):  # warm: compile the shape buckets
+        submit_wave()
+        cycle()
+    times = []
+    for _ in range(4):
+        submit_wave()
+        t0 = time.perf_counter()
+        cycle()
+        times.append(time.perf_counter() - t0)
+    clean_p50 = p50(times)
+
+    # Disabled per-hook cost: recorder present, no open trace.
+    rec_off = sched.recorder
+    per_off_s = timeit.timeit(
+        lambda: rec_off.span("encode", 0.0, 0.0),
+        number=200_000) / 200_000
+    off_pct = 100.0 * (spans_per_cycle * per_off_s) / max(clean_p50, 1e-9)
+    assert off_pct <= 1.0, (off_pct, clean_p50)
+
+    # Enabled per-span cost: an open trace absorbing appends.
+    rec_on = FlightRecorder(capacity=64)
+    rec_on.begin_cycle(0)
+    per_on_s = timeit.timeit(
+        lambda: rec_on.span("encode", 0.0, 0.0),
+        number=200_000) / 200_000
+    on_pct = 100.0 * (spans_per_cycle * per_on_s) / max(clean_p50, 1e-9)
+    assert on_pct <= 1.0, (on_pct, clean_p50)
+
+    # Recorded cycles end-to-end: the scheduler late-binds the swapped
+    # recorder to the solver and every cycle yields a sealed trace.
+    sched.recorder = FlightRecorder(capacity=8)
+    for _ in range(12):
+        submit_wave()
+        cycle()
+    traces = sched.recorder.traces()
+    assert traces and len(traces) <= 8, len(traces)
+    assert all(t.route and t.heads >= 0 and t.spans for t in traces)
+
+    log({"bench": "trace_overhead", "cqs": num_cqs,
+         "clean_cycle_p50_ms": round(clean_p50 * 1e3, 2),
+         "disabled_span_ns": round(per_off_s * 1e9, 1),
+         "enabled_span_ns": round(per_on_s * 1e9, 1),
+         "disabled_overhead_pct": round(off_pct, 4),
+         "enabled_overhead_pct": round(on_pct, 4),
+         "traces_recorded": sched.recorder.cycles_recorded})
+    return off_pct
+
+
 def bench_e2e_progressive():
     """The flagship scenario (BASELINE.json north star): 2048 CQs x 32
     flavors with workloads sized to a full flavor, so cycle N assigns at
@@ -988,6 +1068,7 @@ def main():
     snapshot_speedup = bench_snapshot_incremental()
     arena_speedup = bench_workload_arena()
     bench_device_fault_recovery()
+    bench_trace_overhead()
     rows = {}
     admitted_per_sec, speedup = bench_e2e_progressive()
     rows["progressive_fill"] = speedup

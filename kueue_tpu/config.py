@@ -141,6 +141,21 @@ class LeaderElectionConfig:
 
 DEFAULT_STRICT_AFTER_BLOCKED_CYCLES = 8
 
+# Cycle flight recorder defaults (kueue_tpu/obs/OBSERVABILITY.md).
+DEFAULT_FLIGHT_RECORDER_CAPACITY = 256
+
+
+@dataclass
+class ObservabilityConfig:
+    """Flight-recorder wiring (kueue_tpu/obs): every scheduler cycle
+    produces a structured trace held in a bounded ring of the last
+    ``flight_recorder_capacity`` cycles, served via /debug/cycles and
+    feeding the cycle_phase_seconds histograms. Disabling drops span
+    capture to a single compare per phase (the trace_overhead bench row
+    pins both modes at <=1% of a cycle)."""
+    flight_recorder_enable: bool = True
+    flight_recorder_capacity: int = DEFAULT_FLIGHT_RECORDER_CAPACITY
+
 # Device-fault containment defaults (kueue_tpu/resilience) — single
 # source for both the dataclass defaults and load()'s fallbacks.
 DEFAULT_WATCHDOG_SAFETY_FACTOR = 20.0
@@ -210,6 +225,8 @@ class Configuration:
     multi_kueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     resources: Resources = field(default_factory=Resources)
     solver: SolverConfig = field(default_factory=SolverConfig)
+    observability: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig)
     leader_election: LeaderElectionConfig = field(
         default_factory=LeaderElectionConfig)
     feature_gates: dict[str, bool] = field(default_factory=dict)
@@ -285,6 +302,8 @@ def validate(cfg: Configuration) -> list[str]:
             < cfg.solver.breaker_backoff_base_s:
         errs.append("solver.breakerBackoff: base must be positive and "
                     "max >= base")
+    if cfg.observability.flight_recorder_capacity < 1:
+        errs.append("observability.flightRecorderCapacity must be >= 1")
     return errs
 
 
@@ -387,6 +406,13 @@ def load(raw: dict) -> Configuration:
                 "breakerBackoffBase", DEFAULT_BREAKER_BACKOFF_BASE_S),
             breaker_backoff_max_s=s.get(
                 "breakerBackoffMax", DEFAULT_BREAKER_BACKOFF_MAX_S),
+        )
+    if "observability" in raw:
+        o = raw["observability"]
+        cfg.observability = ObservabilityConfig(
+            flight_recorder_enable=o.get("flightRecorderEnable", True),
+            flight_recorder_capacity=o.get(
+                "flightRecorderCapacity", DEFAULT_FLIGHT_RECORDER_CAPACITY),
         )
     cfg.feature_gates = dict(raw.get("featureGates", {}))
     cfg = set_defaults(cfg)

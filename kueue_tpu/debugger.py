@@ -2,7 +2,11 @@
 
 Equivalent of the reference's pkg/debugger/debugger.go:34-56: on demand
 (or on SIGUSR2), log the full cache usage state and every queue's
-pending dump.
+pending dump. With a scheduler attached, the dump also covers the
+solver plane's operator surface (kueue_tpu/obs): circuit-breaker state,
+adaptive-router regime samples, encode-arena slot stats, and the last
+flight-recorder cycle trace — the same producers the VisibilityServer's
+``/debug/*`` endpoints serve.
 """
 
 from __future__ import annotations
@@ -12,10 +16,11 @@ import sys
 
 
 class Dumper:
-    def __init__(self, cache, queues, out=None):
+    def __init__(self, cache, queues, out=None, scheduler=None):
         self.cache = cache
         self.queues = queues
         self.out = out or sys.stderr
+        self.scheduler = scheduler
 
     def dump(self) -> str:
         lines = ["=== kueue_tpu state dump ==="]
@@ -38,7 +43,54 @@ class Dumper:
         lines.append("-- assumed workloads --")
         for key, cq in sorted(self.cache.assumed_workloads.items()):
             lines.append(f"  {key} -> {cq}")
+        if self.scheduler is not None:
+            lines.extend(self._dump_solver_plane())
         return "\n".join(lines)
+
+    def _dump_solver_plane(self) -> list:
+        from kueue_tpu.obs import (arena_status, breaker_status,
+                                   router_status)
+        sched = self.scheduler
+        lines = ["-- breaker --"]
+        st = breaker_status(sched)
+        lines.append(f"state={st['state']} route={st['route']} "
+                     f"consecutive={st['consecutive_faults']}/"
+                     f"{st['threshold']} trips={st['trips']} "
+                     f"recoveries={st['recoveries']} "
+                     f"next_probe_in_s={st['next_probe_in_s']} "
+                     f"backoff_s={st['backoff_s']}")
+        lines.append("-- router --")
+        rt = router_status(sched)
+        lines.append(f"routing={rt['routing']} "
+                     f"last_regime={rt['last_regime']} "
+                     f"cycle_counts={rt['cycle_counts']}")
+        for key, info in sorted(rt["regimes"].items()):
+            lines.append(f"  {key}: median_rate_per_s="
+                         f"{info['median_rate_per_s']} median_cycle_s="
+                         f"{info['median_cycle_s']} "
+                         f"samples={len(info['samples'])}")
+        if sched.solver is not None:
+            lines.append("-- arena --")
+            a = arena_status(sched.solver)
+            lines.append(" ".join(f"{k}={v}" for k, v in a.items()))
+        last = sched.recorder.last()
+        lines.append("-- last cycle trace --")
+        if last is None:
+            lines.append("  (no cycles recorded)")
+        else:
+            d = last.to_dict()
+            lines.append(f"cycle {d['cycle']}: route={d['route']} "
+                         f"regime={d['regime']} heads={d['heads']} "
+                         f"admitted={d['admitted']} "
+                         f"evictions={d['evictions']} "
+                         f"faults={d['faults']} breaker={d['breaker']} "
+                         f"duration_ms={d['duration_ms']}")
+            for s in d["spans"]:
+                lines.append(f"  span {s['name']}: start_ms="
+                             f"{s['start_ms']} dur_ms={s['dur_ms']}")
+            for a in d["annotations"]:
+                lines.append(f"  note {a['kind']}: {a['message']}")
+        return lines
 
     def write(self) -> None:
         print(self.dump(), file=self.out, flush=True)

@@ -140,13 +140,22 @@ class KueueManager:
         setup_webhooks(self.store, self.cfg)
 
         self.scheduler_client = StoreSchedulerClient(self.store, self.recorder)
+        # Cycle flight recorder (kueue_tpu/obs): per-cycle phase traces
+        # in a bounded ring, served via serve_visibility()'s /debug/*.
+        from kueue_tpu.obs import FlightRecorder
+        o = self.cfg.observability
+        self.flight_recorder = FlightRecorder(
+            capacity=o.flight_recorder_capacity,
+            enabled=o.flight_recorder_enable)
         self.scheduler = Scheduler(
             self.queues, self.cache, self.scheduler_client,
             ordering=ordering,
             fair_sharing_enabled=self.cfg.fair_sharing.enable,
             fs_preemption_strategies=self.cfg.fair_sharing.preemption_strategies,
             clock=clock, metrics=self.metrics, solver=solver,
-            solver_min_heads=self.cfg.solver.min_heads)
+            solver_min_heads=self.cfg.solver.min_heads,
+            recorder=self.flight_recorder)
+        self.visibility_server = None  # started by serve_visibility()
         if solver is not None:
             # Production solver wiring: pipelined dispatch + adaptive
             # engine routing + the persistent compilation cache.
@@ -238,6 +247,35 @@ class KueueManager:
     def _namespace_labels(self, ns: str) -> Optional[dict]:
         obj = self.store.try_get("Namespace", "", ns)
         return obj.metadata.labels if obj is not None else {}
+
+    # -- operator surface ----------------------------------------------
+
+    def serve_visibility(self, port: int = 0):
+        """Start the visibility HTTP server with the operator debug
+        surface wired: pending-workloads views plus /metrics and the
+        /debug/{cycles,breaker,router,arena} endpoints (see
+        kueue_tpu/obs/OBSERVABILITY.md). Returns the started server
+        (``.port`` carries the bound port); call ``.stop()`` to shut
+        it down."""
+        from kueue_tpu.obs import DebugEndpoints
+        from kueue_tpu.visibility import VisibilityAPI, VisibilityServer
+        if self.visibility_server is not None:
+            # Rebinding: the old server's socket and serve-forever
+            # thread would otherwise leak with no reachable handle.
+            self.visibility_server.stop()
+        server = VisibilityServer(
+            VisibilityAPI(self.queues), port=port,
+            debug=DebugEndpoints(self.scheduler, self.metrics))
+        server.start()
+        self.visibility_server = server
+        return server
+
+    def dumper(self, out=None):
+        """A SIGUSR2-ready state Dumper covering cache/queues plus the
+        solver plane (breaker, router, arena, last cycle trace)."""
+        from kueue_tpu.debugger import Dumper
+        return Dumper(self.cache, self.queues, out=out,
+                      scheduler=self.scheduler)
 
     # -- deterministic drivers (tests / perf harness) -------------------
 

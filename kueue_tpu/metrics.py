@@ -34,12 +34,43 @@ def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
     return [start * factor**i for i in range(count)]
 
 
+def _bucket_quantile(buckets: list, counts: list, total: int,
+                     q: float) -> float:
+    """Promql-style bucket interpolation shared by Histogram.percentile
+    and Registry.phase_percentile; ``counts`` are per-bucket
+    (non-cumulative) including the +Inf bucket. Values past the last
+    finite bucket clamp to it."""
+    if total == 0 or not buckets:
+        return math.nan
+    target = q * total
+    cum = 0
+    lower = 0.0
+    for i, ub in enumerate(buckets):
+        prev = cum
+        cum += counts[i]
+        if cum >= target:
+            frac = (target - prev) / counts[i] if counts[i] else 0.0
+            return lower + (ub - lower) * frac
+        lower = ub
+    return buckets[-1]
+
+
 def wait_time_buckets() -> list[float]:
     """1, 2.5, 5, 10, ... 10240 (reference: metrics.go:258-260, count=14)."""
     return [1.0] + exponential_buckets(2.5, 2, 13)
 
 
 _DEFAULT_BUCKETS = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10]
+
+# Cycle-phase spans are sub-millisecond to seconds (a remote compile);
+# finer low buckets than the default so encode/route regressions move
+# the estimated percentiles.
+_PHASE_BUCKETS = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                  0.1, 0.25, 0.5, 1.0, 2.5, 5.0]
+_HEADS_BUCKETS = [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096]
+
+# breaker_state gauge encoding (resilience.breaker state names)
+BREAKER_STATE_CODES = {"closed": 0, "half-open": 1, "open": 2}
 
 
 class _Metric:
@@ -135,17 +166,7 @@ class Histogram(_Metric):
         if not s or s[2] == 0:
             return math.nan
         counts, _, total = s
-        target = q * total
-        cum = 0
-        lower = 0.0
-        for i, ub in enumerate(self.buckets):
-            prev = cum
-            cum += counts[i]
-            if cum >= target:
-                frac = (target - prev) / counts[i] if counts[i] else 0.0
-                return lower + (ub - lower) * frac
-            lower = ub
-        return self.buckets[-1] if self.buckets else math.nan
+        return _bucket_quantile(self.buckets, counts, total, q)
 
     def _series(self):
         return list(self.series)
@@ -250,6 +271,21 @@ class Registry:
             "kueue_solver_fault_recovery_cycles",
             "Cycles from the last breaker trip until the device route "
             "was restored by a successful half-open probe")
+        # Cycle flight recorder (kueue_tpu/obs): per-cycle phase spans,
+        # fed from each sealed CycleTrace so /debug/cycles and /metrics
+        # reconcile by construction.
+        self.cycle_phase_seconds = Histogram(
+            "kueue_cycle_phase_seconds",
+            "Per-cycle wall seconds by phase (snapshot|encode|route|"
+            "dispatch|fetch|decode|preempt-plan|nominate|apply|requeue) "
+            "and route", ["phase", "route"], buckets=_PHASE_BUCKETS)
+        self.cycle_heads = Histogram(
+            "kueue_cycle_heads",
+            "Heads processed per admission cycle by route",
+            ["route"], buckets=_HEADS_BUCKETS)
+        self.breaker_state = Gauge(
+            "kueue_solver_breaker_state",
+            "Circuit-breaker state (0=closed, 1=half-open, 2=open)")
         self._all = [v for v in vars(self).values() if isinstance(v, _Metric)]
 
     # --- report helpers (reference: metrics.go:262-400) ---
@@ -290,6 +326,34 @@ class Registry:
 
     def fault_recovered(self, cycles: int) -> None:
         self.fault_recovery_cycles.set(cycles)
+
+    def cycle_observed(self, route: str, heads: int,
+                       phase_sums: dict) -> None:
+        """One sealed cycle trace: head count + per-phase wall seconds
+        (the trace's top-level span sums)."""
+        self.cycle_heads.observe(heads, route=route)
+        for phase, secs in phase_sums.items():
+            self.cycle_phase_seconds.observe(secs, phase=phase, route=route)
+
+    def set_breaker_state(self, state: str) -> None:
+        self.breaker_state.set(BREAKER_STATE_CODES.get(state, -1))
+
+    def phase_percentile(self, phase: str, q: float) -> float:
+        """Estimate the q-quantile of cycle_phase_seconds for one phase,
+        merged across routes (promql-style bucket interpolation). NaN
+        when the phase has no observations."""
+        h = self.cycle_phase_seconds
+        pi = h.label_names.index("phase")
+        merged = [0] * (len(h.buckets) + 1)
+        total = 0
+        with h._lock:
+            for key, (counts, _sum, n) in h.series.items():
+                if key[pi] != phase:
+                    continue
+                for i, c in enumerate(counts):
+                    merged[i] += c
+                total += n
+        return _bucket_quantile(h.buckets, merged, total, q)
 
     def report_pending_workloads(self, cq: str, active: int, inadmissible: int) -> None:
         self.pending_workloads.set(active, cluster_queue=cq, status=PENDING_STATUS_ACTIVE)

@@ -1,0 +1,24 @@
+"""Observability plane: the per-cycle flight recorder and the operator
+debug surface it feeds (see OBSERVABILITY.md)."""
+
+from kueue_tpu.obs.recorder import (
+    DEFAULT_CAPACITY,
+    CycleTrace,
+    FlightRecorder,
+)
+from kueue_tpu.obs.status import (
+    DebugEndpoints,
+    arena_status,
+    breaker_status,
+    router_status,
+)
+
+__all__ = [
+    "DEFAULT_CAPACITY",
+    "CycleTrace",
+    "FlightRecorder",
+    "DebugEndpoints",
+    "arena_status",
+    "breaker_status",
+    "router_status",
+]

@@ -1,0 +1,117 @@
+"""Operator debug surface: structured status snapshots of the solver
+plane (breaker, adaptive router, encode arena, flight recorder), shared
+by the ``VisibilityServer``'s ``/debug/*`` endpoints, the SIGUSR2
+``Dumper``, and tests — one producer per subsystem so every consumer
+shows the same numbers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def breaker_status(scheduler) -> dict:
+    """Circuit-breaker state for operators (ROADMAP PR-3 follow-up):
+    the route the breaker currently pins, consecutive faults, and the
+    next-probe backoff — plus the outage/recovery counters."""
+    b = scheduler.breaker
+    st = b.status()
+    st["route"] = "device" if st["state"] == "closed" else "cpu-breaker"
+    st["next_probe_in_s"] = (
+        0.0 if st["state"] == "closed"
+        else max(0.0, round(st["retry_at"] - scheduler.clock.now(), 3)))
+    st["solver_faults_total"] = scheduler.solver_faults
+    st["cpu_breaker_cycles"] = scheduler.cycle_counts.get("cpu-breaker", 0)
+    return st
+
+
+def router_status(scheduler) -> dict:
+    """Adaptive-router internals: per (engine, regime) progress/secs
+    samples with the median rate the next routing decision will use."""
+    regimes = {}
+    # Materialize before iterating: this runs on the HTTP/dumper thread
+    # while the scheduler thread inserts samples — list() is atomic
+    # under the GIL, a Python-level loop over the live dict is not.
+    for (engine, regime), samples in list(scheduler._route_stats.items()):
+        samples = list(samples)
+        rates = sorted(a / max(t, 1e-9) for a, t in samples)
+        secs = sorted(t for _a, t in samples)
+        regimes[f"{engine}/{regime}"] = {
+            "samples": [[a, round(t, 6)] for a, t in samples],
+            "median_rate_per_s": (round(rates[len(rates) // 2], 3)
+                                  if rates else None),
+            "median_cycle_s": (round(secs[len(secs) // 2], 6)
+                               if secs else None),
+        }
+    return {
+        "routing": scheduler.solver_routing,
+        "last_regime": scheduler._last_regime,
+        "explore_counts": dict(scheduler._route_explore),
+        "cycle_counts": dict(scheduler.cycle_counts),
+        "regimes": regimes,
+    }
+
+
+def arena_status(solver) -> dict:
+    """Encode-arena slot occupancy and churn counters."""
+    arena = getattr(solver, "_arena", None)
+    if arena is None:
+        return {"bound": False}
+    free = len(arena.free)
+    return {
+        "bound": getattr(solver, "_queues", None) is not None,
+        "cap": arena.cap,
+        "high_water": arena.size,
+        "occupied": arena.size - free,
+        "free": free,
+        "dirty": len(arena.dirty),
+        "encoded_rows": arena.encoded_rows,
+        "gathers": arena.gathers,
+        "full_uploads": arena.full_uploads,
+        "row_uploads": arena.row_uploads,
+        "device_twin": arena.dev is not None,
+    }
+
+
+class DebugEndpoints:
+    """Route table for the VisibilityServer's operator endpoints.
+
+    ``handle(path, params)`` returns a JSON-able payload, None for an
+    unknown ``/debug/*`` path (404), and raises ValueError on bad query
+    parameters (400). ``metrics_text()`` backs ``/metrics``.
+    """
+
+    def __init__(self, scheduler, metrics=None):
+        self.scheduler = scheduler
+        self.metrics = metrics
+
+    def metrics_text(self) -> Optional[str]:
+        return self.metrics.dump() if self.metrics is not None else None
+
+    def handle(self, path: str, params: dict) -> Optional[dict]:
+        if path == "/debug/cycles":
+            return self._cycles(params)
+        if path == "/debug/breaker":
+            return breaker_status(self.scheduler)
+        if path == "/debug/router":
+            return router_status(self.scheduler)
+        if path == "/debug/arena":
+            if self.scheduler.solver is None:
+                return {"bound": False}
+            return arena_status(self.scheduler.solver)
+        return None
+
+    def _cycles(self, params: dict) -> dict:
+        rec = self.scheduler.recorder
+        slowest = int(params.get("slowest", 0))   # ValueError -> 400
+        n = int(params.get("n", 0))
+        if slowest < 0 or n < 0:
+            raise ValueError("slowest/n must be >= 0")
+        traces = rec.slowest(slowest) if slowest else rec.traces(n)
+        return {
+            "enabled": rec.enabled,
+            "capacity": rec.capacity,
+            "cycles_recorded": rec.cycles_recorded,
+            "order": "slowest-first" if slowest else "oldest-first",
+            "cycles": [t.to_dict() for t in traces],
+        }

@@ -28,6 +28,12 @@ class RangeSpec:
     # silently falling back to full rebuilds every cycle) trips them.
     max_snapshot_build_p50_ms: float = 0.0
     max_snapshot_build_p99_ms: float = 0.0
+    # Per-cycle phase p99 bounds (cycle flight recorder histograms),
+    # same philosophy as the snapshot-build bounds: host-compute
+    # regression guards with generous headroom, checked ONLY for phases
+    # that recorded samples (a CPU-only run has no solver phases; the
+    # default config's min_heads gate can keep the solver dark).
+    max_phase_p99_ms: dict = field(default_factory=dict)
 
 
 def default_rangespec() -> RangeSpec:
@@ -46,6 +52,17 @@ def default_rangespec() -> RangeSpec:
         cq_class_min_usage_pct={"cq": 55.0},
         max_snapshot_build_p50_ms=3.0,
         max_snapshot_build_p99_ms=30.0,
+        # Phase p99 bounds at the default 30-CQ shape (bucket-estimated
+        # from cycle_phase_seconds; see PR-4). Host phases measured
+        # sub-ms p50 — 100 ms trips only on an order-of-regression
+        # (e.g. the nominate loop going quadratic). Device round-trip
+        # phases get 1 s: a warm dispatch is ms-scale, but a missed
+        # warmup bucket legitimately carries one local compile.
+        max_phase_p99_ms={"snapshot": 100.0, "nominate": 100.0,
+                          "encode": 100.0, "route": 100.0,
+                          "decode": 100.0, "apply": 100.0,
+                          "requeue": 100.0, "dispatch": 1000.0,
+                          "fetch": 1000.0},
     )
 
 
@@ -81,4 +98,10 @@ def check(result: RunResult, spec: RangeSpec) -> list:
         violations.append(
             f"snapshot build p99 {result.snapshot_build_p99_ms:.3f}ms "
             f"exceeds {spec.max_snapshot_build_p99_ms:.3f}ms")
+    for phase, bound in spec.max_phase_p99_ms.items():
+        p99 = result.phase_p99_ms.get(phase)
+        if p99 is not None and p99 > bound:
+            violations.append(
+                f"cycle phase {phase!r} p99 {p99:.3f}ms "
+                f"exceeds {bound:.3f}ms")
     return violations

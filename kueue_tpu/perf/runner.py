@@ -91,6 +91,12 @@ class RunResult:
     # batch-assembly latency.
     encode_p50_ms: float = 0.0
     encode_p99_ms: float = 0.0
+    # Per-cycle phase latency (cycle flight recorder): p50/p99 of the
+    # cycle_phase_seconds histograms, merged across routes. Only phases
+    # that actually observed samples appear (a CPU-only run has no
+    # encode/dispatch series).
+    phase_p50_ms: dict = field(default_factory=dict)
+    phase_p99_ms: dict = field(default_factory=dict)
 
 
 class Runner:
@@ -295,6 +301,19 @@ class Runner:
         if encodes:
             result.encode_p50_ms = _percentile(encodes, 0.50) * 1e3
             result.encode_p99_ms = _percentile(encodes, 0.99) * 1e3
+        # Phase p50/p99 from the flight-recorder-fed histograms
+        # (cycle_phase_seconds, merged across routes): the rangespec's
+        # per-phase regression bounds read these.
+        import math as _math
+        for phase in ("snapshot", "nominate", "encode", "route",
+                      "dispatch", "fetch", "decode", "preempt-plan",
+                      "apply", "requeue"):
+            v50 = mgr.metrics.phase_percentile(phase, 0.50)
+            if _math.isnan(v50):
+                continue
+            result.phase_p50_ms[phase] = v50 * 1e3
+            result.phase_p99_ms[phase] = \
+                mgr.metrics.phase_percentile(phase, 0.99) * 1e3
         return result
 
 

@@ -106,6 +106,23 @@ class CircuitBreaker:
             return True
         return False
 
+    def status(self) -> dict:
+        """Structured state snapshot for the operator surface
+        (/debug/breaker, the SIGUSR2 dumper, flight-recorder
+        annotations). ``retry_at`` is in the caller's clock domain."""
+        return {
+            "state": self.state,
+            "consecutive_faults": self.consecutive_faults,
+            "threshold": self.threshold,
+            "faults": self.faults,
+            "trips": self.trips,
+            "recoveries": self.recoveries,
+            "blocked_cycles": self.blocked_cycles,
+            "last_recovery_cycles": self.last_recovery_cycles,
+            "backoff_s": self._backoff_s,
+            "retry_at": self._retry_at,
+        }
+
     def probe_inconclusive(self, now: float) -> None:
         """The admitted probe cycle never actually round-tripped the
         device (work gates sent everything to the CPU preemptor): it

@@ -31,6 +31,7 @@ from kueue_tpu.core import limitrange as limitrangepkg
 from kueue_tpu.core import priority as prioritypkg
 from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.core.resources import container_limits_violations
+from kueue_tpu.obs import FlightRecorder
 from kueue_tpu.queue import Manager, RequeueReason
 from kueue_tpu.resilience.breaker import CLOSED, CircuitBreaker
 from kueue_tpu.resilience.faultinject import DeviceFault
@@ -102,7 +103,8 @@ class Scheduler:
                  fs_preemption_strategies: Optional[list] = None,
                  clock: Clock = REAL_CLOCK,
                  metrics=None,
-                 solver=None, solver_min_heads: int = 64):
+                 solver=None, solver_min_heads: int = 64,
+                 recorder: Optional[FlightRecorder] = None):
         from kueue_tpu.scheduler.preemption import parse_strategies
         self.queues = queues
         self.cache = cache
@@ -125,6 +127,13 @@ class Scheduler:
             # Workload encode arena: the queue manager's delta feed
             # maintains per-workload encoded rows across cycles.
             solver.bind_queues(queues)
+        # Cycle flight recorder (kueue_tpu/obs): every schedule() call
+        # that popped heads produces a CycleTrace (route, regime, phase
+        # spans, fault/breaker annotations) in a bounded ring, feeding
+        # /debug/cycles and the cycle_phase_seconds histograms.
+        self.recorder = recorder if recorder is not None else FlightRecorder()
+        if solver is not None and hasattr(solver, "bind_recorder"):
+            solver.bind_recorder(self.recorder)
         # Pipelined dispatch: overlap the decision fetch of cycle N with
         # head-pop + encode + dispatch of cycle N+1 (all-fit cycles only;
         # see _schedule_pipelined for the semantics). Off by default —
@@ -134,6 +143,11 @@ class Scheduler:
         self.pipeline_enabled = False
         self._inflight = None  # (InFlight, snapshot)
         self._pipeline_cooldown = 0
+        # Which pipelined shape the last _schedule_pipelined call took
+        # (device-pipelined / device-dispatch-only / device-nofit): the
+        # cycle trace's route label for pipelined cycles.
+        self._pipeline_trace_route = "device-pipelined"
+        self._drained_admitted = None  # last _drain_pipeline's admissions
         # Adaptive routing (the production config): measure admitted/sec
         # per (engine, cycle regime) over a sliding window and run each
         # cycle on the faster engine for its predicted regime,
@@ -253,13 +267,28 @@ class Scheduler:
         if (self.solver is not None and hasattr(self.solver, "bind_queues")
                 and getattr(self.solver, "_queues", None) is None):
             self.solver.bind_queues(self.queues)
+        if (self.solver is not None and hasattr(self.solver, "bind_recorder")
+                and getattr(self.solver, "_recorder", None)
+                is not self.recorder):
+            self.solver.bind_recorder(self.recorder)
         heads = self.queues.heads(timeout=timeout)
         if not heads:
             if self._inflight is not None:
-                return self._drain_pipeline()
+                # A headless drain still round-trips the device (collect
+                # + decode + admit): trace it under its own route name.
+                # heads=0 is honest — the drained batch's heads were
+                # counted by the cycle that dispatched them.
+                trace = self.recorder.begin_cycle(self.attempt_count)
+                self._cycle_evictions = 0
+                self._cycle_faults = 0
+                sig = self._drain_pipeline()
+                self._finish_trace(trace, "drain", heads=0,
+                                   admitted=self._drained_admitted)
+                return sig
             return KeepGoing
         start = self.clock.now()
         wall0 = _time.perf_counter()
+        trace = self.recorder.begin_cycle(self.attempt_count)
         self._drain_cost = 0.0
         self._cycle_evictions = 0
         self._cycle_faults = 0
@@ -307,6 +336,9 @@ class Scheduler:
                                    _time.perf_counter() - wall0
                                    - self._drain_cost)
                 self._note_device_cycle(collects0)
+                self._finish_trace(trace, self._pipeline_trace_route,
+                                   heads=len(heads),
+                                   admitted=self._last_cycle_admitted)
                 return signal
             # Pipeline not applicable this cycle: continue on the
             # synchronous path with a FRESH full snapshot. The pipelined
@@ -320,7 +352,9 @@ class Scheduler:
             # invisible to nominate() and its workloads stranded.
             self._drain_pipeline()
 
+        t_ph = _time.perf_counter()
         snapshot = self.cache.snapshot()
+        t_ph = self._span("snapshot", t_ph)
         vlog.dump_snapshot(self.log, snapshot)
 
         solver_entries: list = []
@@ -329,8 +363,10 @@ class Scheduler:
             solver_entries, pre_entries, heads = self._solve_batch(
                 heads, snapshot, timeout)
 
+        t_ph = _time.perf_counter()
         entries = pre_entries + self.nominate(heads, snapshot)
         entries.sort(key=self._entry_sort_key())
+        t_ph = self._span("nominate", t_ph)
 
         preempted_workloads: set = set()
         skipped_preemptions: dict = {}
@@ -393,10 +429,12 @@ class Scheduler:
             except Exception as exc:  # noqa: BLE001 — cache/API races surface here
                 e.inadmissible_msg = f"Failed to admit workload: {exc}"
 
+        self._span("apply", t_ph)
         result_success = False
         admitted_n = 0
         entries = solver_entries + entries
         vlog.dump_attempts(self.log, entries)
+        t_ph = _time.perf_counter()
         for e in entries:
             if e.status != ASSUMED:
                 self.requeue_and_update(e)
@@ -404,6 +442,7 @@ class Scheduler:
                 result_success = True
                 admitted_n += 1
                 self._solver_release_workload(e.info.key)
+        self._span("requeue", t_ph)
         # Observed regime of this cycle feeds the regime-keyed router:
         # the sample lands under what the cycle WAS, and the next
         # cycle's engine choice predicts it will look the same.
@@ -470,6 +509,8 @@ class Scheduler:
             self.metrics.admission_attempt(result_success, self.clock.now() - start)
             for cq_name, count in skipped_preemptions.items():
                 self.metrics.preemption_skips(cq_name, count)
+        self._finish_trace(trace, route, heads=len(entries),
+                           admitted=admitted_n)
         return KeepGoing if result_success else SlowDown
 
     # --- pipelined dispatch (device-resident state, all-fit cycles) ---
@@ -489,6 +530,37 @@ class Scheduler:
     #   one in-flight cycle; a mispredicted entry is requeued and the next
     #   cycle runs synchronously (cooldown), where fresh state routes it
     #   to CPU preempt-mode nomination exactly like the sync path.
+
+    # --- flight recorder (kueue_tpu/obs) ---
+
+    def _span(self, name: str, t0: float) -> float:
+        """Record a scheduler-side phase span ending now; returns now so
+        consecutive phases chain without a second perf_counter call."""
+        t1 = _time.perf_counter()
+        self.recorder.span(name, t0, t1 - t0)
+        return t1
+
+    def _finish_trace(self, trace, route: str, heads: int,
+                      admitted: Optional[int]) -> None:
+        """Seal this cycle's trace and feed the observability metrics.
+        The cycle_phase_seconds histogram is fed FROM the trace's span
+        sums, so /debug/cycles and /metrics reconcile by construction;
+        the breaker gauge updates every cycle regardless of the
+        recorder (it is a metrics concern, not a tracing one)."""
+        if self.metrics is not None:
+            self.metrics.set_breaker_state(self.breaker.state)
+        if trace is None:
+            return
+        trace.route = route
+        trace.regime = self._cycle_regime
+        trace.heads = heads
+        trace.admitted = admitted
+        trace.evictions = self._cycle_evictions
+        trace.faults = self._cycle_faults
+        trace.breaker = self.breaker.state
+        self.recorder.finish(trace)
+        if self.metrics is not None:
+            self.metrics.cycle_observed(route, heads, trace.phase_sums())
 
     # --- adaptive mode routing (the production "routed system") ---
 
@@ -557,6 +629,11 @@ class Scheduler:
         self.solver_faults += 1
         self._cycle_faults += 1
         tripped = self.breaker.record_fault(self.clock.now())
+        self.recorder.annotate(
+            "fault", f"{where}: {exc!r}"[:200], site=where,
+            timeout=isinstance(exc, DispatchTimeout), tripped=tripped,
+            breaker=self.breaker.state,
+            consecutive=self.breaker.consecutive_faults)
         if self.metrics is not None:
             self.metrics.device_fault(
                 where, timeout=isinstance(exc, DispatchTimeout),
@@ -595,6 +672,11 @@ class Scheduler:
             self.breaker.probe_inconclusive(self.clock.now())
             return
         if self.breaker.record_success(self.clock.now()):
+            self.recorder.annotate(
+                "breaker-closed",
+                f"device route restored after "
+                f"{self.breaker.last_recovery_cycles} cycle(s)",
+                recovery_cycles=self.breaker.last_recovery_cycles)
             if self.metrics is not None:
                 self.metrics.fault_recovered(
                     self.breaker.last_recovery_cycles)
@@ -659,10 +741,13 @@ class Scheduler:
         Returns None to fall back to the synchronous path (any in-flight
         cycle has been drained first)."""
         solver = self.solver
+        self._pipeline_trace_route = "device-pipelined"
         # Light snapshot: the all-fit pipelined cycle never simulates on
         # it (usage truth is the device-resident state); cloning 2k
         # resource trees per cycle was a measurable share of the cycle.
+        t_ph = _time.perf_counter()
         snapshot = self.cache.snapshot(light=True)
+        self._span("snapshot", t_ph)
         valid_heads, invalid_entries = [], []
         for w in heads:
             if self.cache.is_assumed_or_admitted(w):
@@ -740,8 +825,10 @@ class Scheduler:
                 # sample=False: this cycle's routing sample charges the
                 # drained admissions against the FULL mixed-cycle cost.
                 prev_signal = self._drain_pipeline(sample=False)
+            t_ph = _time.perf_counter()
             pmeta, pbatch, bail = self._prepare_pipelined_preempt(plan,
                                                                   pend_ws)
+            self._span("preempt-plan", t_ph)
             if bail:
                 self._last_cycle_admitted = None
         if bail:
@@ -766,6 +853,7 @@ class Scheduler:
                 self.requeue_and_update(e)
             self.cycle_counts["device-nofit"] = \
                 self.cycle_counts.get("device-nofit", 0) + 1
+            self._pipeline_trace_route = "device-nofit"
             if self._inflight is not None:
                 return self._drain_pipeline()
             self._last_cycle_admitted = None
@@ -797,6 +885,7 @@ class Scheduler:
             self._last_cycle_admitted = None  # not a routing sample
             self.cycle_counts["device-dispatch-only"] = \
                 self.cycle_counts.get("device-dispatch-only", 0) + 1
+            self._pipeline_trace_route = "device-dispatch-only"
             return KeepGoing  # first pipelined cycle: results next call
         return self._process_inflight(prev, start)
 
@@ -879,6 +968,9 @@ class Scheduler:
         t0 = _time.perf_counter()
         ev0 = self._cycle_evictions
         sig = self._process_inflight(prev, self.clock.now())
+        # The drained cycle's admissions, surviving the sample branch's
+        # consumption below (the headless-drain trace reports them).
+        self._drained_admitted = self._last_cycle_admitted
         if sample:
             dt = _time.perf_counter() - t0
             # The drained cycle is DEVICE work even when the draining
@@ -917,9 +1009,14 @@ class Scheduler:
                 self.queues.requeue_workload(
                     w, RequeueReason.FAILED_AFTER_NOMINATION)
             self._pipeline_cooldown = 1
+            # An aborted collect admitted nothing: a previous cycle's
+            # count must not leak into the drain trace or the drain
+            # sample branch's routing record.
+            self._last_cycle_admitted = None
             return SlowDown
         entries = []
         any_nonfit = False
+        t_ph = _time.perf_counter()
         for i, w in enumerate(valid_heads):
             if i in nofit_idx or i in pend_idx:
                 continue  # NoFit: requeued at dispatch; pend: below
@@ -950,11 +1047,14 @@ class Scheduler:
                 e.inadmissible_msg = f"Failed to admit workload: {exc}"
                 self._solver_note_unapplied(w.key)
             entries.append(e)
+        self._span("apply", t_ph)
         if any_nonfit:
             self._pipeline_cooldown = 1
         if pmeta is not None:
+            t_ph = _time.perf_counter()
             entries.extend(self._collect_pipelined_preempt(
                 inflight, pmeta, aux, entries))
+            self._span("preempt-plan", t_ph)
             self._cycle_regime = "preempt"
         else:
             self._cycle_regime = "fit"
@@ -962,6 +1062,7 @@ class Scheduler:
         result_success = False
         admitted_n = 0
         vlog.dump_attempts(self.log, entries)
+        t_ph = _time.perf_counter()
         for e in entries:
             if e.status != ASSUMED:
                 self.requeue_and_update(e)
@@ -969,6 +1070,7 @@ class Scheduler:
                 result_success = True
                 admitted_n += 1
                 self._solver_release_workload(e.info.key)
+        self._span("requeue", t_ph)
         self._last_cycle_admitted = admitted_n
         self.cycle_counts["device-pipelined"] = \
             self.cycle_counts.get("device-pipelined", 0) + 1
@@ -1133,11 +1235,13 @@ class Scheduler:
         # carries only the minimal-preemption program).
         defer = not (self.fair_sharing_enabled
                      and self.solver.mesh is not None)
+        t_ph = _time.perf_counter()
         pre_entries = nofit_entries + self.nominate(pred_other, snapshot,
                                                     defer_preemption=defer)
         pending = [e for e in pre_entries if e.preemption_targets is None]
         for e in pending:
             e.preemption_targets = []
+        t_ph = self._span("nominate", t_ph)
         # NB: count ALL predicted-non-fit entries (incl. the device-NoFit
         # shortcut set), or an all-NoFit cycle would look like a fit cycle
         # to the dispatch-skip and preemption work gates.
@@ -1234,6 +1338,7 @@ class Scheduler:
                 pbatch = fbatch = None
                 self._cpu_preempt_targets(pending, snapshot)
                 pending = []
+        self._span("preempt-plan", t_ph)
         if fit_count == 0 and pbatch is None and fbatch is None:
             # Nothing needs the device this cycle: no fit-mode entries and
             # preemption resolved on CPU — skip the dispatch entirely.

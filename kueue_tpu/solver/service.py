@@ -204,9 +204,12 @@ class BatchSolver:
         # Cumulative per-phase wall time + engagement counters, reported
         # by the perf harness (VERDICT r4 missing #4: the artifacts must
         # show whether residency/pipelining engaged and where the cycle
-        # time goes: encode, route, dispatch, fetch, decode).
+        # time goes: encode, route, dispatch, fetch, decode). Every
+        # increment also lands as a span in the flight recorder's open
+        # cycle trace when one is bound (_phase).
         self.phase_s = {"encode": 0.0, "route": 0.0, "dispatch": 0.0,
                         "fetch": 0.0, "decode": 0.0}
+        self._recorder = None  # bound FlightRecorder (obs/recorder.py)
         self.counters = {"prepares": 0, "dispatches": 0, "collects": 0,
                          "resident_cycles": 0, "establishes": 0,
                          "upload_bytes": 0, "fetch_bytes": 0,
@@ -222,6 +225,20 @@ class BatchSolver:
         self._cache = cache
         if self.mesh is None and self.backend == "jit":
             cache.enable_usage_journal()
+
+    def bind_recorder(self, recorder) -> None:
+        """Attach the scheduler's FlightRecorder: phase bookkeeping
+        emits spans into the open cycle trace (no-op per span while no
+        trace is open or the recorder is disabled)."""
+        self._recorder = recorder
+
+    def _phase(self, name: str, t0: float, t1: float) -> None:
+        """One phase interval: accumulate the cumulative total (perf
+        artifacts) AND emit a flight-recorder span."""
+        self.phase_s[name] += t1 - t0
+        rec = self._recorder
+        if rec is not None:
+            rec.span(name, t0, t1 - t0)
 
     def bind_queues(self, queues) -> None:
         """Attach the queue Manager's workload delta feed: the encode
@@ -487,7 +504,7 @@ class BatchSolver:
                                             ordering=self.ordering,
                                             max_podsets=self.max_podsets)
         t1 = _t.perf_counter()
-        self.phase_s["encode"] += t1 - t0
+        self._phase("encode", t0, t1)
         if len(self.encode_samples) >= (1 << 20):
             del self.encode_samples[: 1 << 19]
         self.encode_samples.append(t1 - t0)
@@ -495,7 +512,7 @@ class BatchSolver:
             return None
         start_rank = batch.start_rank if batch.start_rank.any() else None
         fit_pred = self._route(topo, state, batch, start_rank)
-        self.phase_s["route"] += _t.perf_counter() - t1
+        self._phase("route", t1, _t.perf_counter())
         plan = Plan(topo, topo_dev, state, batch, start_rank, fit_pred)
         plan.slots = slots
         plan.deltas = deltas
@@ -794,7 +811,13 @@ class BatchSolver:
                 # scatter of the rows that changed since the last
                 # dispatch (applied to the twin by prepare_device), and
                 # gather on device.
+                t_sc = time.perf_counter()
                 arena_dev, up_nbytes = self._arena.prepare_device()
+                if self._recorder is not None:
+                    # Nested under dispatch (dotted name: excluded from
+                    # per-phase sums — it's already inside dispatch).
+                    self._recorder.span("dispatch.scatter", t_sc,
+                                        time.perf_counter() - t_sc)
                 W = batch.requests.shape[0]
                 slots_w = np.full(W, -1, np.int32)
                 slots_w[:batch.n] = plan.slots
@@ -876,7 +899,7 @@ class BatchSolver:
         inflight.fair_batch = fair_batch
         inflight.deadline_s = deadline_s
         inflight.t_dispatch = time.perf_counter()
-        self.phase_s["dispatch"] += inflight.t_dispatch - t0
+        self._phase("dispatch", t0, inflight.t_dispatch)
         return inflight
 
     def start_fetch(self, inflight: InFlight) -> None:
@@ -993,7 +1016,7 @@ class BatchSolver:
                 raise DispatchTimeout(deadline, waited)
         self._validate_fetched(plan, fetched)
         t_fetch = time.perf_counter()
-        self.phase_s["fetch"] += t_fetch - t0
+        self._phase("fetch", t0, t_fetch)
         self.counters["collects"] += 1
         self.last_fetch_bytes = sum(
             np.asarray(v).nbytes for v in fetched.values())
@@ -1013,7 +1036,7 @@ class BatchSolver:
         decisions = self._decode_batch(plan.batch.infos, snapshot, plan.topo,
                                        plan.batch, fetched,
                                        resident=resident_ok)
-        self.phase_s["decode"] += time.perf_counter() - t_fetch
+        self._phase("decode", t_fetch, time.perf_counter())
         return decisions, aux
 
     def batched_partial_admission(self, plan: Plan, snapshot: Snapshot,

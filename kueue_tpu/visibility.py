@@ -70,15 +70,36 @@ class VisibilityAPI:
                              limit: int = DEFAULT_LIMIT,
                              offset: int = 0) -> PendingWorkloadsSummary:
         """reference: pending_workloads_lq.go — the LQ view is a filtered
-        projection of its CQ's list."""
+        projection of its CQ's list. Computed in one pass over the CQ's
+        ordered infos WITHOUT materializing a PendingWorkload for every
+        unrelated LQ (the old limit=10**9 full-summary build was O(CQ
+        pending) allocations per request at the 50k-pending shape)."""
         lq_key = f"{namespace}/{lq_name}"
-        items = self.queues.local_queues.get(lq_key)
-        if items is None:
+        lq = self.queues.local_queues.get(lq_key)
+        if lq is None:
             return PendingWorkloadsSummary()
-        cq_summary = self.pending_workloads_cq(items.cluster_queue, limit=10**9)
-        filtered = [pw for pw in cq_summary.items
-                    if pw.namespace == namespace and pw.local_queue_name == lq_name]
-        return PendingWorkloadsSummary(items=filtered[offset:offset + limit])
+        infos = self.queues.pending_workloads_info(lq.cluster_queue)
+        items = []
+        lq_pos = 0
+        for idx, info in enumerate(infos):
+            obj = info.obj
+            if (obj.metadata.namespace != namespace
+                    or obj.spec.queue_name != lq_name):
+                continue
+            pos = lq_pos
+            lq_pos += 1
+            if pos < offset:
+                continue
+            if len(items) >= limit:
+                break
+            items.append(PendingWorkload(
+                name=obj.metadata.name,
+                namespace=obj.metadata.namespace,
+                local_queue_name=obj.spec.queue_name,
+                priority=prioritypkg.priority(obj),
+                position_in_cluster_queue=idx,
+                position_in_local_queue=pos))
+        return PendingWorkloadsSummary(items=items)
 
 
 class VisibilityServer:
@@ -87,34 +108,74 @@ class VisibilityServer:
     GET /apis/visibility.kueue.x-k8s.io/v1alpha1/clusterqueues/<cq>/pendingworkloads
     GET /apis/visibility.kueue.x-k8s.io/v1alpha1/namespaces/<ns>/localqueues/<lq>/pendingworkloads
     Query params: limit, offset.
+
+    With a ``debug`` surface wired (obs.DebugEndpoints — the manager's
+    ``serve_visibility`` does this), the server additionally exposes the
+    operator endpoints:
+
+    GET /metrics           Prometheus text exposition (Registry.dump)
+    GET /debug/cycles      recent flight-recorder traces (?n=K | ?slowest=K)
+    GET /debug/breaker     circuit-breaker state + next-probe backoff
+    GET /debug/router      adaptive-router regime samples/medians
+    GET /debug/arena       encode-arena slot occupancy + churn
+
+    Unknown paths are 404; malformed query parameters are 400.
     """
 
-    def __init__(self, api: VisibilityAPI, port: int = 0):
+    def __init__(self, api: VisibilityAPI, port: int = 0, debug=None):
         self.api = api
         self.port = port
+        self.debug = debug
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> int:
         api = self.api
+        debug = self.debug
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
                 pass
+
+            def _respond(self, code: int, body: bytes = b"",
+                         content_type: str = "application/json"):
+                self.send_response(code)
+                if body:
+                    self.send_header("Content-Type", content_type)
+                    self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
 
             def do_GET(self):
                 from urllib.parse import parse_qs, urlsplit
                 parsed = urlsplit(self.path)
                 path = parsed.path
                 params = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+                if debug is not None and path == "/metrics":
+                    text = debug.metrics_text()
+                    if text is None:
+                        return self._respond(404)
+                    return self._respond(200, text.encode(),
+                                         "text/plain; version=0.0.4")
+                if debug is not None and path.startswith("/debug/"):
+                    try:
+                        payload = debug.handle(path, params)
+                    except ValueError as exc:
+                        return self._respond(400, str(exc).encode(),
+                                             "text/plain")
+                    if payload is None:
+                        return self._respond(404)
+                    return self._respond(200, json.dumps(payload).encode())
                 try:
                     limit = int(params.get("limit", DEFAULT_LIMIT))
                     offset = int(params.get("offset", 0))
+                    if limit < 0 or offset < 0:
+                        raise ValueError
                 except ValueError:
-                    self.send_response(400)
-                    self.end_headers()
-                    self.wfile.write(b"limit/offset must be integers")
-                    return
+                    return self._respond(
+                        400, b"limit/offset must be non-negative integers",
+                        "text/plain")
                 parts = [p for p in path.split("/") if p]
                 summary = None
                 if (len(parts) >= 5 and parts[0] == "apis"
@@ -127,15 +188,8 @@ class VisibilityServer:
                     summary = api.pending_workloads_lq(parts[4], parts[6],
                                                        limit, offset)
                 if summary is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                body = json.dumps(asdict(summary)).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                    return self._respond(404)
+                self._respond(200, json.dumps(asdict(summary)).encode())
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self.port = self._httpd.server_address[1]

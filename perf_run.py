@@ -72,6 +72,12 @@ def run_mode(label, scale, solver, config="default"):
         # the vectorized slot gather
         "encode_p50_ms": round(result.encode_p50_ms, 3),
         "encode_p99_ms": round(result.encode_p99_ms, 3),
+        # per-cycle phase latency from the flight-recorder histograms
+        # (cycle_phase_seconds merged across routes; bucket-estimated)
+        "phase_p50_ms": {k: round(v, 3)
+                         for k, v in result.phase_p50_ms.items()},
+        "phase_p99_ms": {k: round(v, 3)
+                         for k, v in result.phase_p99_ms.items()},
     }
     print(json.dumps(out), file=sys.stderr, flush=True)
     return out

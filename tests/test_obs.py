@@ -1,0 +1,287 @@
+"""Cycle flight recorder + operator debug surface (kueue_tpu/obs).
+
+Covers: the recorder's ring/disabled-path contracts, well-formed traces
+from a full KueueManager run (the tier-1 smoke the ISSUE asks for),
+reconciliation between per-trace span sums and the cycle_phase_seconds
+histograms (acceptance criterion), solver-phase spans on the device
+route, fault annotations, and the status producers the /debug/*
+endpoints and Dumper share.
+"""
+
+import io
+import math
+
+import pytest
+
+from kueue_tpu import config as cfgpkg
+from kueue_tpu.api.meta import FakeClock
+from kueue_tpu.manager import KueueManager
+from kueue_tpu.obs import (
+    CycleTrace,
+    FlightRecorder,
+    arena_status,
+    breaker_status,
+    router_status,
+)
+
+from tests.wrappers import (
+    ClusterQueueWrapper,
+    WorkloadWrapper,
+    flavor_quotas,
+    make_flavor,
+    make_local_queue,
+)
+
+
+@pytest.fixture
+def clock():
+    return FakeClock(1000.0)
+
+
+def make_mgr(clock, solver=None, cfg=None):
+    m = KueueManager(cfg=cfg, clock=clock, solver=solver)
+    m.store.create(make_flavor("default"))
+    m.store.create(ClusterQueueWrapper("cq").resource_group(
+        flavor_quotas("default", cpu=4)).obj())
+    m.store.create(make_local_queue("lq", "default", "cq"))
+    m.run_until_idle()
+    return m
+
+
+def submit_n(mgr, n, prefix="w"):
+    for i in range(n):
+        mgr.store.create(WorkloadWrapper(f"{prefix}{i}").queue("lq")
+                         .creation(100 + i).request("cpu", "1").obj())
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = FlightRecorder(capacity=3)
+        for i in range(10):
+            tr = rec.begin_cycle(i)
+            rec.span("apply", tr.t0, 0.001)
+            rec.finish(tr)
+        traces = rec.traces()
+        assert len(traces) == 3
+        assert [t.cycle_id for t in traces] == [7, 8, 9]
+        assert rec.cycles_recorded == 10
+
+    def test_disabled_records_nothing(self):
+        rec = FlightRecorder(enabled=False)
+        assert rec.begin_cycle(1) is None
+        rec.span("encode", 0.0, 1.0)     # no open trace: no-op
+        rec.annotate("fault", "boom")
+        rec.finish(None)
+        assert rec.traces() == [] and rec.last() is None
+
+    def test_span_offsets_and_phase_sums(self):
+        rec = FlightRecorder()
+        tr = rec.begin_cycle(7)
+        rec.span("encode", tr.t0 + 0.010, 0.005)
+        rec.span("dispatch", tr.t0 + 0.015, 0.020)
+        rec.span("dispatch.scatter", tr.t0 + 0.016, 0.004)  # nested
+        rec.span("encode", tr.t0 + 0.040, 0.001)
+        rec.finish(tr)
+        sums = tr.phase_sums()
+        # nested (dotted) spans are inside their parent: not re-summed
+        assert sums == pytest.approx({"encode": 0.006, "dispatch": 0.020})
+        d = tr.to_dict()
+        assert d["cycle"] == 7
+        names = [s["name"] for s in d["spans"]]
+        assert names == ["encode", "dispatch", "dispatch.scatter", "encode"]
+        assert d["spans"][0]["start_ms"] == pytest.approx(10.0, abs=0.01)
+
+    def test_slowest_ordering(self):
+        rec = FlightRecorder()
+        durations = [0.03, 0.01, 0.05, 0.02]
+        for i, dur in enumerate(durations):
+            tr = rec.begin_cycle(i)
+            rec.finish(tr)
+            tr.duration_s = dur  # pin: finish stamps real elapsed time
+        slow = rec.slowest(2)
+        assert [t.cycle_id for t in slow] == [2, 0]
+
+    def test_unfinished_trace_discarded_on_next_begin(self):
+        rec = FlightRecorder()
+        rec.begin_cycle(1)          # never finished (cycle died)
+        tr2 = rec.begin_cycle(2)
+        rec.span("apply", tr2.t0, 0.001)
+        rec.finish(tr2)
+        assert [t.cycle_id for t in rec.traces()] == [2]
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(capacity=0)
+
+
+class TestManagerTraces:
+    """Tier-1 smoke: a full KueueManager run yields well-formed traces."""
+
+    def test_cpu_run_produces_traces(self, clock):
+        mgr = make_mgr(clock)
+        submit_n(mgr, 6)
+        mgr.schedule_until_settled()
+        traces = mgr.scheduler.recorder.traces()
+        assert traces, "no cycle traces recorded"
+        for t in traces:
+            assert t.route == "cpu-forced"  # no solver configured
+            assert t.regime in ("fit", "preempt")
+            assert t.duration_s > 0
+            assert t.admitted is not None and t.admitted >= 0
+            names = {n for n, _s, _d in t.spans}
+            assert {"snapshot", "nominate", "apply", "requeue"} <= names
+            for _name, start, dur in t.spans:
+                assert start >= 0 and dur >= 0
+                assert start + dur <= t.duration_s + 1e-6
+        assert sum(t.admitted for t in traces) == 4  # 4-cpu quota
+
+    def test_sums_reconcile_with_histograms(self, clock):
+        """Acceptance criterion: per-cycle span sums == the
+        cycle_phase_seconds histogram totals (same producer)."""
+        mgr = make_mgr(clock)
+        submit_n(mgr, 5)
+        mgr.schedule_until_settled()
+        traces = mgr.scheduler.recorder.traces()
+        want: dict = {}
+        for t in traces:
+            for phase, secs in t.phase_sums().items():
+                want[phase] = want.get(phase, 0.0) + secs
+        h = mgr.metrics.cycle_phase_seconds
+        pi = h.label_names.index("phase")
+        got: dict = {}
+        for key, (_counts, total, _n) in h.series.items():
+            got[key[pi]] = got.get(key[pi], 0.0) + total
+        assert set(got) == set(want)
+        for phase, secs in want.items():
+            assert got[phase] == pytest.approx(secs, rel=1e-9)
+
+    def test_cycle_heads_and_breaker_gauge(self, clock):
+        mgr = make_mgr(clock)
+        submit_n(mgr, 3)
+        mgr.schedule_until_settled()
+        assert mgr.metrics.cycle_heads.count(route="cpu-forced") > 0
+        assert mgr.metrics.breaker_state.value() == 0  # closed
+
+    def test_recorder_disabled_by_config(self, clock):
+        cfg = cfgpkg.Configuration()
+        cfg.observability.flight_recorder_enable = False
+        mgr = make_mgr(clock, cfg=cfg)
+        submit_n(mgr, 3)
+        mgr.schedule_until_settled()
+        assert mgr.scheduler.recorder.traces() == []
+        # admissions unaffected; histograms stay dark, the breaker
+        # gauge still updates (a metrics concern, not a tracing one)
+        assert mgr.metrics.cycle_heads.count(route="cpu-forced") == 0
+        assert mgr.metrics.breaker_state.value() == 0
+        assert math.isnan(mgr.metrics.phase_percentile("apply", 0.5))
+
+    def test_capacity_config(self, clock):
+        cfg = cfgpkg.Configuration()
+        cfg.observability.flight_recorder_capacity = 2
+        mgr = make_mgr(clock, cfg=cfg)
+        submit_n(mgr, 8)
+        mgr.schedule_until_settled()
+        assert len(mgr.scheduler.recorder.traces()) <= 2
+        assert mgr.scheduler.recorder.cycles_recorded > 2
+
+    def test_capacity_validated(self):
+        with pytest.raises(ValueError):
+            cfgpkg.load({"observability": {"flightRecorderCapacity": 0}})
+
+
+class TestSolverTraces:
+    def _solver_mgr(self, clock):
+        from kueue_tpu.solver import BatchSolver
+        cfg = cfgpkg.Configuration()
+        cfg.solver.min_heads = 0
+        cfg.solver.routing = "always"
+        cfg.solver.pipeline = False
+        return make_mgr(clock, solver=BatchSolver(), cfg=cfg)
+
+    def test_device_route_spans(self, clock):
+        mgr = self._solver_mgr(clock)
+        submit_n(mgr, 4)
+        mgr.schedule_until_settled()
+        traces = mgr.scheduler.recorder.traces()
+        dev = [t for t in traces if t.route == "device"]
+        assert dev, [t.route for t in traces]
+        names = {n for t in dev for n, _s, _d in t.spans}
+        # solver phases flow through the same trace as scheduler phases
+        assert {"encode", "route", "snapshot", "apply"} <= names
+        assert {"dispatch", "fetch", "decode"} <= names
+        # phase_s cumulative totals (perf artifacts) kept in lockstep
+        phase_s = mgr.scheduler.solver.phase_s
+        for phase in ("encode", "dispatch", "fetch", "decode"):
+            span_total = sum(d for t in traces for n, _s, d in t.spans
+                             if n == phase)
+            assert span_total == pytest.approx(phase_s[phase], rel=1e-9)
+
+    def test_fault_annotation_lands_in_trace(self, clock):
+        from kueue_tpu.resilience import faultinject
+        from kueue_tpu.resilience.faultinject import RAISE, FaultInjector
+        mgr = self._solver_mgr(clock)
+        submit_n(mgr, 3)
+        injector = FaultInjector(
+            {faultinject.SITE_DISPATCH: {0: RAISE}})
+        faultinject.install(injector)
+        try:
+            mgr.schedule_until_settled()
+        finally:
+            faultinject.uninstall()
+        faulted = [t for t in mgr.scheduler.recorder.traces() if t.faults]
+        assert faulted
+        notes = [a for t in faulted for a in t.annotations
+                 if a["kind"] == "fault"]
+        assert notes and notes[0]["site"] in ("solve", "dispatch")
+        assert "breaker" in notes[0]
+
+
+class TestStatusSurface:
+    def test_breaker_status(self, clock):
+        mgr = make_mgr(clock)
+        st = breaker_status(mgr.scheduler)
+        assert st["state"] == "closed" and st["route"] == "device"
+        assert st["next_probe_in_s"] == 0.0
+        mgr.scheduler.breaker.record_fault(clock.now())
+        mgr.scheduler.breaker.record_fault(clock.now())
+        mgr.scheduler.breaker.record_fault(clock.now())
+        st = breaker_status(mgr.scheduler)
+        assert st["state"] == "open" and st["route"] == "cpu-breaker"
+        assert st["next_probe_in_s"] > 0
+        assert st["trips"] == 1
+
+    def test_router_status(self, clock):
+        mgr = make_mgr(clock)
+        mgr.scheduler.solver_routing = "adaptive"
+        mgr.scheduler._cycle_regime = "fit"
+        mgr.scheduler._route_record("cpu", 10, 0.5)
+        mgr.scheduler._route_record("cpu", 20, 0.5)
+        rt = router_status(mgr.scheduler)
+        assert rt["routing"] == "adaptive"
+        info = rt["regimes"]["cpu/fit"]
+        assert len(info["samples"]) == 2
+        assert info["median_rate_per_s"] == pytest.approx(40.0)
+
+    def test_arena_status(self, clock):
+        from kueue_tpu.solver import BatchSolver
+        cfg = cfgpkg.Configuration()
+        cfg.solver.min_heads = 0
+        mgr = make_mgr(clock, solver=BatchSolver(), cfg=cfg)
+        submit_n(mgr, 4)
+        mgr.schedule_until_settled()
+        st = arena_status(mgr.scheduler.solver)
+        assert st["bound"] is True
+        assert st["cap"] >= st["occupied"] >= 0
+        assert st["encoded_rows"] > 0
+
+    def test_dumper_includes_solver_plane(self, clock):
+        mgr = make_mgr(clock)
+        submit_n(mgr, 3)
+        mgr.schedule_until_settled()
+        buf = io.StringIO()
+        mgr.dumper(out=buf).write()
+        text = buf.getvalue()
+        assert "-- breaker --" in text and "state=closed" in text
+        assert "-- router --" in text
+        assert "-- last cycle trace --" in text
+        assert "span snapshot" in text

@@ -79,6 +79,37 @@ class TestVisibility:
         summary = vis.pending_workloads_lq("default", "lq")
         assert [pw.position_in_local_queue for pw in summary.items] == [0, 1]
 
+    def test_local_queue_projection_and_pagination(self, mgr):
+        # A second LQ on the same CQ: the LQ view must project without
+        # materializing the other LQ's entries, and offset/limit apply
+        # to LQ positions (not CQ positions).
+        mgr.store.create(make_local_queue("lq2", "default", "cq"))
+        mgr.run_until_idle()
+        for i in range(4):
+            mgr.store.create(WorkloadWrapper(f"a{i}").queue("lq")
+                             .creation(200 + 2 * i)
+                             .request("cpu", "2").obj())
+            mgr.store.create(WorkloadWrapper(f"b{i}").queue("lq2")
+                             .creation(201 + 2 * i)
+                             .request("cpu", "2").obj())
+        mgr.schedule_until_settled()   # nothing admits: 2-cpu vs 1-cpu quota
+        vis = VisibilityAPI(mgr.queues)
+        full = vis.pending_workloads_lq("default", "lq2")
+        assert [pw.name for pw in full.items] == ["b0", "b1", "b2", "b3"]
+        assert [pw.position_in_local_queue for pw in full.items] == [0, 1, 2, 3]
+        # CQ positions are global (interleaved with lq's entries)
+        cq_names = [pw.name for pw in
+                    vis.pending_workloads_cq("cq").items]
+        for pw in full.items:
+            assert cq_names[pw.position_in_cluster_queue] == pw.name
+        page = vis.pending_workloads_lq("default", "lq2", limit=2, offset=1)
+        assert [pw.name for pw in page.items] == ["b1", "b2"]
+        assert [pw.position_in_local_queue for pw in page.items] == [1, 2]
+        # offset past the end / unknown LQ: empty, not an error
+        assert vis.pending_workloads_lq("default", "lq2",
+                                        offset=99).items == []
+        assert vis.pending_workloads_lq("default", "nope").items == []
+
     def test_http_server(self, mgr):
         submit_n(mgr, 3)
         mgr.schedule_until_settled()
@@ -92,6 +123,127 @@ class TestVisibility:
             assert body["items"][0]["name"] == "w1"
         finally:
             server.stop()
+
+
+def _get(port, path):
+    """(status, body bytes) for a GET against the local server."""
+    import urllib.error
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+class TestVisibilityHTTP:
+    """The HTTP handler's edges + the /debug operator endpoints."""
+
+    PW = "/apis/visibility.kueue.x-k8s.io/v1alpha1/clusterqueues/cq/pendingworkloads"
+
+    @pytest.fixture
+    def server(self, mgr):
+        submit_n(mgr, 4)
+        mgr.schedule_until_settled()   # w0 admits; w1..w3 pending
+        server = mgr.serve_visibility()
+        yield server
+        server.stop()
+
+    def test_pagination_edges(self, server):
+        port = server.port
+        status, body = _get(port, self.PW + "?offset=50")
+        assert status == 200 and json.loads(body)["items"] == []
+        status, body = _get(port, self.PW + "?limit=0")
+        assert status == 200 and json.loads(body)["items"] == []
+        status, body = _get(port, self.PW + "?limit=2&offset=1")
+        assert status == 200
+        assert [i["name"] for i in json.loads(body)["items"]] == ["w2", "w3"]
+
+    def test_bad_params_400(self, server):
+        assert _get(server.port, self.PW + "?limit=nope")[0] == 400
+        assert _get(server.port, self.PW + "?offset=-1")[0] == 400
+        assert _get(server.port, "/debug/cycles?slowest=abc")[0] == 400
+        assert _get(server.port, "/debug/cycles?n=-2")[0] == 400
+
+    def test_unknown_paths_404(self, server):
+        assert _get(server.port, "/nope")[0] == 404
+        assert _get(server.port, "/apis/visibility.kueue.x-k8s.io")[0] == 404
+        assert _get(server.port, "/debug/nope")[0] == 404
+
+    def test_metrics_endpoint(self, server):
+        status, body = _get(server.port, "/metrics")
+        assert status == 200
+        text = body.decode()
+        assert "kueue_admission_attempts_total" in text
+        assert "kueue_cycle_phase_seconds" in text
+        assert "kueue_solver_breaker_state" in text
+
+    def test_debug_cycles(self, server, mgr):
+        status, body = _get(server.port, "/debug/cycles")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] and payload["cycles"]
+        cyc = payload["cycles"][-1]
+        assert cyc["route"] and cyc["spans"]
+        names = {s["name"] for s in cyc["spans"]}
+        assert "snapshot" in names and "apply" in names
+        # ?slowest=K returns K cycles, slowest first
+        status, body = _get(server.port, "/debug/cycles?slowest=2")
+        payload = json.loads(body)
+        durs = [c["duration_ms"] for c in payload["cycles"]]
+        assert len(durs) <= 2 and durs == sorted(durs, reverse=True)
+        # reconcile with the histogram totals (acceptance criterion)
+        all_traces = json.loads(_get(server.port,
+                                     "/debug/cycles")[1])["cycles"]
+        span_apply_ms = sum(s["dur_ms"] for c in all_traces
+                            for s in c["spans"] if s["name"] == "apply")
+        hist_apply_ms = sum(
+            mgr.metrics.cycle_phase_seconds.sum(phase="apply", route=r)
+            for r in ("cpu-forced", "cpu", "device")) * 1e3
+        assert span_apply_ms == pytest.approx(hist_apply_ms, abs=0.01)
+
+    def test_debug_breaker_router_arena(self, server):
+        status, body = _get(server.port, "/debug/breaker")
+        assert status == 200
+        b = json.loads(body)
+        assert b["state"] == "closed" and b["route"] == "device"
+        assert "consecutive_faults" in b and "next_probe_in_s" in b
+        status, body = _get(server.port, "/debug/router")
+        assert status == 200
+        assert "regimes" in json.loads(body)
+        status, body = _get(server.port, "/debug/arena")
+        assert status == 200
+        assert json.loads(body)["bound"] is False  # no solver configured
+
+    def test_debug_404_without_wiring(self, mgr):
+        # A bare VisibilityServer (no debug surface) keeps the old
+        # behavior: /metrics and /debug/* are unknown paths.
+        server = VisibilityServer(VisibilityAPI(mgr.queues))
+        port = server.start()
+        try:
+            assert _get(port, "/metrics")[0] == 404
+            assert _get(port, "/debug/cycles")[0] == 404
+        finally:
+            server.stop()
+
+    def test_trace_dump_tool(self, server, tmp_path, capsys):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "trace_dump", os.path.join(os.path.dirname(__file__),
+                                       "..", "tools", "trace_dump.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main([f"http://127.0.0.1:{server.port}"]) == 0
+        out = capsys.readouterr().out
+        assert "flight recorder:" in out
+        assert "route=" in out and "snapshot" in out
+        # file + --slowest paths
+        payload = mod.fetch(f"http://127.0.0.1:{server.port}", slowest=1)
+        assert len(payload["cycles"]) <= 1
+        f = tmp_path / "traces.json"
+        f.write_text(json.dumps(payload))
+        assert mod.main([str(f)]) == 0
 
 
 class TestDumper:

@@ -1,0 +1,104 @@
+"""Fetch and pretty-print flight-recorder cycle traces.
+
+Pulls ``/debug/cycles`` from a running VisibilityServer (see
+``KueueManager.serve_visibility`` / kueue_tpu/obs/OBSERVABILITY.md) and
+renders each cycle as a phase timeline: one header line per cycle
+(route, regime, heads, admitted, evictions, faults, breaker state,
+duration) followed by its spans as proportional bars, nested sub-spans
+(dotted names like ``dispatch.scatter``) indented under their parent.
+
+Usage:
+    python tools/trace_dump.py http://127.0.0.1:8082 [--slowest K | --n K]
+    python tools/trace_dump.py traces.json      # a saved /debug/cycles body
+    some-cmd | python tools/trace_dump.py -     # JSON on stdin
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+BAR_WIDTH = 40
+
+
+def fetch(source: str, slowest: int = 0, n: int = 0) -> dict:
+    """Load a /debug/cycles payload from a base URL, a file, or stdin."""
+    if source == "-":
+        return json.load(sys.stdin)
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.request
+        url = source.rstrip("/")
+        if not url.endswith("/debug/cycles"):
+            url += "/debug/cycles"
+        qs = []
+        if slowest:
+            qs.append(f"slowest={slowest}")
+        elif n:
+            qs.append(f"n={n}")
+        if qs:
+            url += "?" + "&".join(qs)
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.load(resp)
+    with open(source) as f:
+        return json.load(f)
+
+
+def _bar(start_ms: float, dur_ms: float, total_ms: float) -> str:
+    if total_ms <= 0:
+        return ""
+    lo = int(BAR_WIDTH * max(0.0, start_ms) / total_ms)
+    hi = int(BAR_WIDTH * min(total_ms, start_ms + dur_ms) / total_ms)
+    hi = max(hi, lo + 1)
+    return " " * lo + "#" * (hi - lo) + " " * (BAR_WIDTH - hi)
+
+
+def render(payload: dict, out=None) -> None:
+    out = out or sys.stdout
+    cycles = payload.get("cycles", [])
+    print(f"flight recorder: enabled={payload.get('enabled')} "
+          f"capacity={payload.get('capacity')} "
+          f"recorded={payload.get('cycles_recorded')} "
+          f"showing={len(cycles)} ({payload.get('order', '')})", file=out)
+    for c in cycles:
+        print(f"\ncycle {c['cycle']}  route={c['route']} "
+              f"regime={c['regime']} heads={c['heads']} "
+              f"admitted={c['admitted']} evictions={c['evictions']} "
+              f"faults={c['faults']} breaker={c['breaker']} "
+              f"dur={c['duration_ms']:.1f}ms", file=out)
+        total = c["duration_ms"]
+        for s in sorted(c["spans"], key=lambda s: s["start_ms"]):
+            name = s["name"]
+            indent = "  " * name.count(".")
+            label = f"{indent}{name}"
+            print(f"  {label:<24} |{_bar(s['start_ms'], s['dur_ms'], total)}|"
+                  f" {s['dur_ms']:8.2f}ms @ {s['start_ms']:.2f}ms",
+                  file=out)
+        for a in c.get("annotations", []):
+            extra = {k: v for k, v in a.items()
+                     if k not in ("kind", "message")}
+            print(f"  !! {a['kind']}: {a['message']}"
+                  + (f"  {extra}" if extra else ""), file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("source",
+                    help="base URL of a VisibilityServer, a JSON file "
+                         "holding a /debug/cycles body, or - for stdin")
+    ap.add_argument("--slowest", type=int, default=0,
+                    help="show the K slowest retained cycles")
+    ap.add_argument("--n", type=int, default=0,
+                    help="show only the last K cycles")
+    args = ap.parse_args(argv)
+    try:
+        payload = fetch(args.source, slowest=args.slowest, n=args.n)
+    except Exception as exc:  # noqa: BLE001 — CLI surface
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    render(payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -640,6 +640,129 @@ def bench_trace_overhead(num_cqs=256, num_cohorts=32, spans_per_cycle=16):
     return off_pct
 
 
+def bench_overload_shed(num_cqs=256, num_cohorts=32, backlog_waves=10,
+                        storm_cycles=24, shed_heads=32, survival_heads=8):
+    """Bounded-cycle admission (kueue_tpu/resilience/degrade.py): a
+    synthetic overload storm — a deep pre-submitted backlog whose full
+    cycles blow the configured budget — must walk the ladder into
+    shed/survival, and once there the cycle p99 must stay within
+    budget x safety factor. Post-detection: the ladder can only see a
+    cycle's spend at that cycle's END, so the storm's first (normal-
+    state) cycle is the detection cost and is reported separately, not
+    asserted against the budget. Also pins: admissions keep flowing
+    while shedding, the ladder recovers to normal once load subsides,
+    and the IDLE ladder (enabled, normal, no overload) costs <=1% of a
+    cycle."""
+    import timeit
+
+    from kueue_tpu.resilience.degrade import NORMAL, DegradationLadder
+
+    flavors = ["f0"]
+    sched, cache, queues, client, clock = build_env(
+        num_cqs, num_cohorts, flavors, nominal_units=100_000)
+    n = 0
+
+    def submit_wave(cqs=num_cqs):
+        nonlocal n
+        for i in range(cqs):
+            wl = make_workload(f"w{n}", f"lq{i}", cpu_units=2,
+                               creation=float(n))
+            queues.add_or_update_workload(wl)
+            n += 1
+
+    def cycle():
+        t0 = time.perf_counter()
+        sched.schedule(timeout=0)
+        dt = time.perf_counter() - t0
+        clock.advance(1.0)
+        return dt
+
+    # Calibrate: a full-width cycle (the storm shape) vs a shed-width
+    # cycle. The budget sits well above the shed shape and below the
+    # full shape, so the storm overloads it and shedding escapes it.
+    for _ in range(2):  # warm
+        submit_wave()
+        cycle()
+    full_times = []
+    for _ in range(4):
+        submit_wave()
+        full_times.append(cycle())
+    full_p50 = p50(full_times)
+    capped_times = []
+    for _ in range(4):
+        submit_wave(shed_heads)
+        capped_times.append(cycle())
+    capped_p50 = p50(capped_times)
+    budget = capped_p50 * 3.0
+    assert full_p50 > budget, (
+        "overload premise failed: full cycle "
+        f"{full_p50 * 1e3:.2f}ms <= budget {budget * 1e3:.2f}ms")
+
+    # Idle-ladder overhead: enabled, normal state, healthy cycles — the
+    # per-cycle cost is the head-cap check + one EWMA observation.
+    idle = DegradationLadder(budget_s=60.0)
+    idle.observe_cycle(0.001, backlog=5)
+    per_idle_s = timeit.timeit(
+        lambda: (idle.head_cap(), idle.defer_preemption,
+                 idle.observe_cycle(0.001, backlog=5)),
+        number=200_000) / 200_000
+    idle_pct = 100.0 * per_idle_s / max(capped_p50, 1e-9)
+    assert idle_pct <= 1.0, (idle_pct, capped_p50)
+
+    # The storm: a deep backlog, every full cycle over budget.
+    sched.ladder = DegradationLadder(
+        budget_s=budget, shed_heads=shed_heads,
+        survival_heads=survival_heads, escalate_after=1,
+        recovery_cycles=3, ewma_alpha=1.0)
+    for _ in range(backlog_waves):
+        submit_wave()
+    admitted_before = client.admitted
+    storm_times = []   # (seconds, ladder rung the cycle RAN under)
+    for _ in range(storm_cycles):
+        dt = cycle()
+        storm_times.append((dt, sched._cycle_degraded))
+    degraded = [t for t, rung in storm_times if rung != NORMAL]
+    detection = [t for t, rung in storm_times if rung == NORMAL]
+    assert degraded, "the ladder never engaged under the storm"
+    shed_p99 = p99(degraded)
+    safety = 2.0
+    assert shed_p99 <= budget * safety, (
+        f"shed cycle p99 {shed_p99 * 1e3:.2f}ms exceeded budget "
+        f"{budget * 1e3:.2f}ms x {safety}")
+    # load shedding bounds latency, it does not stop admissions
+    assert client.admitted > admitted_before
+    assert sched.shed_heads_requeued > 0
+
+    # Load subsides: trickled small waves keep the ladder observing;
+    # it must walk back to normal within the hysteresis bound.
+    recovery_cycles = -1
+    for c in range(24):
+        submit_wave(survival_heads)
+        cycle()
+        if sched.ladder.state == NORMAL:
+            recovery_cycles = c + 1
+            break
+    assert recovery_cycles > 0, "ladder did not recover after the storm"
+
+    log({"bench": "overload_shed", "cqs": num_cqs,
+         "budget_ms": round(budget * 1e3, 2),
+         "full_cycle_p50_ms": round(full_p50 * 1e3, 2),
+         "capped_cycle_p50_ms": round(capped_p50 * 1e3, 2),
+         "storm_cycles": storm_cycles,
+         "detection_cycles": len(detection),
+         "detection_p50_ms": round(p50(detection) * 1e3, 2) if detection
+         else None,
+         "shed_cycle_p99_ms": round(shed_p99 * 1e3, 2),
+         "budget_x_safety_ms": round(budget * safety * 1e3, 2),
+         "cycles_shed": sched.ladder.cycles_shed,
+         "escalations": sched.ladder.escalations,
+         "shed_heads_requeued": sched.shed_heads_requeued,
+         "recovery_cycles": recovery_cycles,
+         "idle_ladder_ns": round(per_idle_s * 1e9, 1),
+         "idle_overhead_pct": round(idle_pct, 4)})
+    return shed_p99
+
+
 def bench_e2e_progressive():
     """The flagship scenario (BASELINE.json north star): 2048 CQs x 32
     flavors with workloads sized to a full flavor, so cycle N assigns at
@@ -1069,6 +1192,7 @@ def main():
     arena_speedup = bench_workload_arena()
     bench_device_fault_recovery()
     bench_trace_overhead()
+    bench_overload_shed()
     rows = {}
     admitted_per_sec, speedup = bench_e2e_progressive()
     rows["progressive_fill"] = speedup

@@ -141,6 +141,47 @@ class LeaderElectionConfig:
 
 DEFAULT_STRICT_AFTER_BLOCKED_CYCLES = 8
 
+# Cycle deadline budget / degradation ladder defaults: the ladder
+# module owns them (a directly-constructed DegradationLadder and a
+# config-driven one must never disagree); only the budget default —
+# pure config policy, 0 disables — lives here.
+from kueue_tpu.resilience.degrade import (  # noqa: E402
+    DEFAULT_ESCALATE_AFTER as DEFAULT_ESCALATE_AFTER_CYCLES,
+    DEFAULT_ENTER_FACTOR as DEFAULT_OVERLOAD_ENTER_FACTOR,
+    DEFAULT_EWMA_ALPHA as DEFAULT_CYCLE_EWMA_ALPHA,
+    DEFAULT_EXIT_FACTOR as DEFAULT_OVERLOAD_EXIT_FACTOR,
+    DEFAULT_RECOVERY_CYCLES,
+    DEFAULT_SHED_HEADS,
+    DEFAULT_SURVIVAL_HEADS,
+)
+
+DEFAULT_CYCLE_BUDGET_S = 0.0        # 0 disables the ladder
+
+
+@dataclass
+class SchedulerConfig:
+    """Admission-cycle bounding (kueue_tpu/resilience/degrade.py; no
+    reference analogue): a wall-clock budget per cycle and the
+    graceful load-shedding ladder engaged when sustained load exceeds
+    it. ``cycle_budget_s == 0`` disables the ladder entirely."""
+    cycle_budget_s: float = DEFAULT_CYCLE_BUDGET_S
+    # shed: cap nominate heads at this many (extras re-heap untouched)
+    # and defer preempt planning
+    shed_heads: int = DEFAULT_SHED_HEADS
+    # survival: tighter top-k cap, cycle pinned to the CPU-incremental
+    # route ("cpu-survival")
+    survival_heads: int = DEFAULT_SURVIVAL_HEADS
+    # hysteresis band: degrade when cycle-time EWMA > budget x enter,
+    # recover only below budget x exit (exit <= enter)
+    overload_enter_factor: float = DEFAULT_OVERLOAD_ENTER_FACTOR
+    overload_exit_factor: float = DEFAULT_OVERLOAD_EXIT_FACTOR
+    # consecutive overloaded cycles before stepping a rung up / healthy
+    # cycles before stepping one down
+    escalate_after_cycles: int = DEFAULT_ESCALATE_AFTER_CYCLES
+    recovery_cycles: int = DEFAULT_RECOVERY_CYCLES
+    cycle_ewma_alpha: float = DEFAULT_CYCLE_EWMA_ALPHA
+
+
 # Cycle flight recorder defaults (kueue_tpu/obs/OBSERVABILITY.md).
 DEFAULT_FLIGHT_RECORDER_CAPACITY = 256
 
@@ -201,6 +242,11 @@ class SolverConfig:
     watchdog_safety_factor: float = DEFAULT_WATCHDOG_SAFETY_FACTOR
     watchdog_min_deadline_s: float = DEFAULT_WATCHDOG_MIN_DEADLINE_S
     watchdog_max_deadline_s: float = DEFAULT_WATCHDOG_MAX_DEADLINE_S
+    # Supervised dispatch (resilience/supervisor.py): run the dispatch
+    # body (trace/compile/transfer) on a persistent worker thread under
+    # the watchdog deadline, so a hang DURING dispatch is abandoned
+    # instead of freezing the scheduler. Off = dispatch runs inline.
+    supervise_dispatch: bool = True
     # Breaker: this many CONSECUTIVE device faults pin cycles to the
     # CPU fallback (route "cpu-breaker") until a half-open probe — after
     # exponential backoff from base to max, with jitter — succeeds.
@@ -224,6 +270,7 @@ class Configuration:
     fair_sharing: FairSharingConfig = field(default_factory=FairSharingConfig)
     multi_kueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     resources: Resources = field(default_factory=Resources)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     solver: SolverConfig = field(default_factory=SolverConfig)
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
@@ -304,6 +351,21 @@ def validate(cfg: Configuration) -> list[str]:
                     "max >= base")
     if cfg.observability.flight_recorder_capacity < 1:
         errs.append("observability.flightRecorderCapacity must be >= 1")
+    sc = cfg.scheduler
+    if sc.cycle_budget_s < 0:
+        errs.append("scheduler.cycleBudget must be >= 0 (0 disables "
+                    "the degradation ladder)")
+    if sc.shed_heads < 1 or sc.survival_heads < 1:
+        errs.append("scheduler.shedHeads and scheduler.survivalHeads "
+                    "must be >= 1")
+    if not 0 < sc.overload_exit_factor <= sc.overload_enter_factor:
+        errs.append("scheduler.overloadExitFactor must be in (0, "
+                    "overloadEnterFactor] (the hysteresis band)")
+    if sc.escalate_after_cycles < 1 or sc.recovery_cycles < 1:
+        errs.append("scheduler.escalateAfterCycles and "
+                    "scheduler.recoveryCycles must be >= 1")
+    if not 0 < sc.cycle_ewma_alpha <= 1:
+        errs.append("scheduler.cycleEwmaAlpha must be in (0, 1]")
     return errs
 
 
@@ -380,6 +442,23 @@ def load(raw: dict) -> Configuration:
     if "resources" in raw:
         cfg.resources = Resources(
             exclude_resource_prefixes=raw["resources"].get("excludeResourcePrefixes", []))
+    if "scheduler" in raw:
+        sc = raw["scheduler"]
+        cfg.scheduler = SchedulerConfig(
+            cycle_budget_s=sc.get("cycleBudget", DEFAULT_CYCLE_BUDGET_S),
+            shed_heads=sc.get("shedHeads", DEFAULT_SHED_HEADS),
+            survival_heads=sc.get("survivalHeads", DEFAULT_SURVIVAL_HEADS),
+            overload_enter_factor=sc.get(
+                "overloadEnterFactor", DEFAULT_OVERLOAD_ENTER_FACTOR),
+            overload_exit_factor=sc.get(
+                "overloadExitFactor", DEFAULT_OVERLOAD_EXIT_FACTOR),
+            escalate_after_cycles=sc.get(
+                "escalateAfterCycles", DEFAULT_ESCALATE_AFTER_CYCLES),
+            recovery_cycles=sc.get("recoveryCycles",
+                                   DEFAULT_RECOVERY_CYCLES),
+            cycle_ewma_alpha=sc.get("cycleEwmaAlpha",
+                                    DEFAULT_CYCLE_EWMA_ALPHA),
+        )
     if "solver" in raw:
         s = raw["solver"]
         cfg.solver = SolverConfig(
@@ -406,6 +485,7 @@ def load(raw: dict) -> Configuration:
                 "breakerBackoffBase", DEFAULT_BREAKER_BACKOFF_BASE_S),
             breaker_backoff_max_s=s.get(
                 "breakerBackoffMax", DEFAULT_BREAKER_BACKOFF_MAX_S),
+            supervise_dispatch=s.get("superviseDispatch", True),
         )
     if "observability" in raw:
         o = raw["observability"]

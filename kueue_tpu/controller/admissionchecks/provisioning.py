@@ -17,6 +17,8 @@ pkg/controller/admissionchecks/provisioning/controller.go:139-608:
 
 from __future__ import annotations
 
+import hashlib
+import struct
 from typing import Optional
 
 from kueue_tpu.api import autoscaling as asapi
@@ -30,6 +32,7 @@ CONSUME_ANNOTATION = "autoscaling.x-k8s.io/consume-provisioning-request"
 CLASS_NAME_ANNOTATION = "autoscaling.x-k8s.io/provisioning-class-name"
 DEFAULT_MAX_RETRIES = 3
 DEFAULT_MIN_BACKOFF_SECONDS = 60.0
+DEFAULT_BACKOFF_JITTER = 0.2
 
 
 def request_name(wl_name: str, check_name: str, attempt: int) -> str:
@@ -37,15 +40,47 @@ def request_name(wl_name: str, check_name: str, attempt: int) -> str:
     return base if attempt <= 1 else f"{base}-attempt{attempt}"
 
 
+def _jitter_fraction(seed: int, key: str) -> float:
+    """Deterministic per-key jitter in [0, 1): a keyed hash, NOT a
+    shared RNG stream — the backoff is recomputed on every reconcile,
+    so the fraction must be stable for a given (workload, check,
+    attempt) while differing across workloads. Python's builtin hash is
+    salted per process; blake2b is stable across runs, so fake-clock
+    tests stay reproducible."""
+    salt = (seed & (2**64 - 1)).to_bytes(8, "little")  # any int seed
+    digest = hashlib.blake2b(key.encode(), digest_size=8,
+                             salt=salt).digest()
+    return struct.unpack("<Q", digest)[0] / 2**64
+
+
 class ProvisioningController:
     def __init__(self, store: Store, recorder, clock,
                  max_retries: int = DEFAULT_MAX_RETRIES,
-                 min_backoff_seconds: float = DEFAULT_MIN_BACKOFF_SECONDS):
+                 min_backoff_seconds: float = DEFAULT_MIN_BACKOFF_SECONDS,
+                 backoff_jitter: float = DEFAULT_BACKOFF_JITTER,
+                 jitter_seed: int = 0):
         self.store = store
         self.recorder = recorder
         self.clock = clock
         self.max_retries = max_retries
         self.min_backoff_seconds = min_backoff_seconds
+        # Retry-storm de-synchronization: workloads that failed together
+        # (one capacity outage fails a whole wave of ProvReqs at the
+        # same transition time) must not all retry at the same instant.
+        # Each (workload, check, attempt) gets a stable multiplicative
+        # jitter in [1, 1 + backoff_jitter); 0 restores the pure
+        # base * 2^(attempt-1) schedule.
+        self.backoff_jitter = backoff_jitter
+        self.jitter_seed = jitter_seed
+
+    def _backoff_seconds(self, wl_name: str, check_name: str,
+                         attempt: int) -> float:
+        backoff = self.min_backoff_seconds * 2 ** (attempt - 1)
+        if self.backoff_jitter > 0:
+            frac = _jitter_fraction(self.jitter_seed,
+                                    f"{wl_name}/{check_name}/{attempt}")
+            backoff *= 1.0 + self.backoff_jitter * frac
+        return backoff
 
     # -- discovery ------------------------------------------------------
 
@@ -136,8 +171,11 @@ class ProvisioningController:
         if failed is not None and failed.status == "True":
             if attempt <= self.max_retries:
                 # exponential backoff before the next attempt
-                # (reference: remainingTimeToRetry :317-335)
-                backoff = self.min_backoff_seconds * 2 ** (attempt - 1)
+                # (reference: remainingTimeToRetry :317-335), with
+                # seeded per-workload jitter so a wave that failed
+                # together doesn't retry in lockstep
+                backoff = self._backoff_seconds(wl.metadata.name,
+                                                check_name, attempt)
                 elapsed = now - failed.last_transition_time
                 remaining = backoff - elapsed
                 if remaining > 0:

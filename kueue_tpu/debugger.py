@@ -49,7 +49,7 @@ class Dumper:
 
     def _dump_solver_plane(self) -> list:
         from kueue_tpu.obs import (arena_status, breaker_status,
-                                   router_status)
+                                   degrade_status, router_status)
         sched = self.scheduler
         lines = ["-- breaker --"]
         st = breaker_status(sched)
@@ -59,6 +59,15 @@ class Dumper:
                      f"recoveries={st['recoveries']} "
                      f"next_probe_in_s={st['next_probe_in_s']} "
                      f"backoff_s={st['backoff_s']}")
+        lines.append("-- degrade --")
+        dg = degrade_status(sched)
+        lines.append(f"state={dg['state']} enabled={dg['enabled']} "
+                     f"budget_ms={dg['budget_ms']} ewma_ms={dg['ewma_ms']} "
+                     f"cycles_shed={dg['cycles_shed']} "
+                     f"escalations={dg['escalations']} "
+                     f"recoveries={dg['recoveries']} "
+                     f"heads_requeued={dg['shed_heads_requeued_total']} "
+                     f"preempts_deferred={dg['preempt_plans_deferred_total']}")
         lines.append("-- router --")
         rt = router_status(sched)
         lines.append(f"routing={rt['routing']} "

@@ -89,7 +89,10 @@ class KueueManager:
         self.store = store if store is not None else Store(clock)
         self.recorder = EventRecorder()
         self.metrics = Registry()
-        self.runtime = Runtime(clock)
+        # metrics: every reconcile lands in reconcile_seconds{controller}
+        # (the coarse latency signal for the wall_s - cycle_time_total
+        # gap in the perf artifacts).
+        self.runtime = Runtime(clock, metrics=self.metrics)
 
         w = self.cfg.wait_for_pods_ready
         ordering = wlpkg.Ordering(
@@ -156,6 +159,23 @@ class KueueManager:
             solver_min_heads=self.cfg.solver.min_heads,
             recorder=self.flight_recorder)
         self.visibility_server = None  # started by serve_visibility()
+        # Cycle deadline budget (kueue_tpu/resilience/degrade.py): with
+        # scheduler.cycleBudget > 0 the degradation ladder watches every
+        # cycle's wall seconds and sheds load (head caps, deferred
+        # preempt planning, the cpu-survival route) under sustained
+        # overload. Engine-agnostic — wired with or without a solver.
+        sc = self.cfg.scheduler
+        if sc.cycle_budget_s > 0:
+            from kueue_tpu.resilience.degrade import DegradationLadder
+            self.scheduler.ladder = DegradationLadder(
+                budget_s=sc.cycle_budget_s,
+                shed_heads=sc.shed_heads,
+                survival_heads=sc.survival_heads,
+                enter_factor=sc.overload_enter_factor,
+                exit_factor=sc.overload_exit_factor,
+                escalate_after=sc.escalate_after_cycles,
+                recovery_cycles=sc.recovery_cycles,
+                ewma_alpha=sc.cycle_ewma_alpha)
         if solver is not None:
             # Production solver wiring: pipelined dispatch + adaptive
             # engine routing + the persistent compilation cache.
@@ -178,15 +198,27 @@ class KueueManager:
                 threshold=s.breaker_fault_threshold,
                 backoff_base_s=s.breaker_backoff_base_s,
                 backoff_max_s=s.breaker_backoff_max_s)
-            self.scheduler.on_fault = (
-                lambda kind, msg: self.recorder.system_event(
-                    "Warning" if kind != "breaker-closed" else "Normal",
-                    {"fault": "DeviceFault",
-                     "breaker-open": "BreakerOpen",
-                     "breaker-closed": "BreakerClosed"}.get(kind, kind),
-                    msg))
+            if hasattr(solver, "supervise_dispatch"):
+                # Supervised dispatch: the trace/compile half of the
+                # round trip carries the watchdog deadline too.
+                solver.supervise_dispatch = s.supervise_dispatch
             from kueue_tpu.utils.runtime import enable_compilation_cache
             enable_compilation_cache()
+        # Fault/breaker/degrade transitions land as Scheduler system
+        # events — the outage + degraded-mode timeline in the artifacts.
+        # Wired with or without a solver: the degradation ladder watches
+        # the CPU path too.
+        self.scheduler.on_fault = (
+            lambda kind, msg: self.recorder.system_event(
+                "Normal" if kind in ("breaker-closed", "degrade-recovered")
+                else "Warning",
+                {"fault": "DeviceFault",
+                 "breaker-open": "BreakerOpen",
+                 "breaker-closed": "BreakerClosed",
+                 "degrade": "DegradedMode",
+                 "degrade-recovered": "DegradedModeRecovered",
+                 }.get(kind, kind),
+                msg))
 
         # QueueVisibility top-N snapshot cron (reference:
         # clusterqueue_controller.go:553+ — a timed task per CQ on the

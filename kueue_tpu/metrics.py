@@ -72,6 +72,12 @@ _HEADS_BUCKETS = [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096]
 # breaker_state gauge encoding (resilience.breaker state names)
 BREAKER_STATE_CODES = {"closed": 0, "half-open": 1, "open": 2}
 
+# degraded_state gauge encoding: the ladder owns the mapping (a rung
+# added there must never silently report -1 here)
+from kueue_tpu.resilience.degrade import (  # noqa: E402
+    STATE_CODES as DEGRADED_STATE_CODES,
+)
+
 
 class _Metric:
     def __init__(self, name: str, help_: str, label_names: Sequence[str]):
@@ -286,6 +292,27 @@ class Registry:
         self.breaker_state = Gauge(
             "kueue_solver_breaker_state",
             "Circuit-breaker state (0=closed, 1=half-open, 2=open)")
+        # Bounded-cycle admission (kueue_tpu/resilience/degrade.py +
+        # supervisor.py): degradation-ladder state, shed cycles, and
+        # dispatches abandoned by the supervised worker deadline.
+        self.degraded_state = Gauge(
+            "kueue_scheduler_degraded_state",
+            "Degradation-ladder state (0=normal, 1=shed, 2=survival)")
+        self.cycles_shed_total = Counter(
+            "kueue_scheduler_cycles_shed_total",
+            "Admission cycles run in a degraded state (label state: "
+            "shed|survival)", ["state"])
+        self.dispatch_supervised_timeouts_total = Counter(
+            "kueue_solver_dispatch_supervised_timeouts_total",
+            "Dispatches abandoned by the supervised solver-worker "
+            "deadline (hang during trace/compile/transfer)")
+        # Coarse reconciler latency (ROADMAP PR-4 follow-up: the
+        # wall_s - cycle_time_total gap had no signal); fed by the sim
+        # Runtime around every reconcile call.
+        self.reconcile_seconds = Histogram(
+            "kueue_reconcile_seconds",
+            "Reconcile latency by controller", ["controller"],
+            buckets=_PHASE_BUCKETS)
         self._all = [v for v in vars(self).values() if isinstance(v, _Metric)]
 
     # --- report helpers (reference: metrics.go:262-400) ---
@@ -317,15 +344,27 @@ class Registry:
         self.admission_cycle_preemption_skips.set(count, cluster_queue=cq)
 
     def device_fault(self, site: str, timeout: bool = False,
-                     tripped: bool = False) -> None:
+                     tripped: bool = False,
+                     supervised: bool = False) -> None:
         self.device_faults_total.inc(site=site)
         if timeout:
             self.dispatch_timeouts_total.inc()
+        if supervised:
+            self.dispatch_supervised_timeouts_total.inc()
         if tripped:
             self.breaker_trips_total.inc()
 
     def fault_recovered(self, cycles: int) -> None:
         self.fault_recovery_cycles.set(cycles)
+
+    def set_degraded_state(self, state: str) -> None:
+        self.degraded_state.set(DEGRADED_STATE_CODES.get(state, -1))
+
+    def cycle_shed(self, state: str) -> None:
+        self.cycles_shed_total.inc(state=state)
+
+    def reconcile_observed(self, controller: str, seconds: float) -> None:
+        self.reconcile_seconds.observe(seconds, controller=controller)
 
     def cycle_observed(self, route: str, heads: int,
                        phase_sums: dict) -> None:

@@ -10,6 +10,7 @@ from kueue_tpu.obs.status import (
     DebugEndpoints,
     arena_status,
     breaker_status,
+    degrade_status,
     router_status,
 )
 
@@ -20,5 +21,6 @@ __all__ = [
     "DebugEndpoints",
     "arena_status",
     "breaker_status",
+    "degrade_status",
     "router_status",
 ]

@@ -2,7 +2,8 @@
 
 Every scheduler cycle produces a structured ``CycleTrace`` — route mode
 (device / device-pipelined / cpu / cpu-forced / cpu-strict /
-cpu-breaker / drain), regime, head/admit/evict counts, fault and
+cpu-breaker / cpu-survival / drain), regime, degradation-ladder rung,
+head/admit/evict counts, fault and
 breaker annotations, and the cycle's phase spans (snapshot, encode,
 route, dispatch, fetch, decode, preempt-plan, apply, requeue, plus
 nested sub-spans like ``dispatch.scatter``) — held in a bounded ring
@@ -39,7 +40,7 @@ class CycleTrace:
 
     __slots__ = ("cycle_id", "t_wall", "t0", "duration_s", "route",
                  "regime", "heads", "admitted", "evictions", "faults",
-                 "breaker", "spans", "annotations")
+                 "breaker", "degraded", "spans", "annotations")
 
     def __init__(self, cycle_id: int, t_wall: float, t0: float):
         self.cycle_id = cycle_id
@@ -53,6 +54,7 @@ class CycleTrace:
         self.evictions = 0
         self.faults = 0
         self.breaker = ""
+        self.degraded = ""            # ladder rung the cycle ran under
         self.spans: list = []         # (name, start_s, dur_s)
         self.annotations: list = []   # dicts: {"kind", "message", ...}
 
@@ -78,6 +80,7 @@ class CycleTrace:
             "evictions": self.evictions,
             "faults": self.faults,
             "breaker": self.breaker,
+            "degraded": self.degraded,
             "spans": [{"name": n, "start_ms": round(s * 1e3, 3),
                        "dur_ms": round(d * 1e3, 3)}
                       for n, s, d in self.spans],

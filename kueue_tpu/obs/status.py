@@ -22,6 +22,26 @@ def breaker_status(scheduler) -> dict:
         else max(0.0, round(st["retry_at"] - scheduler.clock.now(), 3)))
     st["solver_faults_total"] = scheduler.solver_faults
     st["cpu_breaker_cycles"] = scheduler.cycle_counts.get("cpu-breaker", 0)
+    solver = scheduler.solver
+    if solver is not None and hasattr(solver, "_supervisor"):
+        # Supervised-dispatch worker (resilience/supervisor.py): how
+        # many dispatches were handed off / abandoned.
+        st["supervised_dispatch"] = solver._supervisor.status()
+        st["supervised_timeouts"] = solver.counters.get(
+            "supervised_timeouts", 0)
+    return st
+
+
+def degrade_status(scheduler) -> dict:
+    """Degradation-ladder state for operators (/debug/degrade): the
+    rung, cycle-time EWMA vs budget, hysteresis/recovery knobs, and the
+    shed bookkeeping — the SAME producer the flight-recorder
+    annotations and the degraded_state gauge are fed from, so every
+    consumer shows the same numbers."""
+    st = scheduler.ladder.status()
+    st["shed_heads_requeued_total"] = scheduler.shed_heads_requeued
+    st["preempt_plans_deferred_total"] = scheduler.preempt_plans_deferred
+    st["survival_cycles"] = scheduler.cycle_counts.get("cpu-survival", 0)
     return st
 
 
@@ -93,6 +113,8 @@ class DebugEndpoints:
             return self._cycles(params)
         if path == "/debug/breaker":
             return breaker_status(self.scheduler)
+        if path == "/debug/degrade":
+            return degrade_status(self.scheduler)
         if path == "/debug/router":
             return router_status(self.scheduler)
         if path == "/debug/arena":

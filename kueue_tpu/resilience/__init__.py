@@ -1,6 +1,7 @@
-"""Device-fault containment for the solver hot path (RESILIENCE.md).
+"""Device-fault containment and graceful degradation for the solver
+hot path (RESILIENCE.md).
 
-Three cooperating pieces:
+Five cooperating pieces:
 
 - faultinject: seedable, scripted fault injection at named sites
   wrapping device dispatch, in-flight collect, the resident-arena
@@ -8,10 +9,16 @@ Three cooperating pieces:
 - watchdog: per-dispatch deadlines derived from the router's
   regime-keyed rate estimates x a safety factor; a timed-out collect
   abandons the in-flight result instead of blocking the cycle forever.
+- supervisor: dispatch (trace/compile/transfer) runs on a persistent
+  supervised worker under the same watchdog deadline; a hang during
+  dispatch is abandoned instead of freezing the scheduler.
 - breaker: a circuit breaker fed by watchdog timeouts and dispatch
   exceptions; N consecutive faults pin cycles to the CPU fallback
   (route "cpu-breaker") until a half-open probe with exponential
   backoff + jitter re-admits the device path.
+- degrade: the cycle-budget degradation ladder (normal -> shed ->
+  survival) — bounds the cycle when the LOAD, not the device, exceeds
+  what the configured budget allows.
 """
 
 from kueue_tpu.resilience.breaker import (  # noqa: F401
@@ -19,6 +26,12 @@ from kueue_tpu.resilience.breaker import (  # noqa: F401
     HALF_OPEN,
     OPEN,
     CircuitBreaker,
+)
+from kueue_tpu.resilience.degrade import (  # noqa: F401
+    NORMAL,
+    SHED,
+    SURVIVAL,
+    DegradationLadder,
 )
 from kueue_tpu.resilience.faultinject import (  # noqa: F401
     DeviceFault,
@@ -29,6 +42,10 @@ from kueue_tpu.resilience.faultinject import (  # noqa: F401
     SITE_REPLAY,
     SITE_SCATTER,
     SITES,
+)
+from kueue_tpu.resilience.supervisor import (  # noqa: F401
+    SupervisedTimeout,
+    SupervisedWorker,
 )
 from kueue_tpu.resilience.watchdog import (  # noqa: F401
     DispatchTimeout,

@@ -1,0 +1,187 @@
+"""Supervised dispatch: the solver-worker thread that bounds trace/compile.
+
+PR 3's watchdog bounded the COLLECT half of the device round trip (the
+``device_get`` wait), but dispatch itself — tracing, compilation, the
+argument transfer inside the kernel call — still ran inline on the
+scheduler thread, so a device that wedges *during dispatch* (the
+``hang`` action at the ``device_dispatch`` fault site, or a real dead
+tunnel surfacing inside XLA) froze the scheduler forever. This module
+closes that last unbounded path: dispatch runs on a persistent
+supervised worker thread, and the scheduler waits for the hand-off with
+the same regime-keyed watchdog deadline the collect already uses (the
+cold-cycle clamp absorbs legitimate multi-second compiles).
+
+A late dispatch is ABANDONED, exactly like a late collect: Python
+cannot cancel a blocked device call, only stop waiting for it, so the
+worker is orphaned (a poison pill makes it exit its loop once the
+wedged call eventually returns or dies), a fresh worker is spawned
+lazily for the next dispatch, ``DispatchTimeout`` propagates to the
+scheduler's existing device-fault handler — residency invalidated,
+heads requeued, fault fed to the circuit breaker — and the cycle
+*completes*.
+
+The worker threads are daemons on purpose: an orphan stuck in a dead
+device call must never block interpreter shutdown (a
+``ThreadPoolExecutor`` worker would — its atexit hook joins non-daemon
+threads).
+"""
+
+from __future__ import annotations
+
+import atexit
+import queue
+import threading
+import time
+import weakref
+from typing import Optional
+
+from kueue_tpu.resilience.watchdog import DispatchTimeout
+
+
+class SupervisedTimeout(DispatchTimeout):
+    """The supervised hand-off missed its deadline (a hang INSIDE the
+    dispatch body — trace/compile/transfer). A distinct type from the
+    collect-side DispatchTimeout so the scheduler's metrics can
+    attribute the timeout to the right half of the round trip."""
+
+
+# Live workers, drained at interpreter exit: a daemon thread that ran
+# device work (XLA holds C++ thread state) must not be torn down while
+# parked, or the runtime's teardown can abort with "terminate called
+# without an active exception". Parked workers wake on the poison pill
+# and join promptly; a genuinely wedged orphan times out and stays a
+# daemon (nothing can join a dead device call).
+_live_workers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+@atexit.register
+def _drain_workers_at_exit() -> None:
+    for w in list(_live_workers):
+        w.close(join_timeout=1.0)
+
+
+class _Request:
+    __slots__ = ("fn", "args", "kwargs", "done", "result", "exc")
+
+    def __init__(self, fn, args, kwargs):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.done = threading.Event()
+        self.result = None
+        self.exc: Optional[BaseException] = None
+
+
+class SupervisedWorker:
+    """A persistent daemon worker thread with a bounded hand-off.
+
+    ``run(fn, *args, deadline_s=...)`` executes ``fn`` on the worker and
+    waits at most ``deadline_s`` for it; a miss raises
+    ``DispatchTimeout`` and orphans the worker (``orphaned`` counts
+    them). The thread is REUSED across calls — spawning per dispatch
+    would add thread start-up latency to every cycle; the only time a
+    new thread is minted is after an abandonment (or lazily on first
+    use). Exceptions raised by ``fn`` (injected faults, XLA errors)
+    propagate to the caller unchanged. ``deadline_s=None`` runs ``fn``
+    inline — supervision off is zero-thread, zero-cost.
+
+    Single-supervisor contract: one caller thread at a time (the
+    scheduler); the worker processes one request at a time.
+    """
+
+    def __init__(self, name: str = "supervised-worker"):
+        self.name = name
+        self._queue: Optional[queue.SimpleQueue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.timeouts = 0   # bounded waits that expired
+        self.orphaned = 0   # workers abandoned mid-call
+        self.calls = 0      # supervised calls handed off
+        self._orphans: list = []  # abandoned threads, pruned when dead
+        _live_workers.add(self)
+
+    @staticmethod
+    def _loop(q: "queue.SimpleQueue") -> None:
+        while True:
+            req = q.get()
+            if req is None:  # poison pill: this worker was abandoned
+                return
+            try:
+                req.result = req.fn(*req.args, **req.kwargs)
+            except BaseException as exc:  # noqa: BLE001 — relayed to caller
+                req.exc = exc
+            req.done.set()
+
+    def _ensure_worker(self) -> "queue.SimpleQueue":
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._queue = queue.SimpleQueue()
+                self._thread = threading.Thread(
+                    target=self._loop, args=(self._queue,), daemon=True,
+                    name=self.name)
+                self._thread.start()
+            return self._queue
+
+    def run(self, fn, *args, deadline_s: Optional[float] = None, **kwargs):
+        """Run ``fn(*args, **kwargs)`` under supervision. Raises
+        ``DispatchTimeout`` after ``deadline_s`` seconds; re-raises
+        whatever ``fn`` raised otherwise."""
+        if deadline_s is None:
+            return fn(*args, **kwargs)
+        q = self._ensure_worker()
+        req = _Request(fn, args, kwargs)
+        t0 = time.perf_counter()
+        q.put(req)
+        self.calls += 1
+        if not req.done.wait(timeout=deadline_s):
+            self._abandon()
+            self.timeouts += 1
+            raise SupervisedTimeout(deadline_s, time.perf_counter() - t0)
+        if req.exc is not None:
+            raise req.exc
+        return req.result
+
+    def _abandon(self) -> None:
+        """Stop feeding the wedged worker; it exits its loop when (if)
+        the stuck call ever returns. The next ``run`` mints a fresh
+        worker so it is never queued behind the wedged call. The orphan
+        stays tracked so ``close()`` can wait for stragglers at
+        interpreter exit (an orphan mid-compile torn down with the
+        runtime aborts the process)."""
+        with self._lock:
+            if self._queue is not None:
+                self._queue.put(None)
+            if self._thread is not None:
+                self._orphans.append(self._thread)
+            self._orphans = [t for t in self._orphans if t.is_alive()]
+            self._thread = None
+            self._queue = None
+            self.orphaned += 1
+
+    def stop(self) -> None:
+        """Shut the (idle) worker down cleanly. Safe to call repeatedly;
+        a worker mid-call drains its request first."""
+        self.close(join_timeout=0.0)
+
+    def close(self, join_timeout: float = 1.0) -> None:
+        """stop(), then wait up to ``join_timeout`` (per thread) for
+        the worker AND any orphans to exit — used at interpreter
+        shutdown so no thread is torn down mid-device-call (XLA aborts
+        the process if its C++ state unwinds under a live compile). A
+        genuinely wedged orphan still times out; nothing can join a
+        dead device call."""
+        with self._lock:
+            thread, self._thread = self._thread, None
+            q, self._queue = self._queue, None
+            orphans, self._orphans = self._orphans, []
+        if q is not None:
+            q.put(None)
+        if join_timeout > 0:
+            for t in ([thread] if thread is not None else []) + orphans:
+                t.join(timeout=join_timeout)
+
+    def status(self) -> dict:
+        with self._lock:
+            alive = self._thread is not None and self._thread.is_alive()
+        return {"alive": alive, "calls": self.calls,
+                "timeouts": self.timeouts, "orphaned": self.orphaned}

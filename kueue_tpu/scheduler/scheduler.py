@@ -34,6 +34,7 @@ from kueue_tpu.core.resources import container_limits_violations
 from kueue_tpu.obs import FlightRecorder
 from kueue_tpu.queue import Manager, RequeueReason
 from kueue_tpu.resilience.breaker import CLOSED, CircuitBreaker
+from kueue_tpu.resilience.degrade import NORMAL, DegradationLadder
 from kueue_tpu.resilience.faultinject import DeviceFault
 from kueue_tpu.resilience.watchdog import DispatchTimeout, DispatchWatchdog
 from kueue_tpu.scheduler import flavorassigner as fa
@@ -196,8 +197,21 @@ class Scheduler:
         self.solver_faults = 0          # device faults observed (total)
         self._cycle_faults = 0          # device faults within this cycle
         # Optional observer hook (the manager wires it to the sim event
-        # recorder): on_fault(kind, message) for fault/trip/recovery.
+        # recorder): on_fault(kind, message) for fault/trip/recovery
+        # and degradation-ladder transitions.
         self.on_fault: Optional[Callable[[str, str], None]] = None
+        # Cycle deadline budget (kueue_tpu/resilience/degrade.py): the
+        # ladder watches every cycle's wall seconds (the same spend the
+        # flight-recorder trace records) against scheduler.cycleBudget
+        # and, under pressure, walks normal -> shed (head cap + deferred
+        # preempt planning) -> survival (tighter cap + the cycle pinned
+        # to the CPU-incremental route "cpu-survival"). Disabled by
+        # default (budget 0); the manager wires the config knobs.
+        self.ladder = DegradationLadder()
+        self._cycle_degraded = NORMAL  # ladder state this cycle RAN under
+        self._degrade_deferred = 0     # preempt plans deferred this cycle
+        self.shed_heads_requeued = 0   # heads re-heaped by the cap (total)
+        self.preempt_plans_deferred = 0  # deferred preempt plans (total)
         self._drain_cost = 0.0  # pipeline-drain seconds within this cycle
         self._cycle_evictions = 0  # evictions issued within this cycle
         # Below this head count the accelerator dispatch overhead exceeds
@@ -281,6 +295,7 @@ class Scheduler:
                 trace = self.recorder.begin_cycle(self.attempt_count)
                 self._cycle_evictions = 0
                 self._cycle_faults = 0
+                self._cycle_degraded = self.ladder.state
                 sig = self._drain_pipeline()
                 self._finish_trace(trace, "drain", heads=0,
                                    admitted=self._drained_admitted)
@@ -292,6 +307,15 @@ class Scheduler:
         self._drain_cost = 0.0
         self._cycle_evictions = 0
         self._cycle_faults = 0
+        self._degrade_deferred = 0
+        # The ladder rung this cycle RUNS under (transitions only happen
+        # at cycle end, in _observe_budget): shed/survival cap the heads
+        # NOW — extras re-heap untouched, no status churn.
+        self._cycle_degraded = self.ladder.state
+        heads_popped = len(heads)
+        cap = self.ladder.head_cap()
+        if cap is not None and len(heads) > cap:
+            heads = self._shed_extra_heads(heads, cap)
         collects0 = getattr(self.solver, "counters", {}).get("collects", 0) \
             if self.solver is not None else 0
         route = self._route_mode(heads)
@@ -305,6 +329,21 @@ class Scheduler:
             # engaged until the blocked preemptor admits, becomes
             # infeasible, or goes away.
             route = "cpu-strict"
+        if route in ("device", "cpu") and self.ladder.pin_cpu:
+            # Survival rung: the cycle is pinned to the CPU-incremental
+            # route — full reference semantics over the journal-replay
+            # snapshot, no device sync, no compile risk. Covers BOTH
+            # economics routes: an adaptive "cpu" choice in survival
+            # must still be renamed, or the capped cycle would land in
+            # the router's cpu samples and hide from survival_cycles.
+            # Like cpu-strict this is an intervention, not an economics
+            # signal, and like cpu-strict it is consulted BEFORE the
+            # breaker so it can never consume (and wedge) a half-open
+            # probe. (cpu-forced/cpu-strict/cpu-breaker keep their own
+            # names — each is already a non-sample with its own
+            # operator meaning; _route_record skips every degraded
+            # cycle regardless.)
+            route = "cpu-survival"
         if route == "device" \
                 and not self.breaker.allow_device(self.clock.now()):
             # Breaker open: pin the cycle to the CPU fallback under a
@@ -336,6 +375,9 @@ class Scheduler:
                                    _time.perf_counter() - wall0
                                    - self._drain_cost)
                 self._note_device_cycle(collects0)
+                self._observe_budget(_time.perf_counter() - wall0,
+                                     heads_popped,
+                                     self._last_cycle_admitted)
                 self._finish_trace(trace, self._pipeline_trace_route,
                                    heads=len(heads),
                                    admitted=self._last_cycle_admitted)
@@ -364,7 +406,15 @@ class Scheduler:
                 heads, snapshot, timeout)
 
         t_ph = _time.perf_counter()
-        entries = pre_entries + self.nominate(heads, snapshot)
+        defer_shed = self.ladder.defer_preemption
+        entries = pre_entries + self.nominate(heads, snapshot,
+                                              defer_preemption=defer_shed)
+        if defer_shed:
+            # Shed/survival: preempt planning (target selection — the
+            # superlinear part of a preempt-heavy cycle) is deferred;
+            # target-less preempt entries keep their reserve-capacity
+            # semantics below and re-heap for when the ladder recovers.
+            self._defer_preempt_plans(entries)
         entries.sort(key=self._entry_sort_key())
         t_ph = self._span("nominate", t_ph)
 
@@ -476,6 +526,12 @@ class Scheduler:
             and e.assignment.representative_mode() == fa.PREEMPT
             and not e.preemption_targets
             for e in entries)
+        if self._degrade_deferred:
+            # Deferred preempt plans look exactly like blocked
+            # preemptors (target-less PREEMPT entries), but the ladder
+            # chose not to plan them — shedding must not ratchet the
+            # starvation bound into cpu-strict on top of itself.
+            blocked = False
         if blocked:
             self._blocked_preempt_streak += 1
             self._preemptless_cycles = 0
@@ -509,6 +565,8 @@ class Scheduler:
             self.metrics.admission_attempt(result_success, self.clock.now() - start)
             for cq_name, count in skipped_preemptions.items():
                 self.metrics.preemption_skips(cq_name, count)
+        self._observe_budget(_time.perf_counter() - wall0, heads_popped,
+                             admitted_n)
         self._finish_trace(trace, route, heads=len(entries),
                            admitted=admitted_n)
         return KeepGoing if result_success else SlowDown
@@ -549,6 +607,7 @@ class Scheduler:
         recorder (it is a metrics concern, not a tracing one)."""
         if self.metrics is not None:
             self.metrics.set_breaker_state(self.breaker.state)
+            self.metrics.set_degraded_state(self.ladder.state)
         if trace is None:
             return
         trace.route = route
@@ -558,9 +617,83 @@ class Scheduler:
         trace.evictions = self._cycle_evictions
         trace.faults = self._cycle_faults
         trace.breaker = self.breaker.state
+        trace.degraded = self._cycle_degraded
         self.recorder.finish(trace)
         if self.metrics is not None:
             self.metrics.cycle_observed(route, heads, trace.phase_sums())
+
+    # --- cycle deadline budget (kueue_tpu/resilience/degrade.py) ---
+
+    def _shed_extra_heads(self, heads: list, cap: int) -> list:
+        """Shed/survival head cap: keep the top-``cap`` heads by the
+        admission order's available prefix — priority (when the gate
+        is on, mirroring _entry_sort_key) then queue-order timestamp —
+        and re-heap the rest untouched. Timestamp alone would invert
+        priority exactly when the system is overloaded and priority
+        matters most: a high-priority arrival mid-storm has a YOUNG
+        timestamp and would be shed every cycle behind older
+        low-priority heads. No status patches, no Pending churn: a
+        shed head simply waits a cycle."""
+        prio_on = features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT)
+        heads.sort(key=lambda w: (
+            -prioritypkg.priority(w.obj) if prio_on else 0,
+            self.ordering.queue_order_timestamp(w.obj)))
+        keep, extra = heads[:cap], heads[cap:]
+        for w in extra:
+            self.queues.requeue_workload(
+                w, RequeueReason.FAILED_AFTER_NOMINATION)
+        self.shed_heads_requeued += len(extra)
+        self.recorder.annotate(
+            "shed", f"head cap {self._cycle_degraded}: kept {cap} of "
+                    f"{cap + len(extra)} heads",
+            state=self._cycle_degraded, kept=cap, requeued=len(extra))
+        return keep
+
+    def _defer_preempt_plans(self, entries: list) -> None:
+        """Shed/survival: entries nominated with deferred preemption
+        (targets None) get NO target selection this cycle — they keep
+        reserve-capacity semantics in the admit loop and re-heap
+        immediately so they retry as soon as the ladder recovers."""
+        for e in entries:
+            if e.preemption_targets is None:
+                e.preemption_targets = []
+                e.inadmissible_msg = ("Preemption planning deferred "
+                                      "(load shedding)")
+                e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
+                self._degrade_deferred += 1
+                self.preempt_plans_deferred += 1
+
+    def _observe_budget(self, duration_s: float, heads: int,
+                        admitted: Optional[int]) -> None:
+        """Feed the cycle's wall seconds + backlog pressure (heads
+        popped minus admissions — the cheap unserved-demand proxy) to
+        the degradation ladder; transitions land as flight-recorder
+        annotations, metric counters, and system events — the same
+        sealed-trace feed path the breaker uses, so /debug/degrade and
+        the traces reconcile by construction. Called while the cycle's
+        trace is still open (before _finish_trace)."""
+        lad = self.ladder
+        if not lad.enabled:
+            return
+        if self._cycle_degraded != NORMAL and self.metrics is not None:
+            self.metrics.cycle_shed(self._cycle_degraded)
+        prev = lad.state
+        backlog = heads - (admitted or 0)
+        if not lad.observe_cycle(duration_s, backlog=backlog):
+            return
+        recovered = lad.state == NORMAL
+        msg = (f"degraded-mode {prev}->{lad.state}: cycle ewma "
+               f"{(lad.ewma_s or 0) * 1e3:.1f}ms vs budget "
+               f"{lad.budget_s * 1e3:.1f}ms, backlog {backlog}")
+        self.recorder.annotate("degrade", msg, state=lad.state,
+                               previous=prev,
+                               ewma_ms=round((lad.ewma_s or 0) * 1e3, 3),
+                               budget_ms=round(lad.budget_s * 1e3, 3))
+        self.log.v(2, "degrade.transition", previous=prev, state=lad.state,
+                   ewma_ms=round((lad.ewma_s or 0) * 1e3, 1))
+        if self.on_fault is not None:
+            self.on_fault("degrade-recovered" if recovered else "degrade",
+                          msg)
 
     # --- adaptive mode routing (the production "routed system") ---
 
@@ -606,6 +739,12 @@ class Scheduler:
         if self.solver_routing != "adaptive" or admitted is None \
                 or mode not in ("cpu", "device"):
             return
+        if self._cycle_degraded != NORMAL:
+            # A shed/survival cycle ran with capped heads and deferred
+            # preempt planning: its progress-per-second says nothing
+            # about either engine's real economics. Interventions are
+            # not routing samples.
+            return
         lst = self._route_stats.setdefault((mode, self._cycle_regime), [])
         lst.append((admitted, secs))
         if len(lst) > 8:
@@ -629,15 +768,25 @@ class Scheduler:
         self.solver_faults += 1
         self._cycle_faults += 1
         tripped = self.breaker.record_fault(self.clock.now())
+        # Only the supervised dispatch worker raises SupervisedTimeout;
+        # a collect-side watchdog timeout (plain DispatchTimeout) must
+        # not land in the supervised counter even when it surfaces
+        # through the sync path's "solve" site — and a supervised
+        # abandonment must not land in dispatch_timeouts_total, whose
+        # contract is collect-watchdog abandonments. Exactly one of
+        # the two counters per timeout.
+        from kueue_tpu.resilience.supervisor import SupervisedTimeout
+        supervised = isinstance(exc, SupervisedTimeout)
+        timeout = isinstance(exc, DispatchTimeout) and not supervised
         self.recorder.annotate(
             "fault", f"{where}: {exc!r}"[:200], site=where,
-            timeout=isinstance(exc, DispatchTimeout), tripped=tripped,
-            breaker=self.breaker.state,
+            timeout=timeout, tripped=tripped,
+            supervised=supervised, breaker=self.breaker.state,
             consecutive=self.breaker.consecutive_faults)
         if self.metrics is not None:
             self.metrics.device_fault(
-                where, timeout=isinstance(exc, DispatchTimeout),
-                tripped=tripped)
+                where, timeout=timeout,
+                tripped=tripped, supervised=supervised)
         self.log.v(2, "solver.fault", where=where, error=repr(exc)[:200],
                    breaker=self.breaker.state,
                    consecutive=self.breaker.consecutive_faults)
@@ -712,6 +861,17 @@ class Scheduler:
                 est = max(sync) / 1e3  # samples are milliseconds
         return self.watchdog.deadline_s(est)
 
+    def _supervise_deadline(self) -> Optional[float]:
+        """Deadline for the SUPERVISED dispatch body (trace/compile/
+        transfer): the watchdog's cold clamp, not the warm regime
+        deadline — a dispatch legitimately carries jit compiles (a
+        fresh shape bucket mid-run, a cold start) whose cost is not
+        regime-priced, so only the operator's compile-absorbing bound
+        may abandon it. None when the watchdog is disabled."""
+        if self.watchdog is None:
+            return None
+        return self.watchdog.max_deadline_s
+
     def _solver_note_unapplied(self, key: str) -> None:
         note = getattr(self.solver, "note_unapplied", None)
         if note is not None:
@@ -729,8 +889,12 @@ class Scheduler:
         # Breaker not CLOSED => the cycle is a half-open probe: it must
         # run synchronously so its outcome is known by cycle end (a
         # pipelined dispatch wouldn't resolve until the NEXT cycle).
+        # Ladder not NORMAL => the cycle must stay synchronous and
+        # predictable: shed caps + deferral need the sync shape, and a
+        # degraded cycle must not queue another dispatch behind itself.
         return (s is not None and self.pipeline_enabled
                 and self.breaker.state == CLOSED
+                and self.ladder.state == NORMAL
                 and getattr(s, "resident_capable", False)
                 and not self.cache.pods_ready_tracking
                 and len(heads) >= self.solver_min_heads
@@ -861,7 +1025,8 @@ class Scheduler:
         try:
             inflight = solver.dispatch(
                 plan, fair_sharing=self.fair_sharing_enabled,
-                preempt_batch=pbatch, deadline_s=self._dispatch_deadline())
+                preempt_batch=pbatch, deadline_s=self._dispatch_deadline(),
+                supervise_deadline_s=self._supervise_deadline())
             solver.start_fetch(inflight)
         except Exception as exc:  # noqa: BLE001 — device: sync fallback
             self._solver_fault("dispatch", exc)
@@ -1234,13 +1399,21 @@ class Scheduler:
         # — except under a mesh with fair sharing (the sharded execute
         # carries only the minimal-preemption program).
         defer = not (self.fair_sharing_enabled
-                     and self.solver.mesh is not None)
+                     and self.solver.mesh is not None) \
+            or self.ladder.defer_preemption
         t_ph = _time.perf_counter()
         pre_entries = nofit_entries + self.nominate(pred_other, snapshot,
                                                     defer_preemption=defer)
         pending = [e for e in pre_entries if e.preemption_targets is None]
-        for e in pending:
-            e.preemption_targets = []
+        if pending and self.ladder.defer_preemption:
+            # Shed/survival: skip target selection entirely (no
+            # candidate index, no device preempt batch) — the deferred
+            # entries keep reserve-capacity semantics and re-heap.
+            self._defer_preempt_plans(pending)
+            pending = []
+        else:
+            for e in pending:
+                e.preemption_targets = []
         t_ph = self._span("nominate", t_ph)
         # NB: count ALL predicted-non-fit entries (incl. the device-NoFit
         # shortcut set), or an all-NoFit cycle would look like a fit cycle
@@ -1351,7 +1524,8 @@ class Scheduler:
                 fair_sharing=self.fair_sharing_enabled,
                 fair_batch=fbatch,
                 fs_flags=strategy_flags(self.preemptor.fs_strategies),
-                deadline_s=self._dispatch_deadline())
+                deadline_s=self._dispatch_deadline(),
+                supervise_deadline_s=self._supervise_deadline())
         except Exception as exc:  # noqa: BLE001 — device: CPU fallback
             self._solver_fault("solve", exc)
             if pending:

@@ -80,11 +80,16 @@ class Controller:
 
 
 class Runtime:
-    def __init__(self, clock: Clock = REAL_CLOCK):
+    def __init__(self, clock: Clock = REAL_CLOCK, metrics=None):
         self.clock = clock
         self.controllers: list[Controller] = []
         self._timer_seq = itertools.count()
         self._timers: list = []  # heap of (due, seq, controller, key)
+        # Optional metrics Registry: every reconcile's wall seconds land
+        # in reconcile_seconds{controller} — the coarse latency signal
+        # for the wall_s - cycle_time_total gap the scheduler-only
+        # flight recorder can't see (ROADMAP PR-4 follow-up).
+        self.metrics = metrics
 
     def add_controller(self, ctrl: Controller) -> Controller:
         self.controllers.append(ctrl)
@@ -116,14 +121,22 @@ class Runtime:
         ONE ClusterQueue/LocalQueue key each — before the status
         reconcilers run, instead of interleaving and rebuilding each CQ
         status several times per cycle."""
+        import time as _time
         processed = 0
+        metrics = self.metrics
         self._release_due_timers()
         for _ in range(max_iterations):
             worked = False
             for ctrl in self.controllers:
                 for _ in range(len(ctrl._queue)):
                     worked = True
-                    key, result = ctrl.process_one()
+                    if metrics is not None:
+                        t0 = _time.perf_counter()
+                        key, result = ctrl.process_one()
+                        metrics.reconcile_observed(
+                            ctrl.name, _time.perf_counter() - t0)
+                    else:
+                        key, result = ctrl.process_one()
                     processed += 1
                     if result is True:
                         ctrl.enqueue(key)

@@ -26,6 +26,7 @@ path (solver=None) remains the strict-conformance mode.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import numpy as np
@@ -36,6 +37,7 @@ from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.core.resources import FlavorResource
 from kueue_tpu.resilience import faultinject
 from kueue_tpu.resilience.faultinject import DeviceFault
+from kueue_tpu.resilience.supervisor import SupervisedWorker
 from kueue_tpu.resilience.watchdog import DispatchTimeout
 from kueue_tpu.scheduler import flavorassigner as fa
 from kueue_tpu.solver import encode
@@ -189,6 +191,22 @@ class BatchSolver:
         self._cache = None  # bound Cache (usage journal source)
         self._resident: Optional[ResidentState] = None
         self._fetch_pool = None  # lazy: background-fetch executor
+        # Supervised dispatch (resilience/supervisor.py): with a
+        # deadline, the dispatch body (trace/compile/transfer) runs on
+        # a persistent worker thread and a hang is abandoned instead of
+        # freezing the scheduler — the collect-side watchdog's twin.
+        self.supervise_dispatch = True
+        self._supervisor = SupervisedWorker("solver-dispatch")
+        # Bumped on every abandonment: an orphaned dispatch that later
+        # wakes up checks it before mutating shared host state (the
+        # arena twin) and bails instead of racing the live cycle. The
+        # lock serializes the arena upload section itself — at most one
+        # dispatch (live or orphaned) is ever inside prepare_device, so
+        # a wedge INSIDE the upload blocks the next dispatch on the
+        # lock (which the supervisor then times out and the breaker
+        # contains) instead of corrupting the twin.
+        self._dispatch_epoch = 0
+        self._arena_lock = threading.Lock()
         # Workload encode arena (solver/arena.py): persistent per-workload
         # encoded rows, maintained by the queue manager's delta feed.
         # Engaged only once a Manager is bound (bind_queues) — without
@@ -214,7 +232,7 @@ class BatchSolver:
                          "resident_cycles": 0, "establishes": 0,
                          "upload_bytes": 0, "fetch_bytes": 0,
                          "dispatch_timeouts": 0, "backend_probe_faults": 0,
-                         "validation_faults": 0}
+                         "validation_faults": 0, "supervised_timeouts": 0}
         self.log = vlog.logger("solver")
 
     def bind_cache(self, cache) -> None:
@@ -702,7 +720,8 @@ class BatchSolver:
     def solve_prepared(self, plan: Plan, snapshot: Snapshot,
                        preempt_batch=None, fair_sharing: bool = False,
                        fair_batch=None, fs_flags: tuple = (),
-                       deadline_s: Optional[float] = None):
+                       deadline_s: Optional[float] = None,
+                       supervise_deadline_s: Optional[float] = None):
         """Dispatch the cycle (fit solve, plus the preemption batches when
         present, as ONE device program), sync once, decode. Returns
         (decisions dict, aux) where aux is None or
@@ -760,24 +779,70 @@ class BatchSolver:
         inflight = self.dispatch(plan, preempt_batch=preempt_batch,
                                  fair_sharing=fair_sharing,
                                  fair_batch=fair_batch, fs_flags=fs_flags,
-                                 deadline_s=deadline_s)
+                                 deadline_s=deadline_s,
+                                 supervise_deadline_s=supervise_deadline_s)
         return self.collect(inflight, snapshot)
 
     def dispatch(self, plan: Plan, preempt_batch=None,
                  fair_sharing: bool = False, fair_batch=None,
                  fs_flags: tuple = (),
-                 deadline_s: Optional[float] = None) -> InFlight:
+                 deadline_s: Optional[float] = None,
+                 supervise_deadline_s: Optional[float] = None) -> InFlight:
         """Dispatch the single-chip cycle WITHOUT fetching. The returned
         InFlight's outputs are device references; collect() (or a
         background fetch via start_fetch()) brings the decisions home.
         With residency, the post-cycle usage/cohort_usage stay on device
         as next cycle's inputs — the upload is the workload batch plus
-        sparse corrections only."""
+        sparse corrections only.
+
+        ``deadline_s`` is the regime-keyed watchdog deadline the COLLECT
+        is bounded by (stamped on the InFlight). With
+        ``supervise_deadline_s``, the dispatch body itself runs
+        SUPERVISED on the persistent solver-worker thread
+        (resilience/supervisor.py): tracing/compile/transfer that wedges
+        past it raises DispatchTimeout here instead of freezing the
+        scheduler — the worker is orphaned, and the epoch guard keeps
+        the orphan from mutating live arena state if it ever wakes up.
+        The scheduler passes the watchdog's COLD clamp (max_deadline_s)
+        here, not the warm regime deadline: a dispatch legitimately
+        carries jit compiles (a fresh shape bucket mid-run, a cold
+        start) whose cost is not regime-priced, so only the clamp — the
+        operator's compile-absorbing bound — may abandon it."""
+        if supervise_deadline_s is None or not self.supervise_dispatch:
+            return self._dispatch_impl(plan, preempt_batch, fair_sharing,
+                                       fair_batch, fs_flags, deadline_s)
+        epoch = self._dispatch_epoch
+        try:
+            return self._supervisor.run(
+                self._dispatch_impl, plan, preempt_batch, fair_sharing,
+                fair_batch, fs_flags, deadline_s, epoch,
+                deadline_s=supervise_deadline_s)
+        except DispatchTimeout:
+            self._dispatch_epoch = epoch + 1
+            self.counters["supervised_timeouts"] += 1
+            raise
+
+    def _check_epoch(self, epoch: Optional[int]) -> None:
+        """An orphaned dispatch waking after abandonment must not touch
+        shared host state the live cycle owns (the arena twin): bail
+        with a DeviceFault nobody will see (the request was abandoned —
+        the exception only parks on the orphaned hand-off)."""
+        if epoch is not None and epoch != self._dispatch_epoch:
+            raise DeviceFault("dispatch abandoned by supervisor")
+
+    def _dispatch_impl(self, plan: Plan, preempt_batch=None,
+                       fair_sharing: bool = False, fair_batch=None,
+                       fs_flags: tuple = (),
+                       deadline_s: Optional[float] = None,
+                       epoch: Optional[int] = None) -> InFlight:
         import time
         t0 = time.perf_counter()
         # Injection site: a raise here is exactly a dead-tunnel dispatch
-        # error — the scheduler's device-failure handler owns it.
+        # error — the scheduler's device-failure handler owns it (and a
+        # DELAY here is the `hang` action the supervised deadline
+        # bounds: before this PR it froze the scheduler forever).
         faultinject.site(faultinject.SITE_DISPATCH)
+        self._check_epoch(epoch)
         topo, topo_dev, state, batch = (plan.topo, plan.topo_dev,
                                         plan.state, plan.batch)
         start_rank = plan.start_rank
@@ -812,7 +877,41 @@ class BatchSolver:
                 # dispatch (applied to the twin by prepare_device), and
                 # gather on device.
                 t_sc = time.perf_counter()
-                arena_dev, up_nbytes = self._arena.prepare_device()
+                # Bounded acquire: healthy dispatches never contend
+                # (one dispatcher at a time), so failing to take the
+                # lock means a previous dispatch is WEDGED inside the
+                # upload. Fail fast with a DeviceFault instead of
+                # blocking out the whole supervise deadline — otherwise
+                # every breaker probe for the outage's duration would
+                # park another orphaned thread (plus its Plan arrays)
+                # behind the dead call.
+                if not self._arena_lock.acquire(timeout=1.0):
+                    raise DeviceFault(
+                        "arena upload busy: a previous dispatch is "
+                        "wedged in the device upload")
+                try:
+                    # Entry check AND mutual exclusion: an orphan that
+                    # was abandoned before reaching here bails; one that
+                    # is already wedged inside holds the lock, so later
+                    # dispatches fail fast above — never two threads in
+                    # the upload.
+                    self._check_epoch(epoch)
+                    arena_dev, up_nbytes = self._arena.prepare_device()
+                    if epoch is not None and epoch != self._dispatch_epoch:
+                        # Abandoned WHILE inside the upload: the publish
+                        # (arena.dev, cleared dirty set) is stale — drop
+                        # the twin (the next live dispatch re-uploads
+                        # wholesale from the host arrays, which faults
+                        # never touch) before any later dispatch can
+                        # read it, then bail. An abandonment landing
+                        # after this check is the live cycle's own —
+                        # its upload was consistent, and the scheduler's
+                        # fault path drops the twin right after.
+                        self._arena.drop_device()
+                        raise DeviceFault(
+                            "dispatch abandoned by supervisor")
+                finally:
+                    self._arena_lock.release()
                 if self._recorder is not None:
                     # Nested under dispatch (dotted name: excluded from
                     # per-phase sums — it's already inside dispatch).
@@ -863,6 +962,12 @@ class BatchSolver:
                     fair_sharing=fair_sharing, start_rank=start_rank,
                     fair_preempt_args=fargs, fs_strategies=fs_flags)
 
+        # An orphan whose wedged solve call finally returned must not
+        # run the bookkeeping below: counters would double-count, and
+        # _phase would append its (multi-second) span into whatever
+        # cycle trace is CURRENTLY open — polluting the live cycle's
+        # /debug/cycles view and the cycle_phase_seconds histograms.
+        self._check_epoch(epoch)
         keys = ["admitted", "fit", "chosen", "borrows", "chosen_borrow"]
         if preempt_batch is not None:
             keys += ["preempt_targets", "preempt_feasible"]

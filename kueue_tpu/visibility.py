@@ -116,6 +116,7 @@ class VisibilityServer:
     GET /metrics           Prometheus text exposition (Registry.dump)
     GET /debug/cycles      recent flight-recorder traces (?n=K | ?slowest=K)
     GET /debug/breaker     circuit-breaker state + next-probe backoff
+    GET /debug/degrade     degradation-ladder state + shed bookkeeping
     GET /debug/router      adaptive-router regime samples/medians
     GET /debug/arena       encode-arena slot occupancy + churn
 

@@ -113,19 +113,52 @@ class TestProvisioning:
         # in backoff: no second attempt yet
         assert mgr.store.try_get("ProvisioningRequest", "default",
                                  "w-prov-check-attempt2") is None
-        mgr.advance(61.0)  # backoff 60s for attempt 1
+        mgr.advance(60.0)  # base backoff elapsed, jitter remains
+        assert mgr.store.try_get("ProvisioningRequest", "default",
+                                 "w-prov-check-attempt2") is None
+        mgr.advance(13.0)  # past base 60s x max jitter 1.2 for attempt 1
         assert mgr.store.try_get("ProvisioningRequest", "default",
                                  "w-prov-check-attempt2") is not None
         fail_current("w-prov-check-attempt2")
-        mgr.advance(121.0)
+        mgr.advance(145.0)  # past 120s x 1.2
         fail_current("w-prov-check-attempt3")
-        mgr.advance(241.0)
+        mgr.advance(289.0)  # past 240s x 1.2
         # 3 retries exhausted after the 4th attempt fails -> Rejected ->
         # workload deactivated by the check-based eviction
         fail_current("w-prov-check-attempt4")
         mgr.run_until_idle()
         wl = mgr.store.get("Workload", "default", "w")
         assert not wl.spec.active
+
+    def test_retry_backoff_jitter_desynchronizes_workloads(self, clock):
+        # ISSUE 5 satellite: pure base * 2^(attempt-1) synchronized the
+        # retry storm across every workload that failed together (one
+        # capacity outage fails a whole wave at the same transition
+        # time). The seeded per-(workload, check, attempt) jitter
+        # spreads them — deterministically, so fake-clock tests stay
+        # reproducible.
+        from kueue_tpu.controller.admissionchecks.provisioning import (
+            ProvisioningController, _jitter_fraction)
+        ctrl = ProvisioningController(store=None, recorder=None,
+                                      clock=clock)
+        b1 = ctrl._backoff_seconds("wl-a", "chk", 1)
+        b2 = ctrl._backoff_seconds("wl-b", "chk", 1)
+        # stable per key, different across workloads, bounded
+        assert b1 == ctrl._backoff_seconds("wl-a", "chk", 1)
+        assert b1 != b2
+        for b in (b1, b2):
+            assert 60.0 <= b < 60.0 * 1.2
+        # attempt 2 doubles the base, keeps its own jitter draw
+        b1a2 = ctrl._backoff_seconds("wl-a", "chk", 2)
+        assert 120.0 <= b1a2 < 144.0
+        # jitter=0 restores the pure exponential schedule
+        plain = ProvisioningController(store=None, recorder=None,
+                                       clock=clock, backoff_jitter=0.0)
+        assert plain._backoff_seconds("wl-a", "chk", 1) == 60.0
+        assert plain._backoff_seconds("wl-b", "chk", 3) == 240.0
+        # the fraction itself is uniform-ish and seed-keyed
+        assert _jitter_fraction(0, "k") != _jitter_fraction(1, "k")
+        assert 0.0 <= _jitter_fraction(0, "k") < 1.0
 
 
 class TestMultiKueue:

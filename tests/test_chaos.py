@@ -138,9 +138,14 @@ def _chaos_vs_oracle(seed, waves=6, preemption=False, pipeline=False,
         s.pipeline_enabled = pipeline
         s.breaker = CircuitBreaker(threshold=2, backoff_base_s=2.0,
                                    jitter=0.0, seed=seed)
+        # max_deadline is the COLD-cycle clamp: with supervised dispatch
+        # (PR 5) it must clear a real jit compile inside dispatch, or
+        # every cold cycle faults before the injector even fires. Warm
+        # deadlines clamp to min (0.1s), so injected 0.2s hangs still
+        # reliably trip.
         s.watchdog = DispatchWatchdog(safety_factor=2.0,
                                       min_deadline_s=0.1,
-                                      max_deadline_s=0.5)
+                                      max_deadline_s=10.0)
         _submit_waves(env, 2)
         injector = None
         if chaotic:
@@ -182,16 +187,141 @@ class TestChaosSmoke:
             # (correctly) inconclusive and re-armed. Complete a few
             # admitted workloads so the parked backlog re-heaps with
             # real device work: the next probe round-trips and closes
-            # the breaker.
+            # the breaker. Advance far enough per cycle to clear even a
+            # several-times-doubled probe backoff (supervised dispatch
+            # turns injected dispatch hangs into faults too, so failed
+            # probes — and thus doublings — are more frequent than
+            # before PR 5).
             for wl in list(env.client.applied.values())[:4]:
                 env.cache.delete_workload(wl)
                 env.queues.queue_associated_inadmissible_workloads_after(wl)
-            for _ in range(6):
-                env.clock.advance(5.0)
+            for _ in range(10):
+                env.clock.advance(10.0)
                 env.cycle()
         if s.breaker.trips:
             assert s.breaker.recoveries >= 1
             assert s.cycle_counts.get("cpu-breaker", 0) >= 1
+
+
+class TestDispatchHangRegression:
+    def test_scripted_dispatch_hangs_trip_breaker_and_recover(self):
+        # ISSUE 5 satellite: the `hang` action at the device_dispatch
+        # site used to wedge the scheduler forever (PR 3's watchdog only
+        # bounded collect). Supervised dispatch abandons each hang
+        # within the watchdog's cold clamp, the breaker trips after N
+        # faults, and recovery follows the existing half-open probe
+        # path — the full outage lifecycle, scripted.
+        import time as _t
+        env = build_env(_setup(), solver=True)
+        s = env.scheduler
+        s.breaker = CircuitBreaker(threshold=2, backoff_base_s=2.0,
+                                   jitter=0.0)
+        _submit_waves(env, 2)
+        # Warm: compile the shape buckets with an untightened watchdog
+        # so the clamp below only ever fires on the injected hangs.
+        env.cycle()
+        env.clock.advance(1.0)
+        env.cycle()
+        env.clock.advance(1.0)
+        assert len(admitted_map(env)) == 8
+        s.watchdog = DispatchWatchdog(safety_factor=2.0,
+                                      min_deadline_s=0.05,
+                                      max_deadline_s=0.3)
+        injector = FaultInjector(
+            {faultinject.SITE_DISPATCH: {0: (faultinject.DELAY, 5.0),
+                                         1: (faultinject.DELAY, 5.0)}})
+        t0 = _t.perf_counter()
+        with faultinject.installed(injector):
+            # one fresh wave per hang cycle: both scripted hangs fire
+            _submit_waves(env, 1, start_wave=2)
+            env.cycle()    # hang 0: abandoned, CPU fallback admits
+            env.clock.advance(1.0)
+            _submit_waves(env, 1, start_wave=3)
+            env.cycle()    # hang 1: abandoned -> threshold 2 trips
+            env.clock.advance(1.0)
+            wall = _t.perf_counter() - t0
+            assert s.breaker.trips == 1
+            # Quota is full (16 x 2cpu): free a wave's worth so the
+            # next cycle has real work, then keep the arrivals flowing
+            # so the post-backoff probe cycle isn't headless (a probe
+            # needs device work to round-trip).
+            deleted = 0
+
+            def free_and_submit(wave):
+                nonlocal deleted
+                applied = list(env.client.applied.values())
+                for wl in applied[deleted:deleted + 4]:
+                    env.cache.delete_workload(wl)
+                    env.queues \
+                       .queue_associated_inadmissible_workloads_after(wl)
+                deleted += 4
+                _submit_waves(env, 1, start_wave=wave)
+
+            free_and_submit(4)
+            env.cycle()    # still inside backoff: cpu-breaker route
+            env.clock.advance(3.0)
+            for i in range(6):  # post-backoff probe recovers
+                free_and_submit(5 + i)
+                env.cycle()
+                env.clock.advance(3.0)
+                if s.breaker.recoveries:
+                    break
+        # Both 5s hangs were abandoned at the 0.3s clamp: the two hang
+        # cycles took nowhere near the 10s the hangs would cost inline.
+        assert wall < 5.0, wall
+        assert s.solver.counters["supervised_timeouts"] == 2
+        assert s.solver._supervisor.orphaned == 2
+        assert s.solver_faults == 2
+        # threshold 2: the hang faults tripped the breaker, outage
+        # cycles routed cpu-breaker, and a post-backoff probe recovered.
+        assert s.cycle_counts.get("cpu-breaker", 0) >= 1
+        assert s.breaker.recoveries >= 1
+        # nothing was lost: admissions kept flowing through the outage
+        assert len(admitted_map(env)) >= 16
+        _assert_host_state_clean(env)
+
+
+class TestOverloadStorm:
+    def test_storm_converges_to_fault_free_admitted_set(self):
+        # ISSUE 5 satellite: an overload storm (every cycle blowing a
+        # tiny budget) walks the ladder into shed/survival — and once
+        # load subsides the ladder recovers and the admitted set
+        # converges to the run with no ladder at all. Degradation
+        # affects WHEN work admits, never WHAT admits.
+        from kueue_tpu.resilience.degrade import (
+            NORMAL, DegradationLadder)
+
+        def run(budget_s):
+            env = build_env(_setup(), solver=True)
+            s = env.scheduler
+            if budget_s:
+                s.ladder = DegradationLadder(
+                    budget_s=budget_s, shed_heads=2, survival_heads=1,
+                    escalate_after=1, recovery_cycles=2, ewma_alpha=1.0)
+            _submit_waves(env, 6)  # storm: 24 workloads at once
+            for cycle in range(40):
+                if 12 <= cycle < 25:
+                    # identical post-storm trickle in BOTH runs: keeps
+                    # heads flowing so the ladder (when present) keeps
+                    # observing and can walk back down to normal
+                    _submit_waves(env, 1, start_wave=6 + cycle)
+                env.cycle()
+                env.clock.advance(1.0)
+                if budget_s and cycle == 12:
+                    # load subsided: generous budget from here on
+                    s.ladder.budget_s = 60.0
+            return env
+        clean = run(0.0)
+        storm = run(1e-9)  # every cycle overloads the budget
+        s = storm.scheduler
+        assert s.ladder.escalations >= 1      # the ladder engaged
+        assert s.ladder.cycles_shed >= 1
+        assert s.shed_heads_requeued >= 1     # heads actually shed
+        assert s.cycle_counts.get("cpu-survival", 0) >= 1
+        assert s.ladder.state == NORMAL       # and recovered
+        # convergence: identical admitted set once load subsided
+        assert set(admitted_map(storm)) == set(admitted_map(clean))
+        _assert_host_state_clean(storm)
 
 
 @pytest.mark.slow
@@ -223,7 +353,7 @@ class TestChaosSweep:
                                        jitter=0.0)
             s.watchdog = DispatchWatchdog(safety_factor=2.0,
                                           min_deadline_s=0.1,
-                                          max_deadline_s=0.5)
+                                          max_deadline_s=10.0)
             for i in range(4):
                 env.admit_existing(
                     WorkloadWrapper(f"victim{i}").queue(f"lq-cq{i}")
@@ -254,7 +384,7 @@ class TestChaosSweep:
                                    jitter=0.0)
         s.watchdog = DispatchWatchdog(safety_factor=2.0,
                                       min_deadline_s=0.1,
-                                      max_deadline_s=0.5)
+                                      max_deadline_s=10.0)
         _submit_waves(env, 3)
         injector = FaultInjector(
             {faultinject.SITE_DISPATCH: {i: faultinject.RAISE

@@ -1,0 +1,413 @@
+"""Bounded-cycle admission (ISSUE 5): the degradation-ladder state
+machine (transitions, hysteresis, N-healthy-cycle recovery), its
+scheduler integration (head caps, deferred preempt planning, the
+cpu-survival route, starvation-bound interplay), and the operator
+surface (degraded_state gauge, cycles_shed_total, /debug/degrade,
+flight-recorder annotations — all fed from the same producers).
+"""
+
+import pytest
+
+from kueue_tpu.metrics import Registry
+from kueue_tpu.resilience.degrade import (
+    NORMAL, SHED, SURVIVAL, DegradationLadder)
+from tests.test_solver import admitted_map, build_env
+from tests.wrappers import ClusterQueueWrapper, WorkloadWrapper, flavor_quotas
+
+
+def make_ladder(**kw):
+    kw.setdefault("budget_s", 0.1)
+    kw.setdefault("escalate_after", 2)
+    kw.setdefault("recovery_cycles", 2)
+    kw.setdefault("ewma_alpha", 1.0)  # EWMA == last cycle: exact tests
+    return DegradationLadder(**kw)
+
+
+class TestLadderStateMachine:
+    def test_disabled_ladder_never_moves(self):
+        lad = DegradationLadder(budget_s=0.0)
+        assert not lad.enabled
+        for _ in range(10):
+            assert lad.observe_cycle(10.0, backlog=100) is False
+        assert lad.state == NORMAL and lad.cycles_observed == 0
+
+    def test_escalates_after_consecutive_overloaded_cycles(self):
+        lad = make_ladder()
+        assert lad.observe_cycle(0.2) is False   # 1st overloaded
+        assert lad.state == NORMAL
+        assert lad.observe_cycle(0.2) is True    # 2nd: normal -> shed
+        assert lad.state == SHED
+        assert lad.observe_cycle(0.2) is False
+        assert lad.observe_cycle(0.2) is True    # shed -> survival
+        assert lad.state == SURVIVAL
+        # survival is the floor: more overload cannot escalate further
+        assert lad.observe_cycle(0.2) is False
+        assert lad.observe_cycle(0.2) is False
+        assert lad.state == SURVIVAL
+        assert lad.escalations == 2
+
+    def test_one_overloaded_cycle_is_not_enough(self):
+        lad = make_ladder()
+        lad.observe_cycle(0.2)
+        lad.observe_cycle(0.01)  # healthy cycle resets the streak
+        lad.observe_cycle(0.2)
+        assert lad.state == NORMAL
+
+    def test_recovery_needs_consecutive_healthy_cycles(self):
+        lad = make_ladder()
+        for _ in range(4):
+            lad.observe_cycle(0.2)
+        assert lad.state == SURVIVAL
+        lad.observe_cycle(0.01)
+        lad.observe_cycle(0.2)   # overload interrupts the healthy streak
+        lad.observe_cycle(0.01)
+        assert lad.state == SURVIVAL
+        lad.observe_cycle(0.01)  # 2 consecutive healthy: down one rung
+        assert lad.state == SHED
+        lad.observe_cycle(0.01)
+        lad.observe_cycle(0.01)
+        assert lad.state == NORMAL
+        assert lad.recoveries == 2
+
+    def test_hysteresis_band_holds_the_rung(self):
+        # exit 0.7 x budget < cycle < enter 1.0 x budget: neither streak
+        # may accumulate — a borderline load can't flap the ladder.
+        lad = make_ladder()
+        lad.observe_cycle(0.2)
+        lad.observe_cycle(0.2)
+        assert lad.state == SHED
+        for _ in range(20):
+            assert lad.observe_cycle(0.085) is False  # inside the band
+        assert lad.state == SHED
+        assert lad._over == 0 and lad._healthy == 0
+
+    def test_backlog_growth_escalates_on_raw_cycle_overrun(self):
+        # EWMA still under budget, but the raw cycle blew it while the
+        # backlog grew: storm onset counts as overloaded immediately.
+        lad = make_ladder(ewma_alpha=0.01)  # EWMA barely moves
+        lad.observe_cycle(0.01, backlog=10)
+        assert lad.observe_cycle(0.5, backlog=20) is False
+        assert lad.observe_cycle(0.5, backlog=30) is True
+        assert lad.state == SHED
+
+    def test_backlog_not_growing_allows_recovery(self):
+        lad = make_ladder()
+        lad.observe_cycle(0.2, backlog=10)
+        lad.observe_cycle(0.2, backlog=10)
+        assert lad.state == SHED
+        # healthy cycle times but GROWING backlog: not healthy
+        lad.observe_cycle(0.01, backlog=20)
+        lad.observe_cycle(0.01, backlog=30)
+        assert lad.state == SHED
+        lad.observe_cycle(0.01, backlog=25)
+        lad.observe_cycle(0.01, backlog=20)
+        assert lad.state == NORMAL
+
+    def test_head_cap_and_flags_per_state(self):
+        lad = make_ladder(shed_heads=100, survival_heads=10)
+        assert lad.head_cap() is None
+        assert not lad.defer_preemption and not lad.pin_cpu
+        lad.state = SHED
+        assert lad.head_cap() == 100
+        assert lad.defer_preemption and not lad.pin_cpu
+        lad.state = SURVIVAL
+        assert lad.head_cap() == 10
+        assert lad.defer_preemption and lad.pin_cpu
+
+    def test_cycles_shed_counts_degraded_cycles(self):
+        lad = make_ladder()
+        lad.observe_cycle(0.2)
+        lad.observe_cycle(0.2)  # transition happens at THIS cycle's end
+        assert lad.cycles_shed == 0  # both ran under normal
+        lad.observe_cycle(0.2)
+        assert lad.cycles_shed == 1
+
+    def test_status_payload(self):
+        lad = make_ladder()
+        lad.observe_cycle(0.2, backlog=7)
+        st = lad.status()
+        assert st["state"] == NORMAL and st["enabled"]
+        assert st["budget_ms"] == 100.0
+        assert st["ewma_ms"] == 200.0
+        assert st["last_backlog"] == 7
+        assert st["cycles_observed"] == 1
+
+    def test_invalid_config_rejected(self):
+        with pytest.raises(ValueError):
+            DegradationLadder(budget_s=-1)
+        with pytest.raises(ValueError):
+            DegradationLadder(shed_heads=0)
+        with pytest.raises(ValueError):
+            DegradationLadder(exit_factor=1.5, enter_factor=1.0)
+        with pytest.raises(ValueError):
+            DegradationLadder(recovery_cycles=0)
+        with pytest.raises(ValueError):
+            DegradationLadder(ewma_alpha=0)
+
+
+def _env(n_cqs=4, cpu="100", preemption=False, solver=False):
+    def setup(env):
+        env.add_flavor("default")
+        for i in range(n_cqs):
+            cq = ClusterQueueWrapper(f"cq{i}").cohort("co")
+            if preemption:
+                from kueue_tpu.api import kueue as api
+                cq = cq.preemption(
+                    within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+            env.add_cq(cq.resource_group(
+                flavor_quotas("default", cpu=cpu)).obj(), f"lq-cq{i}")
+    env = build_env(setup, solver=solver)
+    env.scheduler.metrics = Registry()
+    return env
+
+
+def _submit(env, n_per_cq=1, n_cqs=4, cpu="2", priority=0, start=0):
+    n = start
+    for w in range(n_per_cq):
+        for i in range(n_cqs):
+            env.submit(WorkloadWrapper(f"w{n}").queue(f"lq-cq{i}")
+                       .priority(priority).creation(float(n))
+                       .pod_set(count=1, cpu=cpu).obj())
+            n += 1
+    return n
+
+
+class TestSchedulerShedding:
+    def test_shed_caps_heads_and_requeues_extras_fifo(self):
+        env = _env()
+        s = env.scheduler
+        s.ladder = make_ladder()
+        s.ladder.state = SHED
+        s.ladder.shed_heads = 2
+        _submit(env)  # 4 heads, one per CQ
+        env.cycle()
+        # only the 2 oldest heads were processed; extras re-heaped
+        assert set(admitted_map(env)) == {"default/w0", "default/w1"}
+        assert s.shed_heads_requeued == 2
+        # the shed heads were NOT patched (no Pending churn) and retry
+        env.cycle()
+        assert set(admitted_map(env)) == {f"default/w{i}" for i in range(4)}
+        # trace carries the rung + the shed annotation
+        traces = s.recorder.traces()
+        assert traces[0].degraded == SHED
+        kinds = [a["kind"] for a in traces[0].annotations]
+        assert "shed" in kinds
+
+    def test_shed_cap_keeps_high_priority_over_older_heads(self):
+        # Timestamp-only capping would shed a high-priority mid-storm
+        # arrival every cycle behind older low-priority heads — the cap
+        # must mirror the admission order's priority-then-FIFO prefix.
+        env = _env()
+        s = env.scheduler
+        s.ladder = make_ladder()
+        s.ladder.state = SHED
+        s.ladder.shed_heads = 1
+        _submit(env)  # w0..w3, priority 0, oldest timestamps
+        env.submit(WorkloadWrapper("hot").queue("lq-cq0").priority(100)
+                   .creation(99.0).pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        # cq0's heap is priority-ordered, so "hot" pops as its head
+        # despite the young timestamp; the cap (1 of 4 heads) must then
+        # keep it ahead of the older priority-0 heads from cq1..cq3.
+        assert "default/hot" in admitted_map(env)
+
+    def test_survival_pins_cpu_survival_route(self):
+        env = _env(solver=True)
+        s = env.scheduler
+        s.ladder = make_ladder(survival_heads=2)
+        s.ladder.state = SURVIVAL
+        _submit(env)
+        env.cycle()
+        assert s.cycle_counts.get("cpu-survival") == 1
+        # an intervention, not an economics signal
+        assert not s._route_stats
+        assert len(admitted_map(env)) == 2  # top-k only
+        assert env.scheduler.metrics.degraded_state.value() == 2
+
+    def test_survival_does_not_consume_half_open_probe(self):
+        from kueue_tpu.resilience.breaker import OPEN, CircuitBreaker
+        env = _env(solver=True)
+        s = env.scheduler
+        s.breaker = CircuitBreaker(threshold=1, backoff_base_s=1.0,
+                                   jitter=0.0)
+        s.breaker.record_fault(env.clock.now())
+        assert s.breaker.state == OPEN
+        env.clock.advance(10.0)  # a probe is due
+        s.ladder = make_ladder()
+        s.ladder.state = SURVIVAL
+        _submit(env)
+        env.cycle()
+        assert s.cycle_counts.get("cpu-survival") == 1
+        assert s.breaker.state == OPEN  # probe NOT consumed (no wedge)
+
+    def test_shed_defers_preempt_planning(self):
+        env = _env(n_cqs=1, cpu="8", preemption=True)
+        s = env.scheduler
+        # victims occupy the full quota; a high-priority preemptor needs
+        # target selection to make progress
+        env.admit_existing(
+            WorkloadWrapper("victim").queue("lq-cq0").priority(0)
+            .pod_set(count=1, cpu="8").reserve("cq0").obj())
+        env.submit(WorkloadWrapper("pre").queue("lq-cq0").priority(10)
+                   .creation(1.0).pod_set(count=1, cpu="8").obj())
+        s.ladder = make_ladder()
+        s.ladder.state = SHED
+        env.cycle()
+        # deferred: no eviction issued, plan counted, streak NOT ratcheted
+        assert not env.client.evicted
+        assert s.preempt_plans_deferred == 1
+        assert s._blocked_preempt_streak == 0
+        # ladder recovers -> the preemptor plans and evicts normally
+        s.ladder.state = NORMAL
+        env.cycle()
+        assert "default/victim" in env.client.evicted
+
+    def test_shed_defers_device_preempt_batch(self):
+        env = _env(n_cqs=1, cpu="8", preemption=True, solver=True)
+        s = env.scheduler
+        env.admit_existing(
+            WorkloadWrapper("victim").queue("lq-cq0").priority(0)
+            .pod_set(count=1, cpu="8").reserve("cq0").obj())
+        env.submit(WorkloadWrapper("pre").queue("lq-cq0").priority(10)
+                   .creation(1.0).pod_set(count=1, cpu="8").obj())
+        s.ladder = make_ladder()
+        s.ladder.state = SHED
+        env.cycle()
+        assert not env.client.evicted
+        assert s.preempt_plans_deferred == 1
+        s.ladder.state = NORMAL
+        env.cycle()
+        assert "default/victim" in env.client.evicted
+
+    def test_budget_transitions_fire_annotations_events_and_metrics(self):
+        env = _env()
+        s = env.scheduler
+        events = []
+        s.on_fault = lambda kind, msg: events.append((kind, msg))
+        # Budget of -inf effectively: every real cycle overloads it.
+        s.ladder = DegradationLadder(budget_s=1e-9, escalate_after=1,
+                                     recovery_cycles=1, ewma_alpha=1.0)
+        # a head per cycle: the ladder only observes cycles that popped
+        # heads (a headless scheduler has nothing to bound)
+        n = _submit(env)
+        env.cycle()  # overloaded -> normal->shed at cycle end
+        assert s.ladder.state == SHED
+        assert env.scheduler.metrics.degraded_state.value() == 1
+        assert events and events[0][0] == "degrade"
+        tr = s.recorder.traces()[-1]
+        assert any(a["kind"] == "degrade" for a in tr.annotations)
+        n = _submit(env, start=n)
+        env.cycle()  # shed cycle runs -> counted, escalates again
+        assert s.ladder.state == SURVIVAL
+        assert env.scheduler.metrics.cycles_shed_total.value(
+            state="shed") == 1
+        _submit(env, start=n)
+        env.cycle()
+        assert env.scheduler.metrics.cycles_shed_total.value(
+            state="survival") == 1
+
+    def test_ladder_recovers_end_to_end_with_real_budget(self):
+        env = _env()
+        s = env.scheduler
+        # generous budget: real tiny cycles are healthy
+        s.ladder = DegradationLadder(budget_s=60.0, escalate_after=1,
+                                     recovery_cycles=2, ewma_alpha=1.0)
+        s.ladder.state = SURVIVAL  # as if a storm just ended
+        n = 0
+        for _ in range(5):
+            # trickled arrivals: the ladder only observes cycles that
+            # popped heads
+            n = _submit(env, start=n)
+            env.cycle()
+        assert s.ladder.state == NORMAL
+        assert len(admitted_map(env)) == 20  # nothing lost on the way
+
+    def test_pipeline_gated_off_while_degraded(self):
+        env = _env(solver=True)
+        s = env.scheduler
+        s.pipeline_enabled = True
+        s.ladder = make_ladder()
+        s.ladder.state = SHED
+        assert not s._pipeline_ok([object()] * 100)
+        s.ladder.state = NORMAL
+        # other gates may still veto, but the ladder no longer does
+        assert s.ladder.state == NORMAL
+
+
+class TestDegradeStatusSurface:
+    def test_debug_degrade_payload(self):
+        from kueue_tpu.obs import DebugEndpoints, degrade_status
+        env = _env()
+        s = env.scheduler
+        s.ladder = make_ladder()
+        s.ladder.state = SHED
+        s.ladder.shed_heads = 2
+        _submit(env)
+        env.cycle()
+        st = degrade_status(s)
+        assert st["state"] == SHED
+        assert st["shed_heads_requeued_total"] == 2
+        assert "budget_ms" in st and "ewma_ms" in st
+        ep = DebugEndpoints(s, env.scheduler.metrics)
+        assert ep.handle("/debug/degrade", {}) == degrade_status(s)
+
+    def test_metrics_exposition_includes_degrade_series(self):
+        env = _env()
+        s = env.scheduler
+        s.ladder = DegradationLadder(budget_s=1e-9, escalate_after=1)
+        _submit(env)
+        env.cycle()
+        env.cycle()
+        text = env.scheduler.metrics.dump()
+        assert "kueue_scheduler_degraded_state" in text
+        assert "kueue_scheduler_cycles_shed_total" in text
+        assert "kueue_solver_dispatch_supervised_timeouts_total" in text
+
+
+class TestConfigWiring:
+    def test_manager_wires_ladder_and_supervision(self):
+        from kueue_tpu import config as cfgpkg
+        from kueue_tpu.manager import KueueManager
+        from kueue_tpu.solver import BatchSolver
+        cfg = cfgpkg.Configuration()
+        cfg.scheduler.cycle_budget_s = 0.5
+        cfg.scheduler.shed_heads = 33
+        cfg.scheduler.survival_heads = 7
+        cfg.solver.supervise_dispatch = False
+        solver = BatchSolver()
+        mgr = KueueManager(cfg=cfg, solver=solver)
+        lad = mgr.scheduler.ladder
+        assert lad.enabled and lad.budget_s == 0.5
+        assert lad.shed_heads == 33 and lad.survival_heads == 7
+        assert solver.supervise_dispatch is False
+
+    def test_config_load_and_validation(self):
+        from kueue_tpu import config as cfgpkg
+        cfg = cfgpkg.load({"scheduler": {"cycleBudget": 0.25,
+                                         "shedHeads": 128,
+                                         "survivalHeads": 16,
+                                         "recoveryCycles": 5}})
+        assert cfg.scheduler.cycle_budget_s == 0.25
+        assert cfg.scheduler.shed_heads == 128
+        assert cfg.scheduler.recovery_cycles == 5
+        with pytest.raises(ValueError):
+            cfgpkg.load({"scheduler": {"cycleBudget": -1}})
+        with pytest.raises(ValueError):
+            cfgpkg.load({"scheduler": {"shedHeads": 0}})
+        with pytest.raises(ValueError):
+            cfgpkg.load({"scheduler": {"overloadExitFactor": 2.0}})
+        cfg = cfgpkg.load({"solver": {"superviseDispatch": False}})
+        assert cfg.solver.supervise_dispatch is False
+
+    def test_reconcile_seconds_fed_by_runtime(self):
+        from kueue_tpu.manager import KueueManager
+        from tests.wrappers import make_flavor, make_local_queue
+        mgr = KueueManager()
+        mgr.store.create(make_flavor("default"))
+        mgr.store.create(ClusterQueueWrapper("cq").resource_group(
+            flavor_quotas("default", cpu=8)).obj())
+        mgr.store.create(make_local_queue("lq", "default", "cq"))
+        mgr.run_until_idle()
+        h = mgr.metrics.reconcile_seconds
+        assert h.count(controller="clusterqueue") > 0
+        assert h.count(controller="localqueue") > 0

@@ -15,6 +15,7 @@ from kueue_tpu.resilience.breaker import (
 from kueue_tpu.resilience.faultinject import (
     DeviceFault, FaultInjector, InjectedFault, SITE_COLLECT, SITE_DISPATCH,
     SITE_REPLAY, SITE_SCATTER)
+from kueue_tpu.resilience.supervisor import SupervisedWorker
 from kueue_tpu.resilience.watchdog import DispatchTimeout, DispatchWatchdog
 from kueue_tpu.solver import BatchSolver
 from tests.test_solver import admitted_map, build_env
@@ -143,6 +144,72 @@ class TestCircuitBreaker:
         assert b.state == CLOSED
 
 
+class TestSupervisedWorker:
+    def test_inline_without_deadline(self):
+        w = SupervisedWorker()
+        assert w.run(lambda a, b: a + b, 1, 2) == 3
+        assert w.status()["alive"] is False  # no thread was ever spawned
+
+    def test_result_and_exception_relay(self):
+        w = SupervisedWorker()
+        assert w.run(lambda: 42, deadline_s=5.0) == 42
+        with pytest.raises(ValueError, match="boom"):
+            w.run(lambda: (_ for _ in ()).throw(ValueError("boom")),
+                  deadline_s=5.0)
+        w.stop()
+
+    def test_worker_thread_is_reused(self):
+        import threading
+        w = SupervisedWorker()
+        tids = set()
+        for _ in range(3):
+            tids.add(w.run(lambda: threading.get_ident(), deadline_s=5.0))
+        assert len(tids) == 1  # persistent worker, not per-call threads
+        assert w.calls == 3
+        w.stop()
+
+    def test_timeout_abandons_and_respawns(self):
+        import threading
+        release = threading.Event()
+
+        def wedge():
+            release.wait(10.0)
+            return "late"
+
+        w = SupervisedWorker()
+        with pytest.raises(DispatchTimeout):
+            w.run(wedge, deadline_s=0.05)
+        assert w.timeouts == 1 and w.orphaned == 1
+        # the next call is NOT queued behind the wedged one
+        assert w.run(lambda: "fresh", deadline_s=5.0) == "fresh"
+        release.set()  # let the orphan drain and exit its loop
+        w.stop()
+
+    def test_orphan_result_is_discarded(self):
+        import threading
+        release = threading.Event()
+        out = []
+
+        def wedge():
+            release.wait(10.0)
+            out.append("orphan-finished")
+            return "late"
+
+        w = SupervisedWorker()
+        with pytest.raises(DispatchTimeout):
+            w.run(wedge, deadline_s=0.05)
+        release.set()
+        # the orphan finishes eventually; its result reaches nobody
+        for _ in range(100):
+            if out:
+                break
+            import time
+            time.sleep(0.01)
+        assert out == ["orphan-finished"]
+        assert w.run(lambda: "next", deadline_s=5.0) == "next"
+        w.stop()
+
+
 class TestWatchdog:
     def test_deadline_derivation(self):
         w = DispatchWatchdog(safety_factor=10.0, min_deadline_s=0.5,
@@ -229,6 +296,9 @@ class TestSchedulerFaultContainment:
     def test_watchdog_timeout_abandons_the_collect(self):
         env = _fault_env()
         s = env.scheduler
+        # Collect-watchdog test: the tiny cold clamp must not also
+        # abort the (legitimately compiling) supervised dispatch.
+        s.solver.supervise_dispatch = False
         s.watchdog = DispatchWatchdog(safety_factor=1.0,
                                       min_deadline_s=0.05,
                                       max_deadline_s=0.1)
@@ -241,6 +311,10 @@ class TestSchedulerFaultContainment:
         assert s.solver.counters["dispatch_timeouts"] == 1
         assert s.solver_faults == 1
         assert s.metrics.dispatch_timeouts_total.value() == 1
+        # a collect-side watchdog timeout (surfacing via the "solve"
+        # site on the sync path) is NOT a supervised-dispatch timeout
+        assert s.metrics.dispatch_supervised_timeouts_total.value() == 0
+        assert s.solver.counters["supervised_timeouts"] == 0
         assert s.solver._resident is None  # residency invalidated
         # the abandoned cycle's heads re-heap and admit on retry
         env.cycle()
@@ -342,6 +416,69 @@ class TestSchedulerFaultContainment:
         assert s.breaker.state == CLOSED
         assert s.breaker.recoveries == 1
 
+    def test_dispatch_hang_is_supervised_not_a_freeze(self):
+        # ISSUE 5 tentpole: a DELAY (hang) at the device_dispatch site
+        # used to sleep INLINE on the scheduler thread — the watchdog
+        # only bounded collect, so an indefinite hang froze the
+        # scheduler forever. Supervised dispatch abandons it within the
+        # watchdog's cold clamp and the cycle completes via the CPU
+        # path.
+        import time as _t
+        env = _fault_env()
+        s = env.scheduler
+        # Two warm cycles compile every shape bucket the hang cycle
+        # will hit (the establishing dispatch AND the delta-prologue
+        # variant) — so the tight clamp set below cannot be blown by a
+        # legitimate compile, only by the injected hang.
+        for i, name in enumerate(("warm-a", "warm-b")):
+            env.submit(WorkloadWrapper(name).queue("lq")
+                       .creation(float(i)).pod_set(count=1, cpu="2").obj())
+            env.cycle()
+        assert "default/warm-b" in admitted_map(env)
+        s.watchdog = DispatchWatchdog(safety_factor=1.0,
+                                      min_deadline_s=0.05,
+                                      max_deadline_s=0.3)
+        env.submit(WorkloadWrapper("w").queue("lq").creation(2.0)
+                   .pod_set(count=1, cpu="2").obj())
+        faultinject.install(FaultInjector(
+            {SITE_DISPATCH: {0: (faultinject.DELAY, 2.0)}}))
+        t0 = _t.perf_counter()
+        env.cycle()  # 2s hang vs the 0.3s cold clamp: abandoned
+        waited = _t.perf_counter() - t0
+        faultinject.uninstall()
+        assert waited < 2.0  # did NOT sit out the hang inline
+        assert s.solver.counters["supervised_timeouts"] == 1
+        assert s.solver._supervisor.orphaned == 1
+        assert s.solver_faults == 1
+        assert s.solver._resident is None  # residency invalidated
+        assert s.metrics.dispatch_supervised_timeouts_total.value() == 1
+        # the CPU fallback admitted the head in the SAME cycle
+        assert "default/w" in admitted_map(env)
+        # next device cycle re-establishes on a FRESH worker (the
+        # orphan is still sleeping — raise the clamp back over the
+        # re-establish dispatch, which is jit-cached but not free)
+        s.watchdog = DispatchWatchdog()
+        env.submit(WorkloadWrapper("w2").queue("lq").creation(3.0)
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        assert "default/w2" in admitted_map(env)
+
+    def test_supervision_disabled_runs_inline(self):
+        env = _fault_env()
+        s = env.scheduler
+        s.solver.supervise_dispatch = False
+        s.watchdog = DispatchWatchdog(safety_factor=1.0,
+                                      min_deadline_s=0.05,
+                                      max_deadline_s=0.1)
+        env.submit(WorkloadWrapper("w").queue("lq")
+                   .pod_set(count=1, cpu="2").obj())
+        faultinject.install(FaultInjector(
+            {SITE_DISPATCH: {0: (faultinject.DELAY, 0.2)}}))
+        env.cycle()  # inline: the delay is sat out, no dispatch fault
+        faultinject.uninstall()
+        assert s.solver.counters["supervised_timeouts"] == 0
+        assert "default/w" in admitted_map(env)
+
     def test_pipelined_collect_timeout_requeues_heads(self):
         def setup(env):
             env.add_flavor("default")
@@ -351,6 +488,10 @@ class TestSchedulerFaultContainment:
         env = _fault_env(setup)
         s = env.scheduler
         s.pipeline_enabled = True
+        # This test exercises the COLLECT watchdog; its deliberately
+        # tiny cold clamp would also abort legitimate compiles inside
+        # supervised dispatch, so run dispatch inline (PR 3 semantics).
+        s.solver.supervise_dispatch = False
         s.watchdog = DispatchWatchdog(safety_factor=1.0,
                                       min_deadline_s=0.05,
                                       max_deadline_s=0.1)

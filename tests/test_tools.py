@@ -215,6 +215,15 @@ class TestVisibilityHTTP:
         assert status == 200
         assert json.loads(body)["bound"] is False  # no solver configured
 
+    def test_debug_degrade(self, server):
+        status, body = _get(server.port, "/debug/degrade")
+        assert status == 200
+        d = json.loads(body)
+        assert d["state"] == "normal" and d["enabled"] is False
+        assert d["cycles_shed"] == 0
+        assert "shed_heads_requeued_total" in d
+        assert "preempt_plans_deferred_total" in d
+
     def test_debug_404_without_wiring(self, mgr):
         # A bare VisibilityServer (no debug surface) keeps the old
         # behavior: /metrics and /debug/* are unknown paths.

@@ -15,7 +15,14 @@ Prints one JSON line per run plus a final verdict line; exits non-zero
 on divergence. Deterministic for a given seed (FakeClock + seeded
 schedule + seeded breaker jitter).
 
-Usage: python tools/chaos_run.py [seed] [inject_cycles]
+`--storm` runs the overload variant instead (ISSUE 5): the same full
+control plane under a workload storm with a deliberately-blown cycle
+budget — the degradation ladder must engage (shed/survival cycles,
+heads requeued), keep admitting throughout, recover to normal once the
+budget is realistic again, and converge to the no-ladder run's exact
+admitted set.
+
+Usage: python tools/chaos_run.py [seed] [inject_cycles] [--storm]
 """
 
 import json
@@ -148,9 +155,95 @@ def run(seed: int, inject_cycles: int, chaotic: bool) -> dict:
     }
 
 
+def run_storm(seed: int, laddered: bool) -> dict:
+    """One overload-storm run through the full KueueManager: a big
+    burst of arrivals with (optionally) a cycle budget every storm
+    cycle blows, relaxed once the storm subsides."""
+    from kueue_tpu.resilience.degrade import NORMAL, DegradationLadder
+    cfg = cfgpkg.Configuration()
+    cfg.solver.enable = True
+    cfg.solver.min_heads = 0
+    clock = FakeClock(1000.0)
+    mgr = KueueManager(cfg=cfg, clock=clock, solver=BatchSolver())
+    s = mgr.scheduler
+    if laddered:
+        # Forced-overload budget: every real cycle blows 1ns, so the
+        # ladder's walk is deterministic regardless of machine speed;
+        # relaxed to 60s at the subside point below.
+        s.ladder = DegradationLadder(budget_s=1e-9, shed_heads=3,
+                                     survival_heads=1, escalate_after=1,
+                                     recovery_cycles=2, ewma_alpha=1.0)
+    for obj in make_objects():
+        mgr.store.create(obj)
+    mgr.run_until_idle(max_iterations=1_000_000)
+    n = 0
+    for wave in range(6):  # the storm: 36 workloads at once
+        for i in range(NUM_CQS):
+            mgr.store.create(make_workload(wave, i, n))
+            n += 1
+    mgr.run_until_idle(max_iterations=1_000_000)
+    for cycle in range(40):
+        if 12 <= cycle < 25:
+            # identical post-storm trickle in both runs: keeps heads
+            # flowing so the ladder keeps observing and recovers
+            for i in range(NUM_CQS):
+                mgr.store.create(make_workload(6 + cycle, i, n))
+                n += 1
+            mgr.run_until_idle(max_iterations=1_000_000)
+        mgr.scheduler.schedule(timeout=0)
+        mgr.run_until_idle(max_iterations=1_000_000)
+        clock.advance(1.0)
+        if laddered and cycle == 12:
+            s.ladder.budget_s = 60.0  # the storm subsided
+    lad = s.ladder
+    return {
+        "mode": "storm-laddered" if laddered else "storm-clean",
+        "seed": seed,
+        "admitted": admitted_keys(mgr),
+        "state": lad.state,
+        "recovered": lad.state == NORMAL,
+        "escalations": lad.escalations,
+        "recoveries": lad.recoveries,
+        "cycles_shed": lad.cycles_shed,
+        "shed_heads_requeued": s.shed_heads_requeued,
+        "survival_cycles": s.cycle_counts.get("cpu-survival", 0),
+        "cycle_counts": dict(s.cycle_counts),
+        "events": [f"{e.type}/{e.reason}: {e.message}"
+                   for e in mgr.recorder.events
+                   if e.kind == "Scheduler" and "Degraded" in e.reason],
+    }
+
+
+def main_storm(seed: int) -> int:
+    clean = run_storm(seed, laddered=False)
+    storm = run_storm(seed, laddered=True)
+    for r in (clean, storm):
+        print(json.dumps({**r, "admitted": len(r["admitted"]),
+                          "events": r["events"][:8]}), file=sys.stderr)
+    ok = (storm["escalations"] >= 1 and storm["cycles_shed"] >= 1
+          and storm["shed_heads_requeued"] >= 1
+          and storm["survival_cycles"] >= 1 and storm["recovered"]
+          and storm["admitted"] == clean["admitted"])
+    print(json.dumps({
+        "tool": "chaos_run", "mode": "storm", "seed": seed, "ok": ok,
+        "admitted": len(storm["admitted"]),
+        "escalations": storm["escalations"],
+        "recoveries": storm["recoveries"],
+        "cycles_shed": storm["cycles_shed"],
+        "shed_heads_requeued": storm["shed_heads_requeued"],
+        "survival_cycles": storm["survival_cycles"],
+        "recovered": storm["recovered"],
+    }))
+    return 0 if ok else 1
+
+
 def main():
-    seed = int(sys.argv[1]) if len(sys.argv) > 1 else 1234
-    inject_cycles = int(sys.argv[2]) if len(sys.argv) > 2 else 12
+    args = [a for a in sys.argv[1:] if a != "--storm"]
+    storm = "--storm" in sys.argv[1:]
+    seed = int(args[0]) if args else 1234
+    if storm:
+        return main_storm(seed)
+    inject_cycles = int(args[1]) if len(args) > 1 else 12
     clean = run(seed, inject_cycles, chaotic=False)
     chaos = run(seed, inject_cycles, chaotic=True)
     for r in (clean, chaos):

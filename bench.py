@@ -763,6 +763,98 @@ def bench_overload_shed(num_cqs=256, num_cohorts=32, backlog_waves=10,
     return shed_p99
 
 
+# The speculative_pipeline row's rangespec bound (ISSUE 6 acceptance):
+# coverage of the overlapped solve on steady-state traffic. Evaluated
+# IN-PROCESS on the current backend only — the row is backend-stamped
+# like every other, and cross-round comparison across backends is
+# refused by policy (perf.checker.refuse_cross_backend).
+SPECULATIVE_PIPELINE_RANGESPEC = {"min_pipelined_hit_rate": 0.9}
+
+
+def bench_speculative_pipeline(num_cqs=512, num_cohorts=64, cycles=40,
+                               churn_at=(15,)):
+    """Always-on speculative admission pipeline (scheduler/PIPELINE.md):
+    steady-state traffic — every cycle admits a fresh all-fit wave while
+    the previous cycle's admissions complete — must keep the solve
+    stage overlapped (route device-pipelined) in >90% of device cycles,
+    asserted as the rangespec bound above. A scripted mid-run churn
+    burst (an in-flight workload updated under the speculation) must
+    abort via the generation-token validation and fall back to the
+    synchronous path — the abort cost is exactly the sync cycles the
+    hit rate already accounts for, and no double admission is possible
+    (tests/test_pipeline.py owns the bit-equivalence assertion; this
+    row owns the coverage + cost numbers)."""
+    from kueue_tpu.solver import BatchSolver
+
+    sched, cache, queues, client, clock = build_env(
+        num_cqs, num_cohorts, ["f0"], nominal_units=8,
+        solver=BatchSolver(), pipeline=True)
+    n = 0
+
+    def submit_wave():
+        nonlocal n
+        for i in range(num_cqs):
+            wl = make_workload(f"w{n}", f"lq{i}", cpu_units=2,
+                               creation=float(n))
+            queues.add_or_update_workload(wl)
+            n += 1
+
+    def run_cycle():
+        # steady state: last cycle's admissions complete, freeing their
+        # quota through the cache (journal corrections for the solver)
+        for wl in client.drain_applied():
+            cache.delete_workload(wl)
+            queues.queue_associated_inadmissible_workloads_after(wl)
+        submit_wave()
+        sched.schedule(timeout=0)
+        clock.advance(1.0)
+
+    for _ in range(3):  # warm: compiles + the dispatch-only first cycle
+        run_cycle()
+    counts0 = dict(sched.cycle_counts)
+    times = []
+    for c in range(cycles):
+        t0 = time.perf_counter()
+        run_cycle()
+        times.append(time.perf_counter() - t0)
+        if c in churn_at and sched._inflight is not None:
+            # Update a workload that is IN FLIGHT under the speculation:
+            # the queue manager's upsert delta bumps its arena slot
+            # generation, so the next validation must abort.
+            victim = sched._inflight.inflight.plan.batch.infos[0]
+            wl = make_workload(victim.obj.metadata.name,
+                               victim.obj.spec.queue_name, cpu_units=2,
+                               priority=7, creation=float(n))
+            queues.add_or_update_workload(wl)
+    while sched._inflight is not None:
+        t0 = time.perf_counter()
+        sched.schedule(timeout=0)
+        times.append(time.perf_counter() - t0)
+    counts = {k: v - counts0.get(k, 0)
+              for k, v in sched.cycle_counts.items()}
+    pipelined = counts.get("device-pipelined", 0)
+    sync_dev = counts.get("device", 0)
+    hit_rate = pipelined / max(pipelined + sync_dev, 1)
+    bound = SPECULATIVE_PIPELINE_RANGESPEC["min_pipelined_hit_rate"]
+    assert sched.speculation_aborts >= len(churn_at), (
+        "scripted churn produced no mis-speculation abort",
+        sched.speculation_abort_reasons)
+    assert sched.speculation_hits > 0
+    assert hit_rate > bound, (
+        f"pipelined hit rate {hit_rate:.3f} below the rangespec bound "
+        f"{bound} (cycle counts {counts})")
+    log({"bench": "speculative_pipeline", "cqs": num_cqs,
+         "cycles": pipelined + sync_dev,
+         "pipelined_cycles": pipelined, "sync_device_cycles": sync_dev,
+         "pipelined_hit_rate": round(hit_rate, 3),
+         "rangespec": dict(SPECULATIVE_PIPELINE_RANGESPEC),
+         "speculation_hits": sched.speculation_hits,
+         "speculation_aborts": sched.speculation_aborts,
+         "abort_reasons": dict(sched.speculation_abort_reasons),
+         "p50_ms": round(p50(times) * 1e3, 1)})
+    return hit_rate
+
+
 def bench_e2e_progressive():
     """The flagship scenario (BASELINE.json north star): 2048 CQs x 32
     flavors with workloads sized to a full flavor, so cycle N assigns at
@@ -1193,6 +1285,7 @@ def main():
     bench_device_fault_recovery()
     bench_trace_overhead()
     bench_overload_shed()
+    hit_rate = bench_speculative_pipeline()
     rows = {}
     admitted_per_sec, speedup = bench_e2e_progressive()
     rows["progressive_fill"] = speedup
@@ -1220,6 +1313,7 @@ def main():
         "vs_baseline": round(admitted_per_sec / baseline, 2),
         "snapshot_incremental_speedup": round(snapshot_speedup, 1),
         "workload_arena_speedup": round(arena_speedup, 1),
+        "speculative_pipeline_hit_rate": round(hit_rate, 3),
         **BACKEND,
     }))
 

@@ -174,6 +174,34 @@ class Cache:
                 self._journal[seq - first] = entry[:5] + (None,)
         self._journal_aux_stripped = max(self._journal_aux_stripped, upto)
 
+    def generation_token(self) -> tuple:
+        """The structural generation stamp for speculative solves
+        (scheduler/stages.SpeculationToken): three epoch ints, read
+        under the lock. Workload churn does NOT move any of these —
+        usage movement reconciles through the journal; only structural
+        edits (CQ/cohort/flavor-spec changes) invalidate an in-flight
+        speculative result."""
+        with self._lock:
+            return (self.topology_epoch, self.cohort_epoch,
+                    self.flavor_spec_epoch)
+
+    def snapshot_current(self, snap: Snapshot) -> bool:
+        """Cheap generation-token check: True iff no structural epoch
+        moved since ``snap`` was produced (see
+        incremental.generations_current)."""
+        from kueue_tpu.cache.incremental import generations_current
+        with self._lock:
+            return generations_current(snap, self)
+
+    def journal_overflowed(self, consumer: str = SOLVER_CONSUMER) -> bool:
+        """Peek (without clearing) whether ``consumer`` lost journal
+        entries since its last drain — a speculative result computed on
+        residency whose corrections were dropped is unsound and must
+        abort (the flag itself still resets at the next drain, which
+        falls back to a full rebuild)."""
+        with self._lock:
+            return consumer in self._journal_overflowed
+
     def drain_usage_journal(self, upto_seq: int,
                             consumer: str = "solver") -> tuple:
         """Return (entries with cursor < seq <= upto_seq, overflowed) for

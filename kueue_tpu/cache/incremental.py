@@ -40,6 +40,33 @@ from kueue_tpu.cache.snapshot import ClusterQueueSnapshot, CohortSnapshot, Snaps
 SNAPSHOT_CONSUMER = "snapshot"
 
 
+def snapshot_generations(snapshot) -> tuple:
+    """A snapshot's structural generation stamp, in the SAME canonical
+    order as ``Cache.generation_token()`` — the one place the tuple
+    layout is defined on the snapshot side, so a future fourth epoch
+    has exactly two producers to touch (here and generation_token)."""
+    return (snapshot.topology_epoch, snapshot.cohort_epoch,
+            snapshot.flavor_spec_epoch)
+
+
+def generations_current(snapshot, cache) -> bool:
+    """Generation-token validation for the speculative admission
+    pipeline: True iff no STRUCTURAL epoch moved since ``snapshot`` was
+    produced — the cheap alternative to comparing snapshots field by
+    field (three int compares instead of an O(CQs x flavors) walk).
+
+    The epochs used are exactly the ones the maintainer's ``_sync``
+    keys its full-rebuild fallback on: equal epochs guarantee every
+    journaled entry since the snapshot is non-structural, so a
+    speculative solve dispatched against the snapshot stays sound —
+    workload churn reconciles through the usage journal and through the
+    encode arena's per-slot generations, which the SpeculationToken
+    checks separately. Caller holds the cache lock (or tolerates a
+    torn read, as the scheduler's single-threaded cycle does).
+    """
+    return snapshot_generations(snapshot) == cache.generation_token()
+
+
 class SnapshotMaintainer:
     def __init__(self, cache):
         self._cache = cache

@@ -21,6 +21,7 @@ handlers (:554-757):
 from __future__ import annotations
 
 import random
+import time as _time
 from typing import Optional
 
 from kueue_tpu import config as cfgpkg
@@ -35,7 +36,7 @@ class WorkloadReconciler:
     def __init__(self, store: Store, queues, cache, recorder: EventRecorder,
                  clock, cfg: Optional[cfgpkg.Configuration] = None, metrics=None,
                  watchers: Optional[list] = None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None, obs_recorder=None):
         self.store = store
         self.queues = queues
         self.cache = cache
@@ -43,6 +44,11 @@ class WorkloadReconciler:
         self.clock = clock
         self.cfg = cfg or cfgpkg.Configuration()
         self.metrics = metrics
+        # Optional obs FlightRecorder: the per-event spans inside a
+        # reconcile (reconcile.workload.{event}) land in whatever cycle
+        # trace is open (no-op otherwise — same disabled contract as
+        # every recorder hook).
+        self.obs_recorder = obs_recorder
         # seeded for reproducible backoff jitter in the deterministic sim
         self.rng = rng or random.Random(0)
         # MultiKueue et al. observe workload transitions (reference:
@@ -105,7 +111,8 @@ class WorkloadReconciler:
                     return None
         else:
             # deactivated -> evict (reference: :186-215)
-            if self._reconcile_deactivation(wl, now):
+            if self._event_span("deactivation",
+                                self._reconcile_deactivation, wl, now):
                 return None
 
         lq = self.store.try_get("LocalQueue", wl.metadata.namespace,
@@ -131,12 +138,15 @@ class WorkloadReconciler:
                         "The ClusterQueue was restarted after being stopped", True, now)
                     self.store.update(wl)
                     return None
-                if self._sync_admission_checks(wl, cq, now):
+                if self._event_span("admission-checks",
+                                    self._sync_admission_checks,
+                                    wl, cq, now):
                     return None
 
         # Admitted flips to True only here, once all checks are Ready
         # (reference: :252-268)
-        if not wlpkg.is_admitted(wl) and wlpkg.sync_admitted_condition(wl, now):
+        if not wlpkg.is_admitted(wl) and self._event_span(
+                "sync-admitted", wlpkg.sync_admitted_condition, wl, now):
             self.store.update(wl)
             if wlpkg.is_admitted(wl):
                 qr = find_condition(wl.status.conditions, api.WORKLOAD_QUOTA_RESERVED)
@@ -152,13 +162,20 @@ class WorkloadReconciler:
             return None
 
         if wlpkg.has_quota_reservation(wl):
-            if self._reconcile_check_based_eviction(wl, cq_name, now):
+            if self._event_span("check-eviction",
+                                self._reconcile_check_based_eviction,
+                                wl, cq_name, now):
                 return None
-            if self._reconcile_lq_active_state(wl, lq, lq_exists, now):
+            if self._event_span("lq-active", self._reconcile_lq_active_state,
+                                wl, lq, lq_exists, now):
                 return None
-            if cq_name is not None and self._reconcile_cq_active_state(wl, cq_name, now):
+            if cq_name is not None and self._event_span(
+                    "cq-active", self._reconcile_cq_active_state,
+                    wl, cq_name, now):
                 return None
-            return self._reconcile_not_ready_timeout(wl, cq_name, now)
+            return self._event_span("pods-ready-timeout",
+                                    self._reconcile_not_ready_timeout,
+                                    wl, cq_name, now)
 
         # pending: surface why the workload can't queue (reference: :285-330)
         msg = None
@@ -175,6 +192,28 @@ class WorkloadReconciler:
                     wl, api.WORKLOAD_INADMISSIBLE, msg, now):
                 self.store.update(wl)
         return None
+
+
+    # -- per-event observability (PR-5 follow-up) -----------------------
+
+    def _event_span(self, name: str, fn, *args):
+        """Time one event handler inside the reconcile: feeds the
+        reconcile_event_seconds{controller,event} histogram (the
+        per-event split of the coarse reconcile_seconds series) and
+        emits a nested flight-recorder span
+        (``reconcile.workload.{event}`` — dotted, so cycle phase sums
+        never double-count it) when a cycle trace is open. Without
+        metrics/recorder this is the plain call."""
+        if self.metrics is None and self.obs_recorder is None:
+            return fn(*args)
+        t0 = _time.perf_counter()
+        out = fn(*args)
+        dt = _time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.reconcile_event("workload", name, dt)
+        if self.obs_recorder is not None:
+            self.obs_recorder.span(f"reconcile.workload.{name}", t0, dt)
+        return out
 
     # -- pieces ---------------------------------------------------------
 

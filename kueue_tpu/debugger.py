@@ -49,7 +49,8 @@ class Dumper:
 
     def _dump_solver_plane(self) -> list:
         from kueue_tpu.obs import (arena_status, breaker_status,
-                                   degrade_status, router_status)
+                                   degrade_status, pipeline_status,
+                                   router_status)
         sched = self.scheduler
         lines = ["-- breaker --"]
         st = breaker_status(sched)
@@ -68,6 +69,13 @@ class Dumper:
                      f"recoveries={dg['recoveries']} "
                      f"heads_requeued={dg['shed_heads_requeued_total']} "
                      f"preempts_deferred={dg['preempt_plans_deferred_total']}")
+        lines.append("-- pipeline --")
+        pl = pipeline_status(sched)
+        lines.append(f"enabled={pl['enabled']} inflight={pl['inflight']} "
+                     f"hit_rate={pl['pipelined_hit_rate']} "
+                     f"hits={pl['speculation_hits']} "
+                     f"aborts={pl['speculation_aborts']} "
+                     f"abort_reasons={pl['abort_reasons']}")
         lines.append("-- router --")
         rt = router_status(sched)
         lines.append(f"routing={rt['routing']} "

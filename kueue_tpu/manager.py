@@ -113,10 +113,21 @@ class KueueManager:
         check_controllers = set(registered_check_controllers or set())
         check_controllers |= {provpkg.CONTROLLER_NAME, mkpkg.CONTROLLER_NAME}
 
+        # Cycle flight recorder (kueue_tpu/obs): per-cycle phase traces
+        # in a bounded ring, served via serve_visibility()'s /debug/*.
+        # Created before the controllers so the workload reconciler's
+        # per-event spans (reconcile.workload.{event}) share it.
+        from kueue_tpu.obs import FlightRecorder
+        o = self.cfg.observability
+        self.flight_recorder = FlightRecorder(
+            capacity=o.flight_recorder_capacity,
+            enabled=o.flight_recorder_enable)
+
         self.controllers = setup_core_controllers(
             self.runtime, self.store, self.queues, self.cache, self.recorder,
             cfg=self.cfg, metrics=self.metrics,
-            registered_check_controllers=check_controllers)
+            registered_check_controllers=check_controllers,
+            obs_recorder=self.flight_recorder)
 
         self.provisioning = provpkg.setup_provisioning_controller(
             self.runtime, self.store, self.recorder)
@@ -143,13 +154,6 @@ class KueueManager:
         setup_webhooks(self.store, self.cfg)
 
         self.scheduler_client = StoreSchedulerClient(self.store, self.recorder)
-        # Cycle flight recorder (kueue_tpu/obs): per-cycle phase traces
-        # in a bounded ring, served via serve_visibility()'s /debug/*.
-        from kueue_tpu.obs import FlightRecorder
-        o = self.cfg.observability
-        self.flight_recorder = FlightRecorder(
-            capacity=o.flight_recorder_capacity,
-            enabled=o.flight_recorder_enable)
         self.scheduler = Scheduler(
             self.queues, self.cache, self.scheduler_client,
             ordering=ordering,

@@ -306,6 +306,18 @@ class Registry:
             "kueue_solver_dispatch_supervised_timeouts_total",
             "Dispatches abandoned by the supervised solver-worker "
             "deadline (hang during trace/compile/transfer)")
+        # Speculative admission pipeline (scheduler/PIPELINE.md):
+        # validated-and-committed speculative cycles vs mis-speculation
+        # aborts by validation reason (topology-epoch | cohort-epoch |
+        # flavor-spec-epoch | residency | arena-slots |
+        # journal-overflow | injected).
+        self.speculation_hits_total = Counter(
+            "kueue_scheduler_speculation_hits_total",
+            "Speculative pipelined results validated and committed")
+        self.speculation_aborts_total = Counter(
+            "kueue_scheduler_speculation_aborts_total",
+            "Speculative pipelined results abandoned at apply-validation "
+            "by reason", ["reason"])
         # Coarse reconciler latency (ROADMAP PR-4 follow-up: the
         # wall_s - cycle_time_total gap had no signal); fed by the sim
         # Runtime around every reconcile call.
@@ -313,6 +325,14 @@ class Registry:
             "kueue_reconcile_seconds",
             "Reconcile latency by controller", ["controller"],
             buckets=_PHASE_BUCKETS)
+        # Per-event split of the reconcile latency (PR-5 left it
+        # coarse): the hot reconcilers time their internal event
+        # handlers and feed this alongside nested flight-recorder spans
+        # (reconcile.{controller}.{event}).
+        self.reconcile_event_seconds = Histogram(
+            "kueue_reconcile_event_seconds",
+            "Reconcile latency by controller and handled event",
+            ["controller", "event"], buckets=_PHASE_BUCKETS)
         self._all = [v for v in vars(self).values() if isinstance(v, _Metric)]
 
     # --- report helpers (reference: metrics.go:262-400) ---
@@ -365,6 +385,18 @@ class Registry:
 
     def reconcile_observed(self, controller: str, seconds: float) -> None:
         self.reconcile_seconds.observe(seconds, controller=controller)
+
+    def reconcile_event(self, controller: str, event: str,
+                        seconds: float) -> None:
+        self.reconcile_event_seconds.observe(seconds,
+                                             controller=controller,
+                                             event=event)
+
+    def speculation_hit(self) -> None:
+        self.speculation_hits_total.inc()
+
+    def speculation_abort(self, reason: str) -> None:
+        self.speculation_aborts_total.inc(reason=reason)
 
     def cycle_observed(self, route: str, heads: int,
                        phase_sums: dict) -> None:

@@ -11,6 +11,7 @@ from kueue_tpu.obs.status import (
     arena_status,
     breaker_status,
     degrade_status,
+    pipeline_status,
     router_status,
 )
 
@@ -22,5 +23,6 @@ __all__ = [
     "arena_status",
     "breaker_status",
     "degrade_status",
+    "pipeline_status",
     "router_status",
 ]

@@ -72,6 +72,31 @@ def router_status(scheduler) -> dict:
     }
 
 
+def pipeline_status(scheduler) -> dict:
+    """Speculative-pipeline state (/debug/pipeline): coverage (how many
+    device cycles were overlapped), speculation hit/abort outcomes by
+    validation reason, and whether a cycle is in flight right now —
+    fed from the same counters the perf artifacts report, so the
+    ``pipelined_hit_rate`` story is checkable live."""
+    counts = scheduler.cycle_counts
+    pipelined = counts.get("device-pipelined", 0)
+    device_sync = counts.get("device", 0)
+    total = pipelined + device_sync
+    return {
+        "enabled": scheduler.pipeline_enabled,
+        "inflight": scheduler._inflight is not None,
+        "cooldown": scheduler._pipeline_cooldown,
+        "pipelined_cycles": pipelined,
+        "sync_device_cycles": device_sync,
+        "pipelined_hit_rate": (round(pipelined / total, 3)
+                               if total else None),
+        "speculation_hits": scheduler.speculation_hits,
+        "speculation_aborts": scheduler.speculation_aborts,
+        "abort_reasons": dict(scheduler.speculation_abort_reasons),
+        "allow_pipeline_degraded": scheduler.ladder.allow_pipeline,
+    }
+
+
 def arena_status(solver) -> dict:
     """Encode-arena slot occupancy and churn counters."""
     arena = getattr(solver, "_arena", None)
@@ -117,6 +142,8 @@ class DebugEndpoints:
             return degrade_status(self.scheduler)
         if path == "/debug/router":
             return router_status(self.scheduler)
+        if path == "/debug/pipeline":
+            return pipeline_status(self.scheduler)
         if path == "/debug/arena":
             if self.scheduler.solver is None:
                 return {"bound": False}

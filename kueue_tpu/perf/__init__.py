@@ -17,7 +17,8 @@ from kueue_tpu.perf.generator import (
     north_star_generator_config,
 )
 from kueue_tpu.perf.runner import RunResult, Runner
-from kueue_tpu.perf.checker import RangeSpec, check, default_rangespec
+from kueue_tpu.perf.checker import (RangeSpec, check, default_rangespec,
+                                    refuse_cross_backend)
 
 __all__ = [
     "CohortClass", "QueueClass", "WorkloadClass", "WorkloadSet",

@@ -8,12 +8,20 @@ statistics stay inside accepted bounds.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional
 
 from kueue_tpu.perf.runner import RunResult
 
 
 @dataclass
 class RangeSpec:
+    # Backend family the HARDWARE-DEPENDENT bounds (wall time,
+    # snapshot-build ms, phase p99 ms) were calibrated on; "" means the
+    # spec is backend-agnostic. A run on a different backend (or one
+    # that fell back to CPU) REFUSES comparison instead of reporting a
+    # regression that never happened — see refuse_cross_backend and the
+    # ROADMAP bench-env note (BENCH_r05 vs r04 are not comparable).
+    backend: str = ""
     max_wall_s: float = 0.0   # 0 = unchecked (hardware-dependent)
     # workload class -> max average time-to-admission (seconds)
     wl_class_max_avg_tta_s: dict = field(default_factory=dict)
@@ -64,6 +72,26 @@ def default_rangespec() -> RangeSpec:
                           "requeue": 100.0, "dispatch": 1000.0,
                           "fetch": 1000.0},
     )
+
+
+def refuse_cross_backend(spec: RangeSpec, backend: Optional[dict]) -> Optional[str]:
+    """Bench-env honesty (ROADMAP bench-env note): numbers measured on
+    different backends are not comparable, so a spec that declares the
+    backend its bounds were calibrated on refuses to judge a run from
+    another one. Returns the refusal reason, or None when the
+    comparison is sound (backend-agnostic spec, or matching backend
+    with no CPU fallback)."""
+    if not spec.backend or backend is None:
+        return None
+    run_backend = backend.get("backend", "unknown")
+    if backend.get("cpu_fallback") and spec.backend != "cpu":
+        return (f"rangespec calibrated on {spec.backend!r} but the run "
+                f"fell back to CPU — cross-backend comparison refused")
+    if run_backend != spec.backend:
+        return (f"rangespec calibrated on {spec.backend!r} but the run "
+                f"used {run_backend!r} — cross-backend comparison "
+                f"refused")
+    return None
 
 
 def check(result: RunResult, spec: RangeSpec) -> list:

@@ -79,6 +79,9 @@ class RunResult:
     # solver cycle time went.
     engine_cycles: dict = field(default_factory=dict)
     pipelined_hit_rate: Optional[float] = None
+    # Speculative-pipeline outcomes (scheduler/PIPELINE.md): validated
+    # commits vs mis-speculation aborts by validation reason.
+    speculation: dict = field(default_factory=dict)
     solver_phase_s: dict = field(default_factory=dict)
     solver_counters: dict = field(default_factory=dict)
     # Snapshot-build attribution (incremental journal-replay snapshots):
@@ -281,6 +284,13 @@ class Runner:
         if dev:
             result.pipelined_hit_rate = (
                 result.engine_cycles.get("device-pipelined", 0) / dev)
+        sched = mgr.scheduler
+        if sched.speculation_hits or sched.speculation_aborts:
+            result.speculation = {
+                "hits": sched.speculation_hits,
+                "aborts": sched.speculation_aborts,
+                "abort_reasons": dict(sched.speculation_abort_reasons),
+            }
         if self.solver is not None:
             result.solver_phase_s = {
                 k: round(v, 2)

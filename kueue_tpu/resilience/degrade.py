@@ -101,6 +101,7 @@ class DegradationLadder:
         self.cycles_shed = 0       # cycles that RAN in shed or survival
         self.escalations = 0       # rung-up transitions
         self.recoveries = 0        # rung-down transitions
+        self.idle_cycles = 0       # idle ticks fed while degraded
         self.last_transition: Optional[str] = None  # "a->b"
 
     @property
@@ -124,6 +125,44 @@ class DegradationLadder:
     def pin_cpu(self) -> bool:
         """Survival pins the CPU-incremental route."""
         return self.state == SURVIVAL
+
+    @property
+    def allow_pipeline(self) -> bool:
+        """Speculative pipelining under degradation (ISSUE 6): shed
+        keeps it — BOUNDED, because the head cap ran before routing and
+        the scheduler bails any cycle that needs preempt planning back
+        to the sync path — while survival (which pins the CPU route
+        anyway) must drain the in-flight queue, not grow it. Before the
+        speculative pipeline, ANY degraded rung was a hard pipeline
+        gate, which threw away the device overlap exactly when cycle
+        time mattered most."""
+        return self.state != SURVIVAL
+
+    def observe_idle(self) -> bool:
+        """An idle scheduler tick (no heads popped). A degraded ladder
+        with an empty queue used to hold its rung until traffic resumed
+        — observe_cycle only ran for cycles that popped heads — so a
+        storm's last shed cycle pinned the cap onto the NEXT burst.
+        Idle ticks count toward the healthy-cycle streak (there is no
+        cycle time to EWMA, and an empty queue means no backlog
+        growth); returns True when the ladder rung down."""
+        if self.budget_s <= 0 or self.state == NORMAL:
+            return False
+        self.idle_cycles += 1
+        self._over = 0
+        self._healthy += 1
+        self._last_backlog = 0
+        # The storm's EWMA is stale the moment the queue is empty: left
+        # in place, the first (healthy) cycles after traffic resumes
+        # would inherit it and spuriously re-escalate. No cycle ran, so
+        # there is no cycle-time signal — drop the estimate.
+        self.ewma_s = None
+        if self._healthy >= self.recovery_cycles:
+            self._move(NORMAL if self.state == SHED else SHED)
+            self.recoveries += 1
+            self._healthy = 0
+            return True
+        return False
 
     def observe_cycle(self, duration_s: float,
                       backlog: Optional[int] = None) -> bool:
@@ -200,5 +239,7 @@ class DegradationLadder:
             "cycles_shed": self.cycles_shed,
             "escalations": self.escalations,
             "recoveries": self.recoveries,
+            "idle_cycles": self.idle_cycles,
+            "allow_pipeline": self.allow_pipeline,
             "last_transition": self.last_transition,
         }

@@ -1,12 +1,14 @@
 """Seedable fault injection for the solver's device path.
 
-Named injection sites wrap the four places a flaky or vanished
-accelerator can hurt the admission cycle (see RESILIENCE.md):
+Named injection sites wrap the places a flaky or vanished accelerator
+can hurt the admission cycle (see RESILIENCE.md):
 
 - ``device_dispatch``  — kernel dispatch (BatchSolver.dispatch)
 - ``device_collect``   — the in-flight result fetch (BatchSolver.collect)
 - ``arena_scatter``    — the encode arena's changed-row device scatter
 - ``journal_replay``   — the solver's residency journal reconcile
+- ``speculation_validate`` — the pipelined apply step's generation-token
+  check (a raise forces a mis-speculation abort, PIPELINE.md)
 
 Each site can, per a deterministic scripted schedule, RAISE (a dead
 tunnel / XLA error), DELAY (a wedged ``device_get`` — the watchdog's
@@ -38,7 +40,13 @@ SITE_DISPATCH = "device_dispatch"
 SITE_COLLECT = "device_collect"
 SITE_SCATTER = "arena_scatter"
 SITE_REPLAY = "journal_replay"
-SITES = (SITE_DISPATCH, SITE_COLLECT, SITE_SCATTER, SITE_REPLAY)
+# Speculative-pipeline validation (scheduler._process_inflight): a RAISE
+# here is a FORCED MIS-SPECULATION — the abort path must fall back to
+# the synchronous cycle with no double admission. Last in SITES so
+# seeded scripted() schedules for the original four sites are unchanged.
+SITE_SPECULATION = "speculation_validate"
+SITES = (SITE_DISPATCH, SITE_COLLECT, SITE_SCATTER, SITE_REPLAY,
+         SITE_SPECULATION)
 
 RAISE = "raise"
 DELAY = "delay"
@@ -99,6 +107,7 @@ class FaultInjector:
                            else (RAISE, CORRUPT)),
             SITE_SCATTER: (RAISE, CORRUPT),
             SITE_REPLAY: (RAISE,),
+            SITE_SPECULATION: (RAISE,),  # forced mis-speculation
         }
         schedule: dict = {}
         for site in SITES:

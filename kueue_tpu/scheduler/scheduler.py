@@ -35,9 +35,11 @@ from kueue_tpu.obs import FlightRecorder
 from kueue_tpu.queue import Manager, RequeueReason
 from kueue_tpu.resilience.breaker import CLOSED, CircuitBreaker
 from kueue_tpu.resilience.degrade import NORMAL, DegradationLadder
+from kueue_tpu.resilience import faultinject
 from kueue_tpu.resilience.faultinject import DeviceFault
 from kueue_tpu.resilience.watchdog import DispatchTimeout, DispatchWatchdog
 from kueue_tpu.scheduler import flavorassigner as fa
+from kueue_tpu.scheduler import stages
 from kueue_tpu.scheduler.podset_reducer import PodSetReducer
 from kueue_tpu.scheduler.preemption import Preemptor, Target, make_reclaim_oracle
 from kueue_tpu.utils import vlog
@@ -135,15 +137,24 @@ class Scheduler:
         self.recorder = recorder if recorder is not None else FlightRecorder()
         if solver is not None and hasattr(solver, "bind_recorder"):
             solver.bind_recorder(self.recorder)
-        # Pipelined dispatch: overlap the decision fetch of cycle N with
-        # head-pop + encode + dispatch of cycle N+1 (all-fit cycles only;
-        # see _schedule_pipelined for the semantics). Off by default —
-        # decisions land one cycle later, so conformance suites and
+        # Speculative admission pipeline: overlap the solve of snapshot
+        # N with the apply of cycle N-1 (see _schedule_pipelined and
+        # scheduler/PIPELINE.md). Every dispatch carries a generation
+        # stamp (stages.SpeculationToken) and the apply step validates
+        # it before committing — mis-speculation abandons the in-flight
+        # result and falls back to the synchronous path. Off by default
+        # — decisions land one cycle later, so conformance suites and
         # latency-sensitive deployments keep the synchronous cycle; the
         # manager/bench production wiring turns it on.
         self.pipeline_enabled = False
-        self._inflight = None  # (InFlight, snapshot)
+        self._inflight: Optional[stages.InFlightCycle] = None
         self._pipeline_cooldown = 0
+        # Speculation outcome counters (the pipelined hit-rate story):
+        # hits = validated-and-committed speculative cycles, aborts =
+        # mis-speculations (by validation reason).
+        self.speculation_hits = 0
+        self.speculation_aborts = 0
+        self.speculation_abort_reasons: dict = {}
         # Which pipelined shape the last _schedule_pipelined call took
         # (device-pipelined / device-dispatch-only / device-nofit): the
         # cycle trace's route label for pipelined cycles.
@@ -300,6 +311,10 @@ class Scheduler:
                 self._finish_trace(trace, "drain", heads=0,
                                    admitted=self._drained_admitted)
                 return sig
+            # Idle tick: a degraded ladder with an empty queue must not
+            # hold its rung until traffic resumes — quiescence IS
+            # health (PR-5 follow-up).
+            self._observe_idle()
             return KeepGoing
         start = self.clock.now()
         wall0 = _time.perf_counter()
@@ -396,15 +411,104 @@ class Scheduler:
 
         t_ph = _time.perf_counter()
         snapshot = self.cache.snapshot()
-        t_ph = self._span("snapshot", t_ph)
+        self._span("snapshot", t_ph)
         vlog.dump_snapshot(self.log, snapshot)
 
+        # The explicit stage machine (stages.py carries the typed
+        # contracts; the speculative pipeline above runs the same
+        # stages with solve overlapped across cycles).
+        nom = self._stage_nominate(heads, snapshot, route, timeout)
+        self._stage_apply(nom, timeout)
+        applied = self._stage_requeue(nom)
+        entries = nom.entries
+        result_success = applied.success
+        admitted_n = applied.admitted
+        skipped_preemptions = nom.skipped_preemptions
+        # Observed regime of this cycle feeds the regime-keyed router:
+        # the sample lands under what the cycle WAS, and the next
+        # cycle's engine choice predicts it will look the same.
+        regime = applied.regime
+        self._cycle_regime = regime
+        self._last_regime = regime
+        # A preempt-mode entry is blocked only when it found NO feasible
+        # targets (the reserve-capacity branch): feed the starvation
+        # bound. An entry that selected targets is PROGRESSING — it
+        # issued evictions (PENDING_PREEMPTION) or lost an intra-cycle
+        # race (overlap/fit skip) that resolves by itself; counting
+        # either as blocked let healthy preemption churn ratchet the
+        # streak to the bound and pin device-routed cycles to cpu-strict
+        # (ADVICE r5 medium). This mirrors _collect_pipelined_preempt,
+        # which sets blocked_any only for target-less entries. Cycles
+        # with NO preempt-mode entry at all: a blocked preemptor parks
+        # inadmissible between capacity releases, so a SHORT arrival-
+        # only stretch (up to the bound) keeps the starvation evidence
+        # intact — but past that grace the evidence decays one cycle at
+        # a time (never a wholesale reset), so it cannot carry over to
+        # an UNRELATED later preemptor after the original one vanished
+        # (ADVICE r5 follow-up), while a parked preemptor that re-heaps
+        # within the grace still accumulates toward the bound. While
+        # the bound is ENGAGED the decay is immediate, so a vanished
+        # preemptor releases strict mode within ~K cycles.
+        blocked = applied.blocked_preemptor
+        if self._degrade_deferred:
+            # Deferred preempt plans look exactly like blocked
+            # preemptors (target-less PREEMPT entries), but the ladder
+            # chose not to plan them — shedding must not ratchet the
+            # starvation bound into cpu-strict on top of itself.
+            blocked = False
+        if blocked:
+            self._blocked_preempt_streak += 1
+            self._preemptless_cycles = 0
+        elif regime == "preempt":
+            self._blocked_preempt_streak = 0  # preemptors made progress
+            self._preemptless_cycles = 0
+        elif self._blocked_preempt_streak > 0:
+            self._preemptless_cycles += 1
+            bound = self.strict_after_blocked_cycles
+            engaged = bound and self._blocked_preempt_streak >= bound
+            if engaged or self._preemptless_cycles > max(bound, 1):
+                self._blocked_preempt_streak -= 1
+        self.cycle_counts[route] = self.cycle_counts.get(route, 0) + 1
+        if route == "device":
+            self._note_device_cycle(collects0)
+        # The cycle is done with its snapshot: the incremental maintainer
+        # may recycle un-materialized shells into the next handout.
+        self.cache.release_snapshot(snapshot)
+        if route in ("device", "cpu"):
+            # Progress = admissions + evictions: a pure-eviction cycle
+            # admits zero on EITHER engine, and an all-zero rate pair
+            # would pin the router to its tie-break default.
+            self._route_record(route, admitted_n + self._cycle_evictions,
+                               _time.perf_counter() - wall0
+                               - self._drain_cost)
+        self.log.v(2, "cycle", engine=route, heads=len(entries),
+                   admitted=admitted_n,
+                   ms=round((_time.perf_counter() - wall0) * 1e3, 1))
+
+        if self.metrics is not None:
+            self.metrics.admission_attempt(result_success, self.clock.now() - start)
+            for cq_name, count in skipped_preemptions.items():
+                self.metrics.preemption_skips(cq_name, count)
+        self._observe_budget(_time.perf_counter() - wall0, heads_popped,
+                             admitted_n)
+        self._finish_trace(trace, route, heads=len(entries),
+                           admitted=admitted_n)
+        return KeepGoing if result_success else SlowDown
+
+    # --- the stage machine (typed contracts in scheduler/stages.py) ---
+
+    def _stage_nominate(self, heads: list, snapshot: Snapshot, route: str,
+                        timeout) -> stages.NominatedCycle:
+        """NOMINATE stage: route the device share through the solve
+        stage, CPU-nominate the remainder (validation + flavor
+        assignment + preemption discovery) against the cycle snapshot,
+        and sort by the admission order. Returns the NominatedCycle the
+        apply stage consumes."""
         solver_entries: list = []
         pre_entries: list = []
         if route == "device":
-            solver_entries, pre_entries, heads = self._solve_batch(
+            solver_entries, pre_entries, heads = self._stage_solve(
                 heads, snapshot, timeout)
-
         t_ph = _time.perf_counter()
         defer_shed = self.ladder.defer_preemption
         entries = pre_entries + self.nominate(heads, snapshot,
@@ -413,14 +517,26 @@ class Scheduler:
             # Shed/survival: preempt planning (target selection — the
             # superlinear part of a preempt-heavy cycle) is deferred;
             # target-less preempt entries keep their reserve-capacity
-            # semantics below and re-heap for when the ladder recovers.
+            # semantics in apply and re-heap for when the ladder
+            # recovers.
             self._defer_preempt_plans(entries)
         entries.sort(key=self._entry_sort_key())
-        t_ph = self._span("nominate", t_ph)
+        self._span("nominate", t_ph)
+        return stages.NominatedCycle(snapshot=snapshot, entries=entries,
+                                     solver_entries=solver_entries,
+                                     route=route)
 
+    def _stage_apply(self, nom: stages.NominatedCycle, timeout) -> None:
+        """APPLY stage: sequential admit with intra-cycle usage
+        accounting — skip overlapping preemption targets, re-check fit
+        after earlier admissions, reserve capacity for blocked
+        preemptors, issue evictions (reference: scheduler.go:238-330).
+        Mutates the nominated entries in place."""
+        snapshot = nom.snapshot
+        t_ph = _time.perf_counter()
         preempted_workloads: set = set()
-        skipped_preemptions: dict = {}
-        for e in entries:
+        skipped_preemptions = nom.skipped_preemptions
+        for e in nom.entries:
             mode = e.assignment.representative_mode()
             if mode == fa.NO_FIT:
                 continue
@@ -478,12 +594,18 @@ class Scheduler:
                 self.admit(e, cq)
             except Exception as exc:  # noqa: BLE001 — cache/API races surface here
                 e.inadmissible_msg = f"Failed to admit workload: {exc}"
-
         self._span("apply", t_ph)
+
+    def _stage_requeue(self, nom: stages.NominatedCycle) -> stages.AppliedCycle:
+        """Requeue sweep closing the apply stage: re-heap every
+        non-admitted entry (solver-routed entries rejoin here), count
+        admissions, and report the cycle's observed regime + blocked-
+        preemptor evidence for the starvation bound."""
+        entries = nom.solver_entries + nom.entries
+        nom.entries = entries  # the merged list (trace head count)
+        vlog.dump_attempts(self.log, entries)
         result_success = False
         admitted_n = 0
-        entries = solver_entries + entries
-        vlog.dump_attempts(self.log, entries)
         t_ph = _time.perf_counter()
         for e in entries:
             if e.status != ASSUMED:
@@ -493,83 +615,44 @@ class Scheduler:
                 admitted_n += 1
                 self._solver_release_workload(e.info.key)
         self._span("requeue", t_ph)
-        # Observed regime of this cycle feeds the regime-keyed router:
-        # the sample lands under what the cycle WAS, and the next
-        # cycle's engine choice predicts it will look the same.
         regime = "preempt" if any(
             e.preemption_targets
             or e.assignment.representative_mode() == fa.PREEMPT
             for e in entries) else "fit"
-        self._cycle_regime = regime
-        self._last_regime = regime
         # A preempt-mode entry is blocked only when it found NO feasible
-        # targets (the reserve-capacity branch): feed the starvation
-        # bound. An entry that selected targets is PROGRESSING — it
-        # issued evictions (PENDING_PREEMPTION) or lost an intra-cycle
-        # race (overlap/fit skip) that resolves by itself; counting
-        # either as blocked let healthy preemption churn ratchet the
-        # streak to the bound and pin device-routed cycles to cpu-strict
-        # (ADVICE r5 medium). This mirrors _collect_pipelined_preempt,
-        # which sets blocked_any only for target-less entries. Cycles
-        # with NO preempt-mode entry at all: a blocked preemptor parks
-        # inadmissible between capacity releases, so a SHORT arrival-
-        # only stretch (up to the bound) keeps the starvation evidence
-        # intact — but past that grace the evidence decays one cycle at
-        # a time (never a wholesale reset), so it cannot carry over to
-        # an UNRELATED later preemptor after the original one vanished
-        # (ADVICE r5 follow-up), while a parked preemptor that re-heaps
-        # within the grace still accumulates toward the bound. While
-        # the bound is ENGAGED the decay is immediate, so a vanished
-        # preemptor releases strict mode within ~K cycles.
+        # targets (the reserve-capacity branch) — see the streak logic
+        # in schedule() for why.
         blocked = any(
             e.status != ASSUMED
             and e.assignment.representative_mode() == fa.PREEMPT
             and not e.preemption_targets
             for e in entries)
-        if self._degrade_deferred:
-            # Deferred preempt plans look exactly like blocked
-            # preemptors (target-less PREEMPT entries), but the ladder
-            # chose not to plan them — shedding must not ratchet the
-            # starvation bound into cpu-strict on top of itself.
-            blocked = False
-        if blocked:
-            self._blocked_preempt_streak += 1
-            self._preemptless_cycles = 0
-        elif regime == "preempt":
-            self._blocked_preempt_streak = 0  # preemptors made progress
-            self._preemptless_cycles = 0
-        elif self._blocked_preempt_streak > 0:
-            self._preemptless_cycles += 1
-            bound = self.strict_after_blocked_cycles
-            engaged = bound and self._blocked_preempt_streak >= bound
-            if engaged or self._preemptless_cycles > max(bound, 1):
-                self._blocked_preempt_streak -= 1
-        self.cycle_counts[route] = self.cycle_counts.get(route, 0) + 1
-        if route == "device":
-            self._note_device_cycle(collects0)
-        # The cycle is done with its snapshot: the incremental maintainer
-        # may recycle un-materialized shells into the next handout.
-        self.cache.release_snapshot(snapshot)
-        if route in ("device", "cpu"):
-            # Progress = admissions + evictions: a pure-eviction cycle
-            # admits zero on EITHER engine, and an all-zero rate pair
-            # would pin the router to its tie-break default.
-            self._route_record(route, admitted_n + self._cycle_evictions,
-                               _time.perf_counter() - wall0
-                               - self._drain_cost)
-        self.log.v(2, "cycle", engine=route, heads=len(entries),
-                   admitted=admitted_n,
-                   ms=round((_time.perf_counter() - wall0) * 1e3, 1))
+        return stages.AppliedCycle(admitted=admitted_n,
+                                   success=result_success,
+                                   regime=regime,
+                                   blocked_preemptor=blocked)
 
+    def _observe_idle(self) -> None:
+        """An idle scheduler tick (no heads popped): feed the
+        degradation ladder's recovery counter. A degraded ladder with
+        an empty queue used to hold its rung until traffic resumed
+        (PR-5 follow-up) — quiescence is the healthiest signal there
+        is, so idle ticks rung the ladder down."""
+        lad = self.ladder
+        if not lad.enabled or lad.state == NORMAL:
+            return
+        prev = lad.state
+        if not lad.observe_idle():
+            return
+        msg = (f"degraded-mode {prev}->{lad.state}: queue idle for "
+               f"{lad.recovery_cycles} scheduler tick(s)")
+        self.log.v(2, "degrade.transition", previous=prev, state=lad.state,
+                   idle=True)
         if self.metrics is not None:
-            self.metrics.admission_attempt(result_success, self.clock.now() - start)
-            for cq_name, count in skipped_preemptions.items():
-                self.metrics.preemption_skips(cq_name, count)
-        self._observe_budget(_time.perf_counter() - wall0, heads_popped,
-                             admitted_n)
-        self._finish_trace(trace, route, heads=len(entries),
-                           admitted=admitted_n)
-        return KeepGoing if result_success else SlowDown
+            self.metrics.set_degraded_state(lad.state)
+        if self.on_fault is not None:
+            self.on_fault("degrade-recovered" if lad.state == NORMAL
+                          else "degrade", msg)
 
     # --- pipelined dispatch (device-resident state, all-fit cycles) ---
     #
@@ -889,12 +972,14 @@ class Scheduler:
         # Breaker not CLOSED => the cycle is a half-open probe: it must
         # run synchronously so its outcome is known by cycle end (a
         # pipelined dispatch wouldn't resolve until the NEXT cycle).
-        # Ladder not NORMAL => the cycle must stay synchronous and
-        # predictable: shed caps + deferral need the sync shape, and a
-        # degraded cycle must not queue another dispatch behind itself.
+        # Ladder: shed allows BOUNDED pipelining — the head cap already
+        # ran before routing, and _schedule_pipelined bails to sync on
+        # any cycle that needs preempt planning (deferred under shed) —
+        # but survival pins the CPU route, so the in-flight queue must
+        # drain rather than grow (ladder.allow_pipeline).
         return (s is not None and self.pipeline_enabled
                 and self.breaker.state == CLOSED
-                and self.ladder.state == NORMAL
+                and self.ladder.allow_pipeline
                 and getattr(s, "resident_capable", False)
                 and not self.cache.pods_ready_tracking
                 and len(heads) >= self.solver_min_heads
@@ -906,6 +991,17 @@ class Scheduler:
         cycle has been drained first)."""
         solver = self.solver
         self._pipeline_trace_route = "device-pipelined"
+        early = self._inflight
+        if early is not None and early.token is not None:
+            # Validate the in-flight speculation BEFORE dispatching the
+            # next cycle: a new dispatch chains on the in-flight device
+            # state, so aborting the predecessor after the fact would
+            # doom the successor too (one abort, not a cascade).
+            ok, reason = self._validate_speculation(early)
+            if not ok:
+                self._inflight = None
+                self._abort_speculation(early, reason)
+                return None  # sync path owns this cycle's heads
         # Light snapshot: the all-fit pipelined cycle never simulates on
         # it (usage truth is the device-resident state); cloning 2k
         # resource trees per cycle was a measurable share of the cycle.
@@ -933,7 +1029,7 @@ class Scheduler:
             plan = None
         prev = self._inflight
         if (plan is not None and plan.resident and prev is not None
-                and plan.rs is not prev[0].plan.rs):
+                and plan.rs is not prev.inflight.plan.rs):
             # Residency was re-established under the in-flight cycle (a
             # topology change or journal overflow): the fresh state was
             # encoded from a snapshot that cannot include the in-flight
@@ -968,6 +1064,12 @@ class Scheduler:
                 else:
                     bail = True
                     break
+        if not bail and pend_ws and self.ladder.defer_preemption:
+            # Shed rung: pipelining stays on for all-fit cycles (the
+            # bounded allowance), but preempt planning is deferred and
+            # the sync path owns the deferral semantics — a cycle that
+            # needs target selection bails.
+            bail = True
         if not bail and len(pend_ws) * 4 > len(valid_heads):
             # Preempt-dominated cycle: the pipelined-mixed machinery
             # (full snapshot + candidate index + one-cycle eviction lag)
@@ -1038,8 +1140,13 @@ class Scheduler:
             self.requeue_and_update(e)
         for e in nofit_entries:
             self.requeue_and_update(e)
-        prev, self._inflight = self._inflight, (inflight, snapshot,
-                                                nofit_idx, pend_idx, pmeta)
+        # Generation stamp of the speculated-on state: validated by
+        # _process_inflight before the result may commit (PIPELINE.md).
+        token = stages.SpeculationToken.stamp(self.cache, solver, plan,
+                                              snapshot)
+        prev, self._inflight = self._inflight, stages.InFlightCycle(
+            inflight=inflight, snapshot=snapshot, nofit_idx=nofit_idx,
+            pend_idx=pend_idx, pmeta=pmeta, token=token)
         if prev is None:
             if prev_signal is not None:
                 # Mixed-cycle pre-drain: _last_cycle_admitted still
@@ -1063,16 +1170,23 @@ class Scheduler:
         prev, self._inflight = self._inflight, None
         if prev is None:
             return
-        inflight, _snapshot, nofit_idx, _pend_idx, _pmeta = prev
-        if _pmeta is not None:
-            self.cache.release_snapshot(_pmeta[2])
-        for i, w in enumerate(inflight.plan.batch.infos):
-            if i in nofit_idx:
+        self._requeue_inflight(prev)
+        self._solver_invalidate()
+
+    def _requeue_inflight(self, prev: stages.InFlightCycle) -> None:
+        """The abandon sweep shared by every in-flight-discard path
+        (mis-speculation abort, collect fault, leadership loss):
+        release the deferred preempt-nomination snapshot and re-heap
+        every batch row not already requeued at dispatch time (the
+        device-NoFit shortcut set). pend rows requeue here too — their
+        evictions never issued."""
+        if prev.pmeta is not None:
+            self.cache.release_snapshot(prev.pmeta[2])
+        for i, w in enumerate(prev.inflight.plan.batch.infos):
+            if i in prev.nofit_idx:
                 continue  # already requeued at dispatch time
-            # pend rows requeue here too — their evictions never issued
             self.queues.requeue_workload(
                 w, RequeueReason.FAILED_AFTER_NOMINATION)
-        self._solver_invalidate()
 
     def _prepare_pipelined_preempt(self, plan, pend_ws: list):
         """Nominate predicted-non-fit, preempt-capable entries against a
@@ -1154,10 +1268,27 @@ class Scheduler:
             self._last_cycle_admitted = None  # consumed
         return sig
 
-    def _process_inflight(self, prev, start) -> SpeedSignal:
-        inflight, snapshot, nofit_idx, pend_idx, pmeta = prev
+    def _process_inflight(self, prev: stages.InFlightCycle,
+                          start) -> SpeedSignal:
+        inflight, snapshot = prev.inflight, prev.snapshot
+        nofit_idx, pend_idx, pmeta = (prev.nofit_idx, prev.pend_idx,
+                                      prev.pmeta)
         solver = self.solver
         valid_heads = inflight.plan.batch.infos
+        # Speculation validation BEFORE the result may commit: the
+        # generation token proves the state the solve assumed still
+        # describes the live cache (structural epochs, residency
+        # identity, arena slot generations, journal cursor health).
+        # Mis-speculation abandons the result un-decoded and the heads
+        # retry on the synchronous path — never a stale admission.
+        # Deliberately re-checked even when _schedule_pipelined already
+        # validated this inflight at entry: in threaded deployments the
+        # store's watch handlers mutate the cache concurrently, so
+        # churn can land between the entry check and this commit point
+        # — the re-check is two tuple compares + one small gather.
+        ok, reason = self._validate_speculation(prev)
+        if not ok:
+            return self._abort_speculation(prev, reason)
         try:
             decisions, aux = solver.collect(inflight, snapshot)
         except Exception as exc:  # noqa: BLE001 — fetch: retry the heads
@@ -1166,19 +1297,18 @@ class Scheduler:
             # heads re-heap — the cycle completes instead of blocking
             # on a wedged device_get.
             self._solver_fault("collect", exc)
-            if pmeta is not None:
-                self.cache.release_snapshot(pmeta[2])
-            for i, w in enumerate(valid_heads):
-                if i in nofit_idx:
-                    continue  # already requeued at dispatch time
-                self.queues.requeue_workload(
-                    w, RequeueReason.FAILED_AFTER_NOMINATION)
+            self._requeue_inflight(prev)
             self._pipeline_cooldown = 1
             # An aborted collect admitted nothing: a previous cycle's
             # count must not leak into the drain trace or the drain
             # sample branch's routing record.
             self._last_cycle_admitted = None
             return SlowDown
+        if prev.token is not None:
+            # Validated AND collected: the speculation committed.
+            self.speculation_hits += 1
+            if self.metrics is not None:
+                self.metrics.speculation_hit()
         entries = []
         any_nonfit = False
         t_ph = _time.perf_counter()
@@ -1245,6 +1375,50 @@ class Scheduler:
             self.metrics.admission_attempt(result_success,
                                            self.clock.now() - start)
         return KeepGoing if result_success else SlowDown
+
+    def _validate_speculation(self, prev: stages.InFlightCycle) -> tuple:
+        """(ok, reason) for the in-flight cycle's generation token.
+        Routed through the ``speculation_validate`` injection site so
+        chaos suites can force a mis-speculation; a token-less inflight
+        (custom solvers) validates trivially."""
+        try:
+            faultinject.site(faultinject.SITE_SPECULATION)
+            if prev.token is not None:
+                return prev.token.validate(self.cache, self.solver)
+        except DeviceFault:
+            return False, "injected"
+        return True, ""
+
+    def _abort_speculation(self, prev: stages.InFlightCycle,
+                           reason: str) -> SpeedSignal:
+        """Mis-speculation: the state the in-flight solve was computed
+        against moved mid-flight. Abandon the result UN-DECODED (the
+        assume/forget protocol's cheap half: nothing was assumed yet,
+        so there is nothing to forget), requeue its heads for the
+        synchronous fallback cycle, and invalidate residency — the
+        device state chained Phase B usage for admissions that will
+        never be confirmed. Deliberately NOT a breaker fault: nothing
+        device-side failed, so the device route stays open and the
+        next cycle (cooldown -> synchronous) re-establishes from fresh
+        state."""
+        self.speculation_aborts += 1
+        self.speculation_abort_reasons[reason] = \
+            self.speculation_abort_reasons.get(reason, 0) + 1
+        self.recorder.annotate(
+            "speculation-abort",
+            f"speculative result abandoned: {reason}", reason=reason,
+            aborts=self.speculation_aborts)
+        if self.metrics is not None:
+            self.metrics.speculation_abort(reason)
+        self.log.v(2, "speculation.abort", reason=reason,
+                   aborts=self.speculation_aborts)
+        self._requeue_inflight(prev)
+        self._solver_invalidate()
+        self._pipeline_cooldown = 1
+        # An aborted speculation admitted nothing: the drain trace and
+        # the drain sample branch must not see a stale count.
+        self._last_cycle_admitted = None
+        return SlowDown
 
     def _collect_pipelined_preempt(self, inflight, pmeta, aux,
                                    fit_entries: list) -> list:
@@ -1319,8 +1493,8 @@ class Scheduler:
 
     # --- batched TPU admission (kueue_tpu.solver) ---
 
-    def _solve_batch(self, heads: list, snapshot: Snapshot, timeout):
-        """Run the batched solver over the validated heads.
+    def _stage_solve(self, heads: list, snapshot: Snapshot, timeout):
+        """SOLVE stage: run the batched solver over the validated heads.
 
         One device sync per cycle: the solver's host-side router (exact
         Phase A on the local CPU backend) says which heads the device

@@ -1,0 +1,164 @@
+"""Typed inter-stage contract for the admission cycle.
+
+``Scheduler.schedule()`` is an explicit three-stage machine — see
+kueue_tpu/scheduler/PIPELINE.md for the full protocol:
+
+- **nominate**: pop + validate heads, assign flavors / discover
+  preemption candidates against the cycle snapshot (CPU side).
+- **solve**: the batched device solve (fit Phase A/B + fused preemption
+  target selection) for the routed share of the heads.
+- **apply**: admit survivors with intra-cycle accounting, issue
+  evictions, requeue everything else.
+
+The dataclasses below are the contracts the stages hand each other, for
+both the synchronous cycle (all three stages inside one ``schedule()``
+call) and the speculative pipeline, where the solve stage for snapshot N
+runs while cycle N-1's apply is still mutating the cache. Speculative
+results are only committed after ``SpeculationToken.validate`` proves
+the state they were computed against still describes the live cache —
+the assume/forget + generation-token optimistic-concurrency protocol
+(SURVEY.md §2.7 "assume-cache"): structural epochs, device-residency
+identity, per-slot encode-arena generations, and the solver's journal
+cursor health. Mis-speculation abandons the in-flight result (heads
+re-heap, residency drops) and the cycle falls back to the synchronous
+path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class SpeculationToken:
+    """Generation stamp of the state a speculative solve was computed
+    against. Cheap by construction: three epoch ints, one object
+    identity, and one small int64 gather — never a snapshot comparison.
+
+    - ``epochs``: the cache's structural generation token
+      (topology/cohort/flavor-spec). Workload churn deliberately does
+      NOT invalidate — the resident solver state reconciles usage
+      movement through the usage journal; only structural edits make
+      in-flight decisions unsound.
+    - ``journal_seq``: the journal cursor the dispatch snapshot froze
+      at (diagnostics; staleness itself is fine, losing entries is not).
+    - ``resident``: the ResidentState identity the plan chained on, or
+      None for a non-resident dispatch.
+    - ``slots``/``slot_gens``: the encode-arena slots the dispatched
+      batch gathered, with their per-slot generations — a mid-flight
+      upsert/delete of a dispatched workload bumps its slot generation
+      and the speculation aborts instead of admitting a stale object.
+    """
+
+    journal_seq: int = -1
+    epochs: tuple = ()
+    resident: object = None
+    slots: object = None
+    slot_gens: object = None
+
+    # reason slugs per position of the canonical epochs tuple
+    # (incremental.snapshot_generations / Cache.generation_token order)
+    _EPOCH_REASONS = ("topology-epoch", "cohort-epoch",
+                      "flavor-spec-epoch")
+
+    @classmethod
+    def stamp(cls, cache, solver, plan, snapshot) -> "SpeculationToken":
+        from kueue_tpu.cache.incremental import snapshot_generations
+        slots = getattr(plan, "slots", None)
+        # Prefer the encode-time capture (service.Plan.slot_gens): a
+        # delta landing between encode and this stamp must read as
+        # staleness, not get baked into the witness.
+        gens = getattr(plan, "slot_gens", None)
+        if gens is None and slots is not None:
+            slot_fn = getattr(solver, "slot_generations", None)
+            if slot_fn is not None:
+                gens = slot_fn(slots)
+        return cls(
+            journal_seq=getattr(snapshot, "journal_seq", -1),
+            # The SNAPSHOT's generations, not the cache's current ones:
+            # the token witnesses the state the solve was computed
+            # against, so an epoch bump that raced in between the
+            # snapshot and this stamp reads as the staleness it is.
+            epochs=snapshot_generations(snapshot),
+            resident=getattr(plan, "rs", None) if plan.resident else None,
+            slots=slots,
+            slot_gens=gens,
+        )
+
+    def validate(self, cache, solver) -> tuple:
+        """(ok, reason). Reasons are stable slugs for the abort counter
+        labels: topology-epoch | cohort-epoch | flavor-spec-epoch |
+        residency | arena-slots | journal-overflow."""
+        if self.epochs:
+            live = cache.generation_token()
+            if self.epochs != live:
+                for i, reason in enumerate(self._EPOCH_REASONS):
+                    if self.epochs[i] != live[i]:
+                        return False, reason
+        if self.resident is not None \
+                and getattr(solver, "_resident", None) is not self.resident:
+            return False, "residency"
+        if self.slot_gens is not None:
+            slot_fn = getattr(solver, "slot_generations", None)
+            gens = slot_fn(self.slots) if slot_fn is not None else None
+            if gens is None or not np.array_equal(gens, self.slot_gens):
+                return False, "arena-slots"
+        overflowed = getattr(cache, "journal_overflowed", None)
+        if overflowed is not None and overflowed():
+            return False, "journal-overflow"
+        return True, ""
+
+
+@dataclass
+class InFlightCycle:
+    """A dispatched, un-collected speculative cycle — what the solve
+    stage hands the (next call's) apply stage.
+
+    - ``inflight``: the solver's InFlight (device result references).
+    - ``snapshot``: the light snapshot the cycle was encoded against.
+    - ``nofit_idx``: batch rows already requeued at dispatch time via
+      the device-NoFit shortcut.
+    - ``pend_idx``/``pmeta``: the pipelined-mixed preemption rows and
+      their (pending entries, cq_by, full snapshot) collect-time state.
+    - ``token``: the speculation stamp validated before commit.
+    """
+
+    inflight: object
+    snapshot: object
+    nofit_idx: set = field(default_factory=set)
+    pend_idx: set = field(default_factory=set)
+    pmeta: object = None
+    token: Optional[SpeculationToken] = None
+
+
+@dataclass
+class NominatedCycle:
+    """Output of the nominate stage (plus the solve stage's CPU-side
+    spillover): everything the apply stage admits from.
+
+    ``entries`` are sorted by the admission order
+    (borrows -> DRF share -> priority -> FIFO); ``solver_entries``
+    were already admitted/skipped by the device solve and only rejoin
+    for the requeue sweep.
+    """
+
+    snapshot: object = None
+    entries: list = field(default_factory=list)
+    solver_entries: list = field(default_factory=list)
+    route: str = ""
+    # filled by the apply stage: per-CQ preemption-skip counts for the
+    # admission_cycle_preemption_skips gauge
+    skipped_preemptions: dict = field(default_factory=dict)
+
+
+@dataclass
+class AppliedCycle:
+    """Output of the apply stage: the cycle's admission outcome."""
+
+    admitted: int = 0
+    success: bool = False
+    regime: str = "fit"
+    blocked_preemptor: bool = False

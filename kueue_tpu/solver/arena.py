@@ -54,6 +54,11 @@ ARENA_FIELDS = ("requests", "podset_active", "wl_cq", "priority",
 # per-size compiles).
 _UPD_BUCKETS = (8, 512)
 
+# Churn batches at least this big take the vectorized multi-row encode
+# (_encode_rows) instead of the per-row path: below it, the batch
+# bookkeeping costs more than the per-row numpy dispatch it saves.
+_BATCH_ENCODE_MIN = 8
+
 
 def _scramble_rows(upd_rows: dict) -> dict:
     """The scatter site's CORRUPT action: requests inflated past any
@@ -90,6 +95,11 @@ class WorkloadArena:
         self.enc_obj: list = []  # the api.Workload the row encoded
         self.info_at: list = []  # the Info whose row this is
         self.free: list = []     # recycled slots
+        # Per-slot encode generation (speculative pipeline): bumped on
+        # every re-encode AND on every delta that invalidates the slot
+        # (del/upsert), so an in-flight dispatch can prove its gathered
+        # rows were untouched mid-flight (stages.SpeculationToken).
+        self.gen = np.zeros(0, np.int64)
         # Positional fast path: the previous cycle's (entry ids, slots).
         # A head list position whose Info identity is unchanged AND whose
         # slot no delta touched since needs NO per-entry Python work —
@@ -145,6 +155,7 @@ class WorkloadArena:
                 self.free.append(slot)
             else:  # upsert: the object was replaced — row is stale
                 self.enc_obj[slot] = None
+            self.gen[slot] += 1  # in-flight speculation on this row aborts
             self._touched.add(slot)
 
     # --- slot storage ---
@@ -198,6 +209,9 @@ class WorkloadArena:
                 setattr(self, name, arr)
         self.enc_obj.extend([None] * (cap - self.cap))
         self.info_at.extend([None] * (cap - self.cap))
+        gen = np.zeros(cap, np.int64)
+        gen[: self.cap] = self.gen[: self.cap]
+        self.gen = gen
         self.cap = cap
         self.dev = None  # shape moved: full re-upload on next dispatch
 
@@ -226,6 +240,7 @@ class WorkloadArena:
         encodes are the arena's only per-churned-workload cost)."""
         self.dirty.add(slot)
         self.encoded_rows += 1
+        self.gen[slot] += 1
         req_row = self.requests[slot]
         act_row = self.podset_active[slot]
         elig_row = self.eligible[slot]
@@ -271,6 +286,102 @@ class WorkloadArena:
                                                   snapshot, topo)
         self.solvable[slot] = True
 
+    def _encode_rows(self, slots: list, infos: list, snapshot, topo,
+                     ordering) -> None:
+        """Vectorized multi-row churn encode (ROADMAP PR-2 follow-up):
+        same semantics as ``_encode_row`` — the randomized equivalence
+        suite pins the two to the from-scratch oracle — but the numpy
+        work is ONE fancy-indexed write per arena field for the whole
+        batch instead of ~15us/row of small-array dispatch. The
+        per-workload dict walks (requests, eligibility-cache lookups)
+        stay host Python; they were never the overhead — the per-row
+        ndarray scalar stores were."""
+        n = len(slots)
+        self.encoded_rows += n
+        slots_arr = np.asarray(slots, np.int64)
+        self.dirty.update(slots)
+        self.gen[slots_arr] += 1
+        solv = self.solvable[slots_arr]
+        if solv.any():
+            # Only previously-solvable occupants hold non-zero data
+            # (same invariant _encode_row relies on).
+            clear = slots_arr[solv]
+            self.requests[clear] = 0
+            self.podset_active[clear] = False
+            self.eligible[clear] = False
+            self.solvable[clear] = False
+        cqs = snapshot.cluster_queues
+        resource_index = topo.resource_index
+        qis = np.zeros(n, np.int32)
+        prios = np.zeros(n, np.int64)
+        tss = np.zeros(n, np.float64)
+        solvable = np.zeros(n, bool)
+        req_r: list = []
+        req_p: list = []
+        req_c: list = []
+        req_v: list = []
+        act_r: list = []
+        act_p: list = []
+        elig_rows: list = []
+        P = self.P
+        for k, info in enumerate(infos):
+            cq = cqs.get(info.cluster_queue)
+            if cq is None:
+                continue  # unknown CQ: all-zero row, like the oracle
+            qi = topo.cq_index[info.cluster_queue]
+            qis[k] = qi
+            prios[k] = prioritypkg.priority(info.obj)
+            tss[k] = ordering.queue_order_timestamp(info.obj)
+            if len(info.total_requests) > P:
+                continue  # CPU fallback row (zeros, not solvable)
+            covers_pods = topo.covers_pods[qi]
+            slot = slots[k]
+            triples: list = []
+            ok = True
+            for pi, psr in enumerate(info.total_requests):
+                reqs = dict(psr.requests)
+                if covers_pods:
+                    reqs[RESOURCE_PODS] = psr.count
+                for r, v in reqs.items():
+                    ri = resource_index.get(r)
+                    if ri is None or topo.group_id[qi, ri] < 0:
+                        ok = False  # unencodable: whole row stays zero
+                        break
+                    triples.append((pi, ri, v))
+                if not ok:
+                    break
+            if not ok:
+                continue
+            for pi in range(len(info.total_requests)):
+                act_r.append(slot)
+                act_p.append(pi)
+                elig_rows.append(encode.eligibility_row(
+                    info, pi, qi, cq, snapshot, topo))
+            for pi, ri, v in triples:
+                req_r.append(slot)
+                req_p.append(pi)
+                req_c.append(ri)
+                req_v.append(v)
+            solvable[k] = True
+        self.wl_cq[slots_arr] = qis
+        self.priority[slots_arr] = prios
+        self.timestamp[slots_arr] = tss
+        if req_r:
+            self.requests[req_r, req_p, req_c] = req_v
+        if act_r:
+            self.podset_active[act_r, act_p] = True
+            self.eligible[act_r, act_p] = np.stack(elig_rows)
+        self.solvable[slots_arr] = solvable
+
+    def slot_generations(self, slots) -> np.ndarray:
+        """Current per-slot encode generations for ``slots``
+        (speculation validation). Pending queue-manager deltas are
+        drained first, so a del/upsert that arrived mid-flight but has
+        not been through ``assemble`` yet still bumps the generation it
+        invalidates."""
+        self._drain()
+        return self.gen[np.asarray(slots, np.int64)].copy()
+
     def ensure(self, entries: list, snapshot, topo, ordering) -> np.ndarray:
         """Slots for this cycle's heads, (re)encoding only the rows whose
         validity key moved. Returns [n] int32.
@@ -303,6 +414,8 @@ class WorkloadArena:
         info_at = self.info_at
         slot_of = self.slot_of
         cap = self.cap
+        enc_slots: list = []
+        enc_infos: list = []
         for i in changed:
             info = entries[i]
             slot = info._arena_slot
@@ -317,11 +430,24 @@ class WorkloadArena:
                     cap = self.cap
                 info._arena_slot = slot
                 info_at[slot] = info
-            obj = info.obj
-            if enc_obj[slot] is not obj:
-                self._encode_row(slot, info, snapshot, topo, ordering)
-                enc_obj[slot] = obj
+            if enc_obj[slot] is not info.obj:
+                # Deferred: churn batches big enough to amortize the
+                # bookkeeping re-encode vectorized, in one pass. The
+                # enc_obj mark lands only AFTER the encode succeeds —
+                # a raising encode (an anticipated fallback path) must
+                # leave the slot retryable, not sticky-stale.
+                enc_slots.append(slot)
+                enc_infos.append(info)
             slots[i] = slot
+        if len(enc_slots) >= _BATCH_ENCODE_MIN:
+            self._encode_rows(enc_slots, enc_infos, snapshot, topo,
+                              ordering)
+            for slot, info in zip(enc_slots, enc_infos):
+                self.enc_obj[slot] = info.obj
+        else:
+            for slot, info in zip(enc_slots, enc_infos):
+                self._encode_row(slot, info, snapshot, topo, ordering)
+                self.enc_obj[slot] = info.obj
         self._last_ids = ids
         self._last_slots = slots
         # Copy: callers may mutate their list, and the pin must hold the

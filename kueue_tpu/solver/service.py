@@ -130,6 +130,11 @@ class Plan:
         self.resident = False     # dispatch through the resident kernel
         self.rs = None            # the ResidentState this plan was built on
         self.slots = None         # arena slots for batch.infos (arena path)
+        # Per-slot arena generations captured AT ENCODE TIME (not at
+        # dispatch): the speculation token must witness the state the
+        # rows were gathered from, so a delta landing between encode
+        # and stamp is seen as the staleness it is (stages.py).
+        self.slot_gens = None
 
 
 class InFlight:
@@ -272,6 +277,18 @@ class BatchSolver:
         leaves the pending set without a queue-manager delete), so its
         arena slot can be recycled."""
         self._arena.release(key)
+
+    def slot_generations(self, slots):
+        """Per-slot encode-arena generations for a dispatched batch's
+        slots — the speculative pipeline's staleness witness
+        (scheduler/stages.SpeculationToken): stamped at dispatch,
+        re-read at apply-validation; any mid-flight upsert/delete of a
+        dispatched workload bumps its slot's generation and the
+        speculation aborts. None when no arena feed is bound (no
+        invalidation source -> no per-slot protocol)."""
+        if slots is None or self._queues is None:
+            return None
+        return self._arena.slot_generations(slots)
 
     @property
     def resident_capable(self) -> bool:
@@ -515,6 +532,7 @@ class BatchSolver:
             batch, slots = self._arena.assemble(entries, snapshot, topo,
                                                 self.ordering,
                                                 self.max_podsets)
+            slot_gens = self._arena.gen[np.asarray(slots, np.int64)].copy()
             self.counters["arena_rows_encoded"] = self._arena.encoded_rows
             self.counters["arena_gathers"] = self._arena.gathers
         else:
@@ -533,6 +551,8 @@ class BatchSolver:
         self._phase("route", t1, _t.perf_counter())
         plan = Plan(topo, topo_dev, state, batch, start_rank, fit_pred)
         plan.slots = slots
+        if slots is not None:
+            plan.slot_gens = slot_gens
         plan.deltas = deltas
         plan.resident = resident
         if resident:

@@ -324,6 +324,45 @@ class TestOverloadStorm:
         _assert_host_state_clean(storm)
 
 
+class TestSpeculationAborts:
+    def test_scripted_mis_speculation_falls_back_with_no_double_admission(self):
+        # ISSUE 6 satellite: a scripted fault at the new
+        # speculation_validate site forces mis-speculation aborts on a
+        # pipelined run. Every abort must fall back to the synchronous
+        # path, the admitted set must converge to the fault-free
+        # oracle's, and no workload may be admitted twice.
+        results = {}
+        for chaotic in (False, True):
+            env = build_env(_setup(), solver=True)
+            env.scheduler.pipeline_enabled = True
+            injector = None
+            if chaotic:
+                injector = FaultInjector(
+                    {faultinject.SITE_SPECULATION:
+                     {i: faultinject.RAISE for i in (0, 2, 3)}})
+            try:
+                _run_to_settled(env, injector, inject_cycles=10,
+                                trickle_waves=3)
+            finally:
+                faultinject.uninstall()
+            results[chaotic] = env
+        oracle, chaos = results[False], results[True]
+        s = chaos.scheduler
+        assert s.speculation_aborts >= 1
+        assert s.speculation_abort_reasons.get("injected", 0) >= 1
+        # abort -> synchronous fallback -> identical admitted set
+        assert set(admitted_map(chaos)) == set(admitted_map(oracle))
+        # no double admission: one QuotaReserved event per admitted key
+        reserved: dict = {}
+        for key, reason in chaos.client.events:
+            if reason == "QuotaReserved":
+                reserved[key] = reserved.get(key, 0) + 1
+        assert all(c == 1 for c in reserved.values())
+        # the breaker was NOT fed: mis-speculation is not a device fault
+        assert s.breaker.trips == 0 and s.solver_faults == 0
+        _assert_host_state_clean(chaos)
+
+
 @pytest.mark.slow
 class TestChaosSweep:
     @pytest.mark.parametrize("seed", [7, 99, 4242])

@@ -122,6 +122,69 @@ class TestLadderStateMachine:
         lad.observe_cycle(0.2)
         assert lad.cycles_shed == 1
 
+    def test_idle_cycles_rung_down_while_quiescent(self):
+        # PR-5 follow-up: a degraded ladder with an empty queue held its
+        # rung until traffic resumed. Idle ticks now count toward the
+        # healthy-cycle streak.
+        lad = make_ladder()
+        for _ in range(4):
+            lad.observe_cycle(0.2)
+        assert lad.state == SURVIVAL
+        assert lad.observe_idle() is False
+        assert lad.observe_idle() is True   # 2 idle ticks: down a rung
+        assert lad.state == SHED
+        lad.observe_idle()
+        assert lad.observe_idle() is True
+        assert lad.state == NORMAL
+        assert lad.recoveries == 2 and lad.idle_cycles == 4
+
+    def test_idle_recovery_drops_the_stale_storm_ewma(self):
+        # The storm's EWMA must not survive an idle recovery: left in
+        # place, the first healthy cycles after traffic resumes would
+        # inherit it and spuriously re-escalate.
+        lad = make_ladder(ewma_alpha=0.3)
+        for _ in range(4):
+            lad.observe_cycle(0.3)
+        assert lad.state == SURVIVAL and lad.ewma_s > lad.budget_s
+        while lad.state != NORMAL:
+            lad.observe_idle()
+        assert lad.ewma_s is None
+        # resumed healthy traffic stays normal
+        for _ in range(4):
+            lad.observe_cycle(0.02)
+        assert lad.state == NORMAL and lad._over == 0
+
+    def test_idle_ticks_mix_with_healthy_cycles(self):
+        # a trickle cycle between idle ticks keeps accumulating the SAME
+        # healthy streak; an overloaded cycle resets it
+        lad = make_ladder()
+        lad.observe_cycle(0.2)
+        lad.observe_cycle(0.2)
+        assert lad.state == SHED
+        lad.observe_idle()
+        lad.observe_cycle(0.2)      # overload resets the streak
+        lad.observe_idle()
+        assert lad.state == SHED
+        assert lad.observe_idle() is True
+        assert lad.state == NORMAL
+
+    def test_idle_is_noop_when_normal_or_disabled(self):
+        lad = make_ladder()
+        assert lad.observe_idle() is False
+        assert lad.idle_cycles == 0 and lad._healthy == 0
+        off = DegradationLadder(budget_s=0.0)
+        off.state = SHED
+        assert off.observe_idle() is False
+        assert off.state == SHED
+
+    def test_allow_pipeline_per_state(self):
+        lad = make_ladder()
+        assert lad.allow_pipeline
+        lad.state = SHED
+        assert lad.allow_pipeline   # bounded allowance (ISSUE 6)
+        lad.state = SURVIVAL
+        assert not lad.allow_pipeline
+
     def test_status_payload(self):
         lad = make_ladder()
         lad.observe_cycle(0.2, backlog=7)
@@ -322,16 +385,22 @@ class TestSchedulerShedding:
         assert s.ladder.state == NORMAL
         assert len(admitted_map(env)) == 20  # nothing lost on the way
 
-    def test_pipeline_gated_off_while_degraded(self):
+    def test_pipeline_bounded_under_shed_gated_off_in_survival(self):
+        # ISSUE 6: shed allows BOUNDED pipelining (the head cap ran
+        # before routing; preempt-planning cycles bail to sync), while
+        # survival still gates it off (the cycle is CPU-pinned anyway
+        # and the in-flight queue must drain, not grow).
         env = _env(solver=True)
         s = env.scheduler
         s.pipeline_enabled = True
         s.ladder = make_ladder()
         s.ladder.state = SHED
+        assert s.ladder.allow_pipeline
+        s.ladder.state = SURVIVAL
+        assert not s.ladder.allow_pipeline
         assert not s._pipeline_ok([object()] * 100)
         s.ladder.state = NORMAL
-        # other gates may still veto, but the ladder no longer does
-        assert s.ladder.state == NORMAL
+        assert s.ladder.allow_pipeline
 
 
 class TestDegradeStatusSurface:

@@ -277,6 +277,124 @@ class TestArenaEquivalence:
         _assert_batches_equal(arena_batch, fresh)
 
 
+class TestBatchedChurnEncode:
+    """ISSUE 6 satellite: churn batches >= _BATCH_ENCODE_MIN take the
+    vectorized multi-row encode (one fancy-indexed write per arena
+    field) instead of paying ~15us/row of small-numpy dispatch; small
+    batches keep the per-row path. Both must stay bit-identical to the
+    from-scratch oracle."""
+
+    def _spy(self, env):
+        calls = {"batch": 0, "row": 0}
+        orig_rows, orig_row = env.arena._encode_rows, env.arena._encode_row
+
+        def spy_rows(*a, **k):
+            calls["batch"] += 1
+            return orig_rows(*a, **k)
+
+        def spy_row(*a, **k):
+            calls["row"] += 1
+            return orig_row(*a, **k)
+
+        env.arena._encode_rows = spy_rows
+        env.arena._encode_row = spy_row
+        return calls
+
+    def test_large_churn_is_vectorized_and_bit_identical(self):
+        rng = random.Random(5)
+        env = ArenaEnv(num_cqs=4, max_podsets=2)
+        for i in range(40):
+            env.submit(_make_wl(env, f"w{i}", rng))
+        snapshot, topo = env.topo()
+        infos = env.infos()
+        entries = [infos[k] for k in sorted(infos)]
+        calls = self._spy(env)
+        arena_batch, fresh, slots = env.both_batches(entries, snapshot,
+                                                     topo)
+        _assert_batches_equal(arena_batch, fresh, "vectorized first sight")
+        assert calls["batch"] == 1 and calls["row"] == 0
+
+    def test_small_churn_keeps_per_row_path(self):
+        from kueue_tpu.solver.arena import _BATCH_ENCODE_MIN
+        rng = random.Random(6)
+        env = ArenaEnv(num_cqs=4, max_podsets=2)
+        live = {}
+        for i in range(20):
+            wl = _make_wl(env, f"w{i}", rng)
+            live[f"w{i}"] = wl
+            env.submit(wl)
+        snapshot, topo = env.topo()
+        infos = env.infos()
+        entries = [infos[k] for k in sorted(infos)]
+        env.both_batches(entries, snapshot, topo)  # steady state
+        churn = _BATCH_ENCODE_MIN - 1
+        for name in sorted(live)[:churn]:
+            wl = _make_wl(env, name, rng)
+            wl.metadata.resource_version = \
+                live[name].metadata.resource_version + 1
+            env.submit(wl)
+        infos = env.infos()
+        entries = [infos[k] for k in sorted(infos)]
+        calls = self._spy(env)
+        arena_batch, fresh, _ = env.both_batches(entries, snapshot, topo)
+        _assert_batches_equal(arena_batch, fresh, "per-row churn")
+        assert calls["batch"] == 0 and calls["row"] == churn
+
+    def test_failed_encode_leaves_slot_retryable(self):
+        # An encode that raises (the scheduler's _prepare_failed sync
+        # fallback is an anticipated path) must NOT mark the slot as
+        # freshly encoded — the next cycle retries instead of riding a
+        # cleared row for the workload's whole pending lifetime.
+        rng = random.Random(7)
+        env = ArenaEnv(num_cqs=2, max_podsets=2)
+        wl = WorkloadWrapper("w0").queue("lq0").pod_set(cpu="2").obj()
+        wl.metadata.resource_version = 1
+        env.submit(wl)
+        snapshot, topo = env.topo()
+        info = env.infos()["default/w0"]
+        env.arena.begin_cycle(topo)
+        orig = env.arena._encode_row
+
+        def boom(*a, **k):
+            raise RuntimeError("encode blew up")
+
+        env.arena._encode_row = boom
+        with pytest.raises(RuntimeError):
+            env.arena.assemble([info], snapshot, topo, env.ordering, 2)
+        env.arena._encode_row = orig
+        env.arena._last_ids = None  # the failed cycle never completed
+        batch, _ = env.arena.assemble([info], snapshot, topo,
+                                      env.ordering, 2)
+        fresh = _fresh_batch([info], snapshot, topo, env.ordering, 2)
+        _assert_batches_equal(batch, fresh, "post-failure retry")
+        assert batch.solvable[0]
+
+    def test_slot_generations_track_encodes_and_deltas(self):
+        env = ArenaEnv(num_cqs=2)
+        wl = WorkloadWrapper("w0").queue("lq0").pod_set(cpu="2").obj()
+        wl.metadata.resource_version = 1
+        env.submit(wl)
+        snapshot, topo = env.topo()
+        info = env.infos()["default/w0"]
+        env.arena.begin_cycle(topo)
+        _, slots = env.arena.assemble([info], snapshot, topo,
+                                      env.ordering, 2)
+        g0 = env.arena.slot_generations(slots)
+        # a requeue of the unchanged Info moves nothing
+        env.arena.assemble([info], snapshot, topo, env.ordering, 2)
+        assert np.array_equal(env.arena.slot_generations(slots), g0)
+        # an upsert delta bumps the generation BEFORE the re-encode
+        wl2 = WorkloadWrapper("w0").queue("lq0").pod_set(cpu="5").obj()
+        wl2.metadata.resource_version = 2
+        env.submit(wl2)
+        g1 = env.arena.slot_generations(slots)
+        assert g1[0] > g0[0]
+        # ...and the re-encode bumps it again
+        info2 = env.infos()["default/w0"]
+        env.arena.assemble([info2], snapshot, topo, env.ordering, 2)
+        assert env.arena.slot_generations(slots)[0] > g1[0]
+
+
 class TestEligibilityCacheEviction:
     def test_evicts_oldest_half_not_all(self):
         cache = {i: i for i in range(10)}

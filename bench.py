@@ -1272,6 +1272,142 @@ def bench_depth4_cohorts(num_cqs=2048, num_leaves=256, num_mids=128,
     return t_cpu / t_dev
 
 
+def bench_cold_start(num_cqs=32, num_cohorts=8, budget_s=240.0):
+    """Compile-storm immunity (solver/warmgov.py + solver/COMPILE.md,
+    ROADMAP item 4): process start -> first device-routed cycle, with
+    and without a primed persistent compilation cache.
+
+    Each "process start" is a fresh KueueManager + BatchSolver with the
+    in-process jit cache cleared (jax.clear_caches()) and the
+    warmed-program registry reset — the in-process equivalent of a
+    restart. The compile governor launches at manager construction
+    (solver.warmupAtStartup); until the traffic's shape bucket is warm,
+    cycles route "cpu-warmup" (admissions keep flowing on the CPU
+    path), and the first device-routed cycle marks cold-start done.
+
+    Asserts: both starts reach a device-routed cycle within the budget;
+    ZERO mid-traffic compiles (every device-dispatched program variant
+    was warmed first — the cpu-warmup gate held until then); and, when
+    the backend's persistent cache works (entries on disk after the
+    cold start), the primed start performs zero fresh compiles (pure
+    cache load, checked via jax's compilation-cache events) and beats
+    the cold one."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from kueue_tpu import config as cfgpkg
+    from kueue_tpu.api.meta import FakeClock
+    from kueue_tpu.manager import KueueManager
+    from kueue_tpu.solver import BatchSolver
+    from kueue_tpu.solver import service as svc
+    from kueue_tpu.solver import warmgov
+    from kueue_tpu.utils.runtime import enable_compilation_cache
+
+    cache_dir = tempfile.mkdtemp(prefix="kueue-coldstart-")
+
+    def one_start(label):
+        jax.clear_caches()
+        svc.reset_seen_programs()
+        cfg = cfgpkg.Configuration()
+        cfg.solver.enable = True
+        cfg.solver.min_heads = 0
+        cfg.solver.compile_cache_dir = cache_dir
+        cfg.solver.warmup_at_startup = True
+        clock = FakeClock(1000.0)
+        t0 = time.perf_counter()
+        mgr = KueueManager(cfg=cfg, clock=clock, solver=BatchSolver())
+        # Production deployments size the arena up front (the perf
+        # harness passes expected_pending) so the arena-gather variants
+        # warm at the real capacity instead of compiling on the first
+        # arena dispatch.
+        mgr.warm_governor.expected_pending = num_cqs * 4
+        for obj in ([make_flavor("f0")]
+                    + [make_cq(f"cq{i}", f"cohort-{i % num_cohorts}",
+                               ["f0"], nominal_units=100_000)
+                       for i in range(num_cqs)]
+                    + [make_lq(f"lq{i}", f"cq{i}")
+                       for i in range(num_cqs)]):
+            mgr.store.create(obj)
+        mgr.run_until_idle(max_iterations=1_000_000)
+        n = 0
+        first_device_s = None
+        waves = 0
+        while time.perf_counter() - t0 < budget_s:
+            for i in range(num_cqs):
+                wl = make_workload(f"{label}-w{n}", f"lq{i}", cpu_units=1,
+                                   creation=float(n))
+                mgr.store.create(wl)
+                n += 1
+            mgr.run_until_idle(max_iterations=1_000_000)
+            mgr.scheduler.schedule(timeout=0)
+            mgr.run_until_idle(max_iterations=1_000_000)
+            clock.advance(1.0)
+            waves += 1
+            counts = mgr.scheduler.cycle_counts
+            if (counts.get("device", 0) + counts.get("device-pipelined", 0)
+                    + counts.get("device-dispatch-only", 0)) >= 1:
+                first_device_s = time.perf_counter() - t0
+                break
+            time.sleep(0.25)  # let the background ladder make progress
+        # Drain the ladder before "process shutdown": the measurement
+        # stops at the first device cycle, but the smaller drain
+        # buckets may still be warming in the background — stopping
+        # mid-compile would leave them un-persisted, and the primed
+        # run would (correctly!) compile them fresh.
+        t_drain = time.perf_counter()
+        while (mgr.warm_governor.state == warmgov.GOV_WARMING
+               and time.perf_counter() - t_drain < budget_s):
+            time.sleep(0.1)
+        st = mgr.warm_governor.status()
+        mgr.warm_governor.stop()
+        mid = mgr.scheduler.solver.counters["mid_traffic_compiles"]
+        # Fresh compiles attributed per bucket (the provenance deltas),
+        # not raw process-wide cache misses — warm_setup's zero-fill
+        # compiles outside the buckets are not ladder programs.
+        fresh = sum(1 for b in st["buckets"] if b["source"] == "fresh")
+        return {"first_device_cycle_s": first_device_s, "waves": waves,
+                "cpu_warmup_cycles":
+                    mgr.scheduler.cycle_counts.get("cpu-warmup", 0),
+                "mid_traffic_compiles": mid, "fresh_buckets": fresh,
+                "warmup_state": st["state"],
+                "warmup_faults": st["warmup_faults"]}
+
+    try:
+        cold = one_start("cold")
+        # Did the backend's persistent cache actually persist anything?
+        # (Provenance classification degrades gracefully without it.)
+        cache_supported = any(files for _, _, files in os.walk(cache_dir))
+        primed = one_start("primed")
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        enable_compilation_cache()  # restore the shared bench cache dir
+
+    assert cold["first_device_cycle_s"] is not None, \
+        f"cold start never reached a device cycle within {budget_s}s"
+    assert primed["first_device_cycle_s"] is not None, \
+        f"primed start never reached a device cycle within {budget_s}s"
+    # Zero mid-traffic compiles: the cpu-warmup gate held every cycle
+    # off the device route until its bucket was warm.
+    assert cold["mid_traffic_compiles"] == 0, cold
+    assert primed["mid_traffic_compiles"] == 0, primed
+    if cache_supported:
+        # Cache reuse is asserted structurally (zero fresh buckets);
+        # the latency ratio is reported but not asserted — a single
+        # wall-clock sample comparison is noise-bound when compiles are
+        # cheap relative to the drive loop's quantization.
+        assert primed["fresh_buckets"] == 0, primed
+
+    log({"bench": "cold_start", "cqs": num_cqs,
+         "budget_s": budget_s, "cache_supported": cache_supported,
+         "cold": cold, "primed": primed,
+         "primed_speedup": round(
+             cold["first_device_cycle_s"]
+             / max(primed["first_device_cycle_s"], 1e-9), 2)})
+    return cold["first_device_cycle_s"], primed["first_device_cycle_s"]
+
+
 def main():
     import jax
     from kueue_tpu.utils.runtime import ensure_live_backend
@@ -1285,6 +1421,7 @@ def main():
     bench_device_fault_recovery()
     bench_trace_overhead()
     bench_overload_shed()
+    bench_cold_start()
     hit_rate = bench_speculative_pipeline()
     rows = {}
     admitted_per_sec, speedup = bench_e2e_progressive()

@@ -206,6 +206,9 @@ DEFAULT_BREAKER_FAULT_THRESHOLD = 3
 DEFAULT_BREAKER_BACKOFF_BASE_S = 1.0
 DEFAULT_BREAKER_BACKOFF_MAX_S = 60.0
 
+# Compile governor defaults (solver/warmgov.py + solver/COMPILE.md).
+DEFAULT_WARMUP_DEADLINE_S = 120.0
+
 
 @dataclass
 class SolverConfig:
@@ -253,6 +256,22 @@ class SolverConfig:
     breaker_fault_threshold: int = DEFAULT_BREAKER_FAULT_THRESHOLD
     breaker_backoff_base_s: float = DEFAULT_BREAKER_BACKOFF_BASE_S
     breaker_backoff_max_s: float = DEFAULT_BREAKER_BACKOFF_MAX_S
+    # Compile governor (solver/warmgov.py + solver/COMPILE.md).
+    # compileCacheDir: root of the persistent XLA compilation cache;
+    # the governor stamps a per-topology subdirectory
+    # (topo-<fingerprint>) into the layout so a topology change cannot
+    # replay stale executables, and a process restart reuses compiles.
+    # "" keeps the default repo-local .jax_cache behavior.
+    compile_cache_dir: str = ""
+    # warmupAtStartup: launch the governor's supervised background
+    # warmup thread from KueueManager construction — until a shape
+    # bucket is warm, cycles that would dispatch it route "cpu-warmup"
+    # (no hot-path compile). Off by default: deterministic drivers
+    # (tests, tools) attach and start the governor explicitly.
+    warmup_at_startup: bool = False
+    # Per-bucket warmup deadline: a wedged remote compile abandons the
+    # bucket (retried once, then skipped) and the ladder continues.
+    warmup_deadline_s: float = DEFAULT_WARMUP_DEADLINE_S
 
 
 @dataclass
@@ -349,6 +368,8 @@ def validate(cfg: Configuration) -> list[str]:
             < cfg.solver.breaker_backoff_base_s:
         errs.append("solver.breakerBackoff: base must be positive and "
                     "max >= base")
+    if cfg.solver.warmup_deadline_s <= 0:
+        errs.append("solver.warmupDeadline must be positive")
     if cfg.observability.flight_recorder_capacity < 1:
         errs.append("observability.flightRecorderCapacity must be >= 1")
     sc = cfg.scheduler
@@ -486,6 +507,10 @@ def load(raw: dict) -> Configuration:
             breaker_backoff_max_s=s.get(
                 "breakerBackoffMax", DEFAULT_BREAKER_BACKOFF_MAX_S),
             supervise_dispatch=s.get("superviseDispatch", True),
+            compile_cache_dir=s.get("compileCacheDir", ""),
+            warmup_at_startup=s.get("warmupAtStartup", False),
+            warmup_deadline_s=s.get("warmupDeadline",
+                                    DEFAULT_WARMUP_DEADLINE_S),
         )
     if "observability" in raw:
         o = raw["observability"]

@@ -50,7 +50,7 @@ class Dumper:
     def _dump_solver_plane(self) -> list:
         from kueue_tpu.obs import (arena_status, breaker_status,
                                    degrade_status, pipeline_status,
-                                   router_status)
+                                   router_status, warmup_status)
         sched = self.scheduler
         lines = ["-- breaker --"]
         st = breaker_status(sched)
@@ -76,6 +76,23 @@ class Dumper:
                      f"hits={pl['speculation_hits']} "
                      f"aborts={pl['speculation_aborts']} "
                      f"abort_reasons={pl['abort_reasons']}")
+        wu = warmup_status(sched)
+        if wu.get("attached"):
+            lines.append("-- warmup --")
+            lines.append(f"state={wu['state']} "
+                         f"programs_warmed={wu['programs_warmed']} "
+                         f"faults={wu['warmup_faults']} "
+                         f"cpu_warmup_cycles={wu['cpu_warmup_cycles']} "
+                         f"unwarm_routed={wu['unwarm_routed_cycles']} "
+                         f"cache_subdir={wu['cache_subdir'] or '(none)'}")
+            for b in wu["buckets"]:
+                lines.append(f"  bucket width={b['width']}: "
+                             f"state={b['state']} source={b['source']} "
+                             f"programs={b['programs']} "
+                             f"compile_ms={b['compile_ms']} "
+                             f"attempts={b['attempts']}"
+                             + (f" error={b['error']}" if b["error"]
+                                else ""))
         lines.append("-- router --")
         rt = router_status(sched)
         lines.append(f"routing={rt['routing']} "

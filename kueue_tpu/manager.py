@@ -207,7 +207,31 @@ class KueueManager:
                 # round trip carries the watchdog deadline too.
                 solver.supervise_dispatch = s.supervise_dispatch
             from kueue_tpu.utils.runtime import enable_compilation_cache
-            enable_compilation_cache()
+            enable_compilation_cache(s.compile_cache_dir or None)
+        # Compile governor (solver/warmgov.py): compiles become a
+        # managed background event — a supervised warmup thread walks
+        # the shape-bucket ladder (loading from the persistent cache,
+        # stamped per topology under solver.compileCacheDir) while the
+        # scheduler routes un-warmed buckets to the CPU path
+        # ("cpu-warmup") instead of paying a hot-path compile. Attached
+        # whenever a warm-capable solver is present so /debug/warmup
+        # and the dumper always work; the background walk starts here
+        # only with solver.warmupAtStartup (deterministic drivers call
+        # start_warmup()/run_sync themselves).
+        self.warm_governor = None
+        if solver is not None and hasattr(solver, "warm_setup"):
+            from kueue_tpu.solver.warmgov import CompileGovernor
+            s = self.cfg.solver
+            self.warm_governor = CompileGovernor(
+                solver, self.cache, metrics=self.metrics,
+                recorder=self.flight_recorder,
+                bucket_deadline_s=s.warmup_deadline_s,
+                cache_dir=s.compile_cache_dir,
+                max_width=s.max_heads,
+                fair_sharing=self.cfg.fair_sharing.enable)
+            self.scheduler.warm_gov = self.warm_governor
+            if s.warmup_at_startup:
+                self.warm_governor.start()
         # Fault/breaker/degrade transitions land as Scheduler system
         # events — the outage + degraded-mode timeline in the artifacts.
         # Wired with or without a solver: the degradation ladder watches

@@ -306,6 +306,21 @@ class Registry:
             "kueue_solver_dispatch_supervised_timeouts_total",
             "Dispatches abandoned by the supervised solver-worker "
             "deadline (hang during trace/compile/transfer)")
+        # Compile governor (solver/warmgov.py + solver/COMPILE.md):
+        # per-bucket compile provenance and the governor's warm-state
+        # machine, plus warmup attempts that faulted.
+        self.compile_events_total = Counter(
+            "kueue_solver_compile_events_total",
+            "Kernel programs compiled or loaded per shape bucket by "
+            "source (fresh|cache-hit|jit-cache)", ["bucket", "source"])
+        self.warmup_state = Gauge(
+            "kueue_solver_warmup_state",
+            "Compile-governor state (0=idle, 1=warming, 2=warm, "
+            "3=partial)")
+        self.warmup_faults_total = Counter(
+            "kueue_solver_warmup_faults_total",
+            "Warmup bucket attempts that faulted (compile errors or "
+            "per-bucket deadline abandonments; the ladder continues)")
         # Speculative admission pipeline (scheduler/PIPELINE.md):
         # validated-and-committed speculative cycles vs mis-speculation
         # aborts by validation reason (topology-epoch | cohort-epoch |
@@ -376,6 +391,19 @@ class Registry:
 
     def fault_recovered(self, cycles: int) -> None:
         self.fault_recovery_cycles.set(cycles)
+
+    def compile_event(self, bucket: str, source: str, n: int = 1) -> None:
+        self.compile_events_total.inc(n, bucket=bucket, source=source)
+
+    def set_warmup_state(self, state: str) -> None:
+        # Lazy import: the governor module owns the state encoding, but
+        # pulls in the (jax-heavy) solver package — callers without a
+        # governor must not pay that import.
+        from kueue_tpu.solver.warmgov import WARMUP_STATE_CODES
+        self.warmup_state.set(WARMUP_STATE_CODES.get(state, -1))
+
+    def warmup_fault(self) -> None:
+        self.warmup_faults_total.inc()
 
     def set_degraded_state(self, state: str) -> None:
         self.degraded_state.set(DEGRADED_STATE_CODES.get(state, -1))

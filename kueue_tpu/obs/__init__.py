@@ -13,6 +13,7 @@ from kueue_tpu.obs.status import (
     degrade_status,
     pipeline_status,
     router_status,
+    warmup_status,
 )
 
 __all__ = [
@@ -25,4 +26,5 @@ __all__ = [
     "degrade_status",
     "pipeline_status",
     "router_status",
+    "warmup_status",
 ]

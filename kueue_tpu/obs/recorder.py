@@ -2,7 +2,8 @@
 
 Every scheduler cycle produces a structured ``CycleTrace`` — route mode
 (device / device-pipelined / cpu / cpu-forced / cpu-strict /
-cpu-breaker / cpu-survival / drain), regime, degradation-ladder rung,
+cpu-breaker / cpu-survival / cpu-warmup / drain), regime,
+degradation-ladder rung,
 head/admit/evict counts, fault and
 breaker annotations, and the cycle's phase spans (snapshot, encode,
 route, dispatch, fetch, decode, preempt-plan, apply, requeue, plus

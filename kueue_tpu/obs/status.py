@@ -97,6 +97,22 @@ def pipeline_status(scheduler) -> dict:
     }
 
 
+def warmup_status(scheduler) -> dict:
+    """Compile-governor state (/debug/warmup): the warm-state machine,
+    the per-bucket ladder with compile provenance (fresh / cache-hit /
+    jit-cache), warmup faults, and how many cycles the route gate
+    diverted to cpu-warmup — the same producer tools/warm_probe.py and
+    the SIGUSR2 dumper print, so every consumer shows the same numbers.
+    See solver/COMPILE.md."""
+    gov = getattr(scheduler, "warm_gov", None)
+    if gov is None:
+        return {"attached": False}
+    st = gov.status()
+    st["attached"] = True
+    st["cpu_warmup_cycles"] = scheduler.cycle_counts.get("cpu-warmup", 0)
+    return st
+
+
 def arena_status(solver) -> dict:
     """Encode-arena slot occupancy and churn counters."""
     arena = getattr(solver, "_arena", None)
@@ -144,6 +160,8 @@ class DebugEndpoints:
             return router_status(self.scheduler)
         if path == "/debug/pipeline":
             return pipeline_status(self.scheduler)
+        if path == "/debug/warmup":
+            return warmup_status(self.scheduler)
         if path == "/debug/arena":
             if self.scheduler.solver is None:
                 return {"bound": False}

@@ -18,10 +18,12 @@ from kueue_tpu.perf.generator import (
 )
 from kueue_tpu.perf.runner import RunResult, Runner
 from kueue_tpu.perf.checker import (RangeSpec, check, default_rangespec,
+                                    north_star_rangespec,
                                     refuse_cross_backend)
 
 __all__ = [
     "CohortClass", "QueueClass", "WorkloadClass", "WorkloadSet",
     "default_generator_config", "generate",
     "Runner", "RunResult", "RangeSpec", "check", "default_rangespec",
+    "north_star_rangespec", "refuse_cross_backend",
 ]

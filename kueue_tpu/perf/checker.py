@@ -42,6 +42,12 @@ class RangeSpec:
     # that recorded samples (a CPU-only run has no solver phases; the
     # default config's min_heads gate can keep the solver dark).
     max_phase_p99_ms: dict = field(default_factory=dict)
+    # Compile-storm immunity bound (solver/COMPILE.md): program variants
+    # first executed inside a measured cycle. Backend-independent (a
+    # count, not a latency), so it survives cross-backend refusal.
+    # None = unchecked; 0 = the steady-state contract (every variant
+    # warmed by the compile governor before the clock started).
+    max_mid_traffic_compiles: Optional[int] = None
 
 
 def default_rangespec() -> RangeSpec:
@@ -72,6 +78,18 @@ def default_rangespec() -> RangeSpec:
                           "requeue": 100.0, "dispatch": 1000.0,
                           "fetch": 1000.0},
     )
+
+
+def north_star_rangespec() -> RangeSpec:
+    """Bounds for the north-star scenario (50k pending x 2k CQs x 32
+    flavors). No published reference queueing-dynamics bounds exist at
+    this scale, so the spec carries only the backend-independent
+    compile-storm contract: after the compile governor's pre-clock
+    warmup, ZERO program variants may first execute inside a measured
+    cycle (ROADMAP item 4 / solver/COMPILE.md). A violation means the
+    bucket ladder missed a shape the traffic hit — a hot-path compile
+    stall in production."""
+    return RangeSpec(max_mid_traffic_compiles=0)
 
 
 def refuse_cross_backend(spec: RangeSpec, backend: Optional[dict]) -> Optional[str]:
@@ -132,4 +150,12 @@ def check(result: RunResult, spec: RangeSpec) -> list:
             violations.append(
                 f"cycle phase {phase!r} p99 {p99:.3f}ms "
                 f"exceeds {bound:.3f}ms")
+    if spec.max_mid_traffic_compiles is not None \
+            and result.mid_traffic_compiles is not None \
+            and result.mid_traffic_compiles > spec.max_mid_traffic_compiles:
+        violations.append(
+            f"{result.mid_traffic_compiles} program variant(s) first "
+            f"executed inside a measured cycle (bound "
+            f"{spec.max_mid_traffic_compiles}) — the warmup ladder "
+            f"missed shape bucket(s) the traffic hit")
     return violations

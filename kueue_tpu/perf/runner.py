@@ -100,6 +100,14 @@ class RunResult:
     # encode/dispatch series).
     phase_p50_ms: dict = field(default_factory=dict)
     phase_p99_ms: dict = field(default_factory=dict)
+    # Compile-storm accounting (solver/COMPILE.md): program variants
+    # that first executed INSIDE a measured cycle — i.e. potential jit
+    # compiles on the hot path. The north-star rangespec pins this at 0
+    # after warmup. None for solver-less runs.
+    mid_traffic_compiles: Optional[int] = None
+    # Compile-governor summary (state, per-bucket provenance counts,
+    # warmup faults, cycles the route gate diverted to cpu-warmup).
+    warmup: dict = field(default_factory=dict)
 
 
 class Runner:
@@ -122,44 +130,21 @@ class Runner:
             mgr.store.create(lq)
         mgr.run_until_idle(max_iterations=10_000_000)
 
-        if self.solver is not None and hasattr(self.solver, "warm"):
-            # Pre-clock shape-bucket warmup (VERDICT r4 ask #3): compile
-            # (or load from the persistent cache) the kernel variants the
-            # run will hit, so no measured cycle or router sample carries
-            # a compile. Widths: the full-backlog bucket plus the drain
-            # buckets.
-            # Every width bucket the drain phase will pass through
-            # (encode buckets by powers of 4 from 8), largest first.
-            full = min(2048, len(load.cluster_queues))
-            widths, b = [], 8
-            while True:
-                widths.append(b)
-                if b >= full:
-                    break
-                b *= 4
-            widths.reverse()
-            # Rank buckets from the real topology: heads() pops one head
-            # per CQ, so a batch's largest conflict domain is the largest
-            # cohort's CQ count, bucketed the way max_rank_bound buckets
-            # (powers of 4 from 8). Warm it and the next bucket up (a
-            # cohort-less CQ tail can nudge the bound).
-            members: dict = {}
-            for cq in load.cluster_queues:
-                members[cq.spec.cohort or cq.metadata.name] = \
-                    members.get(cq.spec.cohort or cq.metadata.name, 0) + 1
-            b = 8
-            while b < max(members.values() or [1]):
-                b *= 4
-            try:
-                # expected_pending pre-sizes the encode arena (no mid-run
-                # growth → stable gather shapes) and warms the
-                # arena-resident kernel variants.
-                self.solver.warm(self.mgr.cache.snapshot(),
-                                 widths=tuple(widths), max_ranks=(b, b * 4),
-                                 deltas_buckets=(8,),
-                                 expected_pending=len(load.arrivals))
-            except Exception:  # noqa: BLE001 — warmup is best-effort
-                pass
+        if self.mgr.warm_governor is not None:
+            # Pre-clock shape-bucket warmup (VERDICT r4 ask #3), now
+            # delegated to the compile governor (solver/warmgov.py): ONE
+            # copy of the geometric bucket ladder, walked synchronously
+            # before the measured clock starts so no measured cycle or
+            # router sample carries a compile. expected_pending
+            # pre-sizes the encode arena (no mid-run growth -> stable
+            # gather shapes) and warms the arena-resident variants.
+            # Failures are no longer silently swallowed: every faulted
+            # bucket lands in vlog, warmup_faults_total, and the
+            # governor's /debug/warmup status — the walk itself is
+            # fault-contained (a failed bucket degrades that bucket to
+            # the cpu-warmup route, never the run).
+            self.mgr.warm_governor.run_sync(
+                expected_pending=len(load.arrivals))
 
         # The measured clock starts AFTER environment setup + shape
         # warmup (the reference's harness also measures from scheduler
@@ -297,6 +282,22 @@ class Runner:
                 for k, v in getattr(self.solver, "phase_s", {}).items()}
             result.solver_counters = dict(
                 getattr(self.solver, "counters", {}))
+            result.mid_traffic_compiles = result.solver_counters.get(
+                "mid_traffic_compiles")
+        gov = self.mgr.warm_governor
+        if gov is not None:
+            st = gov.status()
+            sources: dict = {}
+            for b in st["buckets"]:
+                key = b["source"] if b["state"] == "warm" else b["state"]
+                sources[key] = sources.get(key, 0) + 1
+            result.warmup = {
+                "state": st["state"],
+                "programs_warmed": st["programs_warmed"],
+                "warmup_faults": st["warmup_faults"],
+                "unwarm_routed_cycles": st["unwarm_routed_cycles"],
+                "bucket_sources": sources,
+            }
         if cycle_times:
             result.cycle_time_total_s = sum(cycle_times)
             cycle_times.sort()

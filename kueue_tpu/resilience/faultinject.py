@@ -9,6 +9,10 @@ can hurt the admission cycle (see RESILIENCE.md):
 - ``journal_replay``   — the solver's residency journal reconcile
 - ``speculation_validate`` — the pipelined apply step's generation-token
   check (a raise forces a mis-speculation abort, PIPELINE.md)
+- ``compile_warmup``   — the compile governor's per-bucket warm body
+  (solver/COMPILE.md; a DELAY here is a wedged remote compile — the
+  governor's per-bucket deadline abandons the bucket and the ladder
+  continues, never wedging startup)
 
 Each site can, per a deterministic scripted schedule, RAISE (a dead
 tunnel / XLA error), DELAY (a wedged ``device_get`` — the watchdog's
@@ -45,8 +49,13 @@ SITE_REPLAY = "journal_replay"
 # the synchronous cycle with no double admission. Last in SITES so
 # seeded scripted() schedules for the original four sites are unchanged.
 SITE_SPECULATION = "speculation_validate"
+# Compile-governor warmup (solver/warmgov.py): fires once per bucket
+# warm attempt, OFF the scheduler thread — a fault here must only cost
+# that bucket, never a cycle. Appended after SITE_SPECULATION so seeded
+# scripted() schedules for the earlier sites are unchanged.
+SITE_WARMUP = "compile_warmup"
 SITES = (SITE_DISPATCH, SITE_COLLECT, SITE_SCATTER, SITE_REPLAY,
-         SITE_SPECULATION)
+         SITE_SPECULATION, SITE_WARMUP)
 
 RAISE = "raise"
 DELAY = "delay"
@@ -108,6 +117,9 @@ class FaultInjector:
             SITE_SCATTER: (RAISE, CORRUPT),
             SITE_REPLAY: (RAISE,),
             SITE_SPECULATION: (RAISE,),  # forced mis-speculation
+            # a wedged warmup compile (DELAY) is the governor's own
+            # deadline's regime; RAISE is a backend error mid-warm
+            SITE_WARMUP: (RAISE, (DELAY, delay_s)) if delay_s else (RAISE,),
         }
         schedule: dict = {}
         for site in SITES:

@@ -205,6 +205,14 @@ class Scheduler:
         # device path through half-open probes with backed-off jitter.
         self.breaker = CircuitBreaker()
         self.watchdog: Optional[DispatchWatchdog] = DispatchWatchdog()
+        # Compile governor (solver/warmgov.py): when attached (the
+        # manager/perf wiring), the scheduler consults its warm-state
+        # before committing a cycle to the device route — an un-warmed
+        # shape bucket routes to the CPU path as "cpu-warmup" instead
+        # of blocking on a hot-path compile, and the governor warms the
+        # bucket in the background. None (or an idle governor) leaves
+        # routing untouched.
+        self.warm_gov = None
         self.solver_faults = 0          # device faults observed (total)
         self._cycle_faults = 0          # device faults within this cycle
         # Optional observer hook (the manager wires it to the sim event
@@ -359,6 +367,21 @@ class Scheduler:
             # operator meaning; _route_record skips every degraded
             # cycle regardless.)
             route = "cpu-survival"
+        if route == "device" and self.warm_gov is not None \
+                and not self.warm_gov.route_ready(len(heads)):
+            # Compile governor (solver/warmgov.py): this cycle's batch
+            # width encodes into a bucket with no warm programs, so a
+            # dispatch would carry a jit compile on the hot path — the
+            # exact stall the governor exists to keep off measured
+            # cycles. Route to the CPU path (full reference semantics,
+            # no compile risk) under a distinct name and ask the
+            # governor to warm the bucket in the background. Like
+            # cpu-strict/cpu-survival this is an intervention, not an
+            # economics signal (never a router sample), and it is
+            # consulted BEFORE the breaker so it can never consume
+            # (and wedge) a half-open probe.
+            self.warm_gov.request(len(heads))
+            route = "cpu-warmup"
         if route == "device" \
                 and not self.breaker.allow_device(self.clock.now()):
             # Breaker open: pin the cycle to the CPU fallback under a

@@ -68,6 +68,49 @@ def _topo_np(topo) -> dict:
 CPU_FALLBACK = object()
 
 
+# --- warmed-program registry (compile-storm visibility; COMPILE.md) ---
+#
+# XLA's jit cache is process-global, so the registry of program
+# variants known compiled — warmed by the governor, or already
+# dispatched once — is module-level too. A dispatch or route whose
+# variant key has never been seen carries a (potential) compile on the
+# hot path: counted as counters["mid_traffic_compiles"], the number
+# the north-star rangespec pins at zero after warmup. A persistent-
+# cache hit still costs a trace + deserialize stall on the scheduler
+# path, so first-dispatch counts regardless of where the executable
+# comes from (the conservative reading).
+_SEEN_PROGRAMS: set = set()
+_SEEN_LOCK = threading.Lock()
+
+
+def note_program(key: tuple) -> bool:
+    """Record a program variant as compiled; True when it was new."""
+    with _SEEN_LOCK:
+        if key in _SEEN_PROGRAMS:
+            return False
+        _SEEN_PROGRAMS.add(key)
+        return True
+
+
+def reset_seen_programs() -> None:
+    """Forget every recorded variant — pairs with jax.clear_caches()
+    when a bench/test simulates a process restart."""
+    with _SEEN_LOCK:
+        _SEEN_PROGRAMS.clear()
+
+
+class WarmContext:
+    """Host/device zero-state shared by every bucket warm: built once
+    by ``BatchSolver.warm_setup`` (the only solver-state-mutating
+    step), after which each ``warm_router``/``warm_bucket``/
+    ``warm_scatter`` call is read-only w.r.t. the solver — safe on the
+    governor's worker thread while live cycles dispatch already-warmed
+    buckets (solver/COMPILE.md)."""
+
+    __slots__ = ("topo", "topo_dev", "usage", "cohort_usage",
+                 "arena_dev", "arena_cap")
+
+
 def _scramble_fetched(fetched: dict) -> dict:
     """The collect site's CORRUPT action: garbage decision arrays, as a
     bit-flipped fetch would produce. Deliberately invariant-violating
@@ -237,7 +280,8 @@ class BatchSolver:
                          "resident_cycles": 0, "establishes": 0,
                          "upload_bytes": 0, "fetch_bytes": 0,
                          "dispatch_timeouts": 0, "backend_probe_faults": 0,
-                         "validation_faults": 0, "supervised_timeouts": 0}
+                         "validation_faults": 0, "supervised_timeouts": 0,
+                         "mid_traffic_compiles": 0}
         self.log = vlog.logger("solver")
 
     def bind_cache(self, cache) -> None:
@@ -357,6 +401,188 @@ class BatchSolver:
         if len(self._sync_samples) > 16:
             self._sync_samples.pop(0)
 
+    # --- shape-bucket warmup (compile governor seam; solver/COMPILE.md) ---
+
+    def _topo_dims(self, topo) -> tuple:
+        """The shape signature compilation actually keys on: every
+        kernel argument's dims derive from these plus the per-call
+        batch/rank/delta buckets (the warmed-program registry keys)."""
+        return (topo.nominal.shape, topo.cohort_subtree.shape[0],
+                topo.cq_chain.shape[1])
+
+    def warm_setup(self, snapshot: Snapshot,
+                   expected_pending: Optional[int] = None):
+        """Build the zeroed shape context (WarmContext) every bucket
+        warm runs against: compilation keys on shapes + static args
+        only, so zero batches at the run's REAL topology warm the real
+        programs. This is the only warm step that mutates solver state
+        (the topology cache and, with ``expected_pending``, the encode
+        arena pre-size — growth mid-run would drop the device twin and
+        mint a fresh gather shape), so the governor calls it while
+        every bucket is still un-warmed and the route gate holds
+        cycles on the CPU path. None for mesh/native backends (their
+        dispatch paths cache separately)."""
+        if self.mesh is not None or self.backend != "jit":
+            return None
+        import jax.numpy as jnp
+        from kueue_tpu.solver.arena import ARENA_FIELDS
+        topo, topo_dev = self._topology(snapshot)
+        Q, F, R = topo.nominal.shape
+        # The BUCKETED cohort dim (what encode_state allocates) — the
+        # raw cohort count warmed wrong-shape programs that a real
+        # cycle never hit, a silent miss until the narrowed backend
+        # probes surfaced the shape error (ISSUE 3 satellite).
+        C = topo.cohort_subtree.shape[0]
+        ctx = WarmContext()
+        ctx.topo, ctx.topo_dev = topo, topo_dev
+        ctx.usage = jnp.zeros((Q, F, R), jnp.int64)
+        ctx.cohort_usage = jnp.zeros((max(C, 1), F, R), jnp.int64)
+        ctx.arena_dev = None
+        ctx.arena_cap = 0
+        if expected_pending is not None:
+            # Pre-size the arena so the run never pays mid-run growth,
+            # and warm the arena-resident kernel at that shape.
+            self._arena.reserve(expected_pending, topo)
+        elif self._queues is not None:
+            # Arena-capable solver warming pre-traffic (cap may still
+            # be 0): live dispatch engages the arena at its floor
+            # capacity on the first workload, so warm the arena-gather
+            # variant at that floor — the plain resident variant is
+            # never dispatched once queues are bound. (No-op when the
+            # arena already holds workloads.)
+            self._arena.reserve(1, topo)
+        if self._arena.cap and (expected_pending is not None
+                                or self._queues is not None):
+            # With the arena engaged, the plain resident kernel is
+            # never dispatched — the gather variant is the one to warm.
+            ctx.arena_dev = {
+                name: jnp.zeros(getattr(self._arena, name).shape,
+                                getattr(self._arena, name).dtype)
+                for name in ARENA_FIELDS}
+            ctx.arena_cap = self._arena.cap
+        return ctx
+
+    @staticmethod
+    def _warm_batch_arrays(topo, width: int, max_podsets: int):
+        from kueue_tpu.solver.encode import _bucket
+        _, F, R = topo.nominal.shape
+        W = _bucket(max(1, width))
+        P = max_podsets
+        return (W, np.zeros((W, P, R), np.int64), np.zeros((W, P), bool),
+                np.zeros(W, np.int32), np.zeros(W, np.int64),
+                np.zeros(W, np.float64), np.zeros((W, P, F), bool),
+                np.zeros(W, bool), np.zeros((W, P, R), np.int32))
+
+    def warm_router(self, ctx: WarmContext, width: int) -> int:
+        """Warm the local-CPU Phase A router (with and without the
+        flavor-resume variant) at one width bucket."""
+        topo = ctx.topo
+        Q, F, R = topo.nominal.shape
+        C = topo.cohort_subtree.shape[0]
+        (W, requests, podset_active, wl_cq, priority, timestamp,
+         eligible, solvable, start_rank) = self._warm_batch_arrays(
+            topo, width, self.max_podsets)
+        try:
+            from kueue_tpu.solver.encode import WorkloadBatch
+            b = WorkloadBatch(infos=[], n=0)
+            (b.requests, b.podset_active, b.wl_cq, b.priority,
+             b.timestamp, b.eligible, b.solvable, b.start_rank) = (
+                requests, podset_active, wl_cq, priority, timestamp,
+                eligible, solvable, start_rank)
+            state = encode.State(usage=np.zeros((Q, F, R), np.int64),
+                                 cohort_usage=np.zeros(
+                                     (max(C, 1), F, R), np.int64))
+            self._route(topo, state, b, None, count_compiles=False)
+            self._route(topo, state, b, start_rank, count_compiles=False)
+            return 2
+        except Exception as exc:  # noqa: BLE001 — classified below
+            self._note_backend_error("warm_route", exc)
+            return 0
+
+    def warm_bucket(self, ctx: WarmContext, width: int,
+                    max_ranks=(8, 32, 128, 512), deltas_buckets=(8,),
+                    fair_sharing: bool = False) -> int:
+        """Warm every single-chip solve variant for one batch-width
+        bucket: the fused sync kernel plus the resident kernel (the
+        arena-gather variant when the arena is engaged), with and
+        without a delta prologue and flavor-resume ranks, per
+        conflict-domain rank bucket. Registers each program in the
+        warmed-program registry so a later live dispatch of the same
+        variant is not counted as a mid-traffic compile. Read-only
+        w.r.t. solver state (see warm_setup)."""
+        topo, topo_dev = ctx.topo, ctx.topo_dev
+        usage, cohort_usage = ctx.usage, ctx.cohort_usage
+        dims = self._topo_dims(topo)
+        (W, requests, podset_active, wl_cq, priority, timestamp,
+         eligible, solvable, start_rank) = self._warm_batch_arrays(
+            topo, width, self.max_podsets)
+        P = self.max_podsets
+        args = (requests, podset_active, wl_cq, priority, timestamp,
+                eligible, solvable)
+        L = topo.cq_chain.shape[1]
+        warmed = 0
+        for max_rank in max_ranks:
+            for sr in (None, start_rank):
+                out = solve_cycle_fused(
+                    topo_dev, usage, cohort_usage, *args,
+                    num_podsets=P, max_rank=max_rank,
+                    fair_sharing=fair_sharing, start_rank=sr)
+                out["admitted"].block_until_ready()
+                note_program(("fused", dims, W, P, max_rank,
+                              fair_sharing, sr is not None, (), (), ()))
+                warmed += 1
+                for dlt in (None,) + tuple(deltas_buckets):
+                    deltas = None
+                    if dlt is not None:
+                        deltas = (np.full(dlt, -1, np.int32),
+                                  np.zeros(dlt, np.int32),
+                                  np.zeros(dlt, np.int32),
+                                  np.zeros(dlt, np.int64),
+                                  np.full((L, dlt, 3), -1, np.int32),
+                                  np.full((L, dlt), -1, np.int32))
+                    if ctx.arena_dev is None:
+                        out = solve_cycle_resident(
+                            topo_dev, usage, cohort_usage, deltas,
+                            *args, num_podsets=P, max_rank=max_rank,
+                            fair_sharing=fair_sharing, start_rank=sr)
+                        key = ("resident", dims, W, P, max_rank,
+                               fair_sharing, sr is not None, dlt,
+                               (), (), ())
+                    else:
+                        slots_w = np.full(W, -1, np.int32)
+                        out = solve_cycle_resident_arena(
+                            topo_dev, usage, cohort_usage, deltas,
+                            ctx.arena_dev, slots_w,
+                            num_podsets=P, max_rank=max_rank,
+                            fair_sharing=fair_sharing, start_rank=sr)
+                        key = ("arena", dims, ctx.arena_cap, W, P,
+                               max_rank, fair_sharing, sr is not None,
+                               dlt, (), (), ())
+                    out["admitted"].block_until_ready()
+                    note_program(key)
+                    warmed += 1
+        return warmed
+
+    def warm_scatter(self, ctx: WarmContext) -> int:
+        """Warm the changed-row arena scatter programs: one compile per
+        row bucket at this arena capacity (shape-independent of the
+        solve variants by design)."""
+        if ctx.arena_dev is None:
+            return 0
+        from kueue_tpu.solver.arena import _UPD_BUCKETS
+        from kueue_tpu.solver.kernel import scatter_arena_rows
+        warmed = 0
+        for D in _UPD_BUCKETS:
+            upd_slots = np.full(D, ctx.arena_cap, np.int32)
+            upd_rows = {name: np.zeros((D,) + a.shape[1:], a.dtype)
+                        for name, a in ctx.arena_dev.items()}
+            out = scatter_arena_rows(ctx.arena_dev, upd_slots, upd_rows)
+            out["solvable"].block_until_ready()
+            note_program(("scatter", ctx.arena_cap, self.max_podsets,
+                          self._topo_dims(ctx.topo), D))
+            warmed += 1
+        return warmed
+
     def warm(self, snapshot: Snapshot, widths=(2048,),
              max_ranks=(8, 32, 128, 512), deltas_buckets=(8,),
              fair_sharing: bool = False,
@@ -365,121 +591,21 @@ class BatchSolver:
         kernel variants for the shape buckets a run will hit, BEFORE the
         measured clock starts (VERDICT r4 weak #7 / ask #3: un-amortized
         jit compiles landed inside measured cycles and poisoned both the
-        router's early samples and the cycle p99).
-
-        Uses the run's REAL topology (exact shapes) with zeroed batches:
-        compilation keys on shapes + static args only. Warms, per batch
-        width and conflict-domain rank bucket: the fused sync kernel,
-        the resident kernel (the production path) with and without a
-        delta prologue, with and without flavor-resume ranks, plus the
-        local-CPU Phase A router. Returns the number of programs warmed.
-        Skipped for mesh/native backends (their dispatch paths cache
-        separately)."""
-        if self.mesh is not None or self.backend != "jit":
+        router's early samples and the cycle p99). One blocking call
+        over the whole ladder; the compile governor
+        (solver/warmgov.py) drives the same per-bucket helpers
+        incrementally, supervised and fault-contained. Returns the
+        number of programs warmed; 0 for mesh/native backends."""
+        ctx = self.warm_setup(snapshot, expected_pending)
+        if ctx is None:
             return 0
-        import jax.numpy as jnp
-        from kueue_tpu.solver.encode import _bucket
-        topo, topo_dev = self._topology(snapshot)
-        Q, F, R = topo.nominal.shape
-        # The BUCKETED cohort dim (what encode_state allocates) — the
-        # raw cohort count warmed wrong-shape programs that a real
-        # cycle never hit, a silent miss until the narrowed backend
-        # probes surfaced the shape error (ISSUE 3 satellite).
-        C = topo.cohort_subtree.shape[0]
-        usage = jnp.zeros((Q, F, R), jnp.int64)
-        cohort_usage = jnp.zeros((max(C, 1), F, R), jnp.int64)
         warmed = 0
-        arena_dev = None
-        if expected_pending is not None:
-            # Pre-size the arena so the run never pays mid-run growth
-            # (growth drops the device twin and mints a fresh gather
-            # shape), and warm the arena-resident kernel at that shape.
-            from kueue_tpu.solver.arena import ARENA_FIELDS
-            self._arena.reserve(expected_pending, topo)
-            if self._arena.cap:
-                arena_dev = {
-                    name: jnp.zeros(getattr(self._arena, name).shape,
-                                    getattr(self._arena, name).dtype)
-                    for name in ARENA_FIELDS}
         for width in widths:
-            W = _bucket(max(1, width))
-            P = self.max_podsets
-            requests = np.zeros((W, P, R), np.int64)
-            podset_active = np.zeros((W, P), bool)
-            wl_cq = np.zeros(W, np.int32)
-            priority = np.zeros(W, np.int64)
-            timestamp = np.zeros(W, np.float64)
-            eligible = np.zeros((W, P, F), bool)
-            solvable = np.zeros(W, bool)
-            start_rank = np.zeros((W, P, R), np.int32)
-            args = (requests, podset_active, wl_cq, priority, timestamp,
-                    eligible, solvable)
-            # router (local CPU backend) — one compile per width
-            try:
-                from kueue_tpu.solver.encode import WorkloadBatch
-                b = WorkloadBatch(infos=[], n=0)
-                (b.requests, b.podset_active, b.wl_cq, b.priority,
-                 b.timestamp, b.eligible, b.solvable, b.start_rank) = (
-                    requests, podset_active, wl_cq, priority, timestamp,
-                    eligible, solvable, start_rank)
-                state = encode.State(usage=np.zeros((Q, F, R), np.int64),
-                                     cohort_usage=np.zeros(
-                                         (max(C, 1), F, R), np.int64))
-                self._route(topo, state, b, None)
-                self._route(topo, state, b, start_rank)  # resume variant
-                warmed += 2
-            except Exception as exc:  # noqa: BLE001 — classified below
-                self._note_backend_error("warm_route", exc)
-            for max_rank in max_ranks:
-                for sr in (None, start_rank):
-                    out = solve_cycle_fused(
-                        topo_dev, usage, cohort_usage, *args,
-                        num_podsets=P, max_rank=max_rank,
-                        fair_sharing=fair_sharing, start_rank=sr)
-                    out["admitted"].block_until_ready()
-                    warmed += 1
-                    L = topo.cq_chain.shape[1]
-                    for dlt in (None,) + tuple(deltas_buckets):
-                        deltas = None
-                        if dlt is not None:
-                            deltas = (np.full(dlt, -1, np.int32),
-                                      np.zeros(dlt, np.int32),
-                                      np.zeros(dlt, np.int32),
-                                      np.zeros(dlt, np.int64),
-                                      np.full((L, dlt, 3), -1, np.int32),
-                                      np.full((L, dlt), -1, np.int32))
-                        if arena_dev is None:
-                            out = solve_cycle_resident(
-                                topo_dev, usage, cohort_usage, deltas,
-                                *args, num_podsets=P, max_rank=max_rank,
-                                fair_sharing=fair_sharing, start_rank=sr)
-                            out["admitted"].block_until_ready()
-                            warmed += 1
-                            continue
-                        # With the arena bound, the plain resident kernel
-                        # is never dispatched — warm the arena-gather
-                        # variant instead.
-                        slots_w = np.full(W, -1, np.int32)
-                        out = solve_cycle_resident_arena(
-                            topo_dev, usage, cohort_usage, deltas,
-                            arena_dev, slots_w,
-                            num_podsets=P, max_rank=max_rank,
-                            fair_sharing=fair_sharing, start_rank=sr)
-                        out["admitted"].block_until_ready()
-                        warmed += 1
-        if arena_dev is not None:
-            # The changed-row scatter program: one compile per row
-            # bucket at this arena capacity (shape-independent of the
-            # solve variants by design).
-            from kueue_tpu.solver.arena import _UPD_BUCKETS
-            from kueue_tpu.solver.kernel import scatter_arena_rows
-            for D in _UPD_BUCKETS:
-                upd_slots = np.full(D, self._arena.cap, np.int32)
-                upd_rows = {name: np.zeros((D,) + a.shape[1:], a.dtype)
-                            for name, a in arena_dev.items()}
-                out = scatter_arena_rows(arena_dev, upd_slots, upd_rows)
-                out["solvable"].block_until_ready()
-                warmed += 1
+            warmed += self.warm_router(ctx, width)
+            warmed += self.warm_bucket(ctx, width, max_ranks=max_ranks,
+                                       deltas_buckets=deltas_buckets,
+                                       fair_sharing=fair_sharing)
+        warmed += self.warm_scatter(ctx)
         return warmed
 
     # --- encoding with topology caching across cycles ---
@@ -709,12 +835,30 @@ class BatchSolver:
         # dirty-set was already cleared: force a full re-upload.
         self._arena.drop_device()
 
-    def _route(self, topo, state, batch, start_rank):
+    def _note_mid_traffic_compile(self, kind: str, width: int) -> None:
+        """A program variant never warmed (or dispatched) in this
+        process is about to execute on the hot path — a potential
+        compile stall inside a measured cycle. Counted for the perf
+        artifacts (RunResult.mid_traffic_compiles; the north-star
+        rangespec pins it at 0 — solver/COMPILE.md), logged, and
+        annotated onto the open cycle trace."""
+        self.counters["mid_traffic_compiles"] += 1
+        self.log.v(2, "solver.midTrafficCompile", kind=kind, width=width)
+        rec = self._recorder
+        if rec is not None:
+            rec.annotate("compile",
+                         f"unwarmed {kind} program at width {width} "
+                         f"compiled mid-traffic", program=kind, width=width)
+
+    def _route(self, topo, state, batch, start_rank,
+               count_compiles: bool = True):
         """Exact host-side replica of the device Phase A (same jitted
         program, local CPU backend): integer math, so the fit bits are
         identical to the device's. Returns [n] bool, or None when no
         local CPU backend exists (the scheduler then nominates
-        device-rejected entries after the sync instead)."""
+        device-rejected entries after the sync instead).
+        ``count_compiles=False`` suppresses the mid-traffic compile
+        accounting (warm paths register programs without counting)."""
         if self._cpu_device is None:
             try:
                 self._cpu_device = jax.devices("cpu")[0]
@@ -728,6 +872,11 @@ class BatchSolver:
             cached = (topo.token,
                       jax.device_put(_topo_np(topo), self._cpu_device))
             self._topo_cpu = cached
+        if note_program(("route", self._topo_dims(topo),
+                         batch.requests.shape[0], self.max_podsets,
+                         start_rank is not None)) and count_compiles:
+            self._note_mid_traffic_compile("route",
+                                           batch.requests.shape[0])
         with jax.default_device(self._cpu_device):
             out = solve_phase_a(cached[1], state.usage, state.cohort_usage,
                                 batch.requests, batch.podset_active,
@@ -876,6 +1025,27 @@ class BatchSolver:
         if fair_batch is not None:
             from kueue_tpu.solver import fairpreempt
             fargs = fairpreempt.fair_args(fair_batch)
+        if fargs is None:
+            # fs_strategies is a STATIC jit arg that only parameterizes
+            # the fair-preemption program: with no fair batch this cycle
+            # it is dead, but a non-empty tuple would still mint a
+            # distinct (computationally identical) executable — and the
+            # scheduler's sync path always passes the configured flags.
+            # Normalize so the warmed variants are reused.
+            fs_flags = ()
+
+        # Mid-traffic compile accounting (solver/COMPILE.md): the
+        # variant keys mirror the warm helpers' registry keys exactly,
+        # so a dispatch of a warmed bucket never counts and a dispatch
+        # of an unwarmed one always does.
+        dims = self._topo_dims(topo)
+        W = batch.requests.shape[0]
+        D = plan.deltas[0].shape[0] if plan.deltas is not None else None
+        pshapes = (tuple(np.asarray(a).shape for a in pargs)
+                   if pargs is not None else ())
+        fshapes = (tuple(np.asarray(a).shape for a in fargs)
+                   if fargs is not None else ())
+        sr_flag = start_rank is not None
 
         # Identity check: the plan must have been built on the CURRENT
         # ResidentState — after an invalidate + re-establish, a stale
@@ -937,10 +1107,14 @@ class BatchSolver:
                     # per-phase sums — it's already inside dispatch).
                     self._recorder.span("dispatch.scatter", t_sc,
                                         time.perf_counter() - t_sc)
-                W = batch.requests.shape[0]
                 slots_w = np.full(W, -1, np.int32)
                 slots_w[:batch.n] = plan.slots
                 arena_bytes = up_nbytes + slots_w.nbytes
+                if note_program(("arena", dims, self._arena.cap, W,
+                                 self.max_podsets, max_rank, fair_sharing,
+                                 sr_flag, D, pshapes, fshapes,
+                                 tuple(fs_flags))):
+                    self._note_mid_traffic_compile("arena", W)
                 result = solve_cycle_resident_arena(
                     topo_dev, usage_in, cohort_in, plan.deltas,
                     arena_dev, slots_w,
@@ -949,6 +1123,10 @@ class BatchSolver:
                     preempt_args=pargs, fair_preempt_args=fargs,
                     fs_strategies=fs_flags)
             else:
+                if note_program(("resident", dims, W, self.max_podsets,
+                                 max_rank, fair_sharing, sr_flag, D,
+                                 pshapes, fshapes, tuple(fs_flags))):
+                    self._note_mid_traffic_compile("resident", W)
                 result = solve_cycle_resident(
                     topo_dev, usage_in, cohort_in, plan.deltas,
                     batch.requests, batch.podset_active, batch.wl_cq,
@@ -965,6 +1143,10 @@ class BatchSolver:
         else:
             plan.resident = False
             if pargs is None and fargs is None:
+                if note_program(("fused", dims, W, self.max_podsets,
+                                 max_rank, fair_sharing, sr_flag,
+                                 (), (), ())):
+                    self._note_mid_traffic_compile("fused", W)
                 result = solve_cycle_fused(
                     topo_dev, state.usage, state.cohort_usage,
                     batch.requests, batch.podset_active, batch.wl_cq,
@@ -973,6 +1155,10 @@ class BatchSolver:
                     max_rank=max_rank, fair_sharing=fair_sharing,
                     start_rank=start_rank)
             else:
+                if note_program(("preempt", dims, W, self.max_podsets,
+                                 max_rank, fair_sharing, sr_flag,
+                                 pshapes, fshapes, tuple(fs_flags))):
+                    self._note_mid_traffic_compile("preempt", W)
                 result = solve_cycle_with_preempt(
                     topo_dev, state.usage, state.cohort_usage,
                     batch.requests, batch.podset_active, batch.wl_cq,

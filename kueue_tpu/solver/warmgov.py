@@ -1,0 +1,621 @@
+"""Compile governor: compiles as a managed background event.
+
+SURVEY.md §7 names dynamic shapes / recompilation storms as a hard part
+of the TPU reformulation, and ROADMAP item 4 asks for the watchdog's
+compile-absorbing cold clamp to become unnecessary in steady state.
+Before this module, only the bench harness warmed shape buckets (an
+inline ladder in perf/runner.py, best-effort, failures swallowed); a
+production ``KueueManager`` paid every compile on the hot path, where it
+was either absorbed by the supervised-dispatch cold clamp or — worse —
+abandoned as a fault, poisoning the router and breaker with what is
+really a legitimate compile.
+
+The ``CompileGovernor`` owns the geometric shape-bucket ladder
+(``width_ladder`` × ``rank_ladder``, refactored out of perf/runner.py
+and ``BatchSolver.warm``) and walks it largest-impact-first on a
+supervised background thread:
+
+- Each bucket warm runs on a ``SupervisedWorker`` under a per-bucket
+  deadline: a wedged remote compile abandons THAT bucket (retried at
+  the ladder tail, then skipped) and the ladder continues — warmup can
+  never wedge startup.
+- A ``compile_warmup`` fault-injection site makes warmup chaos-testable
+  like every other device path (resilience/faultinject.py).
+- Executables load from the persistent XLA compilation cache, stamped
+  into a per-topology layout (``<cacheDir>/topo-<fingerprint>``) so a
+  topology change can never replay stale executables and a process
+  restart reuses compiles — preserving the "restart is cheap" property
+  (SURVEY.md §5). Per-bucket provenance (fresh / cache-hit / jit-cache)
+  is read from jax's compilation-cache monitoring events.
+- The scheduler consults ``route_ready()`` before committing a cycle to
+  the device route: an un-warmed bucket routes the cycle to the CPU
+  path (full reference semantics, no compile risk) under the
+  ``cpu-warmup`` route name and enqueues a background warm via
+  ``request()`` — so in steady state zero measured cycles carry a
+  compile and the watchdog's cold clamp is a true last resort.
+- Compile begin/end/fault events flow into the flight recorder (they
+  annotate whatever cycle trace is concurrently open — showing exactly
+  which cycles overlapped a background compile), the metrics registry
+  (``compile_events_total{bucket,source}``, ``warmup_state``,
+  ``warmup_faults_total``), ``/debug/warmup``, and the SIGUSR2 dumper.
+
+See solver/COMPILE.md for the ladder, cache key, governor states, and
+the route-gating contract.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Optional
+
+from kueue_tpu.resilience import faultinject
+from kueue_tpu.resilience.supervisor import SupervisedWorker
+from kueue_tpu.resilience.watchdog import DispatchTimeout
+from kueue_tpu.solver.encode import _bucket
+from kueue_tpu.utils import vlog
+
+# governor states (the warmup_state gauge encoding)
+GOV_IDLE = "idle"        # never engaged — the route gate is inert
+GOV_WARMING = "warming"  # ladder walk in progress
+GOV_WARM = "warm"        # every bucket warm
+GOV_PARTIAL = "partial"  # walk finished with skipped/failed buckets
+WARMUP_STATE_CODES = {GOV_IDLE: 0, GOV_WARMING: 1, GOV_WARM: 2,
+                      GOV_PARTIAL: 3}
+
+# per-bucket states
+B_PENDING = "pending"
+B_WARMING = "warming"
+B_WARM = "warm"
+B_FAILED = "failed"    # faulted, retry scheduled at the ladder tail
+B_SKIPPED = "skipped"  # gave up after max_attempts — operator surface
+
+DEFAULT_BUCKET_DEADLINE_S = 120.0
+DEFAULT_MAX_WIDTH = 2048
+DEFAULT_MAX_ATTEMPTS = 2
+
+
+# --- ladder derivation (the one copy; perf/runner.py delegates here) ---
+
+def width_ladder(num_cqs: int, max_width: int = DEFAULT_MAX_WIDTH) -> list:
+    """Geometric batch-width bucket ladder, largest-impact-first: the
+    full-backlog bucket plus every drain bucket below it (encode
+    buckets by powers of 4 from 8). ``heads()`` pops at most one head
+    per CQ, so the full bucket is min(max_width, num_cqs)."""
+    full = max(1, min(max_width, num_cqs))
+    widths, b = [], 8
+    while True:
+        widths.append(b)
+        if b >= full:
+            break
+        b *= 4
+    widths.reverse()
+    return widths
+
+
+def rank_ladder(cohort_members: dict) -> tuple:
+    """Conflict-domain rank buckets from the real topology: ``heads()``
+    pops one head per CQ, so a batch's largest conflict domain is the
+    largest cohort's CQ count, bucketed the way kernel.max_rank_bound
+    buckets (powers of 4 from 8). The whole ladder from 8 through one
+    bucket past the bound is warmed — drain-phase cycles can observe
+    any smaller domain, and a cohort-less CQ tail can nudge the bound
+    up."""
+    bound = 8
+    while bound < max(cohort_members.values() or [1]):
+        bound *= 4
+    ranks, r = [], 8
+    while r <= bound * 4:
+        ranks.append(r)
+        r *= 4
+    return tuple(ranks)
+
+
+def snapshot_cohort_members(snapshot) -> dict:
+    """cohort name (or CQ name when cohort-less) -> member CQ count."""
+    members: dict = {}
+    for name, cq in snapshot.cluster_queues.items():
+        key = cq.cohort.name if cq.cohort is not None else name
+        members[key] = members.get(key, 0) + 1
+    return members
+
+
+def topology_fingerprint(topo, max_podsets: int) -> str:
+    """Stable cache-layout stamp: everything the compiled executables'
+    shapes derive from (topology tensor dims + podset width) plus the
+    toolchain identity (jax version, backend platform). The
+    process-local ``topo.token`` is deliberately NOT included — it
+    changes on every rebuild, and the whole point of the stamp is
+    cross-process reuse that still refuses stale shapes."""
+    import hashlib
+
+    import jax
+    dims = (topo.nominal.shape, topo.cohort_subtree.shape,
+            topo.cq_chain.shape, max_podsets,
+            jax.__version__, jax.default_backend())
+    return hashlib.blake2b(repr(dims).encode(), digest_size=8).hexdigest()
+
+
+# --- persistent-cache provenance (jax compilation-cache monitoring) ---
+#
+# jax emits /jax/compilation_cache/cache_{hits,misses} monitoring events
+# whenever the persistent cache serves or misses a compile. One
+# process-global listener feeds the counters; per-bucket provenance is
+# the delta across that bucket's warm. Without a registered listener
+# (old jax) — or with no persistent cache configured — no events fire
+# and warms classify as "jit-cache".
+
+_EVENTS = {"hits": 0, "misses": 0}
+_events_lock = threading.Lock()
+_events_registered = False
+
+
+def _note_jax_event(name: str, **kwargs) -> None:
+    if name.endswith("/cache_hits"):
+        _EVENTS["hits"] += 1
+    elif name.endswith("/cache_misses"):
+        _EVENTS["misses"] += 1
+
+
+def ensure_event_listener() -> bool:
+    """Register the compilation-cache event listener (idempotent).
+    False when this jax has no monitoring API."""
+    global _events_registered
+    with _events_lock:
+        if _events_registered:
+            return True
+        try:
+            from jax._src import monitoring
+            monitoring.register_event_listener(_note_jax_event)
+        except Exception:  # noqa: BLE001 — older jax without monitoring
+            return False
+        _events_registered = True
+        return True
+
+
+def compile_event_counts() -> tuple:
+    """(persistent-cache hits, misses) observed so far in this process."""
+    return (_EVENTS["hits"], _EVENTS["misses"])
+
+
+class BucketState:
+    """One ladder step's lifecycle + provenance (the /debug/warmup and
+    warm_probe row)."""
+
+    __slots__ = ("width", "ranks", "scatter", "state", "source",
+                 "attempts", "programs", "compile_s", "error")
+
+    def __init__(self, width: int, ranks: tuple, scatter: bool = False):
+        self.width = width
+        self.ranks = tuple(ranks)
+        self.scatter = scatter      # this step also warms the arena scatter
+        self.state = B_PENDING
+        self.source = None          # fresh | cache-hit | jit-cache
+        self.attempts = 0
+        self.programs = 0
+        self.compile_s = 0.0
+        self.error = ""
+
+    def to_dict(self) -> dict:
+        return {"width": self.width, "ranks": list(self.ranks),
+                "state": self.state, "source": self.source,
+                "attempts": self.attempts, "programs": self.programs,
+                "compile_ms": round(self.compile_s * 1e3, 1),
+                "error": self.error}
+
+
+class CompileGovernor:
+    """Supervised shape-bucket warmup + the scheduler's warm-state gate.
+
+    Constructed idle (state ``idle``; ``route_ready`` always True so an
+    attached-but-unused governor changes nothing). ``start()`` launches
+    the background walk; ``run_sync()`` walks the ladder on the calling
+    thread (the perf harness's pre-clock warmup). Both share the same
+    fault-contained per-bucket machinery.
+    """
+
+    def __init__(self, solver, cache, *, metrics=None, recorder=None,
+                 bucket_deadline_s: float = DEFAULT_BUCKET_DEADLINE_S,
+                 cache_dir: str = "", max_width: int = DEFAULT_MAX_WIDTH,
+                 deltas_buckets: tuple = (8,),
+                 max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+                 expected_pending: Optional[int] = None,
+                 fair_sharing: bool = False):
+        self.solver = solver
+        self.cache = cache
+        self.metrics = metrics
+        self.recorder = recorder
+        self.bucket_deadline_s = bucket_deadline_s
+        self.cache_dir = cache_dir
+        self.max_width = max_width
+        self.deltas_buckets = tuple(deltas_buckets)
+        self.max_attempts = max_attempts
+        self.expected_pending = expected_pending
+        # fair_sharing is a STATIC kernel arg: a deployment with fair
+        # sharing enabled dispatches genuinely different programs, so
+        # the ladder must warm with the same flag (manager wires it
+        # from cfg.fair_sharing.enable).
+        self.fair_sharing = fair_sharing
+        self.state = GOV_IDLE
+        self.buckets: dict = {}       # width -> BucketState (ladder order)
+        self.warmup_faults = 0        # faulted bucket attempts (total)
+        self.programs_warmed = 0
+        self.unwarm_routed = 0        # cycles the gate sent to cpu-warmup
+        self.cache_subdir = ""        # the stamped per-topology dir
+        self._warm_widths: frozenset = frozenset()  # atomic hot-path read
+        self._ranks: tuple = (8, 32)  # ladder ranks (for late requests)
+        self._worker = SupervisedWorker("compile-warmup")
+        self._lock = threading.Lock()
+        self._requests: collections.deque = collections.deque()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._walked = False
+        self._vacuous = False         # mesh/native: nothing to warm
+        self._ctx = None              # solver WarmContext, once built
+        self.log = vlog.logger("warmgov")
+
+    # --- hot path (scheduler thread) ---
+
+    def route_ready(self, heads: int) -> bool:
+        """Gate consulted by the scheduler before committing a cycle to
+        the device route: True when the batch-width bucket this head
+        count encodes into has warm programs, or the governor was never
+        engaged (an idle governor must not change routing), or the
+        backend caches its dispatch paths elsewhere (mesh/native:
+        vacuously warm, the gate must never divert)."""
+        if self.state == GOV_IDLE or self._vacuous:
+            return True
+        w = _bucket(max(1, min(heads, self.max_width)))
+        return w in self._warm_widths
+
+    def request(self, heads: int) -> None:
+        """The scheduler hit an un-warmed bucket mid-traffic (the cycle
+        itself routed to the CPU path): enqueue a background warm for
+        it. Idempotent per bucket; wakes — or lazily starts — the
+        background worker. A bucket already SKIPPED (gave up after
+        max_attempts) is not re-queued: that is an operator decision
+        (tools/warm_probe.py)."""
+        if self._vacuous:
+            return
+        self.unwarm_routed += 1
+        w = _bucket(max(1, min(heads, self.max_width)))
+        with self._lock:
+            if w in self._warm_widths:
+                return
+            b = self.buckets.get(w)
+            if b is not None and b.state in (B_WARMING, B_SKIPPED):
+                return
+            if b is None:
+                b = BucketState(w, self._ranks)
+                self.buckets[w] = b
+            if w in self._requests:
+                return
+            self._requests.append(w)
+        self._wake.set()
+        self.start()  # no-op while the background thread is alive
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        """Launch (idempotently) the supervised background warmup
+        thread: waits for a non-empty topology, walks the ladder
+        largest-first, then parks serving ``request()`` retries.
+
+        The route gate engages IMMEDIATELY (state leaves ``idle`` here,
+        not when the walk begins): between start() and the walk seeing
+        a topology there must be no window where an un-warmed cycle
+        slips onto the device route and pays the compile the governor
+        exists to absorb."""
+        with self._lock:
+            if self.state == GOV_IDLE:
+                self.state = GOV_WARMING
+            if self._thread is not None and self._thread.is_alive():
+                self._set_gauge()
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="compile-governor")
+            self._thread.start()
+        self._set_gauge()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        self._worker.stop()
+
+    def run_sync(self, expected_pending: Optional[int] = None) -> int:
+        """Walk the whole ladder on the calling thread (the perf/bench
+        harnesses' pre-clock warmup). Blocking, but each bucket still
+        runs under the supervised per-bucket deadline, so a wedged
+        remote compile abandons that bucket instead of hanging the
+        harness, and a walk-level failure degrades to the route gate
+        (logged + counted, like the background walk) instead of
+        crashing the harness. Returns the number of programs warmed."""
+        self._walked = True
+        if expected_pending is not None:
+            self.expected_pending = expected_pending
+        return self._walk_contained()
+
+    # --- the ladder walk ---
+
+    def _has_topology(self) -> bool:
+        hm = getattr(self.cache, "hm", None)
+        return bool(hm is not None and hm.cluster_queues)
+
+    def _run(self) -> None:
+        while not self._stop.is_set() and not self._has_topology():
+            self._stop.wait(0.05)
+        if self._stop.is_set():
+            return
+        if not self._walked:
+            self._walked = True
+            # The topology gate above releases on the FIRST reconciled
+            # CQ, which may be mid-startup (more CQs still landing):
+            # re-walk until the structural generation token is stable
+            # across a walk, so the ladder, cache fingerprint, and the
+            # frozen WarmContext are never built from a partial
+            # topology. Structural tokens only move on CQ/flavor
+            # changes, so steady state walks exactly once.
+            tok = self._gen_token()
+            self._walk_contained()
+            while not self._stop.is_set():
+                new_tok = self._gen_token()
+                if new_tok == tok:
+                    break
+                tok = new_tok
+                self._reset_for_rewalk()
+                self._walk_contained()
+        # Serve mid-traffic requests (un-warmed buckets the route gate
+        # diverted) until stopped.
+        while not self._stop.is_set():
+            self._wake.wait()
+            self._wake.clear()
+            while not self._stop.is_set():
+                with self._lock:
+                    w = (self._requests.popleft()
+                         if self._requests else None)
+                if w is None:
+                    break
+                b = self.buckets.get(w)
+                if b is None or b.state in (B_WARM, B_SKIPPED):
+                    continue
+                if self._ctx is None:
+                    # Walk never built a context (mesh/native backend):
+                    # nothing to warm.
+                    continue
+                if not self._warm_one(b) and b.state == B_FAILED:
+                    with self._lock:
+                        self._requests.append(w)
+                self._finish_state()
+
+    def _gen_token(self):
+        fn = getattr(self.cache, "generation_token", None)
+        try:
+            return fn() if fn is not None else None
+        except Exception:  # noqa: BLE001 — stub caches in tests
+            return None
+
+    def _reset_for_rewalk(self) -> None:
+        """The topology changed structurally since the last walk: every
+        warmed program was keyed on the OLD dims, so the buckets' warm
+        state is meaningless — hold the gate and walk the new ladder.
+        (Old-dims compiles stay in the jit/persistent caches; only the
+        governor's bookkeeping resets.)"""
+        with self._lock:
+            self.buckets.clear()
+            self._requests.clear()
+            self._warm_widths = frozenset()
+            self._vacuous = False
+            self._ctx = None
+            self.state = GOV_WARMING
+        self._set_gauge()
+
+    def _walk_contained(self) -> int:
+        """_walk with walk-level containment: a failure outside the
+        per-bucket machinery (snapshot/encode error in warm_setup)
+        degrades to the CPU-route gate — logged via vlog and counted in
+        warmup_faults_total, never raised to the caller (the old bench
+        warmup swallowed these silently; a production startup must not
+        die on them)."""
+        try:
+            return self._walk()
+        except Exception as exc:  # noqa: BLE001 — warmup must not crash
+            self.warmup_faults += 1
+            if self.metrics is not None:
+                self.metrics.warmup_fault()
+            self.log.error("warmgov.walkFault", error=repr(exc)[:200])
+            with self._lock:
+                self.state = GOV_PARTIAL
+            self._set_gauge()
+            return 0
+
+    def _walk(self) -> int:
+        snapshot = self.cache.snapshot()
+        ctx = self.solver.warm_setup(snapshot, self.expected_pending)
+        if ctx is None:
+            # mesh/native backends cache their dispatch paths
+            # separately: vacuously warm, the gate never diverts
+            # (route_ready short-circuits on the flag — _warm_widths
+            # stays empty, so without it every cycle would divert).
+            with self._lock:
+                self._vacuous = True
+                self.state = GOV_WARM
+            self._set_gauge()
+            return 0
+        self._ctx = ctx
+        self._stamp_cache_dir(ctx.topo)
+        widths = width_ladder(len(snapshot.cluster_queues), self.max_width)
+        ranks = rank_ladder(snapshot_cohort_members(snapshot))
+        with self._lock:
+            self._ranks = ranks
+            self.state = GOV_WARMING
+            for i, w in enumerate(widths):
+                b = self.buckets.get(w)
+                if b is None:
+                    # the scatter programs ride on the first (largest)
+                    # step — they are per-arena-capacity, not per-width
+                    self.buckets[w] = BucketState(w, ranks,
+                                                  scatter=(i == 0))
+                elif b.ranks != tuple(ranks) or (i == 0 and not b.scatter):
+                    # A request() between start() and here created this
+                    # bucket with the placeholder ranks (and no scatter
+                    # claim): refresh it against the real ladder, and
+                    # re-warm if it already ran — a bucket warmed at the
+                    # wrong ranks is not warm (already-compiled subsets
+                    # replay from the jit cache, so the re-warm is
+                    # cheap).
+                    b.ranks = tuple(ranks)
+                    b.scatter = b.scatter or (i == 0)
+                    if b.state == B_WARM:
+                        b.state = B_PENDING
+            self._warm_widths = frozenset(
+                w for w, st in self.buckets.items() if st.state == B_WARM)
+        self._set_gauge()
+        self.log.v(2, "warmgov.walkStart", widths=widths, ranks=ranks,
+                   deadline_s=self.bucket_deadline_s,
+                   cache_dir=self.cache_subdir or self.cache_dir)
+        queue = collections.deque(
+            self.buckets[w] for w in widths
+            if self.buckets[w].state != B_WARM)
+        while queue and not self._stop.is_set():
+            b = queue.popleft()
+            if not self._warm_one(b) and b.state == B_FAILED:
+                queue.append(b)  # retry at the ladder tail, then skip
+        self._finish_state()
+        return self.programs_warmed
+
+    def _warm_one(self, b: BucketState) -> bool:
+        b.state = B_WARMING
+        b.attempts += 1
+        hits0, misses0 = compile_event_counts()
+        t0 = time.perf_counter()
+        self._annotate("compile-begin",
+                       f"warmup bucket width={b.width} "
+                       f"(attempt {b.attempts})",
+                       width=b.width, attempt=b.attempts)
+        try:
+            n = self._worker.run(self._warm_body, b,
+                                 deadline_s=self.bucket_deadline_s)
+        except DispatchTimeout as exc:
+            self._fault(b, exc, timeout=True)
+            return False
+        except Exception as exc:  # noqa: BLE001 — injected or real
+            self._fault(b, exc, timeout=False)
+            return False
+        b.compile_s = time.perf_counter() - t0
+        hits, misses = compile_event_counts()
+        if misses > misses0:
+            b.source = "fresh"       # at least one real compile
+        elif hits > hits0:
+            b.source = "cache-hit"   # served from the persistent cache
+        else:
+            b.source = "jit-cache"   # in-memory jit cache (or no cache)
+        b.programs = n
+        b.error = ""
+        b.state = B_WARM
+        self.programs_warmed += n
+        with self._lock:
+            self._warm_widths = frozenset(
+                w for w, st in self.buckets.items() if st.state == B_WARM)
+        if self.metrics is not None:
+            self.metrics.compile_event(str(b.width), b.source, n)
+        self._annotate("compile-end",
+                       f"bucket width={b.width} warm: {n} program(s) "
+                       f"{b.source} in {b.compile_s * 1e3:.0f}ms",
+                       width=b.width, programs=n, source=b.source,
+                       ms=round(b.compile_s * 1e3, 1))
+        self.log.v(2, "warmgov.bucketWarm", width=b.width, programs=n,
+                   source=b.source, ms=round(b.compile_s * 1e3, 1))
+        return True
+
+    def _warm_body(self, b: BucketState) -> int:
+        # Injection site: a DELAY here is a wedged remote compile — the
+        # per-bucket deadline abandons the bucket and the ladder
+        # continues; a RAISE is a backend error mid-warm. Runs on the
+        # supervised worker thread, never the scheduler's.
+        faultinject.site(faultinject.SITE_WARMUP)
+        ctx = self._ctx
+        n = self.solver.warm_router(ctx, b.width)
+        n += self.solver.warm_bucket(ctx, b.width, max_ranks=b.ranks,
+                                     deltas_buckets=self.deltas_buckets,
+                                     fair_sharing=self.fair_sharing)
+        if b.scatter:
+            n += self.solver.warm_scatter(ctx)
+        return n
+
+    def _fault(self, b: BucketState, exc: BaseException,
+               timeout: bool) -> None:
+        self.warmup_faults += 1
+        b.error = repr(exc)[:200]
+        b.state = B_FAILED if b.attempts < self.max_attempts else B_SKIPPED
+        if self.metrics is not None:
+            self.metrics.warmup_fault()
+        self._annotate("compile-fault",
+                       f"warmup bucket width={b.width} "
+                       f"{'deadline' if timeout else 'fault'}: "
+                       f"{exc!r}"[:200],
+                       width=b.width, timeout=timeout, state=b.state)
+        self.log.error("warmgov.bucketFault", width=b.width,
+                       error=repr(exc)[:200], timeout=timeout,
+                       attempts=b.attempts, state=b.state)
+
+    def _finish_state(self) -> None:
+        with self._lock:
+            states = {b.state for b in self.buckets.values()}
+            self.state = GOV_WARM if states <= {B_WARM} else GOV_PARTIAL
+        self._set_gauge()
+
+    def _stamp_cache_dir(self, topo) -> None:
+        """Point the persistent compilation cache at the per-topology
+        layout ``<cacheDir>/topo-<fingerprint>`` (solver.compileCacheDir
+        knob): a topology change lands in a different directory, so a
+        restart can never replay executables compiled for other shapes.
+        Persists EVERY executable (min compile time 0): over a remote
+        tunnel even a sub-second compile is a hot-path stall worth a
+        disk read on restart."""
+        ensure_event_listener()
+        if not self.cache_dir:
+            return
+        from kueue_tpu.utils.runtime import enable_compilation_cache
+        fp = topology_fingerprint(topo, self.solver.max_podsets)
+        self.cache_subdir = os.path.join(self.cache_dir, f"topo-{fp}")
+        enable_compilation_cache(self.cache_subdir,
+                                 min_compile_time_secs=0.0)
+
+    # --- surface (metrics / recorder / debug endpoints / dumper) ---
+
+    def _set_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_warmup_state(self.state)
+
+    def _annotate(self, kind: str, message: str, **fields) -> None:
+        # Attaches to whatever cycle trace is concurrently open (the
+        # governor runs off-thread): the trace shows which cycles
+        # overlapped a background compile. No open trace = dropped.
+        if self.recorder is not None:
+            self.recorder.annotate(kind, message, **fields)
+
+    def status(self) -> dict:
+        """The /debug/warmup + SIGUSR2 + warm_probe producer."""
+        with self._lock:
+            buckets = [b.to_dict() for b in self.buckets.values()]
+            warm = sorted(self._warm_widths)
+        return {
+            "state": self.state,
+            "buckets": buckets,
+            "warm_widths": warm,
+            "programs_warmed": self.programs_warmed,
+            "warmup_faults": self.warmup_faults,
+            "unwarm_routed_cycles": self.unwarm_routed,
+            "cache_dir": self.cache_dir,
+            "cache_subdir": self.cache_subdir,
+            "bucket_deadline_s": self.bucket_deadline_s,
+            "deltas_buckets": list(self.deltas_buckets),
+            "worker": self._worker.status(),
+        }

@@ -32,22 +32,38 @@ def tune_gc(thresholds: tuple = SCHEDULER_GC_THRESHOLDS) -> tuple:
     return prev
 
 
-def enable_compilation_cache(cache_dir: str = None) -> None:
+def enable_compilation_cache(cache_dir: str = None,
+                             min_compile_time_secs: float = 0.5) -> None:
     """Persistent XLA compilation cache: over a remote-compile TPU tunnel
     a fresh kernel variant costs seconds, which lands in first-cycle /
     first-run latency (the north-star run's p99 was one compile per shape
     bucket). Caching serialized executables on disk amortizes that across
     process runs — the bench/perf harnesses and the manager all call this
-    before touching jax. Safe on any backend; no-op if jax is too old."""
+    before touching jax. Safe on any backend; no-op if jax is too old.
+
+    The compile governor (solver/warmgov.py) re-points the cache at a
+    per-topology subdirectory and passes ``min_compile_time_secs=0`` so
+    EVERY warmed executable persists — a sub-second compile is still a
+    hot-path stall worth a disk read on restart."""
     import os
     import jax
     if cache_dir is None:
         cache_dir = os.path.join(os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
     try:
+        changed = jax.config.jax_compilation_cache_dir != cache_dir
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_time_secs)
+        if changed:
+            # jax latches the cache instance at the FIRST compile after
+            # process start; a config update alone is silently ignored
+            # once anything has compiled (the governor re-points the
+            # cache mid-process, after warm_setup's zero-batch fills
+            # already compiled). Reset so the new directory takes.
+            from jax._src import compilation_cache
+            compilation_cache.reset_cache()
     except Exception:  # noqa: BLE001 — older jax without the knobs
         pass
 

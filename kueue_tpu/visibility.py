@@ -118,6 +118,8 @@ class VisibilityServer:
     GET /debug/breaker     circuit-breaker state + next-probe backoff
     GET /debug/degrade     degradation-ladder state + shed bookkeeping
     GET /debug/router      adaptive-router regime samples/medians
+    GET /debug/pipeline    speculative-pipeline coverage + abort reasons
+    GET /debug/warmup      compile-governor state + per-bucket provenance
     GET /debug/arena       encode-arena slot occupancy + churn
 
     Unknown paths are 404; malformed query parameters are 400.

@@ -19,7 +19,8 @@ import time
 def run_mode(label, scale, solver, config="default", backend=None):
     from kueue_tpu.perf import (
         Runner, check, default_generator_config, default_rangespec, generate,
-        north_star_generator_config, refuse_cross_backend)
+        north_star_generator_config, north_star_rangespec,
+        refuse_cross_backend)
     if config == "north-star":
         load = generate(north_star_generator_config(), scale=scale,
                         num_flavors=32)
@@ -28,8 +29,11 @@ def run_mode(label, scale, solver, config="default", backend=None):
     t0 = time.monotonic()
     result = Runner(load, solver=solver).run()
     # the rangespec's queueing-dynamics bounds are calibrated for the
-    # default 15k scenario only
-    spec = default_rangespec() if config == "default" else None
+    # default 15k scenario; the north-star spec carries the
+    # backend-independent compile-storm bound (zero mid-traffic
+    # compiles after the governor's warmup — solver/COMPILE.md)
+    spec = (default_rangespec() if config == "default"
+            else north_star_rangespec())
     # Bench-env honesty (ROADMAP bench-env note): a rangespec that
     # declares its calibration backend refuses to judge a run from a
     # different one — rangespec_ok becomes None (not judged), never a
@@ -95,6 +99,11 @@ def run_mode(label, scale, solver, config="default", backend=None):
                          for k, v in result.phase_p50_ms.items()},
         "phase_p99_ms": {k: round(v, 3)
                          for k, v in result.phase_p99_ms.items()},
+        # compile-storm accounting (solver/COMPILE.md): program variants
+        # that first executed inside a measured cycle (the north-star
+        # rangespec pins this at 0), plus the governor's warmup summary
+        "mid_traffic_compiles": result.mid_traffic_compiles,
+        "warmup": result.warmup,
     }
     print(json.dumps(out), file=sys.stderr, flush=True)
     return out
@@ -118,7 +127,9 @@ def main():
         scenario = ("north_star_generator_config (250 cohorts x 8 CQs = "
                     "2,000 CQs x 32 flavors, 50,000 workloads at scale=1; "
                     "BASELINE.json config #5)")
-        rangespec = "none (no published reference bounds at this scale)"
+        rangespec = ("compile-storm bound only (zero mid-traffic compiles "
+                     "after warmup; no published reference "
+                     "queueing-dynamics bounds at this scale)")
     else:
         scenario = ("reference default_generator_config "
                     "(5 cohorts x 6 CQs, 15k workloads at scale=1)")

@@ -1,0 +1,476 @@
+"""Compile governor (kueue_tpu/solver/warmgov.py + solver/COMPILE.md):
+ladder derivation, the scheduler's cpu-warmup route gate, warmup chaos
+(a wedged/erroring compile must never wedge startup or trip the
+breaker), restart reuse through the persistent compilation cache, and
+the operator surface (/debug/warmup, dumper section, manager wiring).
+"""
+
+import os
+
+import pytest
+
+from kueue_tpu.metrics import Registry
+from kueue_tpu.resilience import faultinject
+from kueue_tpu.resilience.breaker import CLOSED
+from kueue_tpu.resilience.faultinject import (
+    DELAY, RAISE, SITE_WARMUP, FaultInjector)
+from kueue_tpu.solver import warmgov
+from kueue_tpu.solver.warmgov import (
+    B_SKIPPED, B_WARM, GOV_IDLE, GOV_PARTIAL, GOV_WARM, GOV_WARMING,
+    CompileGovernor, rank_ladder, snapshot_cohort_members, width_ladder)
+from tests.test_scheduler import Env
+from tests.wrappers import ClusterQueueWrapper, WorkloadWrapper, flavor_quotas
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    faultinject.uninstall()
+
+
+def simple_env(num_cqs=1, cohort=None):
+    env = Env()
+    env.add_flavor("default")
+    for i in range(num_cqs):
+        cq = ClusterQueueWrapper(f"cq{i}") \
+            .resource_group(flavor_quotas("default", cpu="100"))
+        if cohort is not None:
+            cq = cq.cohort(cohort)
+        env.add_cq(cq.obj(), f"lq{i}")
+    return env
+
+
+class StubWarmSolver:
+    """Warm-capable solver stub: the governor's control flow (ladder,
+    supervision, fault containment, provenance plumbing) without paying
+    real compiles. ``programs_per_call`` is what each warm helper
+    reports."""
+
+    max_podsets = 4
+
+    def __init__(self):
+        self.warm_calls = []
+
+    def warm_setup(self, snapshot, expected_pending=None):
+        ctx = type("Ctx", (), {})()
+        ctx.topo = None
+        return ctx
+
+    def warm_router(self, ctx, width):
+        self.warm_calls.append(("router", width))
+        return 1
+
+    def warm_bucket(self, ctx, width, max_ranks=(8, 32),
+                    deltas_buckets=(8,), fair_sharing=False):
+        self.warm_calls.append(("bucket", width))
+        return 2
+
+    def warm_scatter(self, ctx):
+        self.warm_calls.append(("scatter", None))
+        return 1
+
+
+class TestLadderDerivation:
+    def test_width_ladder_is_geometric_largest_first(self):
+        assert width_ladder(1) == [8]
+        assert width_ladder(8) == [8]
+        assert width_ladder(9) == [32, 8]
+        assert width_ladder(2048) == [2048, 512, 128, 32, 8]
+        # max_width caps the full-backlog bucket
+        assert width_ladder(100_000, max_width=512) == [512, 128, 32, 8]
+
+    def test_rank_ladder_covers_through_one_past_the_bound(self):
+        # largest cohort 1 CQ -> bound 8 -> ladder through 32
+        assert rank_ladder({"a": 1}) == (8, 32)
+        # largest cohort 20 CQs -> bound 32 -> ladder through 128
+        assert rank_ladder({"a": 20, "b": 2}) == (8, 32, 128)
+
+    def test_cohort_members_from_snapshot(self):
+        env = simple_env(num_cqs=3, cohort="co")
+        members = snapshot_cohort_members(env.cache.snapshot())
+        assert members == {"co": 3}
+        env2 = simple_env(num_cqs=2)  # cohort-less: keyed by CQ name
+        assert snapshot_cohort_members(env2.cache.snapshot()) \
+            == {"cq0": 1, "cq1": 1}
+
+
+class TestRouteGate:
+    def test_idle_governor_never_gates(self):
+        gov = CompileGovernor(StubWarmSolver(), None)
+        assert gov.state == GOV_IDLE
+        assert gov.route_ready(1) and gov.route_ready(2048)
+
+    def test_started_governor_gates_until_the_bucket_is_warm(self):
+        gov = CompileGovernor(StubWarmSolver(), None)
+        gov.state = GOV_WARMING  # as start() sets before the walk
+        assert not gov.route_ready(10)
+        gov._warm_widths = frozenset([32])
+        assert gov.route_ready(10)      # _bucket(10) == 32
+        assert not gov.route_ready(100)  # _bucket(100) == 128: unwarmed
+
+    def test_scheduler_routes_cpu_warmup_and_requests_the_bucket(self):
+        env = simple_env()
+        from kueue_tpu.solver import BatchSolver
+        env.scheduler.solver = BatchSolver()
+        env.scheduler.solver_min_heads = 0
+        env.scheduler.metrics = Registry()
+        gov = CompileGovernor(StubWarmSolver(), env.cache)
+        gov.start = lambda: None  # no background thread in this test
+        gov.state = GOV_WARMING
+        env.scheduler.warm_gov = gov
+        env.submit(WorkloadWrapper("w").queue("lq0")
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        # The cycle admitted on the CPU path under the distinct route
+        # name — no device dispatch, no compile, not a router sample.
+        assert "default/w" in env.client.applied
+        assert env.scheduler.cycle_counts == {"cpu-warmup": 1}
+        assert gov.unwarm_routed == 1
+        assert not env.scheduler._route_stats
+        assert env.scheduler.solver.counters["dispatches"] == 0
+        # The un-warmed bucket was queued for a background warm.
+        assert list(gov._requests) == [8]
+
+    def test_mesh_backend_is_vacuously_warm(self):
+        """warm_setup returns None for mesh/native backends (their
+        dispatch paths cache separately): the governor must report warm
+        AND the gate must never divert — an empty _warm_widths with a
+        non-idle state would otherwise pin every cycle to cpu-warmup."""
+        class MeshSolver(StubWarmSolver):
+            def warm_setup(self, snapshot, expected_pending=None):
+                return None
+
+        env = simple_env()
+        gov = CompileGovernor(MeshSolver(), env.cache)
+        gov.run_sync()
+        assert gov.state == GOV_WARM
+        assert gov.route_ready(8) and gov.route_ready(2048)
+        gov.request(8)  # no-op: nothing to warm on this backend
+        assert not gov._requests and gov.unwarm_routed == 0
+
+    def test_request_created_bucket_refreshed_by_walk(self):
+        """A request() between start() and the walk creates its bucket
+        with the placeholder ranks and no scatter claim; the walk must
+        refresh it against the real ladder (and re-warm it), not skip
+        it because the width key already exists."""
+        env = simple_env(num_cqs=30, cohort="co")
+        gov = CompileGovernor(StubWarmSolver(), env.cache)
+        gov.start = lambda: None  # no background thread in this test
+        gov.state = GOV_WARMING
+        gov.request(20)  # width bucket 32, placeholder ranks
+        assert gov.buckets[32].ranks == (8, 32)
+        assert not gov.buckets[32].scatter
+        gov.run_sync()
+        assert gov.state == GOV_WARM
+        # largest cohort 30 CQs -> bound 32 -> ladder through 128
+        assert gov.buckets[32].ranks == (8, 32, 128)
+        assert gov.buckets[32].scatter  # largest width carries scatter
+
+    def test_warmed_sync_dispatch_counts_no_mid_traffic_compiles(self):
+        """End-to-end key agreement: a real governor warm followed by a
+        real sync device dispatch — every variant key the dispatch
+        computes (including the normalized fs_strategies for a cycle
+        with no fair batch) must have been registered by the warm
+        helpers, so mid_traffic_compiles stays 0."""
+        env = simple_env()
+        from kueue_tpu.solver import BatchSolver
+        solver = BatchSolver()
+        env.scheduler.solver = solver
+        env.scheduler.solver_min_heads = 0
+        # Production wiring binds cache+queues at Scheduler
+        # construction, BEFORE the governor warms — warm_setup keys the
+        # arena decision on it (an arena-capable solver warms the
+        # arena-gather variant at the floor capacity).
+        solver.bind_cache(env.cache)
+        solver.bind_queues(env.scheduler.queues)
+        gov = CompileGovernor(solver, env.cache)
+        assert gov.run_sync() > 0
+        assert gov.state == GOV_WARM
+        env.scheduler.warm_gov = gov
+        env.submit(WorkloadWrapper("w").queue("lq0")
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        assert "default/w" in env.client.applied
+        assert env.scheduler.cycle_counts.get("device") == 1
+        assert solver.counters["mid_traffic_compiles"] == 0
+
+    def test_warm_bucket_routes_device_again(self):
+        env = simple_env()
+        from kueue_tpu.solver import BatchSolver
+        env.scheduler.solver = BatchSolver()
+        env.scheduler.solver_min_heads = 0
+        gov = CompileGovernor(StubWarmSolver(), env.cache)
+        gov.state = GOV_WARM
+        gov._warm_widths = frozenset([8])
+        env.scheduler.warm_gov = gov
+        env.submit(WorkloadWrapper("w").queue("lq0")
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        assert "default/w" in env.client.applied
+        assert env.scheduler.cycle_counts.get("device") == 1
+        assert gov.unwarm_routed == 0
+
+
+class TestWarmupChaos:
+    def test_hang_then_error_skips_the_bucket_not_startup(self):
+        """The ISSUE 7 chaos contract: a wedged remote compile (DELAY at
+        compile_warmup) is abandoned by the per-bucket deadline, the
+        bucket retries at the ladder tail, a second fault skips it, and
+        the walk COMPLETES — startup is never wedged, the scheduler
+        keeps admitting via cpu-warmup, and the breaker never sees a
+        fault (a warmup fault is not a device-path fault)."""
+        env = simple_env()
+        metrics = Registry()
+        gov = CompileGovernor(StubWarmSolver(), env.cache,
+                              metrics=metrics, bucket_deadline_s=0.05)
+        faultinject.install(FaultInjector(
+            {SITE_WARMUP: {0: (DELAY, 0.3), 1: RAISE}}))
+        gov.run_sync()
+        faultinject.uninstall()
+        assert gov.state == GOV_PARTIAL
+        (b,) = gov.buckets.values()
+        assert b.state == B_SKIPPED and b.attempts == 2
+        assert "deadline" in b.error or "Injected" in b.error \
+            or "Timeout" in b.error or b.error
+        assert gov.warmup_faults == 2
+        assert metrics.warmup_faults_total.value() == 2
+        # a skipped bucket is an operator decision: request() won't
+        # re-queue it
+        gov.request(4)
+        assert not gov._requests
+        # the scheduler still admits (cpu-warmup — the gate holds), and
+        # warmup faults never touched the breaker
+        env.scheduler.warm_gov = gov
+        from kueue_tpu.solver import BatchSolver
+        env.scheduler.solver = BatchSolver()
+        env.scheduler.solver_min_heads = 0
+        env.submit(WorkloadWrapper("w").queue("lq0")
+                   .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        assert "default/w" in env.client.applied
+        assert env.scheduler.cycle_counts == {"cpu-warmup": 1}
+        assert env.scheduler.breaker.state == CLOSED
+        assert env.scheduler.solver_faults == 0
+        gov.stop()
+
+    def test_background_start_completes_under_chaos(self):
+        """The supervised background walk (the production startup path)
+        finishes despite a first-bucket fault; the retry at the ladder
+        tail succeeds and the governor reaches fully warm."""
+        env = simple_env()
+        solver = StubWarmSolver()
+        gov = CompileGovernor(solver, env.cache, bucket_deadline_s=5.0)
+        faultinject.install(FaultInjector({SITE_WARMUP: {0: RAISE}}))
+        gov.start()
+        assert gov.state == GOV_WARMING  # the gate engages immediately
+        try:
+            import time
+            deadline = time.time() + 10.0
+            while gov.state == GOV_WARMING and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            faultinject.uninstall()
+            gov.stop()
+        assert gov.state == GOV_WARM
+        (b,) = gov.buckets.values()
+        assert b.state == B_WARM and b.attempts == 2
+        assert gov.warmup_faults == 1
+
+    def test_background_walk_rewalks_on_structural_change(self):
+        """The topology gate releases on the FIRST reconciled CQ, which
+        can be mid-startup: the background walk re-walks until the
+        structural generation token is stable across a walk, so the
+        ladder is never frozen from a partial topology."""
+        import time
+
+        env = simple_env()
+        solver = StubWarmSolver()
+        gov = CompileGovernor(solver, env.cache)
+        toks = iter([1, 2])  # changed across the first walk, then stable
+        gov._gen_token = lambda: next(toks, 2)
+        gov.start()
+        deadline = time.time() + 10.0
+        while gov.state == GOV_WARMING and time.time() < deadline:
+            time.sleep(0.01)
+        gov.stop()
+        assert gov.state == GOV_WARM
+        # two full walks: the partial-topology one, then the stable one
+        assert len([c for c in solver.warm_calls
+                    if c[0] == "bucket"]) == 2
+
+    def test_walk_level_failure_is_contained(self):
+        """A warm_setup failure (snapshot/encode error) degrades to the
+        route gate — logged and counted, never raised to the caller."""
+        class BrokenSolver(StubWarmSolver):
+            def warm_setup(self, snapshot, expected_pending=None):
+                raise RuntimeError("boom")
+
+        env = simple_env()
+        metrics = Registry()
+        gov = CompileGovernor(BrokenSolver(), env.cache, metrics=metrics)
+        assert gov.run_sync() == 0  # no raise
+        assert gov.state == GOV_PARTIAL
+        assert gov.warmup_faults == 1
+        assert metrics.warmup_faults_total.value() == 1
+
+
+class TestRestartReuse:
+    def test_second_instance_is_fully_warm_with_zero_fresh_compiles(
+            self, tmp_path, monkeypatch):
+        """Two solver instances sharing one persistent cache dir: the
+        first compiles the ladder fresh; after a simulated restart
+        (cleared jit caches + a new solver), the second governor
+        reaches fully-warm purely from the cache — zero fresh compiles,
+        asserted via the compile-event counters."""
+        import jax
+
+        from kueue_tpu.solver import BatchSolver
+        from kueue_tpu.solver import service as svc
+
+        # One rank bucket + no delta variants: the smallest real ladder
+        # (the provenance machinery is what's under test, not coverage
+        # of every variant — tests/test_solver.py owns kernel coverage).
+        monkeypatch.setattr(warmgov, "rank_ladder", lambda members: (8,))
+        cache_dir = str(tmp_path / "compile-cache")
+        # A clean first "process": earlier tests may have left these
+        # programs in the in-process jit cache, which would keep
+        # instance 1 from compiling (and therefore persisting) them.
+        jax.clear_caches()
+        svc.reset_seen_programs()
+
+        def one_instance():
+            env = simple_env()
+            reg = Registry()
+            gov = CompileGovernor(BatchSolver(), env.cache, metrics=reg,
+                                  cache_dir=cache_dir, deltas_buckets=())
+            warmed = gov.run_sync()
+            return gov, reg, warmed
+
+        gov1, reg1, warmed1 = one_instance()
+        assert gov1.state == GOV_WARM and warmed1 > 0
+        assert gov1.cache_subdir.startswith(cache_dir)
+        if not any(files for _, _, files in os.walk(cache_dir)):
+            pytest.skip("persistent compilation cache not supported on "
+                        "this backend/jax build")
+        # the fresh compiles were seen by the event counters
+        assert sum(v for k, v in
+                   reg1.compile_events_total.values.items()
+                   if k[1] == "fresh") > 0
+
+        # --- simulated restart ---
+        jax.clear_caches()
+        svc.reset_seen_programs()
+        gov2, reg2, warmed2 = one_instance()
+        assert gov2.state == GOV_WARM and warmed2 == warmed1
+        for b in gov2.buckets.values():
+            assert b.state == B_WARM
+            assert b.source == "cache-hit", b.to_dict()
+        # zero fresh compiles in the restarted instance
+        assert sum(v for k, v in
+                   reg2.compile_events_total.values.items()
+                   if k[1] == "fresh") == 0
+        assert sum(v for k, v in
+                   reg2.compile_events_total.values.items()
+                   if k[1] == "cache-hit") > 0
+
+    def test_topology_change_lands_in_a_different_cache_subdir(
+            self, monkeypatch):
+        """The per-topology stamp: different topology dims -> different
+        cache layout, so a restart can never replay stale executables."""
+        import numpy as np
+
+        class Topo:
+            def __init__(self, q):
+                self.nominal = np.zeros((q, 2, 3))
+                self.cohort_subtree = np.zeros((4, 2, 3))
+                self.cq_chain = np.zeros((q, 1))
+
+        fp_a = warmgov.topology_fingerprint(Topo(8), 4)
+        fp_b = warmgov.topology_fingerprint(Topo(9), 4)
+        fp_c = warmgov.topology_fingerprint(Topo(8), 2)
+        assert fp_a == warmgov.topology_fingerprint(Topo(8), 4)
+        assert len({fp_a, fp_b, fp_c}) == 3
+
+
+class TestOperatorSurface:
+    def test_debug_warmup_endpoint_and_dumper(self):
+        import io
+
+        from kueue_tpu.debugger import Dumper
+        from kueue_tpu.obs import DebugEndpoints, warmup_status
+
+        env = simple_env()
+        ep = DebugEndpoints(env.scheduler)
+        assert ep.handle("/debug/warmup", {}) == {"attached": False}
+
+        gov = CompileGovernor(StubWarmSolver(), env.cache)
+        gov.run_sync()
+        env.scheduler.warm_gov = gov
+        st = ep.handle("/debug/warmup", {})
+        assert st["attached"] and st["state"] == GOV_WARM
+        assert st["buckets"] and st["buckets"][0]["state"] == B_WARM
+        assert st["cpu_warmup_cycles"] == 0
+        assert st == warmup_status(env.scheduler)  # one producer
+
+        out = io.StringIO()
+        Dumper(env.cache, env.queues, out=out,
+               scheduler=env.scheduler).write()
+        dump = out.getvalue()
+        assert "-- warmup --" in dump and "bucket width=8" in dump
+
+    def test_governor_status_roundtrips_json(self):
+        import json
+
+        env = simple_env()
+        gov = CompileGovernor(StubWarmSolver(), env.cache)
+        gov.run_sync()
+        json.dumps(gov.status())  # must be JSON-able for /debug/warmup
+
+    def test_metrics_warmup_state_gauge(self):
+        reg = Registry()
+        for state, code in (("idle", 0), ("warming", 1), ("warm", 2),
+                            ("partial", 3)):
+            reg.set_warmup_state(state)
+            assert reg.warmup_state.value() == code
+
+
+class TestManagerWiring:
+    def test_manager_attaches_governor_and_knobs(self, tmp_path):
+        from kueue_tpu import config as cfgpkg
+        from kueue_tpu.api.meta import FakeClock
+        from kueue_tpu.manager import KueueManager
+        from kueue_tpu.solver import BatchSolver
+
+        cache_dir = str(tmp_path / "cc")
+        cfg = cfgpkg.Configuration()
+        cfg.solver.enable = True
+        cfg.solver.compile_cache_dir = cache_dir
+        cfg.solver.warmup_deadline_s = 7.0
+        mgr = KueueManager(cfg=cfg, clock=FakeClock(0.0),
+                           solver=BatchSolver())
+        gov = mgr.warm_governor
+        assert gov is not None
+        assert mgr.scheduler.warm_gov is gov
+        assert gov.cache_dir == cache_dir
+        assert gov.bucket_deadline_s == 7.0
+        # warmupAtStartup defaults off: deterministic drivers see an
+        # idle (non-gating) governor
+        assert gov.state == GOV_IDLE and gov._thread is None
+
+    def test_manager_without_solver_has_no_governor(self):
+        from kueue_tpu.api.meta import FakeClock
+        from kueue_tpu.manager import KueueManager
+        mgr = KueueManager(clock=FakeClock(0.0))
+        assert mgr.warm_governor is None
+
+    def test_config_knobs_parse_and_validate(self):
+        from kueue_tpu import config as cfgpkg
+        cfg = cfgpkg.load({"solver": {"compileCacheDir": "/x",
+                                      "warmupAtStartup": True,
+                                      "warmupDeadline": 30.0}})
+        assert cfg.solver.compile_cache_dir == "/x"
+        assert cfg.solver.warmup_at_startup is True
+        assert cfg.solver.warmup_deadline_s == 30.0
+        bad = cfgpkg.Configuration()
+        bad.solver.warmup_deadline_s = 0
+        assert any("warmupDeadline" in e for e in cfgpkg.validate(bad))

@@ -1,0 +1,119 @@
+"""Warm probe: operator view of the compile governor (ISSUE 7 tooling).
+
+Stands up the full control plane (KueueManager + BatchSolver) at a
+given topology shape, walks the compile governor's shape-bucket ladder
+(synchronously, fault-contained — exactly what a production startup's
+background thread does), and prints the governor state plus a
+per-bucket compile-provenance table:
+
+    fresh      — the bucket's programs really compiled in this process
+    cache-hit  — served from the persistent compilation cache
+               (solver.compileCacheDir; a primed cache after a restart)
+    jit-cache  — already in the in-process jit cache (or no persistent
+                 cache configured / supported on this backend)
+    skipped    — gave up after max attempts (see the error column)
+
+Point --cache-dir at the production cache root to answer "would a
+restart here reuse compiles?": a second invocation with the same dir
+and shape should show every bucket cache-hit. The same numbers are
+served live at /debug/warmup and in the SIGUSR2 dump (warmup_status is
+the single producer — see solver/COMPILE.md).
+
+Usage: python tools/warm_probe.py [--cqs N] [--cohorts N]
+           [--pending N] [--cache-dir DIR] [--deadline S] [--json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kueue_tpu import config as cfgpkg  # noqa: E402
+from kueue_tpu.api import kueue as api  # noqa: E402
+from kueue_tpu.api.meta import FakeClock, LabelSelector, ObjectMeta  # noqa: E402
+from kueue_tpu.manager import KueueManager  # noqa: E402
+from kueue_tpu.solver import BatchSolver  # noqa: E402
+
+
+def make_objects(num_cqs: int, num_cohorts: int):
+    rf = api.ResourceFlavor(metadata=ObjectMeta(name="f0", uid="rf-f0"))
+    out = [rf]
+    for i in range(num_cqs):
+        cq = api.ClusterQueue(metadata=ObjectMeta(name=f"cq{i}",
+                                                  uid=f"cq-{i}"))
+        cq.spec.namespace_selector = LabelSelector()
+        cq.spec.cohort = f"cohort-{i % max(num_cohorts, 1)}"
+        cq.spec.resource_groups.append(api.ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[api.FlavorQuotas(name="f0", resources=[
+                api.ResourceQuota(name="cpu", nominal_quota=8000)])]))
+        out.append(cq)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cqs", type=int, default=64,
+                    help="ClusterQueues in the probed topology")
+    ap.add_argument("--cohorts", type=int, default=8)
+    ap.add_argument("--pending", type=int, default=None,
+                    help="expected pending workloads (pre-sizes the "
+                         "encode arena and warms its variants)")
+    ap.add_argument("--cache-dir", default="",
+                    help="persistent compilation cache root "
+                         "(solver.compileCacheDir); the governor stamps "
+                         "the per-topology subdirectory itself")
+    ap.add_argument("--deadline", type=float, default=120.0,
+                    help="per-bucket warmup deadline seconds")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw status JSON instead of the table")
+    args = ap.parse_args()
+
+    cfg = cfgpkg.Configuration()
+    cfg.solver.enable = True
+    cfg.solver.min_heads = 0
+    cfg.solver.compile_cache_dir = args.cache_dir
+    cfg.solver.warmup_deadline_s = args.deadline
+    mgr = KueueManager(cfg=cfg, clock=FakeClock(1000.0),
+                       solver=BatchSolver())
+    for obj in make_objects(args.cqs, args.cohorts):
+        mgr.store.create(obj)
+    mgr.run_until_idle(max_iterations=1_000_000)
+
+    gov = mgr.warm_governor
+    if gov is None:
+        print("no warm-capable solver attached", file=sys.stderr)
+        return 2
+    gov.run_sync(expected_pending=args.pending)
+    from kueue_tpu.obs import warmup_status
+    st = warmup_status(mgr.scheduler)
+
+    if args.json:
+        print(json.dumps(st, indent=1))
+    else:
+        print(f"governor state : {st['state']}")
+        print(f"programs warmed: {st['programs_warmed']}")
+        print(f"warmup faults  : {st['warmup_faults']}")
+        cache = st["cache_subdir"] or "(no persistent cache)"
+        print(f"cache dir      : {cache}")
+        print(f"{'width':>7} {'state':>8} {'source':>10} {'programs':>8} "
+              f"{'compile_ms':>10} {'attempts':>8}  error")
+        for b in st["buckets"]:
+            print(f"{b['width']:>7} {b['state']:>8} "
+                  f"{str(b['source']):>10} {b['programs']:>8} "
+                  f"{b['compile_ms']:>10} {b['attempts']:>8}  "
+                  f"{b['error'] or ''}")
+    ok = st["state"] in ("warm", "idle")
+    print(json.dumps({"tool": "warm_probe", "state": st["state"],
+                      "buckets": len(st["buckets"]),
+                      "programs_warmed": st["programs_warmed"],
+                      "warmup_faults": st["warmup_faults"],
+                      "cache_subdir": st["cache_subdir"], "ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

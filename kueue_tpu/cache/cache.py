@@ -100,9 +100,22 @@ class Cache:
             self._maintainer = SnapshotMaintainer(self)
             self._journal_cursors[SNAPSHOT_CONSUMER] = 0
             self.usage_journal_enabled = True
+        # Structural-dirty bookkeeping for the maintainer's per-CQ
+        # partial rebuild (incremental.py): a single-CQ structural edit
+        # (quota/resource-group change on ONE ClusterQueue, same cohort
+        # edge) records just that CQ's name, so the next snapshot sync
+        # rebuilds only that CQ's subtree instead of every master.
+        # Anything wider (CQ add/delete, cohort or flavor or check
+        # changes) sets the all-flag and keeps the full-rebuild path.
+        # Only maintained when a maintainer exists (the set would
+        # otherwise grow without a consumer).
+        self._structural_dirty_cqs: set = set()
+        self._structural_dirty_all = False
         # Snapshot-build accounting (perf/bench visibility): which path
         # served each full snapshot() and how long the build took.
-        self.snapshot_stats = {"full": 0, "incremental": 0, "light": 0}
+        # "partial" = per-CQ structural rebuild + journal replay.
+        self.snapshot_stats = {"full": 0, "incremental": 0, "light": 0,
+                               "partial": 0}
         self.snapshot_build_s: list = []
 
     def _new_cohort(self, name: str) -> CohortCache:
@@ -174,6 +187,28 @@ class Cache:
                 self._journal[seq - first] = entry[:5] + (None,)
         self._journal_aux_stripped = max(self._journal_aux_stripped, upto)
 
+    def _mark_structural(self, cq_name: Optional[str] = None) -> None:
+        """Record the scope of a structural (epoch-bumping) change for
+        the snapshot maintainer: a CQ name when the change is contained
+        to that ClusterQueue's subtree, None for anything wider. Caller
+        holds the lock and has already bumped the epoch."""
+        if self._maintainer is None:
+            return
+        if cq_name is None:
+            self._structural_dirty_all = True
+        else:
+            self._structural_dirty_cqs.add(cq_name)
+
+    def take_structural_dirty(self) -> tuple:
+        """Consume the structural-dirty scope accumulated since the last
+        call: (dirty CQ names, all-flag). Caller holds the lock (the
+        maintainer's _sync runs under Cache.snapshot's lock)."""
+        dirty, dirty_all = (self._structural_dirty_cqs,
+                            self._structural_dirty_all)
+        self._structural_dirty_cqs = set()
+        self._structural_dirty_all = False
+        return dirty, dirty_all
+
     def generation_token(self) -> tuple:
         """The structural generation stamp for speculative solves
         (scheduler/stages.SpeculationToken): three epoch ints, read
@@ -231,6 +266,7 @@ class Cache:
         with self._lock:
             self._capacity_version += 1
             self.topology_epoch += 1
+            self._mark_structural()  # may materialize a new cohort node
             cqc = ClusterQueueCache(cq)
             self.hm.add_cluster_queue(cqc.name, cqc)
             self.hm.update_cluster_queue_edge(cqc.name, cq.spec.cohort)
@@ -275,6 +311,13 @@ class Cache:
             self._refresh_cohort(cqc)
             if self._topo_signature(cqc) != old_sig:
                 self.topology_epoch += 1
+                # Same cohort payload => the cohort graph's SHAPE is
+                # unchanged (quota edits only move this CQ's node and
+                # the tree's aggregates): the maintainer may rebuild
+                # just this CQ's subtree. An edge move (or to/from a
+                # fresh cohort) invalidates the master cohort graph.
+                self._mark_structural(
+                    cqc.name if old_cohort is cqc.cohort else None)
             else:
                 # Non-structural update (namespace selector, preemption
                 # policy, fungibility knobs): invisible to every epoch,
@@ -290,11 +333,13 @@ class Cache:
             if cqc is not None:
                 cqc.status = TERMINATING
                 self.topology_epoch += 1
+                self._mark_structural(name)  # an activity flip, CQ-local
 
     def delete_cluster_queue(self, name: str) -> None:
         with self._lock:
             self._capacity_version += 1
             self.topology_epoch += 1
+            self._mark_structural()  # cohort membership/GC changes
             cqc = self.hm.cluster_queues.get(name)
             if cqc is None:
                 return
@@ -340,6 +385,7 @@ class Cache:
             self.cohort_epoch += 1
             self._capacity_version += 1
             self.topology_epoch += 1
+            self._mark_structural()
             node = self.hm.add_cohort(cohort.metadata.name)
             node.payload.resource_node.quotas = build_quotas(cohort.spec.resource_groups)
             old_root = node.payload.root()
@@ -359,6 +405,7 @@ class Cache:
             self.cohort_epoch += 1
             self._capacity_version += 1
             self.topology_epoch += 1
+            self._mark_structural()
             node = self.hm.cohorts.get(name)
             if node is None:
                 return
@@ -393,6 +440,7 @@ class Cache:
         self._capacity_version += 1
         self.flavor_spec_epoch += 1
         self.topology_epoch += 1
+        self._mark_structural()
         affected = set()
         for cqc in self.hm.cluster_queues.values():
             was = cqc.active
@@ -419,6 +467,7 @@ class Cache:
 
     def _refresh_check_dependents(self) -> set:
         self.topology_epoch += 1
+        self._mark_structural()
         affected = set()
         for cqc in self.hm.cluster_queues.values():
             was = cqc.active

@@ -92,6 +92,7 @@ class SnapshotMaintainer:
         # that steady-state cycles take the incremental path).
         self.full_rebuilds = 0
         self.incremental_advances = 0
+        self.partial_rebuilds = 0
         self.background_advances = 0
         self.shell_reuses = 0
 
@@ -118,6 +119,25 @@ class SnapshotMaintainer:
         entries, overflow = cache.drain_usage_journal(
             cache._journal_seq, consumer=SNAPSHOT_CONSUMER)
         if overflow or self._epochs != epochs:
+            dirty, dirty_all = cache.take_structural_dirty()
+            if (not overflow and not dirty_all and dirty
+                    and self._epochs is not None
+                    and self._epochs[0] == epochs[0]
+                    and self._epochs[1] == epochs[1]):
+                # Every structural change since the last sync was a
+                # single-CQ edit with an unchanged cohort edge (quota /
+                # resource-group / activity): rebuild ONLY those CQs'
+                # subtrees from live state and replay the journal for
+                # everyone else, instead of re-cloning 2k masters
+                # because one tenant's quota moved (the flavor-churn
+                # scenario's steady diet). Entries for the dirty CQs
+                # are subsumed by their from-live rebuild.
+                self._replay([e for e in entries if e[2] not in dirty])
+                for name in dirty:
+                    self._rebuild_cq(name)
+                self._epochs = epochs
+                self.partial_rebuilds += 1
+                return "partial"
             # Structural change (or lost journal entries): the drained
             # entries are subsumed by rebuilding from live state.
             self._rebuild()
@@ -163,6 +183,61 @@ class SnapshotMaintainer:
                     # Hidden CQs get the cohort pointer (usage bubbling)
                     # but are not members; handouts rebuild member sets.
                     member.cohort = cohort
+
+    # --- per-CQ structural rebuild (single-CQ epoch bumps) ---
+
+    def _rebuild_cq(self, name: str) -> None:
+        """Rebuild ONE ClusterQueue's master from live state after a
+        structural edit contained to it (quota / resource-group /
+        activity change, cohort edge unchanged), then re-sync its cohort
+        tree's aggregates — the live tree was already re-aggregated by
+        update_cohort_resource_node, so quotas/subtree_quota/usage come
+        from there. Preconditions enforced by _sync: no cohort-graph
+        shape change, no flavor-spec or cohort-object epoch movement."""
+        cache = self._cache
+        self._cqs.pop(name, None)
+        self._hidden.pop(name, None)
+        self._inactive.discard(name)
+        cqc = cache.hm.cluster_queues.get(name)
+        if cqc is None:
+            # CQ deletes are dirty-all (full rebuild); defensive only.
+            return
+        snap_cq = ClusterQueueSnapshot(cqc)
+        snap_cq._shared = True
+        if cqc.active:
+            self._cqs[name] = snap_cq
+        else:
+            self._inactive.add(name)
+            self._hidden[name] = snap_cq
+        # The fresh clone shares nothing with any handout.
+        self._fresh_cqs.add(name)
+        node = cache.hm.cohort_of(name)
+        if node is not None:
+            cohort = self._cohorts.get(node.name)
+            if cohort is not None:
+                snap_cq.cohort = cohort
+                self._sync_cohort_tree_from_live(cohort.root())
+
+    def _sync_cohort_tree_from_live(self, cohort) -> None:
+        """Re-sync a master cohort tree's resource nodes (quotas,
+        subtree_quota, usage) from the live tree, privatizing shared
+        nodes first (handouts keep their frozen view). Used by the
+        per-CQ rebuild: a quota edit on one member re-aggregates the
+        live tree wholesale, exactly like a non-structural CQ refresh
+        re-syncs usage (see _sync_cohort_tree_usage)."""
+        live = self._cache.hm.cohorts.get(cohort.name)
+        if live is not None:
+            if cohort.name not in self._fresh_cohorts:
+                cohort.resource_node = cohort.resource_node.clone()
+                self._fresh_cohorts.add(cohort.name)
+            node = live.payload.resource_node
+            # Re-share the live quota dicts exactly like a fresh clone
+            # would (ResourceNode.clone shares quotas/subtree_quota).
+            cohort.resource_node.quotas = node.quotas
+            cohort.resource_node.subtree_quota = node.subtree_quota
+            cohort.resource_node.usage = dict(node.usage)
+        for child in cohort.child_cohorts:
+            self._sync_cohort_tree_from_live(child)
 
     # --- journal replay (the steady-state path) ---
 

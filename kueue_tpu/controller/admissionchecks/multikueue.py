@@ -103,8 +103,25 @@ class MultiKueueController:
         self.origin = origin
         self.worker_lost_timeout = worker_lost_timeout
         self._lost_since: dict = {}  # wl key -> first-noticed-lost time
+        # Activity probe (reference: multikueuecluster.go connection
+        # monitor): clusters marked lost are unreachable — excluded from
+        # placement, mirror deletion and orphan GC — until rejoined.
+        # Scenario drivers and (eventually) a real connection prober
+        # flip these; the sim's "worker cluster loss" failure mode
+        # (SURVEY.md §5) is exercised through exactly this surface.
+        self.lost_clusters: set = set()
+        # wl key -> cluster the Ready check recorded. Placement is
+        # sticky: on reconcile the recorded cluster is probed FIRST, so
+        # a lost cluster rejoining with a stale reserved mirror cannot
+        # steal the workload back from its re-placement (the stale
+        # mirror is deleted by the first-wins branch instead) — the
+        # no-double-dispatch invariant under cluster loss/rejoin.
+        self._reserving: dict = {}
+        self._ctrl = None  # workqueue handle, set by setup_*
 
     def _remote_store(self, cluster_name: str) -> Optional[Store]:
+        if cluster_name in self.lost_clusters:
+            return None  # unreachable: no reads, writes, or deletes
         remote = self.remote_clusters.get(cluster_name)
         if remote is None:
             return None
@@ -113,6 +130,43 @@ class MultiKueueController:
     def cluster_active(self, cluster_name: str) -> bool:
         return self._remote_store(cluster_name) is not None \
             and self.store.try_get("MultiKueueCluster", "", cluster_name) is not None
+
+    # -- activity probe (cluster loss / rejoin) -------------------------
+
+    def mark_cluster_lost(self, cluster_name: str) -> None:
+        """The worker became unreachable (connection probe failure):
+        exclude it everywhere and re-reconcile so workloads reserved
+        there start their worker-lost timeout."""
+        if cluster_name in self.lost_clusters:
+            return
+        self.lost_clusters.add(cluster_name)
+        self.recorder.system_event(
+            "Warning", "MultiKueueClusterLost",
+            f'worker cluster "{cluster_name}" is unreachable')
+        self._requeue_all()
+
+    def mark_cluster_rejoined(self, cluster_name: str) -> None:
+        """The worker is reachable again: re-reconcile so stale mirrors
+        left from before the loss are cleaned up (sticky placement keeps
+        re-placed workloads where they landed) and the cluster returns
+        to the placement set."""
+        if cluster_name not in self.lost_clusters:
+            return
+        self.lost_clusters.discard(cluster_name)
+        self.recorder.system_event(
+            "Normal", "MultiKueueClusterRejoined",
+            f'worker cluster "{cluster_name}" rejoined')
+        self._requeue_all()
+
+    def _requeue_all(self) -> None:
+        """Re-enqueue every local workload (reference: the cluster
+        connection watcher queues all workloads on connect/disconnect,
+        multikueuecluster.go:187-253). Non-multikueue workloads no-op
+        in reconcile."""
+        if self._ctrl is None:
+            return
+        for wl in self.store.list("Workload", copy_objects=False):
+            self._ctrl.enqueue(wlpkg.key(wl))
 
     # -- check/config resolution ----------------------------------------
 
@@ -155,8 +209,17 @@ class MultiKueueController:
 
         clusters = self._clusters_for_check(check_name)
         reserving = None
-        for cluster in clusters:
+        # Sticky placement: probe the recorded reserving cluster first,
+        # so a rejoined cluster holding a stale reserved mirror cannot
+        # out-rank the workload's current placement (no double
+        # dispatch; the stale mirror is GC'd below instead).
+        recorded = self._reserving.get(wlpkg.key(wl))
+        ordered = ([recorded] + [c for c in clusters if c != recorded]
+                   if recorded in clusters else clusters)
+        for cluster in ordered:
             remote = self._remote_store(cluster)
+            if remote is None:
+                continue  # lost: unreachable, cannot be observed reserving
             remote_wl = remote.try_get("Workload", namespace, name)
             if remote_wl is not None and wlpkg.has_quota_reservation(remote_wl):
                 reserving = cluster
@@ -171,6 +234,7 @@ class MultiKueueController:
             if remaining > 0:
                 return float(remaining)
             self._lost_since.pop(wlpkg.key(wl), None)
+            self._reserving.pop(wlpkg.key(wl), None)
             wlpkg.set_admission_check_state(
                 wl.status.admission_checks,
                 api.AdmissionCheckState(
@@ -181,6 +245,7 @@ class MultiKueueController:
         self._lost_since.pop(wlpkg.key(wl), None)
 
         if reserving is not None:
+            self._reserving[wlpkg.key(wl)] = reserving
             # first reservation wins: drop the other mirrors and their jobs
             adapter = self._adapter_for(wl)
             owner = next((o for o in wl.metadata.owner_references
@@ -188,9 +253,10 @@ class MultiKueueController:
             for cluster in clusters:
                 if cluster != reserving:
                     self._delete_mirror(cluster, namespace, name)
-                    if adapter is not None and owner is not None:
-                        adapter.delete_remote(self._remote_store(cluster),
-                                              namespace, owner.name)
+                    other = self._remote_store(cluster)
+                    if adapter is not None and owner is not None \
+                            and other is not None:
+                        adapter.delete_remote(other, namespace, owner.name)
             remote = self._remote_store(reserving)
             remote_wl = remote.try_get("Workload", namespace, name)
             # copy the remote Finished condition back
@@ -215,6 +281,8 @@ class MultiKueueController:
         # no remote reservation yet: mirror to every cluster
         for cluster in clusters:
             remote = self._remote_store(cluster)
+            if remote is None:
+                continue  # lost: mirrored on rejoin via _requeue_all
             if remote.try_get("Workload", namespace, name) is None:
                 from kueue_tpu.sim import AlreadyExists
                 clone = self._clone_for_remote(wl)
@@ -261,20 +329,25 @@ class MultiKueueController:
             pass
 
     def _gc_remotes(self, namespace: str, name: str) -> None:
-        """Remote orphan GC (reference: multikueuecluster.go:255-305)."""
+        """Remote orphan GC (reference: multikueuecluster.go:255-305).
+        Lost clusters are skipped (unreachable); their stale mirrors
+        are collected by the periodic gc_orphans pass after rejoin."""
+        self._reserving.pop(f"{namespace}/{name}", None)
         for cluster in list(self.remote_clusters):
             self._delete_mirror(cluster, namespace, name)
 
     def gc_orphans(self) -> int:
         """Periodic GC: remote workloads whose local original is gone
-        (reference: GC interval, config multiKueue.gcInterval)."""
+        (reference: GC interval, config multiKueue.gcInterval). Runs on
+        the manager's runtime timer every multiKueue.gcInterval seconds;
+        lost clusters are skipped until they rejoin."""
         removed = 0
         for cluster in list(self.remote_clusters):
             remote = self._remote_store(cluster)
             if remote is None:
                 continue
             for remote_wl in remote.list(
-                    "Workload",
+                    "Workload", copy_objects=False,
                     where=lambda w: w.metadata.labels.get(ORIGIN_LABEL) == self.origin):
                 local = self.store.try_get(
                     "Workload", remote_wl.metadata.namespace, remote_wl.metadata.name)
@@ -291,6 +364,7 @@ def setup_multikueue_controller(runtime, store: Store, recorder,
     controller = MultiKueueController(store, recorder, runtime.clock,
                                       remote_clusters=remote_clusters, **kwargs)
     ctrl = runtime.controller("multikueue", controller.reconcile)
+    controller._ctrl = ctrl
 
     def on_workload(event, wl, old):
         ctrl.enqueue(wlpkg.key(wl))

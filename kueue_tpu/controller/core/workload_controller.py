@@ -177,6 +177,16 @@ class WorkloadReconciler:
                                     self._reconcile_not_ready_timeout,
                                     wl, cq_name, now)
 
+        # Eviction completed (no reservation): retryable/stale check
+        # states return to Pending so the next admission re-runs them
+        # (reference: ResetChecksOnEviction). Without this a MultiKueue
+        # worker-lost Retry would livelock evict/requeue, and a stale
+        # Ready could admit a re-reserved workload no worker holds.
+        if wl.status.admission_checks and self._event_span(
+                "reset-checks", wlpkg.reset_checks_after_eviction, wl, now):
+            self.store.update(wl)
+            return None
+
         # pending: surface why the workload can't queue (reference: :285-330)
         msg = None
         if not lq_exists:

@@ -385,6 +385,28 @@ def sync_admission_check_conditions(wl: api.Workload, check_names: set, now: flo
     return changed or len(wl.status.admission_checks) != before
 
 
+def reset_checks_after_eviction(wl: api.Workload, now: float) -> bool:
+    """Once an eviction completes (the quota reservation is gone),
+    Retry and stale Ready check states return to Pending so the next
+    admission re-runs every check (reference:
+    workload.ResetChecksOnEviction). Without this a MultiKueue Retry
+    after worker-cluster loss would re-trigger check-based eviction the
+    moment the workload re-reserves (an evict/requeue livelock), and a
+    stale Ready naming the LOST cluster would admit the re-reserved
+    workload with no worker actually holding it. Rejected states are
+    left alone — they drive deactivation."""
+    changed = False
+    for acs in list(wl.status.admission_checks):
+        if acs.state in (api.CHECK_STATE_RETRY, api.CHECK_STATE_READY):
+            set_admission_check_state(
+                wl.status.admission_checks,
+                api.AdmissionCheckState(
+                    name=acs.name, state=api.CHECK_STATE_PENDING,
+                    message="Reset to Pending after eviction"), now)
+            changed = True
+    return changed
+
+
 def has_all_checks(wl: api.Workload, check_names: set) -> bool:
     existing = {acs.name for acs in wl.status.admission_checks}
     return check_names <= existing

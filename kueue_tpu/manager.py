@@ -137,6 +137,22 @@ class KueueManager:
             origin=self.cfg.multi_kueue.origin,
             worker_lost_timeout=self.cfg.multi_kueue.worker_lost_timeout_seconds)
 
+        # Periodic remote-orphan GC (reference: multikueuecluster.go GC
+        # interval): without this timer gc_orphans existed but nothing
+        # scheduled it, so mirrors whose local original vanished during
+        # a worker-cluster outage leaked until a manual sweep. Runs on
+        # the runtime like the queue-visibility cron so deterministic
+        # drivers (advance()) exercise it; <=0 disables.
+        gc_interval = self.cfg.multi_kueue.gc_interval_seconds
+        if gc_interval > 0 and remote_clusters:
+
+            def gc_orphans(_key):
+                self.multikueue.gc_orphans()
+                return float(gc_interval)
+
+            gc_ctrl = self.runtime.controller("multikueue-gc", gc_orphans)
+            gc_ctrl.enqueue("gc")
+
         # job integrations (reference: jobframework.SetupControllers via
         # cmd/kueue/main.go:229-290). Registration is idempotent across
         # managers; wiring is per-runtime.

@@ -41,7 +41,7 @@ class CycleTrace:
 
     __slots__ = ("cycle_id", "t_wall", "t0", "duration_s", "route",
                  "regime", "heads", "admitted", "evictions", "faults",
-                 "breaker", "degraded", "spans", "annotations")
+                 "breaker", "degraded", "tag", "spans", "annotations")
 
     def __init__(self, cycle_id: int, t_wall: float, t0: float):
         self.cycle_id = cycle_id
@@ -56,6 +56,7 @@ class CycleTrace:
         self.faults = 0
         self.breaker = ""
         self.degraded = ""            # ladder rung the cycle ran under
+        self.tag = ""                 # driver context (scenario phase)
         self.spans: list = []         # (name, start_s, dur_s)
         self.annotations: list = []   # dicts: {"kind", "message", ...}
 
@@ -82,6 +83,7 @@ class CycleTrace:
             "faults": self.faults,
             "breaker": self.breaker,
             "degraded": self.degraded,
+            "tag": self.tag,
             "spans": [{"name": n, "start_ms": round(s * 1e3, 3),
                        "dur_ms": round(d * 1e3, 3)}
                       for n, s, d in self.spans],
@@ -100,6 +102,16 @@ class FlightRecorder:
         self._ring: list = []      # completed traces, oldest first
         self._current: Optional[CycleTrace] = None
         self.cycles_recorded = 0   # lifetime count (ring is bounded)
+        # Driver-owned context tag stamped onto every trace begun while
+        # it is set: scenario drivers (sim/scenarios.py) label cycles
+        # with the traffic phase ("ramp"/"storm"/"recovery") so SLO
+        # evaluation can window the trace stream without guessing from
+        # timestamps. Empty outside scenario runs.
+        self.tag = ""
+
+    def set_tag(self, tag: str) -> None:
+        """Set the phase tag stamped onto subsequent traces ("" clears)."""
+        self.tag = tag
 
     # --- producer side (the scheduler thread) ---
 
@@ -111,6 +123,7 @@ class FlightRecorder:
             self._current = None
             return None
         tr = CycleTrace(cycle_id, time.time(), time.perf_counter())
+        tr.tag = self.tag
         self._current = tr
         return tr
 

@@ -17,13 +17,14 @@ from kueue_tpu.perf.generator import (
     north_star_generator_config,
 )
 from kueue_tpu.perf.runner import RunResult, Runner
-from kueue_tpu.perf.checker import (RangeSpec, check, default_rangespec,
+from kueue_tpu.perf.checker import (RangeSpec, SLOSpec, check, check_slo,
+                                    default_rangespec,
                                     north_star_rangespec,
                                     refuse_cross_backend)
 
 __all__ = [
     "CohortClass", "QueueClass", "WorkloadClass", "WorkloadSet",
     "default_generator_config", "generate",
-    "Runner", "RunResult", "RangeSpec", "check", "default_rangespec",
-    "north_star_rangespec", "refuse_cross_backend",
+    "Runner", "RunResult", "RangeSpec", "SLOSpec", "check", "check_slo",
+    "default_rangespec", "north_star_rangespec", "refuse_cross_backend",
 ]

@@ -112,6 +112,81 @@ def refuse_cross_backend(spec: RangeSpec, backend: Optional[dict]) -> Optional[s
     return None
 
 
+@dataclass
+class SLOSpec:
+    """Service-level bounds for one sim scenario (sim/scenarios.py +
+    sim/SCENARIOS.md): where RangeSpec bounds a perf run's host-compute
+    statistics, SLOSpec bounds a scenario's QUEUEING behavior — per-
+    priority-class p99 time-to-admission under the scenario's traffic,
+    degradation-ladder recovery after its storm, requeue amplification
+    of its eviction waves, and the zero-starvation invariant. Times are
+    VIRTUAL seconds (FakeClock), so the bounds are backend-agnostic by
+    default; a spec that also bounds wall behavior declares the backend
+    it was calibrated on and cross-backend comparison is refused, same
+    policy as RangeSpec (refuse_cross_backend works on both)."""
+    backend: str = ""
+    # priority class -> max p99 time-to-admission (virtual seconds)
+    class_max_p99_tta_s: dict = field(default_factory=dict)
+    min_admitted: int = 0
+    # No workload still eligible at scenario end may be unadmitted
+    # (result.starved lists offenders after the drain phase).
+    zero_starvation: bool = True
+    # Max cycles from storm end (the driver's phase-tag flip) back to
+    # the ladder's normal rung. None = unchecked; a scenario whose
+    # ladder never engaged recovers in 0 cycles by definition.
+    max_ladder_recovery_cycles: Optional[int] = None
+    # Max (admission grants + evictions) / (distinct admitted
+    # workloads): bounds retry-storm churn. 0 = unchecked; 1.0 means
+    # every workload admitted exactly once with no evictions.
+    max_requeue_amplification: float = 0.0
+    max_evictions: Optional[int] = None
+
+
+def check_slo(result, spec: SLOSpec) -> list:
+    """Evaluate a ScenarioResult (sim/scenarios.py) against its SLOSpec;
+    returns violation strings (empty = all gates green). Callers should
+    refuse cross-backend comparison first (refuse_cross_backend accepts
+    an SLOSpec — same .backend contract as RangeSpec)."""
+    violations = []
+    if result.admitted < spec.min_admitted:
+        violations.append(
+            f"admitted {result.admitted} below minimum {spec.min_admitted}")
+    for cls, bound in spec.class_max_p99_tta_s.items():
+        p99 = result.class_p99_tta_s.get(cls)
+        if p99 is None:
+            violations.append(
+                f"no admissions recorded for priority class {cls!r}")
+        elif p99 > bound:
+            violations.append(
+                f"class {cls!r} p99 time-to-admission {p99:.1f}s "
+                f"exceeds {bound:.1f}s")
+    if spec.zero_starvation and result.starved:
+        sample = ", ".join(sorted(result.starved)[:5])
+        violations.append(
+            f"{len(result.starved)} workload(s) starved (never admitted "
+            f"while eligible): {sample}")
+    if spec.max_ladder_recovery_cycles is not None:
+        rec = result.ladder_recovery_cycles
+        if rec is None:
+            violations.append(
+                "ladder engaged but never recovered to the normal rung")
+        elif rec > spec.max_ladder_recovery_cycles:
+            violations.append(
+                f"ladder recovery took {rec} cycles, bound "
+                f"{spec.max_ladder_recovery_cycles}")
+    if spec.max_requeue_amplification \
+            and result.requeue_amplification > spec.max_requeue_amplification:
+        violations.append(
+            f"requeue amplification {result.requeue_amplification:.2f} "
+            f"exceeds {spec.max_requeue_amplification:.2f}")
+    if spec.max_evictions is not None \
+            and result.evictions > spec.max_evictions:
+        violations.append(
+            f"{result.evictions} evictions exceed bound "
+            f"{spec.max_evictions}")
+    return violations
+
+
 def check(result: RunResult, spec: RangeSpec) -> list:
     violations = []
     if spec.max_wall_s and result.wall_s > spec.max_wall_s:

@@ -32,26 +32,62 @@ class Event:
     message: str
 
 
-class EventRecorder:
-    """record.EventRecorder stand-in; events are assertions targets in tests."""
+# Default retained-event window. Million-event scenario runs (the
+# sim/scenarios.py traffic suites) would otherwise grow the event list
+# without bound; 100k keeps every test-scale run fully retained while
+# bounding a storm's memory to the recent window.
+DEFAULT_EVENT_CAPACITY = 100_000
 
-    def __init__(self):
-        self.events: list[Event] = []
+
+class EventRecorder:
+    """record.EventRecorder stand-in; events are assertions targets in
+    tests.
+
+    Bounded: the retained ``events`` window is a ring of the last
+    ``capacity`` events (oldest dropped first), while ``reason_counts``
+    and ``total_events`` keep exact lifetime tallies — so a
+    million-event scenario run can still assert on eviction/requeue
+    *counts* after the early events have rotated out. ``by_reason``
+    operates on the retained window only."""
+
+    def __init__(self, capacity: int = DEFAULT_EVENT_CAPACITY):
+        if capacity < 1:
+            raise ValueError("event recorder capacity must be >= 1")
+        from collections import deque
+        self.capacity = capacity
+        self.events: "deque[Event]" = deque(maxlen=capacity)
+        self.reason_counts: dict = {}   # reason -> lifetime count
+        self.total_events = 0
+
+    def _record(self, event: Event) -> None:
+        self.events.append(event)   # deque(maxlen): oldest falls off
+        self.total_events += 1
+        self.reason_counts[event.reason] = \
+            self.reason_counts.get(event.reason, 0) + 1
 
     def event(self, obj, etype: str, reason: str, message: str) -> None:
         meta = obj.metadata
         key = f"{meta.namespace}/{meta.name}" if meta.namespace else meta.name
-        self.events.append(Event(key, type(obj).__name__, etype, reason, message))
+        self._record(Event(key, type(obj).__name__, etype, reason, message))
 
     def system_event(self, etype: str, reason: str, message: str) -> None:
         """An event about the control plane itself rather than a stored
         object (device faults, breaker trips/recoveries): no object key,
         kind "Scheduler" — chaos tooling and operators read the outage
         timeline from these."""
-        self.events.append(Event("", "Scheduler", etype, reason, message))
+        self._record(Event("", "Scheduler", etype, reason, message))
 
     def by_reason(self, reason: str) -> list[Event]:
+        """Matching events within the retained window (use
+        ``reason_counts`` for exact lifetime tallies)."""
         return [e for e in self.events if e.reason == reason]
+
+    def count_by_reason_prefix(self, prefix: str) -> int:
+        """Lifetime count of events whose reason starts with ``prefix``
+        (e.g. "EvictedDueTo" sums every eviction reason) — survives ring
+        rotation, so scenario SLO gates read amplification from here."""
+        return sum(n for r, n in self.reason_counts.items()
+                   if r.startswith(prefix))
 
 
 class Controller:

@@ -1,0 +1,1082 @@
+"""Trace-driven production-realism scenarios with SLO gates (ISSUE 8).
+
+Each scenario drives the FULL control plane (KueueManager: sim store,
+webhooks, controllers, scheduler) through a seeded traffic trace
+(sim/traces.py) on the virtual FakeClock, playing the job-framework's
+part for plain Workloads (completing evictions, finishing runs, flipping
+PodsReady) so the admission/eviction/requeue loop closes end-to-end.
+Results are gated by perf.checker.SLOSpec bounds — per-priority-class
+p99 time-to-admission, degradation-ladder recovery, requeue
+amplification, and the zero-starvation invariant — all in VIRTUAL
+seconds, so the gates are deterministic for a (seed, scale) pair and
+backend-agnostic by construction (an SLOSpec that bounds wall behavior
+instead declares its backend and cross-backend comparison is refused,
+per perf.checker.refuse_cross_backend).
+
+The catalog (sim/SCENARIOS.md documents each in detail):
+
+- ``diurnal``       (a) sinusoidal arrival wave with burst harmonics
+- ``tenant_storm``  (b) one LocalQueue floods while others trickle
+- ``flavor_churn``  (c) ClusterQueue quota edits mid-traffic (per-CQ
+                        epoch / partial-rebuild path)
+- ``requeue_flood`` (d) waitForPodsReady timeout storm -> mass eviction
+                        -> jittered requeue backoff (SURVEY.md §5)
+- ``cluster_loss``  (e) MultiKueue worker loss mid-dispatch, re-place,
+                        rejoin, orphan GC (SURVEY.md §5)
+- ``mixed_jobs``    (f) jobset/kubeflow/ray/batch-job traffic under
+                        load, parity with the plain-workload path
+
+Run one via ``run_scenario(name, seed=..., scale="smoke"|"full")`` or
+end-to-end with artifacts via ``tools/scenario_run.py``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kueue_tpu import config as cfgpkg
+from kueue_tpu.api import batchv1
+from kueue_tpu.api import jobset as jobsetapi
+from kueue_tpu.api import kubeflow as kf
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api import ray as rayapi
+from kueue_tpu.api.corev1 import Container, PodSpec, PodTemplateSpec
+from kueue_tpu.api.meta import (Condition, FakeClock, LabelSelector,
+                                ObjectMeta, find_condition, set_condition)
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.perf.checker import SLOSpec, check_slo
+from kueue_tpu.sim.traces import (TraceArrival, burst_trace, diurnal_trace,
+                                  steady_trace, storm_trace)
+
+CLASS_LABEL = "scenario.kueue-tpu/class"
+TENANT_LABEL = "scenario.kueue-tpu/tenant"
+
+UNIT = 1000  # one abstract resource unit = 1000 milli-cpu
+
+
+# ----------------------------------------------------------------------
+# result
+# ----------------------------------------------------------------------
+
+@dataclass
+class ScenarioResult:
+    """What one scenario run observed, plus its SLO verdict. All times
+    are virtual seconds; ``backend`` stamps the env the run executed on
+    (informational for virtual-time gates — see module docstring)."""
+    name: str
+    seed: int
+    scale: str
+    backend: dict = field(default_factory=dict)
+    cycles: int = 0
+    duration_s: float = 0.0
+    submitted: int = 0
+    admitted: int = 0        # distinct workloads ever admitted
+    admissions: int = 0      # admission transitions incl. re-admissions
+    evictions: int = 0       # lifetime EvictedDueTo* event count
+    starved: list = field(default_factory=list)
+    class_p99_tta_s: dict = field(default_factory=dict)
+    # 0 = ladder never engaged; N = cycles from storm end back to the
+    # normal rung; None = engaged but never recovered (an SLO violation
+    # when the spec bounds recovery).
+    ladder_recovery_cycles: Optional[int] = 0
+    requeue_amplification: float = 0.0
+    counters: dict = field(default_factory=dict)
+    violations: list = field(default_factory=list)
+    slo: Optional[SLOSpec] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict:
+        return {
+            "scenario": self.name, "seed": self.seed, "scale": self.scale,
+            "backend": dict(self.backend),
+            "cycles": self.cycles, "duration_s": self.duration_s,
+            "submitted": self.submitted, "admitted": self.admitted,
+            "admissions": self.admissions, "evictions": self.evictions,
+            "starved": sorted(self.starved),
+            "class_p99_tta_s": {k: round(v, 3)
+                                for k, v in self.class_p99_tta_s.items()},
+            "ladder_recovery_cycles": self.ladder_recovery_cycles,
+            "requeue_amplification": round(self.requeue_amplification, 3),
+            "counters": dict(self.counters),
+            "ok": self.ok, "violations": list(self.violations),
+        }
+
+
+def _backend_info() -> dict:
+    """Best-effort backend stamp (matches bench.py's BACKEND shape);
+    scenarios never dispatch to a device, so this is provenance only."""
+    try:
+        import jax
+        return {"backend": jax.default_backend(), "cpu_fallback": False}
+    except Exception:
+        return {"backend": "none", "cpu_fallback": False}
+
+
+# ----------------------------------------------------------------------
+# harness
+# ----------------------------------------------------------------------
+
+class ScenarioHarness:
+    """Drives one KueueManager (plus optional MultiKueue workers)
+    through a TraceArrival list on the shared FakeClock.
+
+    The harness plays the job-framework role for plain Workloads: it
+    completes evictions (unset reservation + Requeued=False, the way
+    jobframework stopJob does), finishes runs after their trace
+    runtime, and flips PodsReady per the scenario's policy. Workloads
+    created through a job integration (scenario f) are left to the real
+    reconcilers.
+    """
+
+    def __init__(self, name: str, seed: int, *, tenants: int,
+                 quota_units: int, cohorts: int = 1,
+                 cfg: Optional[cfgpkg.Configuration] = None,
+                 cycle_s: float = 5.0,
+                 reclaim_within_cohort: str = api.PREEMPTION_ANY,
+                 remote_clusters: Optional[list] = None,
+                 mk_check: bool = False):
+        from kueue_tpu.manager import KueueManager
+        self.name = name
+        self.seed = seed
+        self.tenants = tenants
+        self.cycle_s = cycle_s
+        self.clock = FakeClock(1000.0)
+        self.workers: dict = {}
+        for cname in remote_clusters or []:
+            # Workers carry the SAME tenant layout: a mirror keeps the
+            # origin's LocalQueue name, so it only queues on a worker
+            # that has that queue (reference: identical object names
+            # across the fleet, SURVEY.md §2.7).
+            worker = KueueManager(clock=self.clock)
+            self._create_capacity(worker, tenants, quota_units, cohorts,
+                                  reclaim_within_cohort)
+            self.workers[cname] = worker
+        self.mgr = KueueManager(
+            cfg=cfg, clock=self.clock,
+            remote_clusters=self.workers or None)
+        check_names = []
+        if mk_check:
+            from kueue_tpu.api import autoscaling as asapi
+            from kueue_tpu.controller.admissionchecks.multikueue import \
+                CONTROLLER_NAME as MK_CONTROLLER
+            for cname in self.workers:
+                self.mgr.store.create(asapi.MultiKueueCluster(
+                    metadata=ObjectMeta(name=cname)))
+            self.mgr.store.create(asapi.MultiKueueConfig(
+                metadata=ObjectMeta(name="mk-config"),
+                spec=asapi.MultiKueueConfigSpec(clusters=list(self.workers))))
+            ac = api.AdmissionCheck(metadata=ObjectMeta(name="mk-check"))
+            ac.spec.controller_name = MK_CONTROLLER
+            ac.spec.parameters = api.AdmissionCheckParametersReference(
+                kind="MultiKueueConfig", name="mk-config")
+            self.mgr.store.create(ac)
+            check_names = ["mk-check"]
+        self._create_capacity(self.mgr, tenants, quota_units, cohorts,
+                              reclaim_within_cohort, check_names)
+        self.mgr.run_until_idle()
+
+        self._seq = 0
+        self.cycles = 0
+        self.t0 = self.clock.now()
+        self.arrival_info: dict = {}   # object name -> TraceArrival
+        self.submitted = 0
+        self.first_admit: dict = {}    # workload name -> tta (virtual s)
+        self.kind_of_wl: dict = {}     # workload name -> owner kind
+        self.class_of_wl: dict = {}    # workload name -> priority class
+        self.tenant_of_wl: dict = {}   # workload name -> tenant index
+        self.admissions = 0
+        self._reserved: set = set()
+        self._finish_at: dict = {}     # workload name -> virtual due time
+        self._ready_at: dict = {}      # workload name -> virtual due time
+        # policy(workload_name) -> delay after admission until
+        # PodsReady=True, or None = pods never become ready.
+        self.pods_ready_policy: Optional[Callable[[str], Optional[float]]] = None
+        self.requeue_ats: list = []    # observed requeue_state.requeue_at
+        # ladder bookkeeping (cycles from storm end to the normal rung)
+        self._storm_end_cycle: Optional[int] = None
+        self._ladder_engaged = False
+        self._ladder_recovery: Optional[int] = None
+
+    # -- cluster construction ------------------------------------------
+
+    @staticmethod
+    def _create_capacity(mgr, tenants: int, quota_units: int, cohorts: int,
+                         reclaim: str, check_names: list = ()) -> None:
+        rf = api.ResourceFlavor(metadata=ObjectMeta(name="default",
+                                                    uid="rf-default"))
+        mgr.store.create(rf)
+        for t in range(tenants):
+            cq = api.ClusterQueue(metadata=ObjectMeta(
+                name=f"cq-t{t}", uid=f"cq-t{t}"))
+            cq.spec.namespace_selector = LabelSelector()
+            cq.spec.cohort = f"cohort-{t % cohorts}"
+            cq.spec.resource_groups.append(api.ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[api.FlavorQuotas(name="default", resources=[
+                    api.ResourceQuota(name="cpu",
+                                      nominal_quota=quota_units * UNIT)])]))
+            cq.spec.preemption = api.ClusterQueuePreemption(
+                reclaim_within_cohort=reclaim)
+            if check_names:
+                cq.spec.admission_checks = list(check_names)
+            mgr.store.create(cq)
+            lq = api.LocalQueue(metadata=ObjectMeta(
+                name=f"lq-t{t}", namespace="default", uid=f"lq-t{t}"))
+            lq.spec.cluster_queue = f"cq-t{t}"
+            mgr.store.create(lq)
+        mgr.run_until_idle()
+
+    # -- traffic -------------------------------------------------------
+
+    def set_phase(self, tag: str) -> None:
+        """Stamp subsequent cycle traces with a scenario phase tag (the
+        flight-recorder windowing handle for SLO evaluation)."""
+        self.mgr.flight_recorder.set_tag(tag)
+
+    def mark_storm_end(self) -> None:
+        self._storm_end_cycle = self.cycles
+        self.set_phase("recovery")
+
+    def submit(self, arr: TraceArrival) -> None:
+        self._seq += 1
+        name = f"{arr.kind}{self._seq}-t{arr.tenant}"
+        now = self.clock.now()
+        self.arrival_info[name] = arr
+        self.submitted += 1
+        builder = _BUILDERS[arr.kind]
+        self.mgr.store.create(builder(name, f"lq-t{arr.tenant}", arr, now))
+
+    # -- the cycle loop ------------------------------------------------
+
+    def run(self, arrivals: list, duration_s: float,
+            hooks: Optional[list] = None) -> None:
+        """Feed ``arrivals`` (sorted TraceArrivals, at_s relative to run
+        start) over ``duration_s`` virtual seconds of scheduler cycles.
+        ``hooks`` is a list of (at_s, fn) fired once when the virtual
+        offset is reached — quota edits, cluster loss, phase flips."""
+        pending = sorted(arrivals, key=lambda a: a.at_s)
+        hooks = sorted(hooks or [], key=lambda h: h[0])
+        start = self.clock.now()
+        i = h = 0
+        while self.clock.now() - start < duration_s:
+            offset = self.clock.now() - start
+            while h < len(hooks) and hooks[h][0] <= offset:
+                hooks[h][1]()
+                h += 1
+            while i < len(pending) and pending[i].at_s <= offset:
+                self.submit(pending[i])
+                i += 1
+            self.step()
+        while i < len(pending):   # stragglers past the window
+            self.submit(pending[i])
+            i += 1
+        while h < len(hooks):
+            hooks[h][1]()
+            h += 1
+
+    def drain(self, max_cycles: int = 120) -> None:
+        """Keep cycling with no new arrivals until every submitted
+        workload is finished or holding a reservation (requeue backoffs
+        have flushed), or the cycle cap is hit."""
+        for _ in range(max_cycles):
+            if self._settled():
+                return
+            self.step()
+
+    def _settled(self) -> bool:
+        for wl in self.mgr.store.list("Workload", copy_objects=False):
+            if wlpkg.is_finished(wl) or not wlpkg.is_active(wl):
+                continue
+            if not wlpkg.has_quota_reservation(wl):
+                return False
+            if wlpkg.is_evicted(wl):
+                return False   # eviction still completing
+        return True
+
+    def step(self) -> None:
+        self.mgr.run_until_idle()
+        self.mgr.scheduler.schedule(timeout=0)
+        self.mgr.run_until_idle()
+        for worker in self.workers.values():
+            worker.scheduler.schedule(timeout=0)
+            worker.run_until_idle()
+        if self.workers:
+            self.mgr.run_until_idle()
+        self._observe()
+        self.cycles += 1
+        self._track_ladder()
+        self.mgr.advance(self.cycle_s)
+        for worker in self.workers.values():
+            worker.runtime.advance(0.0)
+        if self.workers:
+            self.mgr.run_until_idle()
+
+    # -- observation: the job-framework role for plain workloads -------
+
+    def _observe(self) -> None:
+        now = self.clock.now()
+        store = self.mgr.store
+        for wl in store.list("Workload", copy_objects=False):
+            name = wl.metadata.name
+            reserved = wlpkg.has_quota_reservation(wl)
+            if reserved and name not in self._reserved:
+                self._reserved.add(name)
+                self.admissions += 1
+                arr = self._arrival_for(wl)
+                if name not in self.first_admit:
+                    qr = find_condition(wl.status.conditions,
+                                        api.WORKLOAD_QUOTA_RESERVED)
+                    t_adm = qr.last_transition_time if qr else now
+                    self.first_admit[name] = max(
+                        0.0, t_adm - wl.metadata.creation_timestamp)
+                    self.kind_of_wl[name] = self._wl_kind(wl)
+                    if arr is not None:
+                        self.class_of_wl[name] = arr.class_name
+                        self.tenant_of_wl[name] = arr.tenant
+                if arr is not None and arr.runtime_s > 0:
+                    self._finish_at[name] = now + arr.runtime_s
+                if self.pods_ready_policy is not None:
+                    delay = self.pods_ready_policy(name)
+                    if delay is not None:
+                        self._ready_at[name] = now + delay
+                    else:
+                        self._ready_at.pop(name, None)
+            elif not reserved and name in self._reserved:
+                self._reserved.discard(name)
+                self._finish_at.pop(name, None)
+                self._ready_at.pop(name, None)
+            if reserved and wlpkg.is_evicted(wl) and self._is_plain(wl):
+                self._complete_eviction(name, now)
+                self._reserved.discard(name)
+                self._finish_at.pop(name, None)
+                self._ready_at.pop(name, None)
+        for name, due in list(self._ready_at.items()):
+            if due <= now:
+                del self._ready_at[name]
+                self._set_pods_ready(name, now)
+        for name, due in list(self._finish_at.items()):
+            if due <= now:
+                del self._finish_at[name]
+                self._finish(name, now)
+
+    @staticmethod
+    def _is_plain(wl) -> bool:
+        return not wl.metadata.owner_references
+
+    @staticmethod
+    def _wl_kind(wl) -> str:
+        owner = next((o for o in wl.metadata.owner_references
+                      if o.controller), None)
+        return owner.kind if owner is not None else "workload"
+
+    def _arrival_for(self, wl) -> Optional[TraceArrival]:
+        """The trace arrival behind a workload: direct for plain
+        workloads, via the owning job object's name for job-created
+        ones (the jobframework generates the workload name)."""
+        owner = next((o for o in wl.metadata.owner_references
+                      if o.controller), None)
+        key = owner.name if owner is not None else wl.metadata.name
+        return self.arrival_info.get(key)
+
+    def _complete_eviction(self, name: str, now: float) -> None:
+        """The job side of an eviction (jobframework stopJob /
+        util.FinishEvictionForWorkloads): unset the reservation, set
+        Requeued=False with the eviction reason."""
+        store = self.mgr.store
+        wl = store.try_get("Workload", "default", name)
+        if wl is None:
+            return
+        evicted = find_condition(wl.status.conditions, api.WORKLOAD_EVICTED)
+        if evicted is None or evicted.status != "True":
+            return
+        if wl.status.requeue_state is not None \
+                and wl.status.requeue_state.requeue_at is not None:
+            self.requeue_ats.append(wl.status.requeue_state.requeue_at)
+        wlpkg.unset_quota_reservation_with_condition(
+            wl, "Pending", "The workload was evicted", now)
+        # Requeued=True immediately only for preemption/check evictions;
+        # other reasons wait for their own trigger — the pods-ready
+        # backoff expiry, reactivation (jobframework reconciler :443-449
+        # mirrors the reference). Getting this wrong strands a
+        # MultiKueue worker-lost Retry as pending-forever.
+        requeue_now = evicted.reason in (api.EVICTED_BY_PREEMPTION,
+                                         api.EVICTED_BY_ADMISSION_CHECK)
+        wlpkg.set_requeued_condition(wl, evicted.reason, evicted.message,
+                                     requeue_now, now)
+        store.update(wl)
+
+    def _set_pods_ready(self, name: str, now: float) -> None:
+        wl = self.mgr.store.try_get("Workload", "default", name)
+        if wl is None or not wlpkg.has_quota_reservation(wl):
+            return
+        set_condition(wl.status.conditions, Condition(
+            type=api.WORKLOAD_PODS_READY, status="True", reason="PodsReady",
+            message="All pods reached readiness"), now)
+        self.mgr.store.update(wl)
+
+    def _finish(self, name: str, now: float) -> None:
+        """Mark a run complete. Plain workloads get the Finished
+        condition directly; job-owned workloads are finished through
+        their framework object so the real reconcile path runs."""
+        store = self.mgr.store
+        wl = store.try_get("Workload", "default", name)
+        if wl is None or not wlpkg.has_quota_reservation(wl) \
+                or wlpkg.is_finished(wl):
+            return
+        owner = next((o for o in wl.metadata.owner_references
+                      if o.controller), None)
+        if owner is None:
+            set_condition(wl.status.conditions, Condition(
+                type=api.WORKLOAD_FINISHED, status="True", reason="Succeeded",
+                message="run complete"), now)
+            store.update(wl)
+            return
+        _FINISHERS.get(owner.kind, _finish_noop)(store, owner.name, now)
+
+    # -- ladder --------------------------------------------------------
+
+    def _track_ladder(self) -> None:
+        ladder = getattr(self.mgr.scheduler, "ladder", None)
+        if ladder is None:
+            return
+        from kueue_tpu.resilience.degrade import NORMAL
+        if ladder.state != NORMAL:
+            self._ladder_engaged = True
+        elif (self._ladder_engaged and self._ladder_recovery is None
+                and self._storm_end_cycle is not None):
+            self._ladder_recovery = self.cycles - self._storm_end_cycle
+
+    # -- result assembly -----------------------------------------------
+
+    def result(self, scale: str, slo: SLOSpec,
+               tta_filter: Optional[Callable[[str], bool]] = None,
+               tta_scope: str = "") -> ScenarioResult:
+        """Evaluate the run against ``slo``. ``tta_filter`` narrows the
+        per-class p99 population (e.g. non-storm tenants in
+        tenant_storm — the storm tenant's self-inflicted queueing is
+        reported in counters, not gated)."""
+        res = ScenarioResult(name=self.name, seed=self.seed, scale=scale,
+                             backend=_backend_info())
+        res.cycles = self.cycles
+        res.duration_s = self.clock.now() - self.t0
+        res.submitted = self.submitted
+        res.admitted = len(self.first_admit)
+        res.admissions = self.admissions
+        res.evictions = self.mgr.recorder.count_by_reason_prefix("EvictedDueTo")
+        res.slo = slo
+
+        by_class: dict = {}
+        for name, tta in self.first_admit.items():
+            if tta_filter is not None and not tta_filter(name):
+                continue
+            cls = self.class_of_wl.get(name, "standard")
+            by_class.setdefault(cls, []).append(tta)
+        res.class_p99_tta_s = {cls: _p99(v) for cls, v in by_class.items()}
+        if tta_scope:
+            res.counters["tta_scope"] = tta_scope
+
+        # Starved = still eligible at scenario end (post-drain) without
+        # a place: never admitted, OR evicted and never re-admitted (a
+        # first-admission check alone would mask an eviction wave that
+        # strands its victims as pending-forever — exactly the
+        # MultiKueue worker-lost livelock shape).
+        res.starved = [wl.metadata.name
+                       for wl in self.mgr.store.list("Workload",
+                                                     copy_objects=False)
+                       if wlpkg.is_active(wl)
+                       and not wlpkg.is_finished(wl)
+                       and (wl.metadata.name not in self.first_admit
+                            or not wlpkg.has_quota_reservation(wl))]
+
+        if res.admitted:
+            res.requeue_amplification = \
+                (res.admissions + res.evictions) / res.admitted
+        if self._ladder_engaged:
+            res.ladder_recovery_cycles = self._ladder_recovery
+        else:
+            res.ladder_recovery_cycles = 0
+
+        res.violations = check_slo(res, slo)
+        return res
+
+
+def _p99(values: list) -> float:
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
+
+
+# ----------------------------------------------------------------------
+# object builders (one per arrival kind)
+# ----------------------------------------------------------------------
+
+def _pod_template(units: int) -> PodTemplateSpec:
+    return PodTemplateSpec(spec=PodSpec(containers=[
+        Container(name="c", requests={"cpu": units * UNIT})]))
+
+
+def _build_workload(name, lq, arr, now):
+    wl = api.Workload(metadata=ObjectMeta(
+        name=name, namespace="default", uid=f"wl-{name}",
+        creation_timestamp=now,
+        labels={CLASS_LABEL: arr.class_name,
+                TENANT_LABEL: str(arr.tenant)}))
+    wl.spec.queue_name = lq
+    wl.spec.priority = arr.priority
+    wl.spec.pod_sets.append(api.PodSet(
+        name="main", count=1, template=_pod_template(arr.request)))
+    return wl
+
+
+def _job_meta(name, lq, arr, now):
+    return ObjectMeta(
+        name=name, namespace="default", creation_timestamp=now,
+        labels={api.QUEUE_LABEL: lq, CLASS_LABEL: arr.class_name,
+                TENANT_LABEL: str(arr.tenant)})
+
+
+def _build_job(name, lq, arr, now):
+    job = batchv1.Job(metadata=_job_meta(name, lq, arr, now))
+    job.spec.suspend = True
+    job.spec.parallelism = 1
+    job.spec.template = _pod_template(arr.request)
+    return job
+
+
+def _build_jobset(name, lq, arr, now):
+    js = jobsetapi.JobSet(metadata=_job_meta(name, lq, arr, now))
+    js.spec.suspend = True
+    js.spec.replicated_jobs = [
+        jobsetapi.ReplicatedJob(
+            name="leader", replicas=1,
+            template=batchv1.JobSpec(parallelism=1,
+                                     template=_pod_template(arr.request))),
+        jobsetapi.ReplicatedJob(
+            name="workers", replicas=1,
+            template=batchv1.JobSpec(parallelism=1,
+                                     template=_pod_template(arr.request))),
+    ]
+    return js
+
+
+def _build_pytorch(name, lq, arr, now):
+    pj = kf.PyTorchJob(metadata=_job_meta(name, lq, arr, now))
+    pj.spec.run_policy.suspend = True
+    pj.spec.replica_specs = {
+        "Master": kf.ReplicaSpec(replicas=1,
+                                 template=_pod_template(arr.request)),
+        "Worker": kf.ReplicaSpec(replicas=1,
+                                 template=_pod_template(arr.request)),
+    }
+    return pj
+
+
+def _build_ray(name, lq, arr, now):
+    rj = rayapi.RayJob(metadata=_job_meta(name, lq, arr, now))
+    rj.spec.suspend = True
+    rj.spec.ray_cluster_spec = rayapi.RayClusterSpec(
+        head_group_spec=rayapi.HeadGroupSpec(
+            template=_pod_template(arr.request)),
+        worker_group_specs=[rayapi.WorkerGroupSpec(
+            group_name="workers", replicas=1,
+            template=_pod_template(arr.request))])
+    return rj
+
+
+_BUILDERS = {
+    "workload": _build_workload,
+    "job": _build_job,
+    "jobset": _build_jobset,
+    "pytorch": _build_pytorch,
+    "ray": _build_ray,
+}
+
+
+def _finish_job(store, name, now):
+    job = store.try_get("Job", "default", name)
+    if job is None:
+        return
+    job.status.conditions.append(Condition(
+        type=batchv1.JOB_COMPLETE, status="True", message="done"))
+    store.update(job)
+
+
+def _finish_pytorch(store, name, now):
+    pj = store.try_get("PyTorchJob", "default", name)
+    if pj is None:
+        return
+    pj.status.conditions.append(Condition(
+        type=kf.JOB_SUCCEEDED, status="True", message="done"))
+    store.update(pj)
+
+
+def _finish_jobset(store, name, now):
+    js = store.try_get("JobSet", "default", name)
+    if js is None:
+        return
+    js.status.conditions.append(Condition(
+        type=jobsetapi.JOBSET_COMPLETED, status="True", message="done"))
+    store.update(js)
+
+
+def _finish_ray(store, name, now):
+    rj = store.try_get("RayJob", "default", name)
+    if rj is None:
+        return
+    rj.status.job_status = "SUCCEEDED"
+    store.update(rj)
+
+
+def _finish_noop(store, name, now):
+    return
+
+
+_FINISHERS = {
+    "Job": _finish_job,
+    "PyTorchJob": _finish_pytorch,
+    "JobSet": _finish_jobset,
+    "RayJob": _finish_ray,
+}
+
+
+# ----------------------------------------------------------------------
+# scenario (a): diurnal wave
+# ----------------------------------------------------------------------
+
+def run_diurnal(seed: int = 0, scale: str = "full") -> ScenarioResult:
+    """Sinusoidal arrival rate with burst harmonics over round-robin
+    tenants. Gates: every workload eventually admits (zero starvation
+    after the drain) with bounded per-class p99 time-to-admission."""
+    p = {"smoke": dict(duration=240.0, tenants=3, quota=10, base=0.12),
+         "full": dict(duration=1200.0, tenants=6, quota=12, base=0.5),
+         }[scale]
+    h = ScenarioHarness("diurnal", seed, tenants=p["tenants"],
+                        quota_units=p["quota"])
+    arrivals = diurnal_trace(seed, duration_s=p["duration"],
+                             tenants=p["tenants"], base_rate=p["base"])
+    h.set_phase("wave")
+    h.run(arrivals, p["duration"])
+    h.set_phase("drain")
+    h.drain()
+    slo = SLOSpec(
+        min_admitted=len(arrivals),
+        class_max_p99_tta_s={"prod": 240.0, "standard": 480.0,
+                             "batch": 900.0},
+        max_requeue_amplification=1.5)
+    return h.result(scale, slo)
+
+
+# ----------------------------------------------------------------------
+# scenario (b): tenant storm
+# ----------------------------------------------------------------------
+
+def run_tenant_storm(seed: int = 0, scale: str = "full") -> ScenarioResult:
+    """One LocalQueue floods while the others trickle. The cohort
+    absorbs the flood through borrowing, and reclaimWithinCohort keeps
+    the trickle tenants whole: the gate is zero cross-tenant starvation
+    and bounded p99 time-to-admission for the NON-storm tenants (the
+    storm tenant's self-inflicted backlog is reported, not gated)."""
+    p = {"smoke": dict(duration=300.0, tenants=4, quota=6, storm=40),
+         "full": dict(duration=900.0, tenants=8, quota=8, storm=200),
+         }[scale]
+    h = ScenarioHarness("tenant_storm", seed, tenants=p["tenants"],
+                        quota_units=p["quota"])
+    arrivals = storm_trace(seed, duration_s=p["duration"],
+                           tenants=p["tenants"], storm_tenant=0,
+                           storm_at_s=60.0, storm_count=p["storm"])
+    h.set_phase("trickle")
+    h.run(arrivals, p["duration"],
+          hooks=[(60.0, lambda: h.set_phase("storm")),
+                 (75.0, h.mark_storm_end)])
+    h.set_phase("drain")
+    h.drain(max_cycles=240)
+
+    def non_storm(name: str) -> bool:
+        return h.tenant_of_wl.get(name) != 0
+    storm_ttas = [t for n, t in h.first_admit.items()
+                  if h.tenant_of_wl.get(n) == 0]
+    slo = SLOSpec(
+        min_admitted=len(arrivals),
+        class_max_p99_tta_s={"prod": 120.0, "standard": 300.0,
+                             "batch": 600.0},
+        max_requeue_amplification=2.0)
+    res = h.result(scale, slo, tta_filter=non_storm,
+                   tta_scope="non-storm tenants (t1..)")
+    res.counters["storm_tenant_p99_tta_s"] = \
+        round(_p99(storm_ttas), 3) if storm_ttas else None
+    return res
+
+
+# ----------------------------------------------------------------------
+# scenario (c): flavor-quota churn
+# ----------------------------------------------------------------------
+
+def run_flavor_churn(seed: int = 0, scale: str = "full") -> ScenarioResult:
+    """ClusterQueue quota edits mid-traffic: every churn interval one
+    CQ's nominal quota steps through a cycle (same cohort edge), which
+    is exactly the single-CQ structural-epoch path — the snapshot
+    maintainer must serve it via per-CQ partial rebuilds, not
+    full-snapshot rebuilds, while admission stays correct (zero
+    starvation, bounded p99)."""
+    p = {"smoke": dict(duration=300.0, tenants=4, quota=8, interval=30.0),
+         "full": dict(duration=900.0, tenants=8, quota=10, interval=20.0),
+         }[scale]
+    h = ScenarioHarness("flavor_churn", seed, tenants=p["tenants"],
+                        quota_units=p["quota"])
+    arrivals = steady_trace(seed, p["duration"], p["tenants"],
+                            interval_s=25.0)
+    wiggle = [0, 2, 4, 2]  # extra units over nominal, cycled per edit
+
+    edits = {"n": 0}
+
+    def churn():
+        t = edits["n"] % p["tenants"]
+        extra = wiggle[edits["n"] % len(wiggle)]
+        edits["n"] += 1
+        cq = h.mgr.store.get("ClusterQueue", "", f"cq-t{t}")
+        cq.spec.resource_groups[0].flavors[0].resources[0].nominal_quota = \
+            (p["quota"] + extra) * UNIT
+        h.mgr.store.update(cq)
+
+    hooks = [(off, churn) for off in
+             _frange(p["interval"], p["duration"], p["interval"])]
+    h.set_phase("churn")
+    h.run(arrivals, p["duration"], hooks=hooks)
+    h.set_phase("drain")
+    h.drain()
+    slo = SLOSpec(
+        min_admitted=len(arrivals),
+        class_max_p99_tta_s={"prod": 180.0, "standard": 360.0,
+                             "batch": 720.0},
+        max_requeue_amplification=1.5)
+    res = h.result(scale, slo)
+    maint = h.mgr.cache._maintainer
+    res.counters["quota_edits"] = edits["n"]
+    res.counters["partial_rebuilds"] = maint.partial_rebuilds if maint else 0
+    res.counters["full_rebuilds"] = maint.full_rebuilds if maint else 0
+    if maint is not None and maint.partial_rebuilds == 0 and edits["n"]:
+        res.violations.append(
+            "no per-CQ partial rebuilds recorded despite "
+            f"{edits['n']} single-CQ quota edits (maintainer fell back "
+            f"to {maint.full_rebuilds} full rebuilds)")
+    return res
+
+
+def _frange(start: float, stop: float, step: float) -> list:
+    out = []
+    t = start
+    while t < stop:
+        out.append(t)
+        t += step
+    return out
+
+
+# ----------------------------------------------------------------------
+# scenario (d): waitForPodsReady timeout flood
+# ----------------------------------------------------------------------
+
+def run_requeue_flood(seed: int = 0, scale: str = "full") -> ScenarioResult:
+    """A synchronized admission wave whose pods all miss the PodsReady
+    timeout: mass eviction, then the seeded backoff jitter must
+    de-synchronize the requeue storm (distinct requeue_at values, not
+    one thundering herd), the degradation ladder must recover within
+    its budget after the storm, and every workload must re-admit once
+    pods become ready (zero starvation)."""
+    p = {"smoke": dict(tenants=4, per_tenant=5, quota=8, p99=90.0),
+         "full": dict(tenants=8, per_tenant=12, quota=16, p99=150.0),
+         }[scale]
+    cfg = cfgpkg.Configuration(
+        wait_for_pods_ready=cfgpkg.WaitForPodsReady(
+            enable=True, timeout_seconds=30.0, block_admission=False,
+            requeuing_strategy=cfgpkg.RequeuingStrategy(
+                backoff_base_seconds=10, backoff_max_seconds=120)))
+    h = ScenarioHarness("requeue_flood", seed, tenants=p["tenants"],
+                        quota_units=p["quota"], cfg=cfg)
+    from kueue_tpu.resilience.degrade import DegradationLadder
+    ladder = DegradationLadder(budget_s=60.0, shed_heads=4, survival_heads=1,
+                               escalate_after=1, recovery_cycles=2,
+                               ewma_alpha=1.0)
+    h.mgr.scheduler.ladder = ladder
+
+    storm = {"on": True}
+    h.pods_ready_policy = \
+        lambda name: None if storm["on"] else 0.0
+    arrivals = burst_trace(seed, tenants=p["tenants"],
+                           per_tenant=p["per_tenant"], width_s=5.0,
+                           runtime_s=600.0)
+    total = len(arrivals)
+
+    def storm_on():
+        # The flood makes real cycle time irrelevant in virtual time, so
+        # the overload is forced the chaos_run way: a budget every cycle
+        # blows, relaxed at storm end. Ladder dynamics stay deterministic.
+        ladder.budget_s = 1e-9
+        h.set_phase("storm")
+
+    def storm_off():
+        storm["on"] = False
+        ladder.budget_s = 60.0
+        # the infra issue clears: pods of everything still admitted
+        # start reaching readiness
+        now = h.clock.now()
+        for name in list(h._reserved):
+            h._ready_at.setdefault(name, now)
+        h.mark_storm_end()
+
+    h.set_phase("flood")
+    h.run(arrivals, 120.0, hooks=[(10.0, storm_on), (60.0, storm_off)])
+    h.set_phase("drain")
+    h.drain(max_cycles=240)
+
+    slo = SLOSpec(
+        min_admitted=total,
+        # the tail admits under the shed/survival head caps while the
+        # ladder is engaged: p99 covers the degraded-mode queueing AND
+        # the eviction+jittered-backoff lap, which stretches with scale
+        # (more victims -> longer requeue tail), hence per-scale bounds
+        class_max_p99_tta_s={"standard": p["p99"]},
+        max_ladder_recovery_cycles=8,
+        # every workload admits, evicts once, re-admits: amplification
+        # ~3; headroom for a second timeout lap on stragglers
+        max_requeue_amplification=4.0,
+        max_evictions=2 * total)
+    res = h.result(scale, slo)
+    distinct = len(set(h.requeue_ats))
+    spread = (max(h.requeue_ats) - min(h.requeue_ats)) if h.requeue_ats else 0.0
+    res.counters["requeue_ats"] = len(h.requeue_ats)
+    res.counters["requeue_at_distinct"] = distinct
+    res.counters["requeue_at_spread_s"] = round(spread, 3)
+    if h.requeue_ats and distinct < max(2, int(0.7 * len(h.requeue_ats))):
+        res.violations.append(
+            f"requeue backoff jitter failed to de-synchronize the retry "
+            f"storm: {distinct} distinct requeue_at values across "
+            f"{len(h.requeue_ats)} evictions")
+    return res
+
+
+# ----------------------------------------------------------------------
+# scenario (e): MultiKueue worker-cluster loss and rejoin
+# ----------------------------------------------------------------------
+
+def run_cluster_loss(seed: int = 0, scale: str = "full") -> ScenarioResult:
+    """Workloads dispatch to two worker clusters through the MultiKueue
+    admission check; mid-run one worker becomes unreachable. Reserved
+    workloads there must Retry after the worker-lost timeout, re-place
+    on the surviving cluster, and a rejoin must not double-dispatch
+    (sticky placement deletes the stale mirror instead); orphaned
+    mirrors are collected by the periodic GC. Gate: no stuck-pending
+    workloads, exactly one reserving cluster per workload at the end."""
+    p = {"smoke": dict(tenants=2, per_tenant=4, quota=8),
+         "full": dict(tenants=4, per_tenant=10, quota=16),
+         }[scale]
+    cfg = cfgpkg.Configuration()
+    cfg.multi_kueue.worker_lost_timeout_seconds = 30.0
+    cfg.multi_kueue.gc_interval_seconds = 20.0
+    h = ScenarioHarness(
+        "cluster_loss", seed, tenants=p["tenants"], quota_units=p["quota"],
+        cfg=cfg, mk_check=True, remote_clusters=["w1", "w2"])
+    mk = h.mgr.multikueue
+    arrivals = burst_trace(seed, tenants=p["tenants"],
+                           per_tenant=p["per_tenant"], width_s=5.0,
+                           runtime_s=10_000.0)
+    total = len(arrivals)
+
+    state: dict = {}
+
+    def lose():
+        # one local original deleted during the outage: its w1 mirror
+        # becomes a true orphan only the periodic GC can collect
+        on_w1 = [wl.metadata.name
+                 for wl in h.mgr.store.list("Workload", copy_objects=False)
+                 if mk._reserving.get(wlpkg.key(wl)) == "w1"]
+        if on_w1:
+            state["orphan"] = on_w1[0]
+        # the rest must survive the outage by re-placing on w2
+        state["survivors"] = set(on_w1[1:])
+        mk.mark_cluster_lost("w1")
+        h.set_phase("outage")
+        if "orphan" in state:
+            h.mgr.store.delete("Workload", "default", state["orphan"])
+            h.arrival_info.pop(state["orphan"], None)
+            h.submitted -= 1
+
+    def rejoin():
+        mk.mark_cluster_rejoined("w1")
+        h.mark_storm_end()
+
+    h.set_phase("dispatch")
+    h.run(arrivals, 260.0, hooks=[(40.0, lose), (180.0, rejoin)])
+    h.set_phase("drain")
+    h.drain(max_cycles=240)
+
+    slo = SLOSpec(
+        min_admitted=total - (1 if "orphan" in state else 0),
+        class_max_p99_tta_s={"standard": 60.0},
+        max_requeue_amplification=3.0)
+    res = h.result(scale, slo)
+
+    # no-double-dispatch: every live admitted workload is reserved on
+    # exactly ONE worker cluster
+    double, unplaced = [], []
+    w1 = h.workers["w1"]
+    for wl in h.mgr.store.list("Workload", copy_objects=False):
+        if not wlpkg.is_admitted(wl):
+            continue
+        holders = [cn for cn, worker in h.workers.items()
+                   if (rw := worker.store.try_get(
+                       "Workload", "default", wl.metadata.name)) is not None
+                   and wlpkg.has_quota_reservation(rw)]
+        if len(holders) > 1:
+            double.append(wl.metadata.name)
+        elif not holders:
+            unplaced.append(wl.metadata.name)
+    survivors = state.get("survivors", set())
+    relocated = sum(1 for name in survivors
+                    if mk._reserving.get(f"default/{name}") == "w2")
+    res.counters["lost_with_reservation"] = len(survivors)
+    res.counters["relocated"] = relocated
+    res.counters["double_dispatched"] = len(double)
+    res.counters["unplaced_admitted"] = len(unplaced)
+    if survivors and not relocated:
+        res.violations.append(
+            f"worker loss stranded {len(survivors)} reserved workload(s) "
+            "without a single re-placement on the surviving cluster")
+    orphan = state.get("orphan")
+    orphan_collected = orphan is not None and \
+        w1.store.try_get("Workload", "default", orphan) is None
+    res.counters["orphan_candidate"] = orphan is not None
+    res.counters["orphan_collected"] = bool(orphan_collected)
+    if double:
+        res.violations.append(
+            f"double dispatch after rejoin: {sorted(double)[:5]}")
+    if unplaced:
+        res.violations.append(
+            f"admitted locally with no worker reservation: "
+            f"{sorted(unplaced)[:5]}")
+    if orphan is not None and not orphan_collected:
+        res.violations.append(
+            f"orphan mirror {orphan!r} survived the periodic GC")
+    return res
+
+
+# ----------------------------------------------------------------------
+# scenario (f): mixed job-integration traffic
+# ----------------------------------------------------------------------
+
+MIXED_KINDS = ["workload", "job", "jobset", "pytorch", "ray"]
+
+
+def run_mixed_jobs(seed: int = 0, scale: str = "full") -> ScenarioResult:
+    """Job-integration reconcilers (batch Job, JobSet, PyTorchJob,
+    RayJob) under the same trickle as plain Workloads, including an
+    eviction lap per kind (deactivate -> framework completes the
+    eviction -> reactivate -> re-admit). Gate: admission and eviction
+    parity — every kind admits everything it submitted and the evicted
+    sample re-admits, exactly like the plain path."""
+    p = {"smoke": dict(duration=200.0, tenants=5, quota=10),
+         "full": dict(duration=600.0, tenants=10, quota=12),
+         }[scale]
+    cfg = cfgpkg.Configuration(
+        integrations=cfgpkg.Integrations(
+            frameworks=list(cfgpkg.ALL_INTEGRATIONS)))
+    h = ScenarioHarness("mixed_jobs", seed, tenants=p["tenants"],
+                        quota_units=p["quota"], cfg=cfg)
+    arrivals = steady_trace(seed, p["duration"], p["tenants"],
+                            interval_s=20.0, kinds=MIXED_KINDS)
+    state = {"evicted": {}}
+
+    def evict_lap():
+        # deactivate one admitted object of each kind
+        picked = {}
+        for wl in h.mgr.store.list("Workload", copy_objects=False):
+            kind = h.kind_of_wl.get(wl.metadata.name)
+            if kind is None or kind in picked:
+                continue
+            if wlpkg.has_quota_reservation(wl) and wlpkg.is_active(wl):
+                picked[kind] = wl.metadata.name
+        for kind, name in picked.items():
+            wl = h.mgr.store.get("Workload", "default", name)
+            wl.spec.active = False
+            h.mgr.store.update(wl)
+        state["evicted"] = picked
+        h.set_phase("evict-lap")
+
+    def reactivate():
+        for name in state["evicted"].values():
+            wl = h.mgr.store.try_get("Workload", "default", name)
+            if wl is not None and not wl.spec.active:
+                wl = h.mgr.store.get("Workload", "default", name)
+                wl.spec.active = True
+                h.mgr.store.update(wl)
+        h.set_phase("steady")
+
+    h.set_phase("steady")
+    h.run(arrivals, p["duration"],
+          hooks=[(p["duration"] * 0.4, evict_lap),
+                 (p["duration"] * 0.4 + 40.0, reactivate)])
+    h.set_phase("drain")
+    h.drain(max_cycles=240)
+
+    slo = SLOSpec(
+        min_admitted=len(arrivals),
+        class_max_p99_tta_s={"prod": 120.0, "standard": 240.0,
+                             "batch": 480.0},
+        max_requeue_amplification=1.5)
+    res = h.result(scale, slo)
+
+    submitted_by_kind: dict = {}
+    for arr in h.arrival_info.values():
+        submitted_by_kind[arr.kind] = submitted_by_kind.get(arr.kind, 0) + 1
+    admitted_by_kind: dict = {}
+    owner_kind_to_trace = {"Job": "job", "JobSet": "jobset",
+                           "PyTorchJob": "pytorch", "RayJob": "ray",
+                           "workload": "workload"}
+    for name in h.first_admit:
+        kind = owner_kind_to_trace.get(h.kind_of_wl.get(name, "workload"))
+        admitted_by_kind[kind] = admitted_by_kind.get(kind, 0) + 1
+    res.counters["submitted_by_kind"] = submitted_by_kind
+    res.counters["admitted_by_kind"] = admitted_by_kind
+    res.counters["eviction_lap"] = dict(state["evicted"])
+    for kind, n in submitted_by_kind.items():
+        if admitted_by_kind.get(kind, 0) < n:
+            res.violations.append(
+                f"admission parity broken for kind {kind!r}: "
+                f"{admitted_by_kind.get(kind, 0)}/{n} admitted")
+    for kind, name in state["evicted"].items():
+        wl = h.mgr.store.try_get("Workload", "default", name)
+        # finished is fine too: the sample re-admitted, ran, completed
+        if wl is None or not (wlpkg.is_admitted(wl) or wlpkg.is_finished(wl)):
+            res.violations.append(
+                f"eviction parity broken for kind {kind!r}: evicted "
+                f"sample {name!r} did not re-admit after reactivation")
+    return res
+
+
+# ----------------------------------------------------------------------
+# registry
+# ----------------------------------------------------------------------
+
+SCENARIOS = {
+    "diurnal": run_diurnal,
+    "tenant_storm": run_tenant_storm,
+    "flavor_churn": run_flavor_churn,
+    "requeue_flood": run_requeue_flood,
+    "cluster_loss": run_cluster_loss,
+    "mixed_jobs": run_mixed_jobs,
+}
+
+
+def list_scenarios() -> list:
+    return sorted(SCENARIOS)
+
+
+def run_scenario(name: str, seed: int = 0, scale: str = "full") -> ScenarioResult:
+    if name not in SCENARIOS:
+        raise KeyError(f"unknown scenario {name!r}; "
+                       f"one of {', '.join(list_scenarios())}")
+    if scale not in ("smoke", "full"):
+        raise ValueError(f"scale must be 'smoke' or 'full', got {scale!r}")
+    return SCENARIOS[name](seed=seed, scale=scale)

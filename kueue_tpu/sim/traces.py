@@ -1,0 +1,185 @@
+"""Seeded, deterministic traffic traces for the scenario suite.
+
+The perf generator (perf/generator.py) emits the reference harness's
+uniform-interval arrival schedule — fine for throughput measurement,
+nothing like production traffic. Real million-user load is diurnal
+(sinusoidal base rate), bursty (harmonic spikes riding the wave) and
+adversarial (one tenant flooding while others trickle). This module
+produces those shapes as plain arrival lists from a seeded PRNG, so a
+scenario run is reproducible bit-for-bit from (seed, parameters) and a
+failure can be replayed by seed alone.
+
+Arrival times come from an inhomogeneous Poisson process sampled by
+thinning (Lewis & Shedler): draw candidate points at the peak rate,
+keep each with probability rate(t)/rate_max. Priority classes are
+sampled per arrival from a weighted distribution, mirroring the
+small/medium/large class mix of the perf harness.
+
+All times are virtual seconds on the scenario's FakeClock.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+# Priority-class mix: (class name, priority, resource units, runtime s,
+# sample weight). Mirrors the reference harness's small/medium/large
+# shape: many cheap low-priority workloads, few expensive high-priority
+# ones (default_generator_config.yaml:1-28).
+PRIORITY_CLASSES = (
+    ("batch", 0, 1, 30.0, 0.6),
+    ("standard", 50, 2, 60.0, 0.3),
+    ("prod", 100, 4, 90.0, 0.1),
+)
+
+
+@dataclass
+class TraceArrival:
+    """One workload arrival. ``tenant`` indexes the scenario's
+    LocalQueues; ``kind`` selects the object the driver creates
+    ("workload" = a bare Workload; mixed-job scenarios map framework
+    names like "job"/"jobset"/"pytorch"/"ray" to their wrappers)."""
+    at_s: float
+    tenant: int
+    class_name: str
+    priority: int
+    request: int        # abstract resource units (the harness's "cpu")
+    runtime_s: float
+    kind: str = "workload"
+
+
+def _sample_class(rng: random.Random) -> tuple:
+    r = rng.random()
+    acc = 0.0
+    for cls in PRIORITY_CLASSES:
+        acc += cls[4]
+        if r <= acc:
+            return cls
+    return PRIORITY_CLASSES[-1]
+
+
+def poisson_times(rng: random.Random, rate_fn: Callable[[float], float],
+                  rate_max: float, duration_s: float) -> list:
+    """Inhomogeneous Poisson arrival times on [0, duration_s) by
+    thinning: candidates at ``rate_max``, accepted with probability
+    rate_fn(t)/rate_max. ``rate_max`` must dominate rate_fn."""
+    if rate_max <= 0:
+        return []
+    out: list = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate_max)
+        if t >= duration_s:
+            return out
+        if rng.random() * rate_max <= rate_fn(t):
+            out.append(t)
+
+
+def diurnal_rate(base: float, amplitude: float, period_s: float,
+                 bursts: Optional[list] = None) -> tuple:
+    """(rate_fn, rate_max) for a sinusoidal arrival rate with burst
+    harmonics: rate(t) = base * (1 + amplitude * sin(2πt/period)) plus,
+    for each (center_s, width_s, extra) burst, ``extra`` arrivals/s
+    while |t - center| <= width — the traffic spikes riding the diurnal
+    wave."""
+    bursts = bursts or []
+
+    def rate(t: float) -> float:
+        r = base * (1.0 + amplitude * math.sin(2.0 * math.pi * t / period_s))
+        for center, width, extra in bursts:
+            if abs(t - center) <= width:
+                r += extra
+        return max(0.0, r)
+
+    rate_max = base * (1.0 + abs(amplitude)) \
+        + sum(extra for _, _, extra in bursts)
+    return rate, rate_max
+
+
+def diurnal_trace(seed: int, duration_s: float = 600.0, tenants: int = 6,
+                  base_rate: float = 0.4, amplitude: float = 0.8,
+                  period_s: Optional[float] = None,
+                  bursts: Optional[list] = None) -> list:
+    """Scenario (a) traffic: a sinusoidal wave over ``duration_s`` with
+    two default burst harmonics (one near each rate peak), arrivals
+    spread over ``tenants`` round-robin-with-jitter, classes sampled
+    from PRIORITY_CLASSES."""
+    rng = random.Random(seed)
+    period = period_s if period_s is not None else duration_s / 2.0
+    if bursts is None:
+        # one spike per wave period, riding the crest
+        bursts = [(period * (k + 0.25), period * 0.05, base_rate * 3.0)
+                  for k in range(max(1, int(duration_s / period)))]
+    rate_fn, rate_max = diurnal_rate(base_rate, amplitude, period, bursts)
+    out = []
+    for t in poisson_times(rng, rate_fn, rate_max, duration_s):
+        name, prio, req, runtime, _w = _sample_class(rng)
+        out.append(TraceArrival(
+            at_s=t, tenant=rng.randrange(tenants), class_name=name,
+            priority=prio, request=req, runtime_s=runtime))
+    return out
+
+
+def steady_trace(seed: int, duration_s: float, tenants: int,
+                 interval_s: float, jitter: float = 0.25,
+                 kinds: Optional[list] = None) -> list:
+    """A per-tenant steady trickle: one arrival every ``interval_s``
+    per tenant, with ±jitter de-phasing so tenants don't arrive in
+    lockstep. ``kinds`` (optional) cycles arrival kinds per tenant —
+    the mixed-job scenario feeds framework names here."""
+    rng = random.Random(seed)
+    out = []
+    for tenant in range(tenants):
+        t = rng.uniform(0, interval_s)
+        i = 0
+        while t < duration_s:
+            name, prio, req, runtime, _w = _sample_class(rng)
+            kind = kinds[(tenant + i) % len(kinds)] if kinds else "workload"
+            out.append(TraceArrival(
+                at_s=t, tenant=tenant, class_name=name, priority=prio,
+                request=req, runtime_s=runtime, kind=kind))
+            t += interval_s * (1.0 + jitter * (2.0 * rng.random() - 1.0))
+            i += 1
+    out.sort(key=lambda a: a.at_s)
+    return out
+
+
+def storm_trace(seed: int, duration_s: float, tenants: int,
+                storm_tenant: int = 0, storm_at_s: float = 60.0,
+                storm_count: int = 120, storm_width_s: float = 10.0,
+                trickle_interval_s: float = 20.0) -> list:
+    """Scenario (b) traffic: every tenant trickles steadily, and at
+    ``storm_at_s`` the storm tenant floods ``storm_count`` arrivals
+    inside ``storm_width_s`` — the adversarial neighbor whose backlog
+    must not starve anyone else's queue."""
+    rng = random.Random(seed)
+    out = steady_trace(seed + 1, duration_s, tenants, trickle_interval_s)
+    for _ in range(storm_count):
+        name, prio, req, runtime, _w = _sample_class(rng)
+        out.append(TraceArrival(
+            at_s=storm_at_s + rng.uniform(0, storm_width_s),
+            tenant=storm_tenant, class_name=name, priority=prio,
+            request=req, runtime_s=runtime))
+    out.sort(key=lambda a: a.at_s)
+    return out
+
+
+def burst_trace(seed: int, tenants: int, per_tenant: int,
+                at_s: float = 0.0, width_s: float = 5.0,
+                class_name: str = "standard", priority: int = 50,
+                request: int = 1, runtime_s: float = 120.0) -> list:
+    """A synchronized wave: ``per_tenant`` same-class arrivals per
+    tenant inside ``width_s`` — the shape that makes every admitted
+    workload hit a PodsReady timeout (or a lost worker cluster) at
+    nearly the same instant, i.e. the retry-storm seed."""
+    rng = random.Random(seed)
+    out = [TraceArrival(
+        at_s=at_s + rng.uniform(0, width_s), tenant=tenant,
+        class_name=class_name, priority=priority, request=request,
+        runtime_s=runtime_s)
+        for tenant in range(tenants) for _ in range(per_tenant)]
+    out.sort(key=lambda a: a.at_s)
+    return out

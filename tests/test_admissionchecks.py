@@ -283,3 +283,69 @@ class TestMultiKueue:
         assert removed >= 0
         assert worker1.store.try_get("Workload", "default", "w") is None
         assert worker2.store.try_get("Workload", "default", "w") is None
+
+    def test_periodic_gc_timer_collects_stale_mirror(self, clock):
+        # A mirror stamped with our origin whose local original vanished
+        # DURING a worker outage: event-driven reconcile can't touch the
+        # lost cluster (and nothing re-enqueues the key on rejoin, the
+        # local object is gone), so only the periodic runtime timer
+        # (manager.py wires gc_orphans at gcInterval) can collect it.
+        manager, worker1, worker2 = self.make_clusters(clock)
+        mk = manager.multikueue
+        mk.mark_cluster_lost("worker1")
+        manager.run_until_idle()
+        stale = WorkloadWrapper("stale").queue("lq").request("cpu", "2").obj()
+        stale.metadata.labels[ORIGIN_LABEL] = "multikueue"
+        worker1.store.create(stale)
+        manager.run_until_idle()
+        assert worker1.store.try_get("Workload", "default", "stale") is not None
+        mk.mark_cluster_rejoined("worker1")
+        manager.run_until_idle()
+        # rejoin re-enqueues local workloads only; the orphan has none
+        assert worker1.store.try_get("Workload", "default", "stale") is not None
+        manager.advance(cfgpkg.DEFAULT_MULTIKUEUE_GC_INTERVAL_SECONDS + 1)
+        assert worker1.store.try_get("Workload", "default", "stale") is None
+
+    def test_cluster_loss_replaces_then_rejoin_no_double_dispatch(self, clock):
+        manager, worker1, worker2 = self.make_clusters(clock)
+        mk = manager.multikueue
+        manager.store.create(
+            WorkloadWrapper("w").queue("lq").request("cpu", "2").obj())
+        manager.schedule_until_settled()
+        self.run_all(manager, worker1, worker2)
+        winner_name, winner = next(
+            (n, w) for n, w in (("worker1", worker1), ("worker2", worker2))
+            if w.store.try_get("Workload", "default", "w") is not None)
+        other_name, other = next(
+            (n, w) for n, w in (("worker1", worker1), ("worker2", worker2))
+            if n != winner_name)
+
+        mk.mark_cluster_lost(winner_name)
+        manager.run_until_idle()
+        # before the worker-lost timeout: still Ready, no churn
+        wl = manager.store.get("Workload", "default", "w")
+        assert wlpkg.find_admission_check(wl, "mk-check").state \
+            == api.CHECK_STATE_READY
+        # past the timeout: Retry -> eviction -> checks reset -> the
+        # workload re-places on the surviving cluster
+        manager.advance(15 * 60.0 + 1)
+        for _ in range(4):
+            manager.schedule_until_settled()
+            other.schedule_until_settled()
+            manager.run_until_idle()
+        wl = manager.store.get("Workload", "default", "w")
+        assert wlpkg.is_admitted(wl), wl.status.admission_checks
+        remote = other.store.get("Workload", "default", "w")
+        assert wlpkg.has_quota_reservation(remote)
+
+        # the lost cluster rejoins holding its stale reserved mirror:
+        # sticky placement keeps the workload on the survivor and the
+        # stale mirror is deleted — never a second dispatch
+        mk.mark_cluster_rejoined(winner_name)
+        self.run_all(manager, worker1, worker2)
+        holders = [n for n, w in (("worker1", worker1), ("worker2", worker2))
+                   if (rw := w.store.try_get("Workload", "default", "w"))
+                   is not None and wlpkg.has_quota_reservation(rw)]
+        assert holders == [other_name], holders
+        wl = manager.store.get("Workload", "default", "w")
+        assert wlpkg.is_admitted(wl)

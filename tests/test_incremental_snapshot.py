@@ -216,9 +216,112 @@ class TestRandomizedEquivalence:
         for step in range(400):
             rng.choice(ops)()
             check(cache, f"step {step}")
-        # both paths actually exercised
+        # all three paths actually exercised (single-CQ quota edits now
+        # take the per-CQ partial rebuild instead of a full rebuild)
         assert cache.snapshot_stats["incremental"] > 50, cache.snapshot_stats
         assert cache.snapshot_stats["full"] > 5, cache.snapshot_stats
+        assert cache.snapshot_stats["partial"] > 3, cache.snapshot_stats
+
+    def test_single_cq_edit_storm_stays_partial(self):
+        # Randomized replay==rebuild equivalence focused on the per-CQ
+        # path: ONLY workload deltas and single-CQ quota edits (the
+        # flavor-churn scenario's steady diet) — every structural sync
+        # must take the partial path, never a full rebuild.
+        rng = random.Random(777)
+        cache = build_cache()
+        check(cache, "initial")
+        full_before = cache.snapshot_stats["full"]
+        admitted: list = []
+        nominal = {i: 10 for i in range(6)}
+        for step in range(120):
+            roll = rng.random()
+            if roll < 0.5:
+                wl = admitted_workload(f"p{step}", f"cq{rng.randrange(6)}",
+                                       rng.randint(1, 4),
+                                       flavor=rng.choice(["f0", "f1"]))
+                cache.add_or_update_workload(wl)
+                admitted.append(wl)
+            elif roll < 0.7 and admitted:
+                cache.delete_workload(admitted.pop(
+                    rng.randrange(len(admitted))))
+            else:
+                i = rng.randrange(6)
+                nominal[i] += rng.choice([-1, 1, 2])  # always a real change
+                lending = {1: 4, 3: 2}.get(i)
+                cohort = {0: "left", 1: "left", 2: "left",
+                          3: "right", 4: "right", 5: ""}[i]
+                cache.update_cluster_queue(make_cq(
+                    f"cq{i}", cohort, nominal=nominal[i], lending=lending))
+            check(cache, f"partial-storm step {step}")
+        assert cache.snapshot_stats["full"] == full_before, \
+            cache.snapshot_stats
+        assert cache.snapshot_stats["partial"] > 20, cache.snapshot_stats
+
+    def test_multiple_dirty_cqs_rebuild_in_one_partial_sync(self):
+        cache = build_cache()
+        cache.add_or_update_workload(admitted_workload("w1", "cq0", 3))
+        cache.add_or_update_workload(admitted_workload("w2", "cq3", 2))
+        check(cache, "pre")
+        partial_before = cache.snapshot_stats["partial"]
+        full_before = cache.snapshot_stats["full"]
+        # two single-CQ edits in different cohorts before the next sync,
+        # plus an interleaved workload delta that must still replay
+        cache.update_cluster_queue(make_cq("cq0", "left", nominal=14))
+        cache.add_or_update_workload(admitted_workload("w3", "cq4", 1))
+        cache.update_cluster_queue(make_cq("cq3", "right", nominal=7,
+                                           lending=2))
+        snap = check(cache, "two dirty CQs")
+        assert cache.snapshot_stats["partial"] == partial_before + 1
+        assert cache.snapshot_stats["full"] == full_before
+        live = cache.hm.cluster_queues
+        for name in ("cq0", "cq3"):
+            assert snap.cluster_queues[name].resource_node.quotas \
+                == live[name].resource_node.quotas, name
+        assert "default/w3" in snap.cluster_queues["cq4"].workloads
+
+    def test_cohort_edge_move_falls_back_to_full(self):
+        cache = build_cache()
+        check(cache, "pre")
+        full_before = cache.snapshot_stats["full"]
+        # same quota, different cohort: the graph shape changed, the
+        # per-CQ path must not claim it
+        cache.update_cluster_queue(make_cq("cq0", "right"))
+        check(cache, "edge move")
+        assert cache.snapshot_stats["full"] == full_before + 1
+
+    def test_cq_edit_mixed_with_wider_epoch_falls_back_to_full(self):
+        cache = build_cache()
+        check(cache, "pre")
+        full_before = cache.snapshot_stats["full"]
+        partial_before = cache.snapshot_stats["partial"]
+        # a single-CQ edit AND a flavor-spec change between syncs: the
+        # dirty-CQ scope is subsumed by the full rebuild
+        cache.update_cluster_queue(make_cq("cq1", "left", nominal=13,
+                                           lending=4))
+        cache.add_or_update_resource_flavor(
+            make_flavor("f1", node_labels={"zone": "z9"}))
+        check(cache, "mixed")
+        assert cache.snapshot_stats["full"] == full_before + 1
+        assert cache.snapshot_stats["partial"] == partial_before
+        # and the dirty set was consumed: the next single-CQ edit is
+        # partial again, not poisoned by the stale scope
+        cache.update_cluster_queue(make_cq("cq1", "left", nominal=9,
+                                           lending=4))
+        check(cache, "post-mixed edit")
+        assert cache.snapshot_stats["partial"] == partial_before + 1
+
+    def test_terminate_cluster_queue_takes_partial_path(self):
+        cache = build_cache()
+        cache.add_or_update_workload(admitted_workload("w1", "cq2", 2))
+        check(cache, "pre")
+        partial_before = cache.snapshot_stats["partial"]
+        cache.terminate_cluster_queue("cq2")
+        snap = check(cache, "terminated")
+        assert cache.snapshot_stats["partial"] == partial_before + 1
+        # terminating flips the CQ inactive: hidden from the handout,
+        # usage still bubbling through its cohort (hidden master)
+        assert "cq2" not in snap.cluster_queues
+        assert "cq2" in snap.inactive_cluster_queue_sets
 
     def test_journal_overflow_falls_back_to_rebuild(self):
         cache = build_cache()

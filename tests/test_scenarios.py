@@ -1,0 +1,346 @@
+"""Production-realism scenario suite (sim/scenarios.py, sim/traces.py,
+perf.checker SLO layer; catalog in sim/SCENARIOS.md).
+
+Tier-1 runs every scenario at ``smoke`` scale — seeded, virtual-time,
+each well under a second — plus the trace-generator determinism
+contract, the SLOSpec gate units, and the bounded EventRecorder ring.
+The ``slow`` sweep re-runs the catalog at ``full`` scale (the bench
+``scenario_slo`` row independently pins the two SURVEY §5 failure
+scenarios every round).
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from kueue_tpu.api.meta import ObjectMeta
+from kueue_tpu.perf.checker import SLOSpec, check_slo, refuse_cross_backend
+from kueue_tpu.sim.runtime import EventRecorder
+from kueue_tpu.sim.scenarios import (ScenarioResult, list_scenarios,
+                                     run_scenario)
+from kueue_tpu.sim.traces import (TraceArrival, burst_trace, diurnal_trace,
+                                  steady_trace, storm_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# traces: seeded determinism and shape
+# ----------------------------------------------------------------------
+
+class TestTraces:
+    GENERATORS = [
+        lambda s: diurnal_trace(s, duration_s=300.0, tenants=4,
+                                base_rate=0.3),
+        lambda s: steady_trace(s, 300.0, 4, interval_s=20.0),
+        lambda s: storm_trace(s, 300.0, 4, storm_count=30),
+        lambda s: burst_trace(s, tenants=3, per_tenant=5),
+    ]
+
+    def test_same_seed_same_trace_different_seed_different(self):
+        for gen in self.GENERATORS:
+            assert gen(7) == gen(7)
+            assert gen(7) != gen(8)
+
+    def test_arrivals_sorted_and_in_window(self):
+        for gen in self.GENERATORS:
+            arrivals = gen(3)
+            assert arrivals
+            ats = [a.at_s for a in arrivals]
+            assert ats == sorted(ats)
+            assert all(a.tenant in range(4) or isinstance(a, TraceArrival)
+                       for a in arrivals)
+
+    def test_diurnal_wave_modulates_rate(self):
+        # amplitude 1 zeroes the trough: the crest quarter-period must
+        # carry far more arrivals than the trough quarter-period
+        arrivals = diurnal_trace(11, duration_s=1000.0, tenants=4,
+                                 base_rate=0.5, amplitude=1.0,
+                                 period_s=1000.0, bursts=[])
+        crest = sum(1 for a in arrivals if 125.0 <= a.at_s < 375.0)
+        trough = sum(1 for a in arrivals if 625.0 <= a.at_s < 875.0)
+        assert crest > 4 * max(1, trough), (crest, trough)
+
+    def test_storm_trace_floods_one_tenant(self):
+        arrivals = storm_trace(5, 300.0, 4, storm_tenant=2,
+                               storm_at_s=60.0, storm_count=50,
+                               storm_width_s=10.0)
+        flood = [a for a in arrivals
+                 if a.tenant == 2 and 60.0 <= a.at_s <= 70.0]
+        assert len(flood) >= 50
+        # the other tenants still trickle
+        assert any(a.tenant != 2 for a in arrivals)
+
+    def test_burst_trace_synchronized_wave(self):
+        arrivals = burst_trace(9, tenants=3, per_tenant=4, width_s=5.0)
+        assert len(arrivals) == 12
+        assert all(0.0 <= a.at_s <= 5.0 for a in arrivals)
+        assert {a.tenant for a in arrivals} == {0, 1, 2}
+
+
+# ----------------------------------------------------------------------
+# SLO gate units (perf/checker.py check_slo)
+# ----------------------------------------------------------------------
+
+def make_result(**kw) -> ScenarioResult:
+    res = ScenarioResult(name="unit", seed=0, scale="smoke")
+    res.admitted = kw.pop("admitted", 10)
+    res.admissions = kw.pop("admissions", 10)
+    res.evictions = kw.pop("evictions", 0)
+    res.class_p99_tta_s = kw.pop("class_p99_tta_s", {"standard": 10.0})
+    for k, v in kw.items():
+        setattr(res, k, v)
+    return res
+
+
+class TestSLOGates:
+    def test_all_green(self):
+        res = make_result(requeue_amplification=1.0)
+        spec = SLOSpec(min_admitted=10,
+                       class_max_p99_tta_s={"standard": 60.0},
+                       max_ladder_recovery_cycles=5,
+                       max_requeue_amplification=2.0, max_evictions=0)
+        assert check_slo(res, spec) == []
+
+    def test_min_admitted(self):
+        v = check_slo(make_result(admitted=3), SLOSpec(min_admitted=10))
+        assert any("below minimum" in s for s in v)
+
+    def test_class_p99_bound_and_missing_class(self):
+        res = make_result(class_p99_tta_s={"standard": 120.0})
+        spec = SLOSpec(class_max_p99_tta_s={"standard": 60.0,
+                                            "prod": 30.0})
+        v = check_slo(res, spec)
+        assert any("exceeds" in s and "standard" in s for s in v)
+        assert any("no admissions recorded" in s and "prod" in s for s in v)
+
+    def test_zero_starvation(self):
+        res = make_result(starved=["default/w1", "default/w2"])
+        v = check_slo(res, SLOSpec())
+        assert any("starved" in s for s in v)
+        assert check_slo(res, SLOSpec(zero_starvation=False)) == []
+
+    def test_ladder_recovery(self):
+        spec = SLOSpec(max_ladder_recovery_cycles=5)
+        assert check_slo(
+            make_result(ladder_recovery_cycles=5), spec) == []
+        v = check_slo(make_result(ladder_recovery_cycles=9), spec)
+        assert any("ladder recovery took 9" in s for s in v)
+        v = check_slo(make_result(ladder_recovery_cycles=None), spec)
+        assert any("never recovered" in s for s in v)
+
+    def test_requeue_amplification_and_evictions(self):
+        res = make_result(requeue_amplification=3.5, evictions=7)
+        v = check_slo(res, SLOSpec(max_requeue_amplification=2.0,
+                                   max_evictions=5))
+        assert any("amplification" in s for s in v)
+        assert any("evictions exceed" in s for s in v)
+        assert check_slo(res, SLOSpec()) == []  # both gates off by default
+
+    def test_slospec_backend_honesty(self):
+        # Same contract as RangeSpec: a wall-calibrated spec refuses
+        # cross-backend comparison instead of producing a dishonest gate.
+        spec = SLOSpec(backend="tpu")
+        assert refuse_cross_backend(
+            spec, {"backend": "cpu", "cpu_fallback": False}) is not None
+        assert refuse_cross_backend(
+            spec, {"backend": "tpu", "cpu_fallback": False}) is None
+        # virtual-time specs (no backend) compare anywhere
+        assert refuse_cross_backend(
+            SLOSpec(), {"backend": "cpu", "cpu_fallback": False}) is None
+
+
+# ----------------------------------------------------------------------
+# bounded EventRecorder ring (sim/runtime.py)
+# ----------------------------------------------------------------------
+
+class _Obj:
+    def __init__(self, name):
+        self.metadata = ObjectMeta(name=name, namespace="default")
+
+
+class TestEventRecorderRing:
+    def test_window_bounded_counters_exact(self):
+        rec = EventRecorder(capacity=10)
+        for i in range(25):
+            rec.event(_Obj(f"w{i}"), "Normal", "Admitted", "ok")
+        assert len(rec.events) == 10
+        assert rec.total_events == 25
+        assert rec.reason_counts["Admitted"] == 25
+        # the retained window is the most recent 10
+        assert [e.object_key for e in rec.events] == \
+            [f"default/w{i}" for i in range(15, 25)]
+
+    def test_by_reason_on_window_prefix_on_lifetime(self):
+        rec = EventRecorder(capacity=5)
+        for i in range(8):
+            rec.event(_Obj(f"w{i}"), "Warning", "EvictedDueToPodsReadyTimeout",
+                      "timeout")
+        rec.system_event("Warning", "EvictedDueToPreemption", "bumped")
+        assert len(rec.by_reason("EvictedDueToPodsReadyTimeout")) == 4
+        assert rec.count_by_reason_prefix("EvictedDueTo") == 9
+        assert rec.count_by_reason_prefix("Admitted") == 0
+
+    def test_system_events_share_the_ring(self):
+        rec = EventRecorder(capacity=3)
+        rec.system_event("Warning", "DeviceFault", "site=device_dispatch")
+        assert rec.events[-1].kind == "Scheduler"
+        assert rec.reason_counts["DeviceFault"] == 1
+
+    def test_capacity_validated(self):
+        with pytest.raises(ValueError):
+            EventRecorder(capacity=0)
+
+
+# ----------------------------------------------------------------------
+# the scenario catalog at smoke scale (tier-1)
+# ----------------------------------------------------------------------
+
+class TestScenarioSmoke:
+    def test_catalog_lists_all_six(self):
+        assert list_scenarios() == ["cluster_loss", "diurnal",
+                                    "flavor_churn", "mixed_jobs",
+                                    "requeue_flood", "tenant_storm"]
+
+    def test_unknown_scenario_and_scale_rejected(self):
+        with pytest.raises(KeyError):
+            run_scenario("nope")
+        with pytest.raises(ValueError):
+            run_scenario("diurnal", scale="medium")
+
+    def test_diurnal_green(self):
+        res = run_scenario("diurnal", seed=0, scale="smoke")
+        assert res.ok, res.violations
+        assert res.admitted == res.submitted
+        assert not res.starved
+
+    def test_diurnal_deterministic_per_seed(self):
+        a = run_scenario("diurnal", seed=3, scale="smoke").to_dict()
+        b = run_scenario("diurnal", seed=3, scale="smoke").to_dict()
+        assert a == b
+        c = run_scenario("diurnal", seed=4, scale="smoke").to_dict()
+        assert a != c
+
+    def test_tenant_storm_no_cross_tenant_starvation(self):
+        res = run_scenario("tenant_storm", seed=0, scale="smoke")
+        assert res.ok, res.violations
+        assert res.counters["tta_scope"].startswith("non-storm")
+        # the storm tenant queues behind its own flood; everyone else's
+        # gated p99 stays bounded (it is the SLO population)
+        assert res.counters["storm_tenant_p99_tta_s"] is not None
+
+    def test_flavor_churn_takes_partial_rebuild_path(self):
+        res = run_scenario("flavor_churn", seed=0, scale="smoke")
+        assert res.ok, res.violations
+        assert res.counters["quota_edits"] > 0
+        assert res.counters["partial_rebuilds"] > 0
+        # single-CQ quota edits must not devolve into per-edit full
+        # rebuilds (the scenario adds a violation if partials stay 0)
+        assert res.counters["full_rebuilds"] <= 1 + res.counters["partial_rebuilds"]
+
+    def test_requeue_flood_jitter_desync_and_ladder_recovery(self):
+        res = run_scenario("requeue_flood", seed=0, scale="smoke")
+        assert res.ok, res.violations
+        assert res.evictions > 0
+        assert res.counters["requeue_ats"] > 0
+        # seeded backoff jitter de-synchronizes the retry storm
+        assert res.counters["requeue_at_distinct"] \
+            >= 0.7 * res.counters["requeue_ats"]
+        assert res.counters["requeue_at_spread_s"] > 0
+        # the ladder engaged during the storm and recovered on budget
+        assert res.ladder_recovery_cycles is not None
+        assert 0 < res.ladder_recovery_cycles <= 8
+
+    def test_cluster_loss_replacement_gc_no_double_dispatch(self):
+        res = run_scenario("cluster_loss", seed=0, scale="smoke")
+        assert res.ok, res.violations
+        assert res.counters["lost_with_reservation"] > 0
+        assert res.counters["relocated"] == res.counters["lost_with_reservation"]
+        assert res.counters["double_dispatched"] == 0
+        assert res.counters["unplaced_admitted"] == 0
+        assert res.counters["orphan_collected"] is True
+        assert not res.starved
+
+    def test_mixed_jobs_admission_and_eviction_parity(self):
+        res = run_scenario("mixed_jobs", seed=0, scale="smoke")
+        assert res.ok, res.violations
+        submitted = res.counters["submitted_by_kind"]
+        admitted = res.counters["admitted_by_kind"]
+        assert set(submitted) == {"workload", "job", "jobset",
+                                  "pytorch", "ray"}
+        for kind, n in submitted.items():
+            assert admitted.get(kind, 0) == n, (kind, admitted)
+        # one admitted object of every kind went through the eviction
+        # lap (deactivate -> evict -> reactivate -> re-admit)
+        assert set(res.counters["eviction_lap"]) == \
+            {"workload", "Job", "JobSet", "PyTorchJob", "RayJob"}
+
+    def test_results_backend_stamped(self):
+        res = run_scenario("diurnal", seed=0, scale="smoke")
+        assert "backend" in res.backend
+        d = res.to_dict()
+        assert d["backend"] == res.backend
+        json.dumps(d)  # artifact-serializable
+
+
+# ----------------------------------------------------------------------
+# the driver CLI (tools/scenario_run.py)
+# ----------------------------------------------------------------------
+
+def _load_scenario_run():
+    spec = importlib.util.spec_from_file_location(
+        "scenario_run", os.path.join(REPO, "tools", "scenario_run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestScenarioRunCLI:
+    def test_list(self, capsys):
+        mod = _load_scenario_run()
+        assert mod.main(["--list"]) == 0
+        out = capsys.readouterr().out.split()
+        assert out == list_scenarios()
+
+    def test_unknown_scenario_is_an_argparse_error(self):
+        mod = _load_scenario_run()
+        with pytest.raises(SystemExit) as exc:
+            mod.main(["no-such-scenario"])
+        assert exc.value.code == 2
+
+    def test_single_scenario_with_json_artifact(self, tmp_path, capsys):
+        mod = _load_scenario_run()
+        rc = mod.main(["requeue_flood", "--seed", "0",
+                       "--scale", "smoke", "--json", str(tmp_path)])
+        captured = capsys.readouterr()
+        assert rc == 0
+        verdict = json.loads(captured.out.strip().splitlines()[-1])
+        assert verdict["ok"] is True
+        assert verdict["scenarios"] == 1
+        artifact = json.loads((tmp_path / "requeue_flood.json").read_text())
+        assert artifact["scenario"] == "requeue_flood"
+        assert artifact["ok"] is True
+        assert artifact["counters"]["requeue_at_distinct"] > 0
+
+
+# ----------------------------------------------------------------------
+# full-scale sweep (slow)
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestFullSweep:
+    @pytest.mark.parametrize("name", ["cluster_loss", "diurnal",
+                                      "flavor_churn", "mixed_jobs",
+                                      "requeue_flood", "tenant_storm"])
+    def test_full_scale_green(self, name):
+        res = run_scenario(name, seed=0, scale="full")
+        assert res.ok, (name, res.violations)
+        assert not res.starved
+
+    @pytest.mark.parametrize("seed", [1, 2])
+    def test_failure_scenarios_hold_across_seeds(self, seed):
+        for name in ("requeue_flood", "cluster_loss"):
+            res = run_scenario(name, seed=seed, scale="full")
+            assert res.ok, (name, seed, res.violations)

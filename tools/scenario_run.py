@@ -1,0 +1,86 @@
+"""Scenario driver: production-realism traffic suites end-to-end
+(ISSUE 8 tooling; see kueue_tpu/sim/SCENARIOS.md for the catalog).
+
+Runs one or more sim scenarios (sim/scenarios.py) through the FULL
+control plane (KueueManager: sim store, webhooks, controllers,
+scheduler) on the virtual clock and evaluates each against its SLOSpec
+gates (perf/checker.py): per-priority-class p99 time-to-admission,
+degradation-ladder recovery, requeue amplification, zero starvation,
+plus the scenario's own invariants (jitter de-sync, no double
+dispatch, orphan GC, job-integration parity).
+
+Deterministic for a (seed, scale) pair: virtual time only, seeded
+traces, seeded backoff jitter. A CI failure replays from the seed in
+the verdict line alone.
+
+Prints one JSON line per scenario to stderr plus a final verdict line
+on stdout (chaos_run.py's contract); exits non-zero if any gate is
+red. `--json DIR` additionally writes one `<scenario>.json` artifact
+per run.
+
+Usage:
+  python tools/scenario_run.py [scenario ...] [--seed N]
+                               [--scale smoke|full] [--json DIR]
+                               [--list]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kueue_tpu.sim.scenarios import (  # noqa: E402
+    list_scenarios, run_scenario)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Run production-realism sim scenarios with SLO gates")
+    ap.add_argument("scenarios", nargs="*",
+                    help="scenario names (default: the full catalog)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the scenario catalog and exit")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--scale", choices=("smoke", "full"), default="smoke")
+    ap.add_argument("--json", metavar="DIR", default=None,
+                    help="write one <scenario>.json artifact per run")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in list_scenarios():
+            print(name)
+        return 0
+
+    names = args.scenarios or list_scenarios()
+    unknown = [n for n in names if n not in list_scenarios()]
+    if unknown:
+        ap.error(f"unknown scenario(s) {', '.join(unknown)}; "
+                 f"catalog: {', '.join(list_scenarios())}")
+    if args.json:
+        os.makedirs(args.json, exist_ok=True)
+
+    results = []
+    for name in names:
+        res = run_scenario(name, seed=args.seed, scale=args.scale)
+        results.append(res)
+        print(json.dumps(res.to_dict()), file=sys.stderr)
+        if args.json:
+            path = os.path.join(args.json, f"{name}.json")
+            with open(path, "w") as f:
+                json.dump(res.to_dict(), f, indent=2, sort_keys=True)
+
+    ok = all(r.ok for r in results)
+    print(json.dumps({
+        "tool": "scenario_run", "seed": args.seed, "scale": args.scale,
+        "scenarios": len(results), "ok": ok,
+        "red": sorted(r.name for r in results if not r.ok),
+        "violations": [v for r in results for v in r.violations],
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1027,6 +1027,48 @@ def _admit_victim(cache, name, lq, cq, milli, priority, creation):
     return wl
 
 
+# Device-vs-CPU speedup floors for the preemption / fair-sharing bench
+# regimes (ISSUE 9 acceptance; ROADMAP item 2's "no CPU-won regime"
+# contract). Calibrated on a real device backend — a cpu_fallback run
+# REFUSES the comparison (rangespec_refused) instead of minting a fake
+# regression/regression-fix, per the PR-6 bench-env honesty policy.
+PREEMPT_SPEEDUP_RANGESPEC_BACKEND = "tpu"
+PREEMPT_SPEEDUP_FLOORS = {
+    "preemption_heavy_cycle": 1.0,
+    "fair_sharing_cycle": 1.0,
+    "fair_preemption_cycle": 1.0,
+}
+
+
+def _speedup_rangespec_fields(name, speedup):
+    """rangespec_ok / rangespec_refused fields for a regime row, via
+    perf.checker.check_device_speedup (None = refused)."""
+    floor = PREEMPT_SPEEDUP_FLOORS.get(name)
+    if floor is None:
+        return {}
+    from kueue_tpu.perf.checker import RangeSpec, check_device_speedup
+    spec = RangeSpec(backend=PREEMPT_SPEEDUP_RANGESPEC_BACKEND,
+                     min_device_speedup=floor)
+    ok, note = check_device_speedup(speedup, spec, BACKEND)
+    out = {"rangespec_ok": ok}
+    if ok is None:
+        out["rangespec_refused"] = note
+    elif not ok:
+        out["rangespec_violation"] = note
+    return out
+
+
+def _log_gated_speedup_row(name, row, speedup):
+    """Stamp a regime row with its device-speedup rangespec verdict,
+    emit it, and fail the run on a witnessed violation; refusals pass
+    through with the reason recorded (the cross-backend honesty
+    policy). One enforcement point for every gated regime row."""
+    row.update(_speedup_rangespec_fields(name, speedup))
+    log(row)
+    if row.get("rangespec_ok") is False:
+        raise AssertionError(row.get("rangespec_violation"))
+
+
 def _run_preempt_pair(build, name, extra, routed=False):
     """Run a preemption scenario on the CPU-only and solver-configured
     schedulers; assert identical evictions and report the wall times.
@@ -1037,6 +1079,7 @@ def _run_preempt_pair(build, name, extra, routed=False):
     import gc
     gc.collect()  # earlier rows' garbage must not land in a timed window
     out = {}
+    preempt_plan = []
     runs = 4 if routed else 2
     for label, solver in (("cpu", False), ("device", True)):
         # warmup run compiles the bucketed shapes; each timed run rebuilds
@@ -1066,13 +1109,23 @@ def _run_preempt_pair(build, name, extra, routed=False):
                 route_stats = (sched._route_stats, sched._last_regime)
             if best is None or dt < best[0]:
                 best = (dt, client.evicted, sched.preemption_fallbacks)
+            if solver and sched.last_preempt_plan:
+                preempt_plan.append(sched.last_preempt_plan)
         out[label] = best
     (t_cpu, ev_cpu, _), (t_dev, ev_dev, fb) = out["cpu"], out["device"]
     assert ev_cpu == ev_dev and ev_dev > 0 and fb == 0, (ev_cpu, ev_dev, fb)
-    log({"bench": name, **extra, "evictions": ev_dev,
-         "cpu_ms": round(t_cpu * 1e3, 1), "device_ms": round(t_dev * 1e3, 1),
-         "speedup": round(t_cpu / t_dev, 2)})
-    return t_cpu / t_dev
+    speedup = t_cpu / t_dev
+    row = {"bench": name, **extra, "evictions": ev_dev,
+           "cpu_ms": round(t_cpu * 1e3, 1),
+           "device_ms": round(t_dev * 1e3, 1),
+           "speedup": round(speedup, 2)}
+    if preempt_plan:
+        # last device preempt-plan stats (pool / scanned / fill-back
+        # rounds), same producer as /debug/router — witnesses that the
+        # batched path actually ran, not the CPU fallback
+        row["preempt_plan"] = preempt_plan[-1]
+    _log_gated_speedup_row(name, row, speedup)
+    return speedup
 
 
 def bench_fair_sharing(num_cqs=2048, num_cohorts=256, cycles=4):
@@ -1150,12 +1203,14 @@ def bench_fair_sharing(num_cqs=2048, num_cohorts=256, cycles=4):
     # exact decision equality on the drained totals (the pipelined
     # window shift can't hide drift here)
     assert adm_dev > 0 and tot_cpu == tot_dev, (tot_cpu, tot_dev)
-    log({"bench": "fair_sharing_cycle", "cqs": num_cqs,
-         "admitted_per_cycle": round(adm_dev, 1),
-         "cpu_p50_ms": round(t_cpu * 1e3, 1),
-         "device_p50_ms": round(t_dev * 1e3, 1),
-         "speedup": round(t_cpu / t_dev, 2)})
-    return t_cpu / t_dev
+    speedup = t_cpu / t_dev
+    row = {"bench": "fair_sharing_cycle", "cqs": num_cqs,
+           "admitted_per_cycle": round(adm_dev, 1),
+           "cpu_p50_ms": round(t_cpu * 1e3, 1),
+           "device_p50_ms": round(t_dev * 1e3, 1),
+           "speedup": round(speedup, 2)}
+    _log_gated_speedup_row("fair_sharing_cycle", row, speedup)
+    return speedup
 
 
 def bench_fair_preemption(num_cqs=512, num_cohorts=64, victims_per_cq=12):

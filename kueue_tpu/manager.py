@@ -236,6 +236,8 @@ class KueueManager:
         # start_warmup()/run_sync themselves).
         self.warm_governor = None
         if solver is not None and hasattr(solver, "warm_setup"):
+            from kueue_tpu.scheduler.preemption import parse_strategies
+            from kueue_tpu.solver.fairpreempt import strategy_flags
             from kueue_tpu.solver.warmgov import CompileGovernor
             s = self.cfg.solver
             self.warm_governor = CompileGovernor(
@@ -244,7 +246,9 @@ class KueueManager:
                 bucket_deadline_s=s.warmup_deadline_s,
                 cache_dir=s.compile_cache_dir,
                 max_width=s.max_heads,
-                fair_sharing=self.cfg.fair_sharing.enable)
+                fair_sharing=self.cfg.fair_sharing.enable,
+                fs_flags=strategy_flags(parse_strategies(
+                    self.cfg.fair_sharing.preemption_strategies)))
             self.scheduler.warm_gov = self.warm_governor
             if s.warmup_at_startup:
                 self.warm_governor.start()

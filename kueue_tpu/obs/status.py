@@ -69,6 +69,11 @@ def router_status(scheduler) -> dict:
         "explore_counts": dict(scheduler._route_explore),
         "cycle_counts": dict(scheduler.cycle_counts),
         "regimes": regimes,
+        # last device preempt-plan solve: candidate pool size, prefix
+        # scanned / heap pops, fill-back auction rounds, filled back —
+        # per program (minimal / fair); {} until a batched preemption
+        # cycle has run (solver/PREEMPT.md)
+        "preempt_plan": dict(getattr(scheduler, "last_preempt_plan", {})),
     }
 
 

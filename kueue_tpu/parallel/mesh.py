@@ -197,8 +197,8 @@ def _build_sharded(mesh: Mesh, num_podsets: int, fair_sharing: bool,
             from kueue_tpu.solver.preempt import PREEMPT_ARGS_REPLICATED_SLOTS
             sliced = tuple(a if i in PREEMPT_ARGS_REPLICATED_SLOTS
                            else bslice(a) for i, a in enumerate(pargs))
-            t_l, f_l = solve_preempt_impl(topo_, usage, cohort_usage,
-                                          *sliced)
+            t_l, f_l, _s_l = solve_preempt_impl(topo_, usage, cohort_usage,
+                                                *sliced)
 
             def bgather(a):
                 g = jax.lax.all_gather(a, axis, axis=0, tiled=True)

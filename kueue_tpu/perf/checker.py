@@ -48,6 +48,30 @@ class RangeSpec:
     # None = unchecked; 0 = the steady-state contract (every variant
     # warmed by the compile governor before the clock started).
     max_mid_traffic_compiles: Optional[int] = None
+    # Device-vs-CPU speedup floor for a bench regime row (the ROADMAP
+    # item-2 coverage contract: no bench regime where the router must
+    # pick CPU). Hardware-dependent by definition — a spec carrying it
+    # MUST declare its backend, and cross-backend runs refuse instead of
+    # judging (BENCH_r05 ran cpu_fallback; its rows are not comparable).
+    # 0 = unchecked.
+    min_device_speedup: float = 0.0
+
+
+def check_device_speedup(speedup: float, spec: RangeSpec,
+                         backend: Optional[dict]) -> tuple:
+    """Judge one bench regime row's device-vs-CPU speedup against the
+    spec's floor. Returns (ok, note): ok is None when the comparison is
+    refused (cross-backend / CPU fallback — the PR-6 honesty contract),
+    True/False otherwise, with the note carrying the refusal reason or
+    the violation text."""
+    refusal = refuse_cross_backend(spec, backend)
+    if refusal is not None:
+        return None, refusal
+    if spec.min_device_speedup and speedup <= spec.min_device_speedup:
+        return False, (f"device speedup {speedup:.2f}x <= floor "
+                       f"{spec.min_device_speedup:.2f}x — a CPU-won "
+                       f"regime the router must route away from")
+    return True, ""
 
 
 def default_rangespec() -> RangeSpec:

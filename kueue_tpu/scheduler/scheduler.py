@@ -174,6 +174,13 @@ class Scheduler:
         # router for the other (VERDICT r4 weak #2).
         self._route_stats: dict = {}
         self._route_explore: dict = {"fit": 0, "preempt": 0}
+        # Last device preempt-plan solve stats (candidate pool size,
+        # auction/fill-back rounds, fill-back outcomes), decoded from the
+        # kernel's stats outputs: annotated onto the cycle trace's
+        # preempt-plan span and surfaced via /debug/router
+        # (obs.router_status) so operators can see what the batched
+        # preemptor actually did.
+        self.last_preempt_plan: dict = {}
         self._last_regime = "fit"    # sticky regime predictor
         self._cycle_regime = "fit"   # observed regime of the cycle run
         self._last_cycle_admitted = 0
@@ -1443,6 +1450,45 @@ class Scheduler:
         self._last_cycle_admitted = None
         return SlowDown
 
+
+    def _note_preempt_stats(self, aux, preempt_batch=None,
+                            fair_batch=None) -> None:
+        """Aggregate the device preempt/fair solve stats ([B,4] per
+        program: pool, scanned/pops, fill-back rounds, filled back) into
+        the operator surface: last_preempt_plan (/debug/router) + a
+        preempt-plan annotation on the open cycle trace."""
+        if not aux:
+            return
+        agg: dict = {}
+        for key, name, batch in (("preempt_stats", "minimal",
+                                  preempt_batch),
+                                 ("fair_stats", "fair", fair_batch)):
+            st = aux.get(key)
+            if st is None or len(st) == 0:
+                continue
+            # real problem count from the batch, NOT the stats shape:
+            # st's leading dim is the padded power-of-four bucket B,
+            # and a pool>0 heuristic undercounts — a real minimal
+            # problem can carry an EMPTY pool (sel[in_cq] with every
+            # ordered candidate in another CQ of the cohort)
+            problems = (len(batch.problems) if batch is not None
+                        else int((st[:, 0] > 0).sum()))
+            agg[name] = {
+                "problems": problems,
+                "pool": int(st[:, 0].sum()),
+                "scanned": int(st[:, 1].sum()),
+                "fillback_rounds_max": int(st[:, 2].max()),
+                "filled_back": int(st[:, 3].sum()),
+            }
+        if not agg:
+            return
+        self.last_preempt_plan = agg
+        flat = {f"{n}_{k}": v for n, d in agg.items()
+                for k, v in d.items()}
+        self.recorder.annotate(
+            "preempt-plan",
+            "batched preemption solve stats", **flat)
+
     def _collect_pipelined_preempt(self, inflight, pmeta, aux,
                                    fit_entries: list) -> list:
         """Collect-time half of a pipelined mixed cycle: decode the
@@ -1459,6 +1505,10 @@ class Scheduler:
         targets_by: dict = {}
         if aux is not None and "preempt" in aux \
                 and inflight.preempt_batch is not None:
+            # pipelined cycles never carry a fair batch (fair cycles
+            # bail to sync)
+            self._note_preempt_stats(
+                aux, preempt_batch=inflight.preempt_batch)
             t, f = aux["preempt"]
             targets_by = devpreempt.decode_targets(
                 inflight.preempt_batch, t, f, full_snap, cq_by)
@@ -1733,6 +1783,8 @@ class Scheduler:
             return invalid_entries, pre_entries, pred_fit
 
         if pre is not None and (pbatch is not None or fbatch is not None):
+            self._note_preempt_stats(pre, preempt_batch=pbatch,
+                                     fair_batch=fbatch)
             targets_by_entry = {}
             if pbatch is not None and "preempt" in pre:
                 t, f = pre["preempt"]

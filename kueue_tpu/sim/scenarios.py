@@ -138,7 +138,7 @@ class ScenarioHarness:
                  cycle_s: float = 5.0,
                  reclaim_within_cohort: str = api.PREEMPTION_ANY,
                  remote_clusters: Optional[list] = None,
-                 mk_check: bool = False):
+                 mk_check: bool = False, solver=None):
         from kueue_tpu.manager import KueueManager
         self.name = name
         self.seed = seed
@@ -156,8 +156,15 @@ class ScenarioHarness:
                                   reclaim_within_cohort)
             self.workers[cname] = worker
         self.mgr = KueueManager(
-            cfg=cfg, clock=self.clock,
+            cfg=cfg, clock=self.clock, solver=solver,
             remote_clusters=self.workers or None)
+        # Per-cycle (tag, route, regime) stream read off the flight
+        # recorder as cycles seal — the ring is bounded, so sampling at
+        # step() time survives rotation on long scenarios. Feeds the
+        # route-coverage gates (e.g. tenant_storm's "preemption-heavy
+        # phases route to device" check when a solver is attached).
+        self.cycle_routes: list = []
+        self._seen_trace_ids: set = set()
         check_names = []
         if mk_check:
             from kueue_tpu.api import autoscaling as asapi
@@ -307,6 +314,10 @@ class ScenarioHarness:
         if self.workers:
             self.mgr.run_until_idle()
         self._observe()
+        tr = self.mgr.flight_recorder.last()
+        if tr is not None and tr.cycle_id not in self._seen_trace_ids:
+            self._seen_trace_ids.add(tr.cycle_id)
+            self.cycle_routes.append((tr.tag, tr.route, tr.regime))
         self.cycles += 1
         self._track_ladder()
         self.mgr.advance(self.cycle_s)
@@ -673,17 +684,32 @@ def run_diurnal(seed: int = 0, scale: str = "full") -> ScenarioResult:
 # scenario (b): tenant storm
 # ----------------------------------------------------------------------
 
-def run_tenant_storm(seed: int = 0, scale: str = "full") -> ScenarioResult:
+def run_tenant_storm(seed: int = 0, scale: str = "full",
+                     solver: bool = False) -> ScenarioResult:
     """One LocalQueue floods while the others trickle. The cohort
     absorbs the flood through borrowing, and reclaimWithinCohort keeps
     the trickle tenants whole: the gate is zero cross-tenant starvation
     and bounded p99 time-to-admission for the NON-storm tenants (the
-    storm tenant's self-inflicted backlog is reported, not gated)."""
+    storm tenant's self-inflicted backlog is reported, not gated).
+
+    With ``solver=True`` the harness runs the production batched solver
+    under the adaptive router, and the scenario additionally witnesses
+    ROADMAP item 2's coverage contract UNDER REALISTIC LOAD: the
+    storm's preemption-heavy cycles must route to the device (trace
+    ``route`` + ``regime`` tags), not fall back to the CPU preemptor.
+    The route gate is enforced only on a real device backend — on a
+    CPU-fallback run the router legitimately picks whichever engine is
+    faster there, so the scenario reports the route mix and records the
+    refusal instead (the perf.checker cross-backend honesty policy)."""
     p = {"smoke": dict(duration=300.0, tenants=4, quota=6, storm=40),
          "full": dict(duration=900.0, tenants=8, quota=8, storm=200),
          }[scale]
+    sv = None
+    if solver:
+        from kueue_tpu.solver import BatchSolver
+        sv = BatchSolver()
     h = ScenarioHarness("tenant_storm", seed, tenants=p["tenants"],
-                        quota_units=p["quota"])
+                        quota_units=p["quota"], solver=sv)
     arrivals = storm_trace(seed, duration_s=p["duration"],
                            tenants=p["tenants"], storm_tenant=0,
                            storm_at_s=60.0, storm_count=p["storm"])
@@ -707,6 +733,40 @@ def run_tenant_storm(seed: int = 0, scale: str = "full") -> ScenarioResult:
                    tta_scope="non-storm tenants (t1..)")
     res.counters["storm_tenant_p99_tta_s"] = \
         round(_p99(storm_ttas), 3) if storm_ttas else None
+    # Route/regime coverage (trace tags stamped by set_phase): how the
+    # router handled the storm's preemption-heavy cycles.
+    mix: dict = {}
+    for tag, route, regime in h.cycle_routes:
+        if tag in ("storm", "drain"):
+            key = f"{regime or 'fit'}/{route or 'none'}"
+            mix[key] = mix.get(key, 0) + 1
+    res.counters["storm_route_mix"] = mix
+    if solver:
+        preempt_cycles = sum(n for k, n in mix.items()
+                             if k.startswith("preempt/"))
+        # explicit device-route allowlist ('device' plus its pipelined
+        # variants 'device-pipelined'/'device-dispatch-only'/
+        # 'device-nofit'): a headless 'drain'/'none' step (which can
+        # inherit a stale preempt regime tag) must not satisfy the
+        # coverage gate
+        def _is_device(route: str) -> bool:
+            return route == "device" or route.startswith("device-")
+
+        device_preempt = sum(
+            n for k, n in mix.items()
+            if k.startswith("preempt/") and _is_device(k.split("/")[1]))
+        res.counters["storm_preempt_cycles"] = preempt_cycles
+        res.counters["storm_preempt_device_cycles"] = device_preempt
+        import jax
+        on_device = jax.default_backend() != "cpu"
+        if not on_device:
+            res.counters["route_gate_refused"] = (
+                "cpu backend: device-vs-cpu route economics are not the "
+                "production ones; route mix recorded, gate refused")
+        elif preempt_cycles and not device_preempt:
+            res.violations.append(
+                "storm preemption-heavy cycles never routed to the "
+                f"device (mix: {mix}) — ROADMAP item 2 coverage gate")
     return res
 
 
@@ -1073,10 +1133,23 @@ def list_scenarios() -> list:
     return sorted(SCENARIOS)
 
 
-def run_scenario(name: str, seed: int = 0, scale: str = "full") -> ScenarioResult:
+def run_scenario(name: str, seed: int = 0, scale: str = "full",
+                 solver: bool = False) -> ScenarioResult:
     if name not in SCENARIOS:
         raise KeyError(f"unknown scenario {name!r}; "
                        f"one of {', '.join(list_scenarios())}")
     if scale not in ("smoke", "full"):
         raise ValueError(f"scale must be 'smoke' or 'full', got {scale!r}")
-    return SCENARIOS[name](seed=seed, scale=scale)
+    fn = SCENARIOS[name]
+    if solver:
+        # only scenarios that grew a solver-coverage gate accept the
+        # kwarg (run_tenant_storm's ROADMAP-item-2 device-route gate);
+        # asking for it elsewhere is an operator error, not a silent
+        # no-op
+        import inspect
+        if "solver" not in inspect.signature(fn).parameters:
+            raise ValueError(
+                f"scenario {name!r} has no solver mode; "
+                f"solver-gated scenarios: tenant_storm")
+        return fn(seed=seed, scale=scale, solver=True)
+    return fn(seed=seed, scale=scale)

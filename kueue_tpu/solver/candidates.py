@@ -22,9 +22,15 @@ vectorized boolean masks + slices:
 - the device encode consumes deduplicated per-domain usage-row tables,
   so shipping a problem to the TPU touches no per-candidate Python.
 
+This module is the ENCODE stage of the batched preemption pipeline
+(encode -> solve -> decode; solver/PREEMPT.md): its pools feed
+preempt.encode_problems / fairpreempt.encode_fair_problems, whose
+bucketed problem tensors the parallel prefix/auction solve consumes.
+
 The CPU preemptor (scheduler/preemption.py) keeps its independent
 sequential discovery as the conformance oracle; the differential suites
-(tests/test_preempt_solver.py) cross-validate the two.
+(tests/test_preempt_solver.py, tests/test_preempt_batched.py)
+cross-validate the two.
 """
 
 from __future__ import annotations

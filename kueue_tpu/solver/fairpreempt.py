@@ -5,7 +5,9 @@ pkg/scheduler/preemption/preemption.go:312-437 — pop the max-dominant-
 share ClusterQueue, test the configured strategy against the preemptor's
 and preemptee's shares, remove, re-heap; then the optional second-
 strategy retry pass; then fill-back) with a batched program: every
-fair-preemption entry runs as an independent lane of a vmapped lax.scan,
+fair-preemption entry runs as an independent vmapped lane whose heap
+loop carries INCREMENTAL per-CQ shares and early-exits once the
+preemptor fits (solve_fair_impl; design notes in solver/PREEMPT.md §3),
 composing with the fit solve into the cycle's single device execute.
 
 Share decomposition (the design pinned in solver/preempt.py round 3):
@@ -211,7 +213,15 @@ def solve_fair_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
                     cand_rank, cq_count, cq_order, base_other, floor_ratio,
                     floor_any, weight, lendable, strat: tuple):
     """Batched fairPreemptions. Returns (targets [B,K] bool,
-    feasible [B] bool, reasons [B,K] int8)."""
+    feasible [B] bool, reasons [B,K] int8, stats [B,4] int32 —
+    (candidate pool, heap pops, fill-back iterations, filled back)).
+
+    The DRF-heap loop runs as a while_loop with the per-CQ share vector
+    maintained INCREMENTALLY (one masked max-ratio row reduction per
+    pop — SURVEY.md §7's "trivially vectorizable" observation — instead
+    of a full [QL,RF,RF] shares() recompute per candidate) and exits as
+    soon as the preemptor fits or the heap drains, so a fair cycle pays
+    for the pops it performs, not the padded candidate axis."""
     import jax
     import jax.numpy as jnp
 
@@ -258,6 +268,27 @@ def solve_fair_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
                               // jnp.maximum(weight_b, 1), 0)
             return jnp.where(weight_b == 0, MAXSHARE, share)
 
+        def share_of_row(u_row, nom_row, base_row, floor_q, floor_any_q,
+                         weight_q):
+            """One CQ's dominantResourceShare — the masked max-ratio
+            reduction on a single [RF] usage row. Removals only move the
+            popped CQ's row, so the heap loop updates ONE row's share per
+            step instead of recomputing the whole [QL] vector (same
+            integer math; bit-identical to shares())."""
+            borrow_fr = jnp.where(valid_fr,
+                                  jnp.maximum(0, u_row - nom_row), 0)
+            borrow_res = jnp.sum(jnp.where(same_res, borrow_fr[None, :], 0),
+                                 axis=1) + base_row        # [RF]
+            ratio = jnp.where((borrow_res > 0) & (lendable_b > 0),
+                              borrow_res * 1000
+                              // jnp.maximum(lendable_b, 1),
+                              jnp.int64(-1))
+            drs = jnp.maximum(jnp.max(ratio), floor_q)
+            any_b = jnp.any(borrow_res > 0) | floor_any_q
+            share = jnp.where(any_b, drs * 1000
+                              // jnp.maximum(weight_q, 1), 0)
+            return jnp.where(weight_q == 0, MAXSHARE, share)
+
         req_row = jnp.where(arange_ql[:, None] == 0, req_b[None, :], 0)
 
         def nominated_share(u):
@@ -275,13 +306,21 @@ def solve_fair_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
             tie = jnp.where(elig & (sh == m), order_b, 2**30)
             return jnp.argmin(tie).astype(jnp.int32), jnp.any(elig)
 
-        # --- main DRF-heap loop: one candidate per step ---
-        def fwd(carry, t):
-            u, cu, pos, active, retry, targets, reason, step_of, done = carry
-            sh = shares(u)
+        # --- main DRF-heap loop: one candidate per iteration, with the
+        # share vector carried incrementally and an EARLY EXIT once the
+        # preemptor fits or the heap drains — a fair-heavy cycle pays
+        # for the candidates it actually pops, not the padded K ---
+        def fwd_cond(carry):
+            (_u, _cu, pos, active, _r, _t, _re, _s, done, _sh, _nom,
+             t) = carry
+            return (~done) & jnp.any(active & valid_q & (pos < count_b)) \
+                & (t < K)
+
+        def fwd_body(carry):
+            (u, cu, pos, active, retry, targets, reason, step_of, done,
+             sh, nom_share, t) = carry
             # a CQ with no candidates left can never be popped (the CPU
-            # heap only ever holds CQs with candidates) — without this, a
-            # zero-candidate max-share preemptor CQ would stall the scan
+            # heap only ever holds CQs with candidates)
             qstar, any_elig = pick_cq(sh, active & valid_q
                                       & (pos < count_b))
             any_elig &= ~done
@@ -294,9 +333,16 @@ def solve_fair_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
             cand_p = jnp.sum(jnp.where(k_oh, cand_prio_b, 0))
             own = qstar == 0
 
-            nom_share = nominated_share(u)
-            u_wo = u - jnp.where(q_oh[:, None], cand_u[None, :], 0)
-            new_cand_share = jnp.sum(jnp.where(q_oh, shares(u_wo), 0))
+            def row(m):
+                return jnp.sum(jnp.where(q_oh[:, None], m, 0), axis=0)
+
+            u_q = row(u)
+            nom_q_row = row(nominal)
+            new_cand_share = share_of_row(
+                u_q - cand_u, nom_q_row, row(base_b),
+                jnp.sum(jnp.where(q_oh, floor_b, 0)),
+                jnp.any(q_oh & floor_any_b),
+                jnp.sum(jnp.where(q_oh, weight_b, 0)))
             old_share = jnp.sum(jnp.where(q_oh, sh, 0))
             if strat0_s2a:   # LessThanOrEqualToFinalShare (S2-a)
                 strat_ok = nom_share <= new_cand_share
@@ -309,6 +355,15 @@ def solve_fair_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
             q_chain_oh = jnp.any(q_oh[:, None, None] & chain_oh, axis=0)
             u, cu = remove_usage(u, cu, q_oh, q_chain_oh,
                                  jnp.where(do, cand_u, 0))
+            # incremental share maintenance: only the popped CQ's row
+            # moved (new_cand_share IS its post-removal share), and the
+            # nominated share only moves on an own-CQ removal
+            sh = jnp.where(q_oh & do, new_cand_share, sh)
+            nom_share = jnp.where(
+                own & do,
+                share_of_row(row(u) + req_b, nominal[0], base_b[0],
+                             floor_b[0], floor_any_b[0], weight_b[0]),
+                nom_share)
             targets = targets | (k_oh & do)
             # reason: own -> InClusterQueue; strategy -> FairSharing;
             # below-threshold only -> ReclaimWhileBorrowing
@@ -328,14 +383,15 @@ def solve_fair_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
             active = jnp.where(q_oh & k_valid, keep, active)
             done = done | (do & fits(u, cu, True))
             return (u, cu, pos, active, retry, targets, reason, step_of,
-                    done), None
+                    done, sh, nom_share, t + 1)
 
         init = (u0, cu0, jnp.zeros(QL, jnp.int32),
                 jnp.ones(QL, bool), jnp.zeros(K, bool), jnp.zeros(K, bool),
                 jnp.zeros(K, jnp.int8), jnp.full(K, -1, jnp.int32),
-                jnp.zeros((), bool))
-        (u, cu, pos, active, retry, targets, reason, step_of, done), _ = \
-            jax.lax.scan(fwd, init, jnp.arange(K, dtype=jnp.int32))
+                jnp.zeros((), bool), shares(u0), nominated_share(u0),
+                jnp.int32(0))
+        (u, cu, pos, active, retry, targets, reason, step_of, done,
+         _sh, _nom, pops) = jax.lax.while_loop(fwd_cond, fwd_body, init)
 
         # --- retry pass: second strategy, first retry candidate per CQ,
         # shares fixed at pass entry (preemption.go:412-431) ---
@@ -380,18 +436,22 @@ def solve_fair_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
                              step_of, done),
                 jnp.arange(QL, dtype=jnp.int32))
 
-        total_steps = K + (QL if has_retry else 0)
-
         # no fit => no targets (preemption.go:433-436)
         feasible = done
         targets = targets & feasible
 
         # --- fill-back in reverse REMOVAL order, skipping the fit-maker
-        # (fill_back_workloads, preemption.go:445-457) ---
+        # (fill_back_workloads, preemption.go:445-457). A while_loop over
+        # the steps that actually removed something (descending) — the
+        # old K+QL-step scan paid for every padded step ---
         last_step = jnp.max(jnp.where(targets, step_of, -1))
 
-        def back(carry, s):
-            u, cu = carry
+        def back_cond(carry):
+            _u, _cu, _kept, s, _n = carry
+            return s >= 0
+
+        def back_body(carry):
+            u, cu, kept, s, n = carry
             k_oh = targets & (step_of == s)
             consider = jnp.any(k_oh) & (s != last_step)
             cand_u = jnp.where(consider,
@@ -405,12 +465,22 @@ def solve_fair_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
             keep_back = consider & still
             u = jnp.where(keep_back, u2, u)
             cu = jnp.where(keep_back, cu2, cu)
-            return (u, cu), k_oh & keep_back
+            kept = kept | (k_oh & keep_back)
+            s_next = jnp.max(jnp.where(targets & (step_of < s),
+                                       step_of, -1))
+            return u, cu, kept, s_next, n + 1
 
-        steps_desc = jnp.arange(total_steps - 1, -1, -1, dtype=jnp.int32)
-        (_, _), kept = jax.lax.scan(back, (u, cu), steps_desc)
-        targets = targets & ~jnp.any(kept, axis=0)
-        return targets, feasible, reason
+        s0 = last_step
+        (_u, _cu, kept, _s, fb_iters) = jax.lax.while_loop(
+            back_cond, back_body,
+            (u, cu, jnp.zeros(K, bool), s0, jnp.int32(0)))
+        targets = targets & ~kept
+
+        stats = jnp.stack([
+            jnp.sum(cand_q_b >= 0).astype(jnp.int32),
+            pops, fb_iters,
+            jnp.sum(kept).astype(jnp.int32)])
+        return targets, feasible, reason, stats
 
     cand_q = cand_ql.astype(jnp.int32)
     cand_usage = cand_usage_table[cand_idx]

@@ -482,18 +482,20 @@ def solve_cycle_with_preempt_impl(topo, usage, cohort_usage, requests,
         timestamp, eligible, solvable, num_podsets=num_podsets,
         max_rank=max_rank, fair_sharing=fair_sharing, start_rank=start_rank)
     if preempt_args is not None:
-        targets, feasible = solve_preempt_impl(topo, usage, cohort_usage,
-                                               *preempt_args)
+        targets, feasible, pstats = solve_preempt_impl(
+            topo, usage, cohort_usage, *preempt_args)
         out["preempt_targets"] = targets
         out["preempt_feasible"] = feasible
+        out["preempt_stats"] = pstats
     if fair_preempt_args is not None:
         from kueue_tpu.solver.fairpreempt import solve_fair_impl
-        ft, ff, frs = solve_fair_impl(topo, usage, cohort_usage,
-                                      *fair_preempt_args,
-                                      strat=fs_strategies)
+        ft, ff, frs, fstats = solve_fair_impl(topo, usage, cohort_usage,
+                                              *fair_preempt_args,
+                                              strat=fs_strategies)
         out["fair_targets"] = ft
         out["fair_feasible"] = ff
         out["fair_reasons"] = frs
+        out["fair_stats"] = fstats
     return out
 
 

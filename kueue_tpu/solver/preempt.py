@@ -3,8 +3,15 @@
 Replaces the per-entry sequential simulation of the reference's
 minimalPreemptions (remove candidates in order until the preemptor fits,
 then fill back in reverse — pkg/scheduler/preemption/preemption.go:237-310)
-with one batched program: every preempt-mode entry's simulation runs as an
-independent lane of a vmapped lax.scan over a padded candidate axis.
+with one batched program staged as encode (candidate-pool tensors, this
+module + solver/candidates.py) / solve (the parallel prefix + fill-back
+auction below) / decode (victim sets, decode_targets). The solve stage
+evaluates EVERY candidate prefix of every problem in one shot — the
+greedy loop's state at any prefix is a closed-form clamp-telescoped
+function of per-CQ prefix sums — and resolves fill-back with a handful
+of parallel auction rounds instead of a K-step scan. See
+solver/PREEMPT.md for the derivation and the equivalence argument vs
+the Go greedy.
 
 Host side (cheap, O(entries x candidates) filters):
 - candidate discovery + ordering (findCandidates / candidatesOrdering,
@@ -245,6 +252,17 @@ def encode_problems(problems: list, snapshot, topo, requests_by_entry: dict,
     batch.gq = np.full((B, QL), -1, np.int32)
     for bi, row in enumerate(gq_rows):
         batch.gq[bi, :len(row)] = row
+    # The dedup table's row count is BUCKETED like every other batch dim:
+    # un-padded it tracked the per-cycle distinct-row count exactly, so
+    # every preemption-heavy cycle with a new dedup count minted a fresh
+    # program shape — unwarmable by construction and a compile-storm
+    # hazard (solver/COMPILE.md). Padding rows are all-zero and index 0
+    # is already reserved, so no cand_idx ever points at the padding.
+    U = _bucket(next_off, 1)
+    pad = U - next_off
+    if pad:
+        table_usage.append(np.zeros((pad, RF), np.int64))
+        table_prio.append(np.zeros(pad, np.int32))
     batch.cand_usage = np.concatenate(table_usage, axis=0)
     batch.cand_prio = np.concatenate(table_prio)
     _localize_cohorts(batch, topo)
@@ -406,7 +424,184 @@ def make_problem_sim(topo, usage, cohort_usage, gq_b, gf_b, gr_b, gc_b,
         "borrow_limit": borrow_limit, "u0": u0, "cu0": cu0,
         "chain_oh": chain_oh, "oh_rows": oh_rows, "avail_cq0": avail_cq0,
         "fits": fits, "remove_usage": remove_usage, "add_usage": add_usage,
+        # cohort constant planes, exported for the prefix/auction solver
+        # (solve_preempt_impl) which evaluates every candidate prefix in
+        # parallel instead of scanning
+        "c_subtree": c_subtree, "c_guar": c_guar, "c_bl": c_bl,
     }
+
+
+def _avail_cq0_prefix(sim, has_cohort_b, u0row_k, cu_k):
+    """``avail_cq0`` vectorized over a leading K axis: availability of
+    the preemptor's CQ (local row 0) for EVERY candidate prefix at once.
+    u0row_k [K,RF] is CQ 0's usage row per prefix; cu_k [CL,K,RF] the
+    problem-local cohort usage planes per prefix. Same chain walk as
+    make_problem_sim's avail_cq0 (resource_node.go:89-104)."""
+    import jax.numpy as jnp
+
+    NOLIM = 2**61
+    chain_oh = sim["chain_oh"]
+    c_subtree, c_guar, c_bl = sim["c_subtree"], sim["c_guar"], sim["c_bl"]
+    nominal, guaranteed = sim["nominal"], sim["guaranteed"]
+    borrow_limit = sim["borrow_limit"]
+    DC = chain_oh.shape[1]
+    K, RF = u0row_k.shape
+    parent = jnp.zeros((K, RF), jnp.int64)
+    started = jnp.zeros((), bool)
+    for d in range(DC - 1, -1, -1):
+        oh = chain_oh[0, d]                                   # [CL]
+        ok = jnp.any(oh)
+
+        def rows(t, oh=oh):
+            return jnp.sum(jnp.where(oh[:, None], t, 0), axis=0)
+
+        cuc = jnp.sum(jnp.where(oh[:, None, None], cu_k, 0), axis=0)
+        sub, gua, bl = rows(c_subtree), rows(c_guar), rows(c_bl)
+        root_avail = sub[None, :] - cuc
+        local = jnp.maximum(0, gua[None, :] - cuc)
+        cap = (sub - gua)[None, :] - jnp.maximum(0, cuc - gua[None, :]) \
+            + jnp.minimum(bl, NOLIM // 4)[None, :]
+        child = local + jnp.minimum(parent, cap)
+        new = jnp.where(started, child, root_avail)
+        parent = jnp.where(ok, new, parent)
+        started = started | ok
+    local0 = jnp.maximum(0, guaranteed[0][None, :] - u0row_k)
+    cap0 = (nominal[0] - guaranteed[0])[None, :] \
+        - jnp.maximum(0, u0row_k - guaranteed[0][None, :]) \
+        + jnp.minimum(borrow_limit[0], NOLIM // 4)[None, :]
+    with_cohort = local0 + jnp.minimum(parent, cap0)
+    return jnp.where(has_cohort_b, with_cohort,
+                     nominal[0][None, :] - u0row_k)
+
+
+def _fits_prefix(sim, has_cohort_b, req_b, u0row_k, cu_k, ab_k):
+    """workload_fits for every prefix/hypothesis at once. ab_k: [K] (or
+    a scalar broadcast)."""
+    import jax.numpy as jnp
+
+    nominal = sim["nominal"]
+    has_req = (req_b > 0)[None, :]
+    avail = _avail_cq0_prefix(sim, has_cohort_b, u0row_k, cu_k)
+    borrow_ok = ab_k | jnp.all(
+        ~has_req | (u0row_k + req_b[None, :] <= nominal[0][None, :]), axis=1)
+    return borrow_ok & jnp.all(~has_req | (req_b[None, :] <= avail), axis=1)
+
+
+def _own_cq_cumsum(cand_q_b, vals, QL, reverse=False):
+    """Per-candidate EXCLUSIVE same-CQ running sum of ``vals`` [K,RF]
+    (reverse=True: suffix sums). A static python loop over the QL local
+    CQ rows keeps peak memory at [K,RF] instead of a [QL,K,RF] cumsum
+    blow-up; QL is a small bucketed dim."""
+    import jax.numpy as jnp
+
+    out = jnp.zeros_like(vals)
+    for q in range(QL):
+        m = cand_q_b == q
+        vm = jnp.where(m[:, None], vals, 0)
+        if reverse:
+            cs = jnp.cumsum(vm[::-1], axis=0)[::-1]
+        else:
+            cs = jnp.cumsum(vm, axis=0)
+        out = jnp.where(m[:, None], cs - vm, out)
+    return out
+
+
+def _chain_flows_fwd(sim, cand_chain, dep_of_local, ed, delta0):
+    """Route each candidate's removal marginal up the cohort tree and
+    return IN[c,k]: total arrivals at local cohort c over candidates
+    0..k (every prefix at once).
+
+    Exactness rests on the clamp-telescoping identity
+    ``min(d, max(0, s)) = max(0, s) - max(0, s - d)``: a node's total
+    pass-up is a function of its total arrivals only, so per-candidate
+    MARGINALS (each clamped against the node's running prefix state)
+    reproduce the sequential remove_usage bubbling bit-for-bit. Nodes
+    are processed by tree depth (deepest first) so a node shared by CQs
+    at different chain positions receives all its arrivals in one step."""
+    import jax.numpy as jnp
+
+    CL = sim["CL"]
+    DC = cand_chain.shape[1]
+    K, RF = delta0.shape
+    s0 = sim["cu0"] - sim["c_guar"]                       # [CL,RF]
+    IN = jnp.zeros((CL, K, RF), jnp.int64)
+    flow = delta0
+    arange_cl = jnp.arange(CL)
+    for dlt in range(DC - 1, -1, -1):
+        pos = ed - dlt                                    # [K]
+        act = (pos >= 0) & (ed >= 0)
+        node = jnp.take_along_axis(
+            cand_chain, jnp.clip(pos, 0, DC - 1)[:, None], axis=1)[:, 0]
+        act = act & (node >= 0)
+        noh = (node[None, :] == arange_cl[:, None]) & act[None, :]  # [CL,K]
+        inm = jnp.where(noh[:, :, None], flow[None, :, :], 0)
+        cs = jnp.cumsum(inm, axis=1)                      # [CL,K,RF]
+        excl = cs - inm
+        out = jnp.minimum(inm, jnp.maximum(0, s0[:, None, :] - excl))
+        IN = jnp.where((dep_of_local == dlt)[:, None, None], cs, IN)
+        flow = jnp.where(act[:, None],
+                         jnp.sum(jnp.where(noh[:, :, None], out, 0), axis=0),
+                         flow)
+    return IN
+
+
+def _fillback_ok(sim, cand_chain, dep_of_local, ed, elig, members, v,
+                 cand_q_b, q_safe, u_fwd, cu_fwd, guar_k, req_b,
+                 has_cohort_b, ab_fb, QL):
+    """One fill-back auction round: for every eligible candidate j,
+    would the reverse-greedy accept it back given that exactly
+    ``members`` (the candidates with higher index) came back before it?
+    Returns ok[K] bool. Evaluating every hypothesis against the SAME
+    member set is what makes the round a parallel map; the caller
+    iterates rounds to the exact greedy fixpoint (see solve docstring)."""
+    import jax.numpy as jnp
+
+    CL = sim["CL"]
+    DC = cand_chain.shape[1]
+    K, RF = v.shape
+    c_guar, cu0 = sim["c_guar"], sim["cu0"]
+    arange_cl = jnp.arange(CL)
+    mv = jnp.where(members[:, None], v, 0)
+
+    # CQ-level add marginal per candidate, against the member-suffix
+    # state of its own CQ (addUsage: pass-up = clamp difference)
+    rev_own = _own_cq_cumsum(cand_q_b, mv, QL, reverse=True)
+    t_pre = u_fwd[q_safe] + rev_own - guar_k              # [K,RF]
+    delta_add = jnp.maximum(0, t_pre + v) - jnp.maximum(0, t_pre)
+
+    RIN = jnp.zeros((CL, K, RF), jnp.int64)   # member suffix arrivals
+    OWN = jnp.zeros((CL, K, RF), jnp.int64)   # own hypothetical arrivals
+    flow = delta_add
+    for dlt in range(DC - 1, -1, -1):
+        pos = ed - dlt
+        act = (pos >= 0) & (ed >= 0) & elig
+        node = jnp.take_along_axis(
+            cand_chain, jnp.clip(pos, 0, DC - 1)[:, None], axis=1)[:, 0]
+        act = act & (node >= 0)
+        noh = (node[None, :] == arange_cl[:, None]) & act[None, :]
+        inm = jnp.where((noh & members[None, :])[:, :, None],
+                        flow[None, :, :], 0)
+        rcs = jnp.cumsum(inm[:, ::-1], axis=1)[:, ::-1]
+        rexcl = rcs - inm                                 # strictly-after j
+        RIN = jnp.where((dep_of_local == dlt)[:, None, None], rexcl, RIN)
+        OWN = jnp.where(noh[:, :, None], flow[None, :, :], OWN)
+        # clamp this candidate's marginal through the node state it
+        # would see (cu after fwd + members above it)
+        cu_pre = jnp.sum(jnp.where(noh[:, :, None],
+                                   cu_fwd[:, None, :] + rexcl, 0), axis=0)
+        gguar = jnp.sum(jnp.where(noh[:, :, None],
+                                  c_guar[:, None, :], 0), axis=0)
+        local_c = jnp.maximum(0, gguar - cu_pre)
+        flow = jnp.where(act[:, None], jnp.maximum(0, flow - local_c), flow)
+
+    cu_hyp = cu_fwd[:, None, :] + RIN + OWN               # [CL,K,RF]
+    r0 = jnp.where((members & (cand_q_b == 0))[:, None], v, 0)
+    r0cs = jnp.cumsum(r0[::-1], axis=0)[::-1]
+    r0_excl = r0cs - r0
+    u0row_hyp = u_fwd[0][None, :] + r0_excl \
+        + jnp.where((cand_q_b == 0)[:, None], v, 0)
+    ok = _fits_prefix(sim, has_cohort_b, req_b, u0row_hyp, cu_hyp, ab_fb)
+    return elig & ok
 
 
 def solve_preempt_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
@@ -414,23 +609,39 @@ def solve_preempt_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
                        cand_usage_table, cand_prio_table,
                        allow_borrowing, threshold_active, threshold,
                        has_cohort):
-    """Batched minimalPreemptions. All quota tensors are gathered on
-    device from the fit solve's topology/state:
+    """Batched minimalPreemptions as a PARALLEL PREFIX program — no
+    per-candidate scan. All quota tensors are gathered on device from
+    the fit solve's topology/state:
 
     - usage[Q,F,R], cohort_usage[C,F,R]: pre-cycle state (preemption
       targets are selected in nominate, against the cycle snapshot —
       reference scheduler.go:404-441)
     - per problem b, FlavorResource slot i = (gf[b,i], gr[b,i]); local CQ
       row ql maps to global CQ gq[b,ql]; its cohort chain is
-      chain_local[b,ql] in the problem's local cohort space gc[b] (the
-      union of its CQs' chains) — the per-lane simulation state is
-      [CL,RF], not the whole [C,RF] cohort plane
+      chain_local[b,ql] in the problem's local cohort space gc[b]
 
-    Returns (targets [B,K] bool, feasible [B] bool)."""
+    The greedy remove-until-fit loop is reformulated (solver/PREEMPT.md):
+
+    1. The dynamic cq-stopped-borrowing skip only depends on a CQ's OWN
+       earlier candidates (removals never raise usage), so the do-mask
+       is a closed-form per-CQ exclusive prefix sum — no iteration.
+    2. remove_usage's cohort bubbling telescopes: each node's total
+       pass-up is a clamp difference of its total arrivals, so the
+       simulation state after ANY candidate prefix is a closed-form
+       function of per-CQ prefix sums (_chain_flows_fwd) and the fit
+       check runs for every prefix in parallel; the answer is the first
+       fitting prefix (the auction's single clearing reduction).
+    3. Fill-back runs as bounded auction rounds: each round evaluates
+       every "would it come back" hypothesis in parallel against lower/
+       upper bounds of the accepted set; the bounds squeeze monotonically
+       onto the exact reverse-greedy fixpoint (the topmost unresolved
+       candidate resolves every round), so results stay bit-identical to
+       fillBackWorkloads while typical rounds ~2-3.
+
+    Returns (targets [B,K] bool, feasible [B] bool, stats [B,4] int32 —
+    (candidate pool, prefix scanned, fill-back rounds, filled back))."""
     import jax
     import jax.numpy as jnp
-
-    NOLIM = 2**61
 
     def one(gq_b, gf_b, gr_b, gc_b, chain_local_b, req_b, frs_np_b,
             cand_q_b, cand_usage_b, cand_prio_b, ab0, th_act, th,
@@ -438,74 +649,101 @@ def solve_preempt_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
         sim = make_problem_sim(topo, usage, cohort_usage, gq_b, gf_b, gr_b,
                                gc_b, chain_local_b, req_b, has_cohort_b)
         QL = sim["QL"]
-        nominal = sim["nominal"]
+        nominal, guaranteed = sim["nominal"], sim["guaranteed"]
         u0, cu0 = sim["u0"], sim["cu0"]
-        chain_oh = sim["chain_oh"]
-        fits = sim["fits"]
-        remove_usage = sim["remove_usage"]
-        add_usage = sim["add_usage"]
 
         K = cand_q_b.shape[0]
-        arange_ql = jnp.arange(QL)
+        arange_k = jnp.arange(K)
+        valid = cand_q_b >= 0
+        q_safe = jnp.maximum(cand_q_b, 0)
+        in_cq = cand_q_b == 0
+        v = jnp.where(valid[:, None], cand_usage_b, 0)    # [K,RF]
+        u0_k = u0[q_safe]                                  # [K,RF]
+        nom_k = nominal[q_safe]
+        guar_k = guaranteed[q_safe]
 
-        # --- forward: remove until fit (minimalPreemptions) ---
-        def fwd(carry, xs):
-            u, cu, ab, done = carry
-            cq_k, cusage_k, cprio_k = xs
-            ok = (cq_k >= 0) & ~done
-            q_oh = arange_ql == jnp.maximum(cq_k, 0)     # [QL]
-            q_chain_oh = jnp.any(q_oh[:, None, None] & chain_oh, axis=0)
-            in_cq = cq_k == 0
-            # dynamic skip: other-CQ candidate whose CQ stopped borrowing
-            u_q = jnp.sum(jnp.where(q_oh[:, None], u, 0), axis=0)
-            nom_q = jnp.sum(jnp.where(q_oh[:, None], nominal, 0), axis=0)
-            borrowing_cq = jnp.any(frs_np_b & (u_q > nom_q))
-            skip = (~in_cq) & ~borrowing_cq
-            # borrowWithinCohort threshold: candidate at/above threshold
-            # forbids borrowing for the remainder (preemption.go:252-270)
-            at_or_above = th_act & (~in_cq) & (cprio_k >= th)
-            ab = ab & ~(ok & ~skip & at_or_above)
-            do = ok & ~skip
-            val = jnp.where(do, cusage_k, 0)
-            u, cu = remove_usage(u, cu, q_oh, q_chain_oh, val)
-            done = done | (do & fits(u, cu, ab))
-            return (u, cu, ab, done), do
+        # --- do-mask: the dynamic skip, closed form ---
+        # candidate k's CQ is still borrowing at its turn iff it borrows
+        # after subtracting ALL earlier same-CQ candidates (monotone:
+        # skipped ones only over-subtract an already-false condition)
+        own_all_excl = _own_cq_cumsum(cand_q_b, v, QL)
+        borrowing_before = jnp.any(
+            frs_np_b[None, :] & (u0_k - own_all_excl > nom_k), axis=1)
+        do = valid & (in_cq | borrowing_before)
 
-        init = (u0, cu0, ab0, jnp.zeros((), bool))
-        (u, cu, ab, done), do_seq = jax.lax.scan(
-            fwd, init, (cand_q_b, cand_usage_b, cand_prio_b))
+        # borrowWithinCohort threshold flip (preemption.go:252-270),
+        # cumulative — inclusive of the candidate's own flip
+        at_or_above = th_act & (~in_cq) & (cand_prio_b >= th)
+        ab_k = ab0 & ~(jnp.cumsum((do & at_or_above).astype(jnp.int32))
+                       > 0)                               # [K]
 
-        # no fit => no targets (preemption.go:300-303)
-        targets = do_seq & done
+        # --- prefix states: CQ0 row + cohort planes per prefix ---
+        own_rm_excl = _own_cq_cumsum(cand_q_b, jnp.where(do[:, None], v, 0),
+                                     QL)
+        delta0 = jnp.where(
+            do[:, None],
+            jnp.minimum(v, jnp.maximum(0, u0_k - guar_k - own_rm_excl)), 0)
 
-        # --- reverse: fill back (fillBackWorkloads) — skip the last-added
-        # target (the one that achieved the fit) ---
-        last_idx = jnp.where(done,
-                             (K - 1) - jnp.argmax(targets[::-1], axis=0), -1)
+        cand_chain = chain_local_b[q_safe]                # [K,DC]
+        dep_g = topo["cohort_depth"][jnp.maximum(gc_b, 0)]
+        dep_of_local = jnp.where(gc_b >= 0, dep_g, -1)    # [CL]
+        first = cand_chain[:, 0]
+        ed = jnp.where((first >= 0) & do,
+                       dep_of_local[jnp.maximum(first, 0)], -1)
+        IN = _chain_flows_fwd(sim, cand_chain, dep_of_local, ed, delta0)
+        cu_k = cu0[:, None, :] - IN                       # [CL,K,RF]
+        v0 = jnp.where((do & in_cq)[:, None], v, 0)
+        u0row_k = u0[0][None, :] - jnp.cumsum(v0, axis=0)  # [K,RF]
 
-        def back(carry, xs):
-            u, cu = carry
-            k, cq_k, cusage_k, target_k = xs
-            consider = target_k & (k != last_idx)
-            q_oh = arange_ql == jnp.maximum(cq_k, 0)
-            q_chain_oh = jnp.any(q_oh[:, None, None] & chain_oh, axis=0)
-            val = jnp.where(consider, cusage_k, 0)
-            u2, cu2 = add_usage(u, cu, q_oh, q_chain_oh, val)
-            still = fits(u2, cu2, ab)
-            keep_back = consider & still     # workload comes back
-            u = jnp.where(keep_back, u2, u)
-            cu = jnp.where(keep_back, cu2, cu)
-            return (u, cu), keep_back
+        fit_k = _fits_prefix(sim, has_cohort_b, req_b, u0row_k, cu_k, ab_k)
+        cond = do & fit_k
+        done = jnp.any(cond)
+        k_star = jnp.argmax(cond)                         # first fitting
+        targets_fwd = do & (arange_k <= k_star) & done
+        ab_fb = jnp.where(done, ab_k[k_star], ab0)
 
-        ks = jnp.arange(K)
-        (_, _), kept_rev = jax.lax.scan(
-            back, (u, cu),
-            (ks[::-1], cand_q_b[::-1], cand_usage_b[::-1], targets[::-1]))
-        targets = targets & ~kept_rev[::-1]
-        return targets, done
+        # --- fill-back auction rounds (fillBackWorkloads) ---
+        elig = targets_fwd & (arange_k != k_star)
+        removed_k = do & (arange_k <= k_star)
+        u_fwd = u0 - jnp.stack([
+            jnp.sum(jnp.where(((cand_q_b == q) & removed_k)[:, None], v, 0),
+                    axis=0)
+            for q in range(QL)])                          # [QL,RF]
+        cu_fwd = jnp.where(done, cu0 - IN[:, k_star, :], cu0)
+        u_fwd = jnp.where(done, u_fwd, u0)
+
+        def ok_fn(members):
+            return _fillback_ok(sim, cand_chain, dep_of_local,
+                                jnp.where(elig, ed, -1), elig, members, v,
+                                cand_q_b, q_safe, u_fwd, cu_fwd, guar_k,
+                                req_b, has_cohort_b, ab_fb, QL)
+
+        def fb_cond(carry):
+            lo, hi, it = carry
+            return jnp.any(lo != hi) & (it < K + 2)
+
+        def fb_body(carry):
+            lo, hi, it = carry
+            hi2 = ok_fn(lo)     # over-approx accepted set
+            lo2 = ok_fn(hi2)    # under-approx accepted set
+            return lo2, hi2, it + 1
+
+        hi0 = elig
+        lo0 = ok_fn(hi0)
+        lo_f, hi_f, fb_rounds = jax.lax.while_loop(
+            fb_cond, fb_body, (lo0, hi0, jnp.int32(1)))
+        came_back = lo_f
+        targets = targets_fwd & ~came_back
+
+        stats = jnp.stack([
+            jnp.sum(valid).astype(jnp.int32),
+            jnp.where(done, k_star + 1, 0).astype(jnp.int32),
+            fb_rounds,
+            jnp.sum(came_back).astype(jnp.int32)])
+        return targets, done, stats
 
     # expand the deduplicated candidate table on device (one gather each,
-    # outside the vmap/scan — the upload ships only indices + the table)
+    # outside the vmap — the upload ships only indices + the table)
     cand_q = cand_ql.astype(jnp.int32)        # [B,K]
     cand_usage = cand_usage_table[cand_idx]   # [B,K,RF]
     cand_prio = cand_prio_table[cand_idx]     # [B,K]
@@ -518,7 +756,7 @@ _SOLVE_JIT = None
 
 
 def solve_preemption_batch(topo_dev, usage, cohort_usage,
-                           batch: PreemptionBatch):
+                           batch: PreemptionBatch, with_stats: bool = False):
     """Standalone dispatch (tests / CPU-free preempt cycles). Production
     mixed cycles go through kernel.solve_cycle_with_preempt instead so
     fit + preemption share one execute."""
@@ -527,9 +765,11 @@ def solve_preemption_batch(topo_dev, usage, cohort_usage,
     import jax.numpy as jnp
     if _SOLVE_JIT is None:
         _SOLVE_JIT = jax.jit(solve_preempt_impl)
-    targets, feasible = jax.device_get(_SOLVE_JIT(
+    targets, feasible, stats = jax.device_get(_SOLVE_JIT(
         topo_dev, jnp.asarray(usage), jnp.asarray(cohort_usage),
         *preempt_args(batch)))
+    if with_stats:
+        return np.asarray(targets), np.asarray(feasible), np.asarray(stats)
     return np.asarray(targets), np.asarray(feasible)
 
 

@@ -99,6 +99,21 @@ def reset_seen_programs() -> None:
         _SEEN_PROGRAMS.clear()
 
 
+def _warm_deltas(L: int, dlt):
+    """Placeholder delta-prologue arrays for one changed-row bucket
+    (None = no prologue). The one copy of the prologue layout for
+    every warm helper — it must stay in lockstep with the dispatch
+    side's delta assembly or warm keys silently desynchronize."""
+    if dlt is None:
+        return None
+    return (np.full(dlt, -1, np.int32),
+            np.zeros(dlt, np.int32),
+            np.zeros(dlt, np.int32),
+            np.zeros(dlt, np.int64),
+            np.full((L, dlt, 3), -1, np.int32),
+            np.full((L, dlt), -1, np.int32))
+
+
 class WarmContext:
     """Host/device zero-state shared by every bucket warm: built once
     by ``BatchSolver.warm_setup`` (the only solver-state-mutating
@@ -532,14 +547,7 @@ class BatchSolver:
                               fair_sharing, sr is not None, (), (), ()))
                 warmed += 1
                 for dlt in (None,) + tuple(deltas_buckets):
-                    deltas = None
-                    if dlt is not None:
-                        deltas = (np.full(dlt, -1, np.int32),
-                                  np.zeros(dlt, np.int32),
-                                  np.zeros(dlt, np.int32),
-                                  np.zeros(dlt, np.int64),
-                                  np.full((L, dlt, 3), -1, np.int32),
-                                  np.full((L, dlt), -1, np.int32))
+                    deltas = _warm_deltas(L, dlt)
                     if ctx.arena_dev is None:
                         out = solve_cycle_resident(
                             topo_dev, usage, cohort_usage, deltas,
@@ -581,6 +589,165 @@ class BatchSolver:
             note_program(("scatter", ctx.arena_cap, self.max_podsets,
                           self._topo_dims(ctx.topo), D))
             warmed += 1
+        return warmed
+
+    def warm_preempt_bucket(self, ctx: WarmContext, width: int,
+                            pshapes, max_ranks=(8,),
+                            deltas_buckets=(8,),
+                            fair_sharing: bool = False,
+                            fs_flags: tuple = (),
+                            start_rank: bool = False) -> int:
+        """Warm the mixed admission+preemption program variants for one
+        batch width across the preemption shape ladder: the sync fused
+        kernel (solve_cycle_with_preempt) plus the resident/arena
+        variant the production scheduler actually dispatches, with and
+        without a delta prologue. ``pshapes`` is the ladder of bucketed
+        preemption dims {B, K, QL, CL, RF, U} (encode_problems buckets
+        every one of them, so the ladder can enumerate them from
+        topology alone); ``max_ranks`` is the full rank-rung ladder —
+        dispatch prices max_rank from the batch's conflict domains
+        (kernel.max_rank_bound), so warming only the top rung would
+        miss every cycle whose domains sit below it.
+
+        Without fair sharing every shape warms the minimal-preemptions
+        program. With ``fair_sharing`` the dispatch key splits by how
+        build_fair_problems partitions the cycle's entries: all-same-
+        queue entries build a MINIMAL-only batch (QL bucket 1,
+        fshapes=(), fs_strategies normalized to ()), cohort-candidate
+        entries a FAIR-only batch (pshapes=()), and a mixed cycle pairs
+        a within-CQ minimal batch with a cohort-wide fair batch — each
+        variant is warmed explicitly, because the homogeneous
+        (pargs, fargs) pairing over one geometry matches no production
+        dispatch. ``start_rank`` warms the flavor-resume twin of every
+        program (requeued heads after an eviction carry resume state,
+        so mid-storm preempt cycles routinely dispatch sr=True).
+        Registers every program so the first preemption-heavy cycle
+        after startup is not a mid-traffic compile
+        (solver/COMPILE.md)."""
+        from kueue_tpu.solver import fairpreempt
+        from kueue_tpu.solver import preempt as devpreempt
+        topo, topo_dev = ctx.topo, ctx.topo_dev
+        dims = self._topo_dims(topo)
+        DC = topo.cq_chain.shape[1]
+        if isinstance(pshapes, dict):
+            pshapes = (pshapes,)
+
+        def build_pb(shape):
+            B, K = shape["B"], shape["K"]
+            QL, CL = shape["QL"], shape["CL"]
+            RF, U = shape["RF"], shape["U"]
+            pb = devpreempt.PreemptionBatch()
+            pb.gq = np.full((B, QL), -1, np.int32)
+            pb.gf = np.full((B, RF), -1, np.int32)
+            pb.gr = np.zeros((B, RF), np.int32)
+            pb.gc = np.full((B, CL), -1, np.int32)
+            pb.chain_local = np.full((B, QL, DC), -1, np.int32)
+            pb.requests = np.zeros((B, RF), np.int64)
+            pb.frs_np = np.zeros((B, RF), bool)
+            pb.cand_idx = np.zeros((B, K), np.int32)
+            pb.cand_ql = np.full((B, K), -1, np.int16)
+            pb.cand_usage = np.zeros((U, RF), np.int64)
+            pb.cand_prio = np.zeros(U, np.int32)
+            pb.allow_borrowing = np.zeros(B, bool)
+            pb.threshold_active = np.zeros(B, bool)
+            pb.threshold = np.zeros(B, np.int64)
+            pb.has_cohort = np.zeros(B, bool)
+            pargs = devpreempt.preempt_args(pb)
+            return pb, pargs, tuple(np.asarray(a).shape for a in pargs)
+
+        def build_fb(pb, shape):
+            B, K = shape["B"], shape["K"]
+            QL, RF = shape["QL"], shape["RF"]
+            fb = fairpreempt.FairBatch(
+                **{f: getattr(pb, f) for f in (
+                    "gq", "gf", "gr", "gc", "chain_local", "requests",
+                    "frs_np", "cand_idx", "cand_ql", "cand_usage",
+                    "cand_prio", "allow_borrowing", "threshold_active",
+                    "threshold", "has_cohort")})
+            fb.cand_rank = np.full((B, K), -1, np.int32)
+            fb.cq_count = np.zeros((B, QL), np.int32)
+            fb.cq_order = np.full((B, QL), 2**30, np.int32)
+            fb.base_other = np.zeros((B, QL, RF), np.int64)
+            fb.floor_ratio = np.full((B, QL), -1, np.int64)
+            fb.floor_any = np.zeros((B, QL), bool)
+            fb.weight = np.full((B, QL), 1000, np.int64)
+            fb.lendable = np.zeros((B, RF), np.int64)
+            fargs = fairpreempt.fair_args(fb)
+            return fargs, tuple(np.asarray(a).shape for a in fargs)
+
+        flags = tuple(fs_flags)
+        built = [(shape,) + build_pb(shape) for shape in pshapes]
+        # (pargs, pshapes_key, fargs, fshapes_key, fs_strategies)
+        variants = []
+        for shape, pb, pargs, psh in built:
+            if not fair_sharing or shape["QL"] == 1:
+                # fs off: any geometry dispatches as one minimal batch;
+                # fs on: minimal problems are all-same-queue (QL 1)
+                variants.append((pargs, psh, None, (), ()))
+        if fair_sharing:
+            fair_by_b = {}
+            for shape, pb, pargs, psh in built:
+                if shape["QL"] > 1:
+                    fargs, fsh = build_fb(pb, shape)
+                    variants.append((None, (), fargs, fsh, flags))
+                    fair_by_b.setdefault(shape["B"], (fargs, fsh))
+            # mixed cycles pair a within-CQ minimal batch with a
+            # cohort-wide fair batch; pair equal B rungs (a lopsided
+            # split pays one counted compile)
+            for shape, pb, pargs, psh in built:
+                if shape["QL"] == 1 and shape["B"] in fair_by_b:
+                    fargs, fsh = fair_by_b[shape["B"]]
+                    variants.append((pargs, psh, fargs, fsh, flags))
+
+        (W, requests, podset_active, wl_cq, priority, timestamp,
+         eligible, solvable, sr_arr) = self._warm_batch_arrays(
+            topo, width, self.max_podsets)
+        P = self.max_podsets
+        args = (requests, podset_active, wl_cq, priority, timestamp,
+                eligible, solvable)
+        L = topo.cq_chain.shape[1]
+        sr = sr_arr if start_rank else None
+        sr_flag = sr is not None
+        warmed = 0
+        for max_rank in dict.fromkeys(max_ranks):
+            for pargs, psh, fargs, fsh, fflags in variants:
+                out = solve_cycle_with_preempt(
+                    ctx.topo_dev, ctx.usage, ctx.cohort_usage, *args,
+                    pargs, num_podsets=P, max_rank=max_rank,
+                    fair_sharing=fair_sharing, start_rank=sr,
+                    fair_preempt_args=fargs, fs_strategies=fflags)
+                out["admitted"].block_until_ready()
+                note_program(("preempt", dims, W, P, max_rank,
+                              fair_sharing, sr_flag, psh, fsh, fflags))
+                warmed += 1
+                for dlt in (None,) + tuple(deltas_buckets):
+                    deltas = _warm_deltas(L, dlt)
+                    if ctx.arena_dev is None:
+                        out = solve_cycle_resident(
+                            topo_dev, ctx.usage, ctx.cohort_usage,
+                            deltas, *args, num_podsets=P,
+                            max_rank=max_rank,
+                            fair_sharing=fair_sharing, start_rank=sr,
+                            preempt_args=pargs, fair_preempt_args=fargs,
+                            fs_strategies=fflags)
+                        key = ("resident", dims, W, P, max_rank,
+                               fair_sharing, sr_flag, dlt, psh, fsh,
+                               fflags)
+                    else:
+                        slots_w = np.full(W, -1, np.int32)
+                        out = solve_cycle_resident_arena(
+                            topo_dev, ctx.usage, ctx.cohort_usage,
+                            deltas, ctx.arena_dev, slots_w,
+                            num_podsets=P, max_rank=max_rank,
+                            fair_sharing=fair_sharing, start_rank=sr,
+                            preempt_args=pargs, fair_preempt_args=fargs,
+                            fs_strategies=fflags)
+                        key = ("arena", dims, ctx.arena_cap, W, P,
+                               max_rank, fair_sharing, sr_flag, dlt,
+                               psh, fsh, fflags)
+                    out["admitted"].block_until_ready()
+                    note_program(key)
+                    warmed += 1
         return warmed
 
     def warm(self, snapshot: Snapshot, widths=(2048,),
@@ -1176,9 +1343,10 @@ class BatchSolver:
         self._check_epoch(epoch)
         keys = ["admitted", "fit", "chosen", "borrows", "chosen_borrow"]
         if preempt_batch is not None:
-            keys += ["preempt_targets", "preempt_feasible"]
+            keys += ["preempt_targets", "preempt_feasible", "preempt_stats"]
         if fair_batch is not None:
-            keys += ["fair_targets", "fair_feasible", "fair_reasons"]
+            keys += ["fair_targets", "fair_feasible", "fair_reasons",
+                     "fair_stats"]
         if arena_bytes is not None:
             # Arena dispatch: the batch never shipped — only the slot
             # index array and the changed-row scatter did.
@@ -1336,11 +1504,15 @@ class BatchSolver:
         if inflight.preempt_batch is not None:
             aux = {"preempt": (np.asarray(fetched["preempt_targets"]),
                                np.asarray(fetched["preempt_feasible"]))}
+            if "preempt_stats" in fetched:
+                aux["preempt_stats"] = np.asarray(fetched["preempt_stats"])
         if getattr(inflight, "fair_batch", None) is not None:
             aux = aux or {}
             aux["fair"] = (np.asarray(fetched["fair_targets"]),
                            np.asarray(fetched["fair_feasible"]),
                            np.asarray(fetched["fair_reasons"]))
+            if "fair_stats" in fetched:
+                aux["fair_stats"] = np.asarray(fetched["fair_stats"])
         # Mirror/pending updates only apply when the plan's ResidentState
         # is still the live one (not invalidated+re-established since).
         resident_ok = plan.resident and plan.rs is self._resident

@@ -113,6 +113,57 @@ def rank_ladder(cohort_members: dict) -> tuple:
     return tuple(ranks)
 
 
+def preempt_shape_ladder(cohort_members: dict, width: int) -> tuple:
+    """Bucketed preemption-batch shapes {B,K,QL,CL,RF,U} the warm walk
+    precompiles (encode_problems buckets every dim, so a handful of
+    shape dicts cover the common storm geometries):
+
+    - a RECLAIM shape: problems spanning the widest cohort (QL = its
+      member bucket) with a candidate axis sized by the members (a few
+      victims per CQ -- the K bucket only has to match the power-of-four
+      bucket the real pool lands in, K itself is padded), and
+    - a WITHIN-CQ shape: single-CQ problems (QL bucket 1) with a small
+      pool,
+
+    each at THREE problem-count rungs: B buckets by the number of
+    preempt problems in the cycle, NOT the batch width, so the rungs
+    descend geometrically from the full-backlog bucket (every head
+    preempts) through width/4 (a full storm net of lenders -- the
+    flagship reclaim storm encodes ~one problem per borrowing head)
+    down to width/16 (a partial storm). CL/RF sit at their bucket
+    floors -- chains and request slots bucket from small minimums that
+    real topologies rarely exceed. U (the dedup row table) is pinned
+    at its floor too, but honestly: U buckets on the cycle's DISTINCT
+    victim (usage-row, priority) footprints -- workload content no
+    topology-derived ladder can enumerate -- so a heterogeneous storm
+    (>= 4 distinct footprints) lands off-ladder by construction. A
+    shape outside the ladder (a deeper partial storm, a heterogeneous
+    pool, an unusually wide one) costs ONE counted mid-traffic compile
+    (mid_traffic_compiles / compile_events_total) that the jit cache
+    then holds for the process and the persistent cache across
+    restarts; request()'s background backfill is width-keyed and does
+    not re-warm preemption shapes. Tuning U rungs from production
+    compile_events data is a ROADMAP follow-up."""
+    mm = max(cohort_members.values() or [1])
+    k_reclaim = _bucket(max(8, 4 * mm))
+    shapes = []
+    for b in dict.fromkeys(_bucket(max(1, width // d), 1)
+                           for d in (1, 4, 16)):
+        shapes.append({"B": b, "K": k_reclaim, "QL": _bucket(mm, 1),
+                       "CL": 8, "RF": 8, "U": 4})
+        shapes.append({"B": b, "K": 8, "QL": 1, "CL": 8, "RF": 8,
+                       "U": 4})
+    # cohort-less topologies collapse the two geometries (QL bucket 1,
+    # K floor 8): dedup so each variant compiles once
+    out, seen = [], set()
+    for s in shapes:
+        key = tuple(sorted(s.items()))
+        if key not in seen:
+            seen.add(key)
+            out.append(s)
+    return tuple(out)
+
+
 def snapshot_cohort_members(snapshot) -> dict:
     """cohort name (or CQ name when cohort-less) -> member CQ count."""
     members: dict = {}
@@ -185,7 +236,8 @@ class BucketState:
     warm_probe row)."""
 
     __slots__ = ("width", "ranks", "scatter", "state", "source",
-                 "attempts", "programs", "compile_s", "error")
+                 "attempts", "programs", "compile_s", "error",
+                 "fit_warm")
 
     def __init__(self, width: int, ranks: tuple, scatter: bool = False):
         self.width = width
@@ -197,6 +249,8 @@ class BucketState:
         self.programs = 0
         self.compile_s = 0.0
         self.error = ""
+        self.fit_warm = False       # fit-path variants warm (gate opens
+                                    # before the longer preempt warms)
 
     def to_dict(self) -> dict:
         return {"width": self.width, "ranks": list(self.ranks),
@@ -222,7 +276,8 @@ class CompileGovernor:
                  deltas_buckets: tuple = (8,),
                  max_attempts: int = DEFAULT_MAX_ATTEMPTS,
                  expected_pending: Optional[int] = None,
-                 fair_sharing: bool = False):
+                 fair_sharing: bool = False,
+                 warm_preempt: bool = True, fs_flags: tuple = ()):
         self.solver = solver
         self.cache = cache
         self.metrics = metrics
@@ -238,6 +293,17 @@ class CompileGovernor:
         # the ladder must warm with the same flag (manager wires it
         # from cfg.fair_sharing.enable).
         self.fair_sharing = fair_sharing
+        # Preemption/fair-share program variants ride the ladder's
+        # largest bucket (warm_preempt): the batched preemption solve is
+        # a distinct fused program per preemption-batch shape, and the
+        # first preemption-heavy cycle after startup must not be the
+        # compile that breaks max_mid_traffic_compiles=0. fs_flags is
+        # the static strategy tuple the scheduler will dispatch with
+        # (fairpreempt.strategy_flags) -- a mismatched tuple warms a
+        # program nobody runs.
+        self.warm_preempt = warm_preempt
+        self.fs_flags = tuple(fs_flags)
+        self._preempt_shapes: tuple = ()
         self.state = GOV_IDLE
         self.buckets: dict = {}       # width -> BucketState (ladder order)
         self.warmup_faults = 0        # faulted bucket attempts (total)
@@ -451,7 +517,10 @@ class CompileGovernor:
         self._ctx = ctx
         self._stamp_cache_dir(ctx.topo)
         widths = width_ladder(len(snapshot.cluster_queues), self.max_width)
-        ranks = rank_ladder(snapshot_cohort_members(snapshot))
+        members = snapshot_cohort_members(snapshot)
+        ranks = rank_ladder(members)
+        if self.warm_preempt:
+            self._preempt_shapes = preempt_shape_ladder(members, widths[0])
         with self._lock:
             self._ranks = ranks
             self.state = GOV_WARMING
@@ -472,10 +541,12 @@ class CompileGovernor:
                     # cheap).
                     b.ranks = tuple(ranks)
                     b.scatter = b.scatter or (i == 0)
+                    b.fit_warm = False  # wrong-rank fit warms don't count
                     if b.state == B_WARM:
                         b.state = B_PENDING
             self._warm_widths = frozenset(
-                w for w, st in self.buckets.items() if st.state == B_WARM)
+                w for w, st in self.buckets.items()
+                if st.state == B_WARM or st.fit_warm)
         self._set_gauge()
         self.log.v(2, "warmgov.walkStart", widths=widths, ranks=ranks,
                    deadline_s=self.bucket_deadline_s,
@@ -502,6 +573,23 @@ class CompileGovernor:
         try:
             n = self._worker.run(self._warm_body, b,
                                  deadline_s=self.bucket_deadline_s)
+            if (b.scatter and self._preempt_shapes
+                    and hasattr(self.solver, "warm_preempt_bucket")):
+                # Separate supervised windows, one per (B rung, rank)
+                # chunk: the preempt ladder is many compile batches of
+                # its own, so pricing it all inside the fit phase's
+                # deadline would make the knob's meaning scale with
+                # the ladder (a chronically-over-deadline window would
+                # retry into the same wall and SKIP, silently never
+                # warming preemption). The route gate for this width
+                # is already open (fit_warm) — a timeout in any chunk
+                # retries the bucket at the ladder tail, replaying
+                # completed chunks from the jit cache.
+                for shapes, rank, sr in self._preempt_chunks(b.ranks):
+                    n += self._worker.run(
+                        lambda bb, s=shapes, r=rank, f=sr:
+                            self._warm_preempt_chunk(bb, s, r, f),
+                        b, deadline_s=self.bucket_deadline_s)
         except DispatchTimeout as exc:
             self._fault(b, exc, timeout=True)
             return False
@@ -522,7 +610,8 @@ class CompileGovernor:
         self.programs_warmed += n
         with self._lock:
             self._warm_widths = frozenset(
-                w for w, st in self.buckets.items() if st.state == B_WARM)
+                w for w, st in self.buckets.items()
+                if st.state == B_WARM or st.fit_warm)
         if self.metrics is not None:
             self.metrics.compile_event(str(b.width), b.source, n)
         self._annotate("compile-end",
@@ -547,7 +636,53 @@ class CompileGovernor:
                                      fair_sharing=self.fair_sharing)
         if b.scatter:
             n += self.solver.warm_scatter(ctx)
+            # The width's FIT-path variants are warm: open the route
+            # gate now, before the (much longer) preemption-variant
+            # warm that follows in its own supervised window — holding
+            # fit-only traffic on cpu-warmup until every preempt shape
+            # compiles would multiply the cold-start-to-first-device-
+            # route budget (bench cold_start) by the preempt ladder's
+            # size. A preemption cycle arriving in this window pays a
+            # counted mid-traffic compile, exactly as it would for an
+            # off-ladder shape.
+            b.fit_warm = True
+            with self._lock:
+                self._warm_widths = self._warm_widths | {b.width}
         return n
+
+    def _warm_preempt_chunk(self, b: BucketState, shapes: tuple,
+                            rank: int, sr: bool) -> int:
+        # Preemption variants ride the largest (first) bucket only: a
+        # preemption storm nominates against the full backlog, so the
+        # full-width bucket is the one whose first mixed cycle must
+        # not compile; the shape ladder's descending B rungs cover
+        # partial storms, and anything deeper pays one counted
+        # mid-traffic compile (request()'s background warm is
+        # width-keyed and does not re-warm preemption shapes).
+        return self.solver.warm_preempt_bucket(
+            self._ctx, b.width, shapes, max_ranks=(rank,),
+            deltas_buckets=self.deltas_buckets,
+            fair_sharing=self.fair_sharing,
+            fs_flags=self.fs_flags, start_rank=sr)
+
+    def _preempt_chunks(self, ranks: tuple) -> list:
+        """(shapes, rank, start_rank) work units for the preempt warm,
+        one supervised window each: the ladder grouped by B rung (the
+        mixed fair variant pairs a within-CQ batch with a cohort-wide
+        batch at EQUAL B, so a rung's shapes must warm together),
+        split per rank rung and per flavor-resume twin (requeued heads
+        after an eviction carry resume state, so mid-storm preempt
+        cycles routinely dispatch the start_rank variant). Each chunk
+        is a handful of compiles — comparable to one fit-bucket warm —
+        so the per-bucket deadline keeps its meaning instead of
+        scaling with the whole ladder."""
+        by_b: dict = {}
+        for s in self._preempt_shapes:
+            by_b.setdefault(s["B"], []).append(s)
+        return [(tuple(shapes), r, sr)
+                for r in dict.fromkeys(ranks)
+                for shapes in by_b.values()
+                for sr in (False, True)]
 
     def _fault(self, b: BucketState, exc: BaseException,
                timeout: bool) -> None:

@@ -1,0 +1,542 @@
+"""Randomized equivalence + property suite for the batched (parallel
+prefix / auction) preemption solve — ISSUE 9.
+
+The batched device path (solver/preempt.py solve_preempt_impl +
+solver/fairpreempt.py solve_fair_impl) must match the CPU oracle
+(scheduler/preemption.py minimal_preemptions / fair_preemptions)
+BIT-EXACTLY: same victim sets, same reasons, same admitted maps. That
+is stronger than the documented equivalence class in solver/PREEMPT.md
+(equal victim count + equal preempted quota + policy-order ties) — the
+class exists to define what a future relaxation would have to preserve;
+today's implementation does not use the slack, and this suite pins it.
+
+Also here:
+- DRF dominant-share decomposition property: the fair kernel's masked
+  max-ratio reduction (candidates.share_view constants + the
+  share_of_row formula) reproduces ClusterQueueSnapshot.
+  dominant_resource_share for every CQ, across borrowing/cohort-depth
+  shapes.
+- fill-back auction stats surfaced on the scheduler
+  (last_preempt_plan / router_status) and the preempt-plan trace
+  annotation.
+- dedup-table bucketing (encode_problems pads the candidate row table
+  to a power-of-four bucket so preemption program shapes are warmable).
+- CompileGovernor registers preemption/fair program variants in the
+  warm ladder (warm_preempt_bucket wiring).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api import kueue as api
+from tests.test_preempt_solver import assert_preemption_differential
+from tests.test_solver import admitted_map, build_env
+from tests.wrappers import ClusterQueueWrapper, WorkloadWrapper, flavor_quotas
+
+
+class TestBatchedOracleEquivalenceFuzz:
+    """Randomized scenarios tuned for the batched solve's hard parts:
+    nested cohort trees with CQs attached at DIFFERENT depths (a shared
+    ancestor node sits at different chain positions per CQ — the
+    depth-ordered flow merge in _chain_flows_fwd), multi-resource
+    requests, borrowWithinCohort thresholds, and high-variance victim
+    sizes (fill-back heavy)."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_batched_differential(self, seed):
+        rng = random.Random(7700 + seed)
+        policies = [api.PREEMPTION_NEVER, api.PREEMPTION_LOWER_PRIORITY,
+                    api.PREEMPTION_LOWER_OR_NEWER_EQUAL_PRIORITY]
+        reclaims = [api.PREEMPTION_ANY, api.PREEMPTION_LOWER_PRIORITY,
+                    api.PREEMPTION_NEVER]
+        n_cqs = rng.randint(3, 6)
+        deep = rng.random() < 0.6
+
+        cq_specs = []
+        for i in range(n_cqs):
+            if deep:
+                # mixed attachment depth: directly under the root, or
+                # under one of two child cohorts
+                cohort = rng.choice(["root", "left", "right"])
+            else:
+                cohort = rng.choice(["root", ""])
+            bwc = None
+            if cohort and rng.random() < 0.35:
+                bwc = api.BorrowWithinCohort(
+                    policy=api.BORROW_WITHIN_COHORT_LOWER_PRIORITY,
+                    max_priority_threshold=rng.choice([None, 2, 5]))
+            cq_specs.append((f"cq{i}", cohort, rng.choice(["4", "8", "12"]),
+                             rng.choice(policies), rng.choice(reclaims),
+                             bwc))
+
+        def setup(env):
+            env.add_flavor("default")
+            if deep:
+                env.add_cohort("root")
+                env.add_cohort("left", "root")
+                env.add_cohort("right", "root")
+            for name, cohort, nominal, wcq, rwc, bwc in cq_specs:
+                w = ClusterQueueWrapper(name)
+                if cohort:
+                    w = w.cohort(cohort)
+                w = w.preemption(within_cluster_queue=wcq,
+                                 reclaim_within_cohort=rwc,
+                                 borrow_within_cohort=bwc)
+                env.add_cq(w.resource_group(
+                    flavor_quotas("default", cpu=nominal,
+                                  memory=f"{int(nominal) * 2}Gi")).obj(),
+                    f"lq-{name}")
+
+        existing_specs = []
+        for i in range(rng.randint(2, 9)):
+            cq = rng.randrange(n_cqs)
+            # high-variance victim sizes: many smalls plus a big one so
+            # the greedy over-removes and fill-back has work to do
+            cpu = rng.choice(["1", "1", "2", "2", "3", "8", "10"])
+            existing_specs.append(
+                (f"old{i}", f"cq{cq}", rng.randint(0, 6), cpu, float(i)))
+
+        pending_specs = []
+        for i in range(rng.randint(1, 4)):
+            cq = rng.randrange(n_cqs)
+            pending_specs.append(
+                (f"new{i}", f"lq-cq{cq}", rng.randint(2, 10),
+                 rng.choice(["4", "7", "10"]), float(100 + i)))
+
+        def existing():
+            return [WorkloadWrapper(n).queue(f"lq-{cq}").priority(p)
+                    .pod_set(count=1, cpu=c, memory=f"{c}Gi")
+                    .reserve(cq, now=ts).obj()
+                    for n, cq, p, c, ts in existing_specs]
+
+        def workloads():
+            return [WorkloadWrapper(n).queue(q).priority(p).creation(ts)
+                    .pod_set(count=1, cpu=c, memory=f"{c}Gi").obj()
+                    for n, q, p, c, ts in pending_specs]
+
+        assert_preemption_differential(setup, existing, workloads, cycles=2)
+
+
+class TestMultiDepthSharedNode:
+    """A cohort node shared at DIFFERENT chain positions: cq-top hangs
+    directly off the root (root at chain position 0), cq-deep off a
+    child cohort (root at position 1). The prefix solver must merge
+    their flows at the root in depth order, not chain-position order —
+    a bug here over- or under-clamps the bubbled usage and diverges
+    from the oracle."""
+
+    def test_shared_root_different_positions(self):
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cohort("root")
+            env.add_cohort("child", "root")
+            env.add_cq(ClusterQueueWrapper("top").cohort("root")
+                       .preemption(reclaim_within_cohort=api.PREEMPTION_ANY)
+                       .resource_group(
+                           flavor_quotas("default", cpu="10")).obj(),
+                       "lq-top")
+            env.add_cq(ClusterQueueWrapper("deep").cohort("child")
+                       .preemption(reclaim_within_cohort=api.PREEMPTION_ANY)
+                       .resource_group(
+                           flavor_quotas("default", cpu="6")).obj(),
+                       "lq-deep")
+
+        def existing():
+            # deep borrows past its nominal 6 with several victims; the
+            # removals must bubble through child AND root correctly
+            return [WorkloadWrapper(f"v{i}").queue("lq-deep").priority(0)
+                    .pod_set(count=1, cpu="3").reserve("deep",
+                                                       now=float(i)).obj()
+                    for i in range(4)]
+
+        def workloads():
+            return [WorkloadWrapper("claimant").queue("lq-top").priority(10)
+                    .pod_set(count=1, cpu="10").obj()]
+
+        cpu_env, _ = assert_preemption_differential(setup, existing,
+                                                    workloads)
+        assert cpu_env.client.evicted, "scenario must actually preempt"
+
+
+class TestFillbackAuctionStats:
+    """Fill-back heavy scenario: small victims ordered before a big one
+    force the greedy to over-remove and the auction rounds to return
+    the smalls. Exact oracle equality plus the operator surface: the
+    kernel's stats land on scheduler.last_preempt_plan, /debug/router,
+    and the preempt-plan trace annotation; the encode's dedup table is
+    bucketed."""
+
+    def _scenario(self):
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(ClusterQueueWrapper("cq")
+                       .preemption(
+                           within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                       .resource_group(
+                           flavor_quotas("default", cpu="14")).obj(),
+                       "lq")
+
+        def existing():
+            # order: prio asc -> three smalls first, then the big one
+            out = [WorkloadWrapper(f"small{i}").queue("lq").priority(i)
+                   .pod_set(count=1, cpu="2").reserve("cq",
+                                                      now=float(i)).obj()
+                   for i in range(3)]
+            out.append(WorkloadWrapper("big").queue("lq").priority(5)
+                       .pod_set(count=1, cpu="8").reserve("cq",
+                                                          now=9.0).obj())
+            return out
+
+        def workloads():
+            return [WorkloadWrapper("high").queue("lq").priority(10)
+                    .pod_set(count=1, cpu="8").obj()]
+
+        return setup, existing, workloads
+
+    def test_fillback_and_stats_surface(self, monkeypatch):
+        import kueue_tpu.solver.preempt as devpreempt
+        captured = []
+        orig = devpreempt.encode_problems
+
+        def capture(*a, **k):
+            b = orig(*a, **k)
+            captured.append(b)
+            return b
+
+        monkeypatch.setattr(devpreempt, "encode_problems", capture)
+        setup, existing, workloads = self._scenario()
+        cpu_env, tpu_env = assert_preemption_differential(
+            setup, existing, workloads)
+        # the greedy removes smalls then big, and fill-back returns the
+        # smalls — only the big is evicted
+        assert set(cpu_env.client.evicted) == {"default/big"}
+
+        plan = tpu_env.scheduler.last_preempt_plan
+        assert plan and "minimal" in plan, plan
+        st = plan["minimal"]
+        assert st["pool"] >= 4
+        assert st["filled_back"] >= 3, st
+        assert st["fillback_rounds_max"] >= 1
+
+        # /debug/router surfaces the same stats
+        from kueue_tpu.obs import router_status
+        rs = router_status(tpu_env.scheduler)
+        assert rs["preempt_plan"] == plan
+
+        # trace annotation on the cycle that planned preemptions
+        annos = [a for tr in tpu_env.scheduler.recorder.traces()
+                 for a in tr.annotations if a["kind"] == "preempt-plan"]
+        assert annos and annos[-1]["minimal_filled_back"] >= 3
+
+        # encode-side: the dedup row table is padded to a power-of-four
+        # bucket (warmable program shapes — solver/COMPILE.md)
+        assert captured, "device preemption encode did not run"
+        u = captured[0].cand_usage.shape[0]
+        assert u in {1, 4, 16, 64, 256, 1024}, u
+
+
+class TestDRFShareDecomposition:
+    """Property: the share decomposition the fair kernel consumes
+    (DomainCandidates.share_view constants + the masked max-ratio
+    row formula) reproduces ClusterQueueSnapshot.dominant_resource_share
+    exactly, across borrowing shapes and cohort depths."""
+
+    MAXSHARE = np.int64(2**62)
+
+    def _device_share(self, domain, sv, slots, cq):
+        qi = domain.cq_index[cq.name]
+        u = np.asarray([cq.resource_node.usage.get(fr, 0) for fr in slots],
+                       np.int64)
+        nom = np.asarray([cq.quota_for(fr).nominal for fr in slots],
+                         np.int64)
+        borrow_fr = np.maximum(0, u - nom)
+        resources = [fr.resource for fr in slots]
+        borrow_res = np.asarray(
+            [sum(b for b, r2 in zip(borrow_fr, resources) if r2 == r)
+             for r in resources], np.int64) + sv["base_other"][qi]
+        lend = sv["lendable"]
+        ratio = np.where((borrow_res > 0) & (lend > 0),
+                         borrow_res * 1000 // np.maximum(lend, 1),
+                         np.int64(-1))
+        drs = max(int(ratio.max(initial=-1)), int(sv["floor_ratio"][qi]))
+        any_b = bool((borrow_res > 0).any()) or bool(sv["floor_any"][qi])
+        w = int(sv["weight"][qi])
+        if w == 0:
+            return int(self.MAXSHARE)
+        return drs * 1000 // w if any_b else 0
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_share_view_matches_snapshot(self, seed):
+        rng = random.Random(4400 + seed)
+        n_cqs = rng.randint(2, 5)
+        depth = rng.choice([1, 2])
+
+        def setup(env):
+            env.add_flavor("default")
+            if depth == 2:
+                env.add_cohort("root")
+                env.add_cohort("mid", "root")
+            for i in range(n_cqs):
+                cohort = "mid" if (depth == 2 and i % 2) else "root"
+                env.add_cq(
+                    ClusterQueueWrapper(f"cq{i}").cohort(cohort)
+                    .preemption(reclaim_within_cohort=api.PREEMPTION_ANY)
+                    .fair_weight(rng.choice([1000, 2000, 500]))
+                    .resource_group(flavor_quotas(
+                        "default", cpu=rng.choice(["2", "4", "6"]),
+                        memory="8Gi")).obj(),
+                    f"lq-cq{i}")
+
+        env = build_env(setup, solver=False, fair_sharing=True)
+        # borrow-heavy population: usage above nominal on several CQs
+        for i in range(n_cqs):
+            for v in range(rng.randint(0, 4)):
+                env.admit_existing(
+                    WorkloadWrapper(f"w{i}-{v}").queue(f"lq-cq{i}")
+                    .pod_set(count=1, cpu=rng.choice(["1", "2", "3"]),
+                             memory="1Gi")
+                    .reserve(f"cq{i}", now=float(v)).obj())
+
+        from kueue_tpu.core import workload as wlpkg
+        from kueue_tpu.solver.candidates import CandidateIndex
+        snapshot = env.cache.snapshot()
+        idx = CandidateIndex(snapshot, wlpkg.Ordering(), 0.0)
+        for name, cq in snapshot.cluster_queues.items():
+            if cq.cohort is None:
+                continue
+            domain = idx.domain_for(cq)
+            slots = tuple(sorted(domain.all_frs()))
+            if not slots:
+                continue
+            sv = domain.share_view(slots)
+            want, _ = cq.dominant_resource_share()
+            got = self._device_share(domain, sv, slots, cq)
+            assert got == want, (name, got, want)
+
+
+class TestWarmPreemptLadder:
+    """The governor's walk warms preemption/fair program variants on the
+    largest bucket (warm_preempt_bucket wiring), and the shapes it
+    enumerates are the bucketed dims encode_problems produces."""
+
+    def test_shape_ladder_buckets(self):
+        from kueue_tpu.solver.warmgov import preempt_shape_ladder
+        shapes = preempt_shape_ladder({"a": 3, "b": 7}, 100)
+        # two geometries x three descending B rungs (B buckets by the
+        # cycle's PROBLEM count, not the batch width: full backlog,
+        # width/4, width/16)
+        assert len(shapes) == 6
+        assert {ps["QL"] == 1 for ps in shapes} == {True, False}
+        reclaim = [ps for ps in shapes if ps["QL"] > 1]
+        assert len({ps["B"] for ps in shapes}) == 3
+        assert max(ps["B"] for ps in shapes) >= 100
+        assert min(ps["B"] for ps in shapes) < 100 // 4
+        # every dim is a power-of-four bucket from its minimum
+        for ps in shapes:
+            for dim, v in ps.items():
+                assert v >= 1 and (v in (1,) or v % 4 == 0 or v == 8), \
+                    (dim, v)
+        assert reclaim[0]["QL"] >= 7  # spans the widest cohort
+
+    def test_shape_ladder_dedups_cohortless_geometries(self):
+        """With no cohorts the reclaim geometry collapses onto the
+        within-CQ one: one shape per B rung, not two."""
+        from kueue_tpu.solver.warmgov import preempt_shape_ladder
+        shapes = preempt_shape_ladder({"solo": 1}, 8)
+        assert all(ps["QL"] == 1 for ps in shapes)
+        assert len(shapes) == len({ps["B"] for ps in shapes})
+
+    def test_governor_walk_warms_preempt(self, monkeypatch, tmp_path):
+        from kueue_tpu.solver.warmgov import CompileGovernor
+
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(ClusterQueueWrapper("cq").cohort("team")
+                       .resource_group(
+                           flavor_quotas("default", cpu="4")).obj(), "lq")
+
+        env = build_env(setup, solver=True)
+        solver = env.scheduler.solver
+        calls = []
+        monkeypatch.setattr(solver, "warm_router", lambda *a, **k: 0)
+        monkeypatch.setattr(solver, "warm_bucket", lambda *a, **k: 0)
+        monkeypatch.setattr(solver, "warm_scatter", lambda *a, **k: 0)
+        monkeypatch.setattr(
+            solver, "warm_preempt_bucket",
+            lambda ctx, width, pshapes, **k: calls.append(
+                (width, tuple(pshapes), k)) or 1)
+        gov = CompileGovernor(solver, env.cache,
+                              fair_sharing=True, fs_flags=(True, True, True))
+        warmed = gov.run_sync()
+        assert calls, "walk never warmed a preemption variant"
+        assert warmed >= len(calls)
+        for _w, shapes, kw in calls:
+            assert kw.get("fair_sharing") is True
+            assert kw.get("fs_flags") == (True, True, True)
+            # one chunk = one B rung at one rank rung, so each call is
+            # a bounded compile batch under its own supervised window
+            assert len({ps["B"] for ps in shapes}) == 1
+            assert len(kw.get("max_ranks", ())) == 1
+        # across the chunks, every rank rung and the descending B
+        # rungs are covered (dispatch prices max_rank from the batch's
+        # conflict domains and B from the cycle's problem count, so
+        # the top rungs alone would miss most cycles)
+        all_ranks = {r for _w, _s, kw in calls
+                     for r in kw.get("max_ranks", ())}
+        all_b = {ps["B"] for _w, shapes, _k in calls for ps in shapes}
+        assert len(all_ranks) >= 2
+        assert len(all_b) >= 2
+        # both flavor-resume twins warm (requeued heads after an
+        # eviction dispatch the start_rank variant mid-storm)
+        assert {kw.get("start_rank") for _w, _s, kw in calls} \
+            == {False, True}
+
+    def test_governor_warm_preempt_off(self, monkeypatch):
+        from kueue_tpu.solver.warmgov import CompileGovernor
+
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(ClusterQueueWrapper("cq")
+                       .resource_group(
+                           flavor_quotas("default", cpu="4")).obj(), "lq")
+
+        env = build_env(setup, solver=True)
+        solver = env.scheduler.solver
+        calls = []
+        monkeypatch.setattr(solver, "warm_router", lambda *a, **k: 0)
+        monkeypatch.setattr(solver, "warm_bucket", lambda *a, **k: 0)
+        monkeypatch.setattr(solver, "warm_scatter", lambda *a, **k: 0)
+        monkeypatch.setattr(
+            solver, "warm_preempt_bucket",
+            lambda *a, **k: calls.append(a) or 1)
+        gov = CompileGovernor(solver, env.cache, warm_preempt=False)
+        gov.run_sync()
+        assert not calls
+
+    def test_warmed_preempt_dispatch_counts_no_mid_traffic_compiles(self):
+        """End-to-end key agreement for the preemption path: a real
+        governor warm followed by a real device preemption cycle. The
+        dispatch key buckets B by the cycle's problem count and
+        max_rank by the batch's conflict domains — warming only the
+        width-derived B at the top rank rung (the pre-review ladder)
+        missed every real preemption dispatch, so this pins the full
+        rung coverage."""
+        from kueue_tpu.solver.warmgov import GOV_WARM, CompileGovernor
+
+        def setup(env):
+            env.add_flavor("default")
+            env.add_cq(
+                ClusterQueueWrapper("cq")
+                .preemption(
+                    within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY)
+                .resource_group(flavor_quotas("default", cpu="10")).obj(),
+                "lq")
+
+        env = build_env(setup, solver=True)
+        sv = env.scheduler.solver
+        sv.bind_cache(env.cache)
+        sv.bind_queues(env.scheduler.queues)
+        gov = CompileGovernor(sv, env.cache)
+        assert gov.run_sync() > 0
+        assert gov.state == GOV_WARM
+        env.scheduler.warm_gov = gov
+        env.admit_existing(WorkloadWrapper("low").queue("lq").priority(1)
+                           .pod_set(count=1, cpu="8").reserve("cq").obj())
+        env.submit(WorkloadWrapper("high").queue("lq").priority(10)
+                   .pod_set(count=1, cpu="8").obj())
+        env.cycle()
+        assert set(env.client.evicted) == {"default/low"}
+        assert env.scheduler.cycle_counts.get("device") == 1
+        assert env.scheduler.preemption_fallbacks == 0
+        assert sv.counters["mid_traffic_compiles"] == 0
+
+    def test_fair_sharing_warm_covers_every_dispatch_variant(
+            self, monkeypatch):
+        """Under fair sharing a cycle dispatches a MINIMAL-only batch
+        (all-same-queue entries: fshapes=(), fs_strategies normalized
+        to ()), a FAIR-only batch (pshapes=()), or a mixed pair of a
+        within-CQ minimal batch with a cohort-wide fair batch
+        (build_fair_problems). The warm must register all three key
+        families — the homogeneous (minimal, fair) pairing over one
+        geometry matches no production dispatch. Kernels are stubbed:
+        this checks key structure, not compiles."""
+        from kueue_tpu.solver import service
+
+        def setup(env):
+            env.add_flavor("default")
+            for i in range(2):
+                env.add_cq(
+                    ClusterQueueWrapper(f"cq{i}").cohort("team")
+                    .resource_group(
+                        flavor_quotas("default", cpu="10")).obj(),
+                    f"lq{i}")
+
+        env = build_env(setup, solver=True, fair_sharing=True)
+        sv = env.scheduler.solver
+        sv.bind_cache(env.cache)
+        sv.bind_queues(env.scheduler.queues)
+        ctx = sv.warm_setup(env.cache.snapshot())
+
+        class _Done:
+            def block_until_ready(self):
+                return self
+
+        for fn in ("solve_cycle_with_preempt", "solve_cycle_resident",
+                   "solve_cycle_resident_arena"):
+            monkeypatch.setattr(service, fn,
+                                lambda *a, **k: {"admitted": _Done()})
+        keys = []
+        monkeypatch.setattr(service, "note_program",
+                            lambda key: keys.append(key) or True)
+
+        from kueue_tpu.solver.warmgov import preempt_shape_ladder
+        shapes = preempt_shape_ladder({"team": 2}, 8)
+        flags = (True, True, False)
+        sv.warm_preempt_bucket(ctx, 8, shapes, max_ranks=(8,),
+                               fair_sharing=True, fs_flags=flags)
+        sync = [k for k in keys if k[0] == "preempt"]
+        # key layout: ("preempt", dims, W, P, max_rank, fair_sharing,
+        #              sr, pshapes, fshapes, flags)
+        minimal_only = [k for k in sync if k[7] and not k[8]]
+        fair_only = [k for k in sync if not k[7] and k[8]]
+        mixed = [k for k in sync if k[7] and k[8]]
+        assert minimal_only and fair_only and mixed
+        for k in minimal_only:
+            assert k[9] == (), "no fair batch => fs_strategies ()"
+            assert k[7][0][1] == 1, "minimal problems are same-queue"
+        for k in fair_only + mixed:
+            assert k[9] == flags
+        for k in mixed:
+            # heterogeneous pairing: within-CQ minimal (QL bucket 1)
+            # with a cohort-wide fair batch (QL bucket > 1)
+            assert k[7][0][1] == 1 and k[8][0][1] > 1
+        # resident/arena variants mirror the same families
+        res = [k for k in keys if k[0] in ("resident", "arena")]
+        assert any(k[-3] and not k[-2] for k in res)
+        assert any(not k[-3] and k[-2] for k in res)
+        assert any(k[-3] and k[-2] for k in res)
+
+
+class TestTenantStormRouteCoverage:
+    """PR-8 tenant-storm scenario with the production solver attached:
+    the storm's preemption-heavy cycles are tagged on traces and the
+    route mix is recorded; the device-route gate itself follows the
+    cross-backend honesty policy (enforced on a device backend, refused
+    with a recorded reason on CPU fallback)."""
+
+    @pytest.mark.slow
+    def test_storm_route_mix_recorded(self):
+        import jax
+
+        from kueue_tpu.sim.scenarios import run_tenant_storm
+        res = run_tenant_storm(seed=0, scale="smoke", solver=True)
+        assert res.ok, res.violations
+        mix = res.counters["storm_route_mix"]
+        assert mix, "no storm/drain cycles traced"
+        assert res.counters["storm_preempt_cycles"] > 0, mix
+        if jax.default_backend() == "cpu":
+            assert "route_gate_refused" in res.counters
+        else:
+            assert res.counters["storm_preempt_device_cycles"] > 0

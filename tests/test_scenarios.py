@@ -310,6 +310,26 @@ class TestScenarioRunCLI:
             mod.main(["no-such-scenario"])
         assert exc.value.code == 2
 
+    def test_solver_flag_forwards_and_rejects_ungated(self, monkeypatch):
+        """--solver reaches the scenario callable (the ROADMAP-item-2
+        tenant-storm route gate is operator-runnable, not just a slow
+        test), and asking for it on a scenario without a solver mode
+        is a loud error, not a silent no-op."""
+        from kueue_tpu.sim import scenarios as sc
+        seen = {}
+
+        def fake_storm(seed=0, scale="full", solver=False):
+            seen.update(seed=seed, scale=scale, solver=solver)
+            return sc.ScenarioResult("tenant_storm", seed, scale)
+
+        monkeypatch.setitem(sc.SCENARIOS, "tenant_storm", fake_storm)
+        res = sc.run_scenario("tenant_storm", seed=3, scale="smoke",
+                              solver=True)
+        assert res.name == "tenant_storm"
+        assert seen == {"seed": 3, "scale": "smoke", "solver": True}
+        with pytest.raises(ValueError, match="no solver mode"):
+            sc.run_scenario("requeue_flood", scale="smoke", solver=True)
+
     def test_single_scenario_with_json_artifact(self, tmp_path, capsys):
         mod = _load_scenario_run()
         rc = mod.main(["requeue_flood", "--seed", "0",

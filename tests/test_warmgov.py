@@ -183,7 +183,10 @@ class TestRouteGate:
         # arena-gather variant at the floor capacity).
         solver.bind_cache(env.cache)
         solver.bind_queues(env.scheduler.queues)
-        gov = CompileGovernor(solver, env.cache)
+        # warm_preempt off: this test pins the FIT-path key agreement;
+        # the preemption-path analog (which needs the full preempt
+        # shape ladder) lives in tests/test_preempt_batched.py
+        gov = CompileGovernor(solver, env.cache, warm_preempt=False)
         assert gov.run_sync() > 0
         assert gov.state == GOV_WARM
         env.scheduler.warm_gov = gov

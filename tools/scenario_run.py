@@ -47,6 +47,10 @@ def main(argv=None) -> int:
     ap.add_argument("--scale", choices=("smoke", "full"), default="smoke")
     ap.add_argument("--json", metavar="DIR", default=None,
                     help="write one <scenario>.json artifact per run")
+    ap.add_argument("--solver", action="store_true",
+                    help="run with the production batched solver and its "
+                         "route-coverage gate (solver-gated scenarios "
+                         "only, e.g. tenant_storm)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -64,7 +68,8 @@ def main(argv=None) -> int:
 
     results = []
     for name in names:
-        res = run_scenario(name, seed=args.seed, scale=args.scale)
+        res = run_scenario(name, seed=args.seed, scale=args.scale,
+                           solver=args.solver)
         results.append(res)
         print(json.dumps(res.to_dict()), file=sys.stderr)
         if args.json:

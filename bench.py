@@ -1554,6 +1554,212 @@ def bench_cold_start(num_cqs=32, num_cohorts=8, budget_s=240.0):
     return cold["first_device_cycle_s"], primed["first_device_cycle_s"]
 
 
+# Crash-restart recovery budgets (ISSUE 10 acceptance). The cycle
+# bound is VIRTUAL/structural — cycles from restore() to the first
+# admitted cycle — so it is backend-agnostic and always asserted. The
+# wall bound covers restore() itself (checkpoint load + WAL replay +
+# reconcile settle) and was calibrated on XLA-CPU host runs, so per
+# the perf.checker honesty policy it declares backend "cpu" and the
+# comparison is REFUSED (rangespec_refused) on any other backend
+# instead of minting a fake verdict.
+RESTART_RECOVERY_RANGESPEC_BACKEND = "cpu"
+RESTART_RECOVERY_MAX_RESTORE_WALL_S = 10.0
+RESTART_RECOVERY_MAX_CYCLES_TO_ADMIT = 3
+
+
+def bench_restart_recovery(num_cqs=16, num_cohorts=4, waves=4,
+                           budget_s=240.0):
+    """Crash-restart durability (resilience/recovery.py +
+    RESILIENCE.md §6): two full process lifetimes sharing one
+    persistent compilation cache dir. Each life runs the production
+    config (durable store + solver + compile governor), is killed by an
+    injected crash at a store-write mid-traffic, and is restored from
+    the durable store into a "new process" (jit caches cleared, warmed
+    registry reset, fresh BatchSolver).
+
+    Measured per recovery: restore() wall seconds (load + replay +
+    settle), cycles from restore to the first admitted cycle, and
+    compile provenance during recovery. Asserts: both recoveries admit
+    within RESTART_RECOVERY_MAX_CYCLES_TO_ADMIT cycles (the cpu-warmup
+    gate keeps admission flowing while buckets warm — recovery never
+    waits on a compile); zero mid-traffic compiles; and the SECOND
+    life's recovery — running against the cache the first life
+    persisted — performs zero fresh bucket compiles (pure cache load),
+    the "etcd is the checkpoint, restart is cheap" property end-to-end
+    (SURVEY.md §5)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from kueue_tpu import config as cfgpkg
+    from kueue_tpu.api.meta import FakeClock
+    from kueue_tpu.manager import KueueManager
+    from kueue_tpu.resilience import faultinject, recovery
+    from kueue_tpu.resilience.faultinject import (
+        CRASH, FaultInjector, InjectedCrash)
+    from kueue_tpu.solver import BatchSolver
+    from kueue_tpu.solver import service as svc
+    from kueue_tpu.solver import warmgov
+    from kueue_tpu.utils.runtime import enable_compilation_cache
+
+    cache_dir = tempfile.mkdtemp(prefix="kueue-restart-")
+
+    def make_cfg():
+        cfg = cfgpkg.Configuration()
+        cfg.solver.enable = True
+        cfg.solver.min_heads = 0
+        cfg.solver.routing = "always"
+        cfg.solver.compile_cache_dir = cache_dir
+        cfg.solver.warmup_at_startup = True
+        cfg.store.durable = True
+        return cfg
+
+    def drive_cycle(mgr, clock, label, wave, n):
+        for i in range(num_cqs):
+            mgr.store.create(make_workload(f"{label}-w{n}", f"lq{i}",
+                                           cpu_units=1,
+                                           creation=float(n)))
+            n += 1
+        mgr.run_until_idle(max_iterations=1_000_000)
+        mgr.scheduler.schedule(timeout=0)
+        mgr.run_until_idle(max_iterations=1_000_000)
+        clock.advance(1.0)
+        return n
+
+    def one_life(label):
+        """Fresh process -> traffic -> seeded kill. Returns the
+        durable log (the state that survives) and the shared clock."""
+        jax.clear_caches()
+        svc.reset_seen_programs()
+        clock = FakeClock(1000.0)
+        mgr = KueueManager(cfg=make_cfg(), clock=clock,
+                           solver=BatchSolver())
+        for obj in ([make_flavor("f0")]
+                    + [make_cq(f"cq{i}", f"cohort-{i % num_cohorts}",
+                               ["f0"], nominal_units=100_000)
+                       for i in range(num_cqs)]
+                    + [make_lq(f"lq{i}", f"cq{i}")
+                       for i in range(num_cqs)]):
+            mgr.store.create(obj)
+        mgr.run_until_idle(max_iterations=1_000_000)
+        n = 0
+        for wave in range(waves):
+            n = drive_cycle(mgr, clock, label, wave, n)
+        faultinject.install(FaultInjector(
+            {faultinject.SITE_STORE: {5: CRASH}}))
+        crashed = False
+        try:
+            drive_cycle(mgr, clock, label, waves, n)
+        except InjectedCrash:
+            crashed = True
+        finally:
+            faultinject.uninstall()
+        assert crashed, "kill point never fired"
+        # In-process simulation hygiene (a real SIGKILL needs none):
+        # the dead life's background governor thread must not keep
+        # compiling into the module-global program registry while the
+        # "new process" resets it — that would mask real mid-traffic
+        # compiles and skew the primed-run provenance.
+        mgr.warm_governor.stop()
+        return mgr.durable, clock
+
+    def one_recovery(durable, clock, label):
+        """The 'new process': cleared jit caches, fresh solver —
+        everything it reuses must come from the durable store or the
+        persistent compilation cache."""
+        jax.clear_caches()
+        svc.reset_seen_programs()
+        t0 = time.perf_counter()
+        mgr = recovery.restore(durable, cfg=make_cfg(), clock=clock,
+                               solver=BatchSolver())
+        restore_wall_s = mgr.last_recovery.duration_s
+        n = 100_000  # fresh names: pre-crash arrivals are durable
+        cycles_to_admit = None
+        before = mgr.recorder.reason_counts.get("QuotaReserved", 0)
+        for cycle in range(10):
+            if time.perf_counter() - t0 > budget_s:
+                break
+            n = drive_cycle(mgr, clock, label, cycle, n)
+            if mgr.recorder.reason_counts.get("QuotaReserved",
+                                              0) > before:
+                cycles_to_admit = cycle + 1
+                break
+        # Drain the warm ladder before "shutdown" so this life's
+        # compiles persist for the next one (cold_start's contract).
+        t_drain = time.perf_counter()
+        while (mgr.warm_governor.state == warmgov.GOV_WARMING
+               and time.perf_counter() - t_drain < budget_s):
+            time.sleep(0.1)
+        st = mgr.warm_governor.status()
+        fresh = sum(1 for b in st["buckets"] if b["source"] == "fresh")
+        mid = mgr.scheduler.solver.counters["mid_traffic_compiles"]
+        rep = mgr.last_recovery.to_dict()
+        mgr.shutdown()
+        return {"restore_wall_s": round(restore_wall_s, 4),
+                "cycles_to_first_admission": cycles_to_admit,
+                "mid_traffic_compiles": mid, "fresh_buckets": fresh,
+                "warmup_state": st["state"],
+                "admitted_restored": rep["admitted_restored"],
+                "wal_records_replayed": rep["wal_records_replayed"]}
+
+    try:
+        d1, clk1 = one_life("life1")
+        cold = one_recovery(d1, clk1, "rec1")
+        cache_supported = any(files for _, _, files in os.walk(cache_dir))
+        d2, clk2 = one_life("life2")
+        primed = one_recovery(d2, clk2, "rec2")
+    finally:
+        faultinject.uninstall()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        enable_compilation_cache()  # restore the shared bench cache dir
+
+    # Backend-agnostic gates: recovery admits within the cycle bound
+    # and never pays a hot-path compile (the cpu-warmup gate holds).
+    for name, rec in (("cold", cold), ("primed", primed)):
+        assert rec["cycles_to_first_admission"] is not None \
+            and rec["cycles_to_first_admission"] \
+            <= RESTART_RECOVERY_MAX_CYCLES_TO_ADMIT, (name, rec)
+        assert rec["mid_traffic_compiles"] == 0, (name, rec)
+    # The primed recovery rode the persistent cache: zero fresh bucket
+    # compiles (structural proof, like cold_start's). Only assertable
+    # when the first life's recovery finished its ladder within budget
+    # (so every bucket persisted) — a drain cut short leaves buckets
+    # the second life must legitimately compile fresh.
+    primed_verifiable = (cache_supported
+                         and cold["warmup_state"] != warmgov.GOV_WARMING)
+    if primed_verifiable:
+        assert primed["fresh_buckets"] == 0, primed
+
+    # Wall budget: calibrated on "cpu" — refuse cross-backend instead
+    # of judging (perf.checker honesty policy, ISSUE 10 satellite).
+    from kueue_tpu.perf.checker import RangeSpec, refuse_cross_backend
+    spec = RangeSpec(backend=RESTART_RECOVERY_RANGESPEC_BACKEND,
+                     max_wall_s=RESTART_RECOVERY_MAX_RESTORE_WALL_S)
+    refusal = refuse_cross_backend(spec, BACKEND)
+    row = {"bench": "restart_recovery", "cqs": num_cqs, "waves": waves,
+           "cache_supported": cache_supported,
+           "primed_fresh_verified": primed_verifiable,
+           "cold": cold, "primed": primed,
+           "max_cycles_to_admit": RESTART_RECOVERY_MAX_CYCLES_TO_ADMIT,
+           "rangespec": {"backend": spec.backend,
+                         "max_restore_wall_s": spec.max_wall_s}}
+    if refusal is not None:
+        row["rangespec_ok"] = None
+        row["rangespec_refused"] = refusal
+    else:
+        worst = max(cold["restore_wall_s"], primed["restore_wall_s"])
+        row["rangespec_ok"] = worst <= spec.max_wall_s
+        if not row["rangespec_ok"]:
+            row["rangespec_violation"] = (
+                f"restore wall {worst:.3f}s exceeds "
+                f"{spec.max_wall_s:.1f}s")
+            log(row)
+            raise AssertionError(row["rangespec_violation"])
+    log(row)
+    return cold["restore_wall_s"], primed["restore_wall_s"]
+
+
 def main():
     import jax
     from kueue_tpu.utils.runtime import ensure_live_backend
@@ -1569,6 +1775,7 @@ def main():
     bench_overload_shed()
     bench_scenario_slo()
     bench_cold_start()
+    bench_restart_recovery()
     hit_rate = bench_speculative_pipeline()
     rows = {}
     admitted_per_sec, speedup = bench_e2e_progressive()

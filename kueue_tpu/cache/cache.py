@@ -117,6 +117,14 @@ class Cache:
         self.snapshot_stats = {"full": 0, "incremental": 0, "light": 0,
                                "partial": 0}
         self.snapshot_build_s: list = []
+        # Handout accounting (ISSUE 10 satellite): every FULL snapshot
+        # handed out through snapshot() counts as taken, every
+        # release_snapshot of one counts as released (idempotent — a
+        # double release counts once). live_handouts is the leak
+        # detector the crash-restart suite asserts returns to zero
+        # after a shutdown that dropped an in-flight speculative cycle.
+        self.handouts_taken = 0
+        self.handouts_released = 0
 
     def _new_cohort(self, name: str) -> CohortCache:
         cohort = CohortCache(name)
@@ -673,6 +681,8 @@ class Cache:
                 # reflect anyway.
                 del self.snapshot_build_s[:1 << 19]
             self.snapshot_build_s.append(_time.perf_counter() - t0)
+            self.handouts_taken += 1
+            snap._handout_live = True
             return snap
 
     def release_snapshot(self, snap: Snapshot) -> None:
@@ -686,8 +696,20 @@ class Cache:
         if getattr(snap, "light", False):
             return
         with self._lock:
+            if getattr(snap, "_handout_live", False):
+                snap._handout_live = False
+                self.handouts_released += 1
             if self._maintainer is not None:
                 self._maintainer.release(snap)
+
+    @property
+    def live_handouts(self) -> int:
+        """Full snapshots handed out and not yet released — the leak
+        detector for abandoned cycles (ISSUE 10 satellite). Consumers
+        that legitimately never release (debug oracles) keep their
+        handouts counted here; the scheduler/solver paths all
+        release."""
+        return self.handouts_taken - self.handouts_released
 
     def _build_snapshot(self, light: bool = False) -> Snapshot:
         """From-scratch snapshot construction (the full deep clone, or

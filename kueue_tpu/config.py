@@ -182,6 +182,25 @@ class SchedulerConfig:
     cycle_ewma_alpha: float = DEFAULT_CYCLE_EWMA_ALPHA
 
 
+# Durable-store defaults (kueue_tpu/sim/durable.py + RESILIENCE.md §6).
+DEFAULT_STORE_CHECKPOINT_EVERY = 512
+
+
+@dataclass
+class StoreConfig:
+    """Durability for the sim object store — the "etcd is the
+    checkpoint, restart is cheap" property (SURVEY.md §5,
+    resilience/recovery.py). ``durable`` turns on the checkpoint/WAL
+    event log; ``wal_dir`` empty keeps it in fsync-free process memory
+    (tests, crash harnesses — the log object outliving the manager IS
+    the simulated disk), a path puts checkpoint.bin + wal.log in real
+    files. A full checkpoint compacts the WAL every
+    ``checkpoint_every`` records."""
+    durable: bool = False
+    wal_dir: str = ""
+    checkpoint_every: int = DEFAULT_STORE_CHECKPOINT_EVERY
+
+
 # Cycle flight recorder defaults (kueue_tpu/obs/OBSERVABILITY.md).
 DEFAULT_FLIGHT_RECORDER_CAPACITY = 256
 
@@ -290,6 +309,7 @@ class Configuration:
     multi_kueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     resources: Resources = field(default_factory=Resources)
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    store: StoreConfig = field(default_factory=StoreConfig)
     solver: SolverConfig = field(default_factory=SolverConfig)
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
@@ -387,6 +407,9 @@ def validate(cfg: Configuration) -> list[str]:
                     "scheduler.recoveryCycles must be >= 1")
     if not 0 < sc.cycle_ewma_alpha <= 1:
         errs.append("scheduler.cycleEwmaAlpha must be in (0, 1]")
+    if cfg.store.checkpoint_every < 0:
+        errs.append("store.checkpointEvery must be >= 0 (0 disables "
+                    "automatic WAL compaction)")
     return errs
 
 
@@ -479,6 +502,14 @@ def load(raw: dict) -> Configuration:
                                    DEFAULT_RECOVERY_CYCLES),
             cycle_ewma_alpha=sc.get("cycleEwmaAlpha",
                                     DEFAULT_CYCLE_EWMA_ALPHA),
+        )
+    if "store" in raw:
+        st = raw["store"]
+        cfg.store = StoreConfig(
+            durable=st.get("durable", False),
+            wal_dir=st.get("walDir", ""),
+            checkpoint_every=st.get("checkpointEvery",
+                                    DEFAULT_STORE_CHECKPOINT_EVERY),
         )
     if "solver" in raw:
         s = raw["solver"]

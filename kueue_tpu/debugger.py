@@ -50,9 +50,20 @@ class Dumper:
     def _dump_solver_plane(self) -> list:
         from kueue_tpu.obs import (arena_status, breaker_status,
                                    degrade_status, pipeline_status,
-                                   router_status, warmup_status)
+                                   recovery_status, router_status,
+                                   warmup_status)
         sched = self.scheduler
-        lines = ["-- breaker --"]
+        lines = []
+        rc = recovery_status(sched)
+        if rc["restored"]:
+            lines.append("-- recovery --")
+            lines.append(f"restored=True duration_s={rc['duration_s']} "
+                         f"checkpoint={rc['checkpoint_loaded']} "
+                         f"wal_records={rc['wal_records_replayed']} "
+                         f"torn={rc['torn_records']} "
+                         f"admitted={rc['admitted_restored']} "
+                         f"pending={rc['pending_restored']}")
+        lines.append("-- breaker --")
         st = breaker_status(sched)
         lines.append(f"state={st['state']} route={st['route']} "
                      f"consecutive={st['consecutive_faults']}/"

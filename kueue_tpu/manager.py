@@ -87,6 +87,22 @@ class KueueManager:
         vlog.set_verbosity(max(self.cfg.verbosity, vlog.env_verbosity()))
         self.clock = clock
         self.store = store if store is not None else Store(clock)
+        # Durable store (sim/durable.py + RESILIENCE.md §6): with
+        # store.durable the manager-owned store journals every committed
+        # mutation to a checkpoint/WAL log, and restore() (below)
+        # rebuilds a whole control plane from it after a crash. A store
+        # passed IN keeps its caller-owned durability (HA replicas share
+        # one store; recovery re-attaches after the replay).
+        self.durable = getattr(self.store, "_durable", None)
+        st = self.cfg.store
+        if store is None and st.durable and self.durable is None:
+            from kueue_tpu.sim.durable import DurableLog
+            self.durable = DurableLog(dir=st.wal_dir or None,
+                                      checkpoint_every=st.checkpoint_every)
+            self.store.attach_durable(self.durable)
+        # Crash-restart recovery report (resilience/recovery.py):
+        # populated by restore() on a recovered manager.
+        self.last_recovery = None
         self.recorder = EventRecorder()
         self.metrics = Registry()
         # metrics: every reconcile lands in reconcile_seconds{controller}
@@ -327,6 +343,39 @@ class KueueManager:
     def _namespace_labels(self, ns: str) -> Optional[dict]:
         obj = self.store.try_get("Namespace", "", ns)
         return obj.metadata.labels if obj is not None else {}
+
+    # -- crash-restart durability (resilience/recovery.py) --------------
+
+    def shutdown(self, checkpoint: bool = True) -> None:
+        """Graceful process exit: stop the scheduler loop AND abandon
+        the in-flight speculative cycle (its snapshot handout goes back
+        to the maintainer, device residency + arena claims drop — never
+        strand; the requeued heads are moot for THIS process but keep
+        the queues consistent if the caller drives more cycles), stop
+        the warm governor and visibility server, and take a final
+        durable checkpoint so a restart replays no WAL tail. The
+        manager object stays readable (store, caches) but must not
+        schedule again."""
+        self.scheduler.stop()
+        if self.warm_governor is not None:
+            self.warm_governor.stop()
+        if self.visibility_server is not None:
+            self.visibility_server.stop()
+            self.visibility_server = None
+        if checkpoint and self.durable is not None:
+            self.store.checkpoint_now()
+
+    @classmethod
+    def restore(cls, durable, cfg=None, clock: Clock = REAL_CLOCK,
+                solver=None, **kwargs) -> "KueueManager":
+        """Rebuild a control plane from a durable log's newest
+        recoverable state (a crashed predecessor's checkpoint + WAL
+        tail). See kueue_tpu/resilience/recovery.py for the recovery
+        contract; the returned manager's ``last_recovery`` carries the
+        report."""
+        from kueue_tpu.resilience import recovery
+        return recovery.restore(durable, cfg=cfg, clock=clock,
+                                solver=solver, **kwargs)
 
     # -- operator surface ----------------------------------------------
 

@@ -333,6 +333,17 @@ class Registry:
             "kueue_scheduler_speculation_aborts_total",
             "Speculative pipelined results abandoned at apply-validation "
             "by reason", ["reason"])
+        # Crash-restart durability (resilience/recovery.py +
+        # RESILIENCE.md §6): restarts recovered from the durable store
+        # and how long the rebuild (load + replay + settle) took.
+        self.restarts_total = Counter(
+            "kueue_manager_restarts_total",
+            "Control-plane restarts recovered from the durable store")
+        self.recovery_seconds = Histogram(
+            "kueue_manager_recovery_seconds",
+            "Wall seconds from restore() entry to a settled control "
+            "plane (checkpoint load + WAL replay + reconcile drain)",
+            buckets=exponential_buckets(0.005, 2.0, 16))
         # Coarse reconciler latency (ROADMAP PR-4 follow-up: the
         # wall_s - cycle_time_total gap had no signal); fed by the sim
         # Runtime around every reconcile call.
@@ -419,6 +430,10 @@ class Registry:
         self.reconcile_event_seconds.observe(seconds,
                                              controller=controller,
                                              event=event)
+
+    def restart_recovered(self, seconds: float) -> None:
+        self.restarts_total.inc()
+        self.recovery_seconds.observe(seconds)
 
     def speculation_hit(self) -> None:
         self.speculation_hits_total.inc()

@@ -12,6 +12,7 @@ from kueue_tpu.obs.status import (
     breaker_status,
     degrade_status,
     pipeline_status,
+    recovery_status,
     router_status,
     warmup_status,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "breaker_status",
     "degrade_status",
     "pipeline_status",
+    "recovery_status",
     "router_status",
     "warmup_status",
 ]

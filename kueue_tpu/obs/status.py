@@ -118,6 +118,20 @@ def warmup_status(scheduler) -> dict:
     return st
 
 
+def recovery_status(scheduler) -> dict:
+    """Crash-restart recovery report (/debug/recovery): what the last
+    restore() rebuilt from the durable store — checkpoint/WAL replay
+    provenance (incl. torn-tail fallbacks), restored object counts,
+    admitted-vs-pending workload split, and the rebuild duration
+    (RESILIENCE.md §6). ``restored`` False = this process never
+    recovered (a cold start)."""
+    rep = scheduler.last_recovery
+    out = {"restored": rep is not None}
+    if rep is not None:
+        out.update(rep)
+    return out
+
+
 def arena_status(solver) -> dict:
     """Encode-arena slot occupancy and churn counters."""
     arena = getattr(solver, "_arena", None)
@@ -167,6 +181,8 @@ class DebugEndpoints:
             return pipeline_status(self.scheduler)
         if path == "/debug/warmup":
             return warmup_status(self.scheduler)
+        if path == "/debug/recovery":
+            return recovery_status(self.scheduler)
         if path == "/debug/arena":
             if self.scheduler.solver is None:
                 return {"bound": False}

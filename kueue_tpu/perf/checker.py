@@ -164,6 +164,13 @@ class SLOSpec:
     # every workload admitted exactly once with no evictions.
     max_requeue_amplification: float = 0.0
     max_evictions: Optional[int] = None
+    # Crash-restart durability (RESILIENCE.md §6): max VIRTUAL seconds
+    # from a restore back to the next admission grant — the
+    # recovery-to-first-admission SLO. Virtual time keeps it
+    # backend-agnostic like every other SLOSpec bound. None =
+    # unchecked; with a bound set, a scenario that restarted but never
+    # admitted again is itself a violation.
+    max_recovery_to_first_admission_s: Optional[float] = None
 
 
 def check_slo(result, spec: SLOSpec) -> list:
@@ -208,6 +215,18 @@ def check_slo(result, spec: SLOSpec) -> list:
         violations.append(
             f"{result.evictions} evictions exceed bound "
             f"{spec.max_evictions}")
+    if spec.max_recovery_to_first_admission_s is not None:
+        restarts = getattr(result, "restarts", 0)
+        recov = getattr(result, "recovery_to_first_admission_s", [])
+        if restarts and len(recov) < restarts:
+            violations.append(
+                f"{restarts - len(recov)} of {restarts} restart(s) "
+                "never re-admitted a workload")
+        worst = max(recov) if recov else 0.0
+        if worst > spec.max_recovery_to_first_admission_s:
+            violations.append(
+                f"recovery-to-first-admission {worst:.1f}s exceeds "
+                f"{spec.max_recovery_to_first_admission_s:.1f}s")
     return violations
 
 

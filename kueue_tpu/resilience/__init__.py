@@ -36,11 +36,14 @@ from kueue_tpu.resilience.degrade import (  # noqa: F401
 from kueue_tpu.resilience.faultinject import (  # noqa: F401
     DeviceFault,
     FaultInjector,
+    InjectedCrash,
     InjectedFault,
+    SITE_APPLY,
     SITE_COLLECT,
     SITE_DISPATCH,
     SITE_REPLAY,
     SITE_SCATTER,
+    SITE_STORE,
     SITES,
 )
 from kueue_tpu.resilience.supervisor import (  # noqa: F401

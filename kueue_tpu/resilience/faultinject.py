@@ -13,10 +13,25 @@ can hurt the admission cycle (see RESILIENCE.md):
   (solver/COMPILE.md; a DELAY here is a wedged remote compile — the
   governor's per-bucket deadline abandons the bucket and the ladder
   continues, never wedging startup)
+- ``store_write``      — the sim store's commit point, AFTER the WAL
+  append and BEFORE the watch-event notify (sim/durable.py): a crash
+  here is the "durable but unobserved" window — the write survives
+  restart even though no live component ever saw it
+- ``apply_commit``     — the scheduler's admission write, AFTER the
+  cache assumption and BEFORE the store write: a crash here loses the
+  in-memory assumption while the store still says pending — the
+  workload must requeue on restore, never double-admit
 
 Each site can, per a deterministic scripted schedule, RAISE (a dead
 tunnel / XLA error), DELAY (a wedged ``device_get`` — the watchdog's
-regime), or CORRUPT the payload passing through it. Corruption is
+regime), CORRUPT the payload passing through it, or CRASH — simulate
+process death mid-cycle by raising ``InjectedCrash``, which subclasses
+``BaseException`` so NO containment layer (the scheduler's fallback
+``except Exception`` handlers, the breaker, the supervisor) can absorb
+it; it propagates to the top of the driving loop, where the
+crash-restart harness (resilience/recovery.py, tools/crash_run.py)
+discards the dead manager and restores from the durable store.
+Corruption is
 applied by the call site's own ``corrupt=`` callable, so every site
 scrambles exactly the data that crosses it; the containment contract
 (which corruptions the system must detect vs. deny conservatively) is
@@ -54,13 +69,22 @@ SITE_SPECULATION = "speculation_validate"
 # that bucket, never a cycle. Appended after SITE_SPECULATION so seeded
 # scripted() schedules for the earlier sites are unchanged.
 SITE_WARMUP = "compile_warmup"
+# Crash-restart sites (RESILIENCE.md §6). Appended last so seeded
+# scripted() schedules for the earlier sites are unchanged; scripted()
+# defaults them to rate 0 (a crash ends the run — the kill-point sweep
+# schedules them explicitly, one seeded (site, hit) per run).
+SITE_STORE = "store_write"
+SITE_APPLY = "apply_commit"
 SITES = (SITE_DISPATCH, SITE_COLLECT, SITE_SCATTER, SITE_REPLAY,
-         SITE_SPECULATION, SITE_WARMUP)
+         SITE_SPECULATION, SITE_WARMUP, SITE_STORE, SITE_APPLY)
 
 RAISE = "raise"
 DELAY = "delay"
 CORRUPT = "corrupt"
-ACTIONS = (RAISE, DELAY, CORRUPT)
+# Simulated process death: raises InjectedCrash (a BaseException) that
+# no fallback/containment layer may catch — valid at EVERY site.
+CRASH = "crash"
+ACTIONS = (RAISE, DELAY, CORRUPT, CRASH)
 
 
 class DeviceFault(RuntimeError):
@@ -75,6 +99,21 @@ class InjectedFault(DeviceFault):
 
     def __init__(self, site: str, hit: int):
         super().__init__(f"injected fault at {site} (hit {hit})")
+        self.site = site
+        self.hit = hit
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death at an injection site. Deliberately a
+    BaseException (like KeyboardInterrupt): every ``except Exception``
+    containment layer on the way up — solver fallbacks, the breaker
+    feed, admission error wrapping — must let it through, because a
+    real SIGKILL gives none of them a turn. Only the crash-restart
+    harness at the very top of the driving loop catches it, throws the
+    manager away, and restores from the durable store."""
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(f"injected crash at {site} (hit {hit})")
         self.site = site
         self.hit = hit
 
@@ -120,10 +159,16 @@ class FaultInjector:
             # a wedged warmup compile (DELAY) is the governor's own
             # deadline's regime; RAISE is a backend error mid-warm
             SITE_WARMUP: (RAISE, (DELAY, delay_s)) if delay_s else (RAISE,),
+            # crash-only sites: a crash ends the run, so scripted
+            # schedules default them OFF (rate 0 below) — the kill-point
+            # sweep installs explicit {site: {hit: CRASH}} schedules
+            SITE_STORE: (CRASH,),
+            SITE_APPLY: (CRASH,),
         }
+        default_rate = {SITE_STORE: 0.0, SITE_APPLY: 0.0}
         schedule: dict = {}
         for site in SITES:
-            rate = (rates or {}).get(site, 0.2)
+            rate = (rates or {}).get(site, default_rate.get(site, 0.2))
             hits = {}
             for i in range(horizon):
                 if rng.random() < rate:
@@ -198,6 +243,8 @@ def site(name: str, payload=None,
         return payload
     if action == RAISE:
         raise InjectedFault(name, hit)
+    if action == CRASH:
+        raise InjectedCrash(name, hit)
     if action == CORRUPT:
         return corrupt(payload) if corrupt is not None else payload
     kind, seconds = action

@@ -1,0 +1,205 @@
+"""Crash-restart recovery: rebuild the control plane from the durable
+store (RESILIENCE.md §6).
+
+The reference's fault-tolerance story is structural — *etcd is the
+checkpoint, restart is cheap* (SURVEY.md §5): nothing the process
+holds in memory is authoritative, so recovery is "replay the store".
+This module is that replay for the reproduction, over the sim Store's
+checkpoint/WAL surface (``kueue_tpu/sim/durable.py``):
+
+1. **Load** the newest recoverable state (checkpoint + intact WAL
+   tail; a torn final record falls back with a counted warning).
+2. **Rebuild** a fresh ``KueueManager`` around an empty store, then
+   feed every recovered object through ``Store.load_object`` in
+   dependency order — the ADDED watch events drive the SAME
+   reconcilers that built the original caches, so queue heaps, cache
+   trees and snapshot masters rebuild through the existing full-
+   rebuild path, not a parallel one.
+3. **Reset derived accelerator state**: a reused solver is
+   ``detach()``-ed first (device residency, encode arena, topology
+   cache and cache/queue bindings dropped — its jit caches and the
+   persistent XLA compilation cache are the restart-is-cheap
+   carry-over, re-warmed lazily through the PR-7 compile governor).
+   Breaker and ladder start at their conservative fresh rungs (CLOSED
+   / NORMAL with zero history) and the first post-restore cycle runs
+   synchronously (pipeline cooldown) — never a speculative dispatch
+   against a just-rebuilt cache.
+4. **Resolve in-flight speculation by the store's admission records**:
+   a cycle that was dispatched but never applied left NO trace in the
+   store, so its workloads come back pending and simply requeue; a
+   cycle that applied (the store write committed) comes back admitted.
+   Either way the durable truth is the arbiter — never a double
+   admission, never a stranded workload.
+
+The recovery run is traced (route ``"recovery"`` with load/replay/
+settle spans in the flight recorder), counted
+(``restarts_total`` / ``recovery_seconds``), and reported
+(``/debug/recovery`` + ``KueueManager.last_recovery``).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.meta import REAL_CLOCK, Clock
+
+# Dependency order for the replay: capacity objects before the queues
+# that reference them, workloads last so every LocalQueue/ClusterQueue
+# exists when the workload reconciler routes them. Unknown kinds land
+# between the capacity plane and the workloads.
+_KIND_ORDER = {
+    "Namespace": 0, "LimitRange": 1, "ResourceFlavor": 2, "Cohort": 3,
+    "AdmissionCheck": 4, "MultiKueueConfig": 5, "MultiKueueCluster": 6,
+    "ClusterQueue": 7, "LocalQueue": 8, "WorkloadPriorityClass": 9,
+    "Workload": 99,
+}
+_KIND_DEFAULT = 50
+
+
+@dataclass
+class RecoveryReport:
+    """What one restore() rebuilt, for /debug/recovery and the chaos
+    harness asserts."""
+
+    duration_s: float = 0.0
+    checkpoint_loaded: bool = False
+    wal_records_replayed: int = 0
+    torn_records: int = 0
+    warnings: list = field(default_factory=list)
+    objects: dict = field(default_factory=dict)   # kind -> count
+    rv: int = 0
+    admitted_restored: int = 0    # workloads restored holding quota
+    pending_restored: int = 0     # workloads restored without quota
+    settle_reconciles: int = 0    # reconciles to drain the rebuild
+
+    def to_dict(self) -> dict:
+        return {
+            "duration_s": round(self.duration_s, 6),
+            "checkpoint_loaded": self.checkpoint_loaded,
+            "wal_records_replayed": self.wal_records_replayed,
+            "torn_records": self.torn_records,
+            "warnings": list(self.warnings),
+            "objects": dict(self.objects),
+            "rv": self.rv,
+            "admitted_restored": self.admitted_restored,
+            "pending_restored": self.pending_restored,
+            "settle_reconciles": self.settle_reconciles,
+        }
+
+
+def restore(durable, cfg=None, clock: Clock = REAL_CLOCK, solver=None,
+            registered_check_controllers: Optional[set] = None,
+            remote_clusters: Optional[dict] = None,
+            identity: str = "", checkpoint_after: bool = True):
+    """Build a fresh ``KueueManager`` from a durable log's newest
+    recoverable state. Returns the manager; its ``last_recovery``
+    carries the ``RecoveryReport``.
+
+    ``solver`` may be the dead manager's solver object — it is
+    ``detach()``-ed so every binding to the old control plane drops
+    while its compile investment (jit caches + the persistent
+    compilation cache) carries over. ``checkpoint_after`` compacts the
+    log once the rebuild settles, so a crash-during-recovery restarts
+    from the restored image instead of re-replaying the tail."""
+    from kueue_tpu.core import workload as wlpkg
+    from kueue_tpu.manager import KueueManager
+    from kueue_tpu.sim import Store
+
+    t0 = _time.perf_counter()
+    report = RecoveryReport()
+
+    loaded = durable.load()
+    t_load = _time.perf_counter()
+    report.checkpoint_loaded = loaded.checkpoint_loaded
+    report.wal_records_replayed = loaded.records_replayed
+    report.torn_records = loaded.torn_records
+    report.warnings = list(loaded.warnings)
+    report.rv = loaded.rv
+    report.objects = {k: len(v) for k, v in loaded.objects.items() if v}
+
+    if solver is not None and hasattr(solver, "detach"):
+        # Drop every binding to the dead control plane BEFORE the new
+        # manager constructs around the solver (Scheduler.__init__
+        # rebinds cache/queues/recorder on a clean slate). Residency
+        # and the arena are rebuildable caches; keeping them would
+        # chain the first post-restore dispatch on pre-crash usage.
+        solver.detach()
+
+    store = Store(clock)
+    mgr = KueueManager(
+        cfg=cfg, clock=clock, solver=solver,
+        registered_check_controllers=registered_check_controllers,
+        remote_clusters=remote_clusters, store=store, identity=identity)
+
+    rec = mgr.flight_recorder
+    trace = rec.begin_cycle(0)
+    # The load finished before the trace could open (the recorder
+    # lives on the manager): render it at offset 0 with its true
+    # duration rather than a negative start.
+    rec.span("recovery.load", trace.t0 if trace is not None else t0,
+             t_load - t0)
+
+    t_replay = _time.perf_counter()
+    kinds = sorted(loaded.objects,
+                   key=lambda k: (_KIND_ORDER.get(k, _KIND_DEFAULT), k))
+    for kind in kinds:
+        for obj in loaded.objects[kind].values():
+            store.load_object(obj)
+            if kind == "Workload":
+                if wlpkg.has_quota_reservation(obj):
+                    report.admitted_restored += 1
+                else:
+                    report.pending_restored += 1
+    rec.span("recovery.replay", t_replay, _time.perf_counter() - t_replay)
+
+    # The resourceVersion high-water mark may exceed any SURVIVING
+    # object's rv (a deleted object can have held it): seed it from the
+    # log so post-restore writes never re-mint a used rv.
+    store._rv = max(store._rv, loaded.rv)
+
+    t_settle = _time.perf_counter()
+    report.settle_reconciles = mgr.run_until_idle(
+        max_iterations=1_000_000)
+    rec.span("recovery.settle", t_settle, _time.perf_counter() - t_settle)
+
+    # The restored store owns durability again; a post-settle
+    # checkpoint compacts the log so the NEXT crash replays no tail.
+    store.attach_durable(durable)
+    mgr.durable = durable
+    if checkpoint_after:
+        store.checkpoint_now()
+
+    # Conservative restart posture: breaker CLOSED / ladder NORMAL with
+    # zero history (fresh objects), and the first cycle synchronous —
+    # a speculative dispatch must never chain on a cache that settled
+    # milliseconds ago with no router/watchdog evidence behind it.
+    mgr.scheduler._pipeline_cooldown = max(
+        mgr.scheduler._pipeline_cooldown, 1)
+
+    report.duration_s = _time.perf_counter() - t0
+    if trace is not None:
+        trace.route = "recovery"
+        trace.heads = 0
+        trace.admitted = report.admitted_restored
+        rec.annotate(
+            "recovery",
+            f"restored {sum(report.objects.values())} object(s): "
+            f"{report.admitted_restored} admitted + "
+            f"{report.pending_restored} pending workload(s), "
+            f"{report.wal_records_replayed} WAL record(s) replayed, "
+            f"torn={report.torn_records}",
+            **{k: v for k, v in report.to_dict().items()
+               if k not in ("warnings", "objects")})
+        rec.finish(trace)
+    mgr.metrics.restart_recovered(report.duration_s)
+    mgr.recorder.system_event(
+        "Warning" if report.torn_records else "Normal", "Restarted",
+        f"control plane restored from the durable store in "
+        f"{report.duration_s * 1e3:.1f}ms "
+        f"({report.admitted_restored} admitted, "
+        f"{report.pending_restored} pending)")
+    mgr.last_recovery = report
+    mgr.scheduler.last_recovery = report.to_dict()
+    return mgr

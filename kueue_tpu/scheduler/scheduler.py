@@ -266,6 +266,9 @@ class Scheduler:
         # Synchronous by default; swap for async in production wiring
         # (reference: routine wrapper, scheduler.go:590).
         self.admission_routine: Callable[[Callable], None] = lambda f: f()
+        # Crash-restart recovery (resilience/recovery.py): restore()
+        # stamps its report here for /debug/recovery and the dumper.
+        self.last_recovery: Optional[dict] = None
         # HA: only the leader runs admission cycles (reference:
         # NeedLeaderElection, scheduler.go:144). None = standalone.
         self.leader_check: Optional[Callable[[], bool]] = None
@@ -283,6 +286,15 @@ class Scheduler:
         self.queues.broadcast()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # Never strand an in-flight speculative cycle at shutdown: its
+        # deferred-nomination handout must go back to the snapshot
+        # maintainer and its device-residency + arena claims must drop,
+        # or a solver reused across a restart would chain the NEXT
+        # manager's first cycle on the dead manager's usage mirror
+        # (ISSUE 10 satellite — previously stop() left _inflight set
+        # and both leaked until process exit).
+        if self._inflight is not None:
+            self._abandon_pipeline()
 
     def _run(self) -> None:
         until_with_backoff(self._stop, lambda: self.schedule(timeout=0.2))
@@ -1229,6 +1241,7 @@ class Scheduler:
         means the sync path must own this cycle."""
         from kueue_tpu.solver import preempt as devpreempt
         from kueue_tpu.solver.candidates import candidate_index
+        full_snap = None
         try:
             full_snap = self.cache.snapshot()
             pre_entries = self.nominate(pend_ws, full_snap,
@@ -1263,6 +1276,10 @@ class Scheduler:
             return (pending, cq_by, full_snap), pbatch, False
         except Exception:  # noqa: BLE001 — encode failure: sync fallback
             self.preemption_fallbacks += 1
+            if full_snap is not None:
+                # the deferred-nomination handout never reached pmeta:
+                # release it here or it leaks (live_handouts contract)
+                self.cache.release_snapshot(full_snap)
             return None, None, True
 
     def _drain_pipeline(self, sample: bool = True) -> SpeedSignal:
@@ -2065,6 +2082,12 @@ class Scheduler:
         e.status = ASSUMED
 
         def apply():
+            # Crash window between the cache assumption above and the
+            # store's admission write (RESILIENCE.md §6): a process
+            # death here loses the in-memory assumption while the
+            # durable store still says pending — on restore the
+            # workload must requeue and re-admit exactly once.
+            faultinject.site(faultinject.SITE_APPLY)
             try:
                 self.client.apply_admission(new_wl)
             except KeyError:

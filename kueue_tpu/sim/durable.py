@@ -1,0 +1,261 @@
+"""Checkpoint + WAL durability for the sim Store — the "etcd" role.
+
+SURVEY.md §5 names the property the reference leans on for fault
+tolerance: *etcd is the checkpoint, restart is cheap*. Every derived
+structure (queue heaps, cache trees, snapshot masters, encode arena,
+device residency) is rebuildable from the object store, so process
+death costs only a replay. This module gives the sim's authoritative
+Store that durable surface:
+
+- an **append-only event log** (WAL) of committed mutations — one
+  record per watch event the store fires (ADDED/MODIFIED/DELETED with
+  the post-mutation object), so replay IS the event stream the live
+  controllers consumed, and
+- a **periodic checkpoint** — a full pickled image of the store taken
+  every ``checkpoint_every`` records (and on demand), after which the
+  WAL restarts empty.
+
+Two backings behind one knob: the default is an **fsync-free
+in-memory byte buffer** (tests, the crash-restart chaos suites — the
+"disk" that survives a simulated process death is just this object
+outliving the manager), and ``dir=...`` puts the same byte format in
+real files (``checkpoint.bin`` + ``wal.log``) for cross-process use.
+
+Record framing is length + CRC32 + pickled body. ``load()`` replays
+the checkpoint plus the WAL tail and treats a short or checksum-failed
+final record as a **torn write**: replay stops at the last intact
+record with a counted warning (``LoadResult.torn_records``) instead of
+raising — exactly the crash-mid-append case the WAL exists for.
+Recovery semantics on top of this layer live in
+``kueue_tpu/resilience/recovery.py`` (RESILIENCE.md §6).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.utils import vlog
+
+_HEADER = struct.Struct("<II")  # (body length, crc32(body))
+
+CHECKPOINT_FILE = "checkpoint.bin"
+WAL_FILE = "wal.log"
+
+
+def _frame(body: bytes) -> bytes:
+    return _HEADER.pack(len(body), zlib.crc32(body)) + body
+
+
+def _iter_records(buf: bytes):
+    """Yield (record bytes, torn) pairs; a torn tail yields (None, True)
+    once and stops. Complete, checksum-clean records stream through."""
+    off = 0
+    n = len(buf)
+    while off < n:
+        if n - off < _HEADER.size:
+            yield None, True
+            return
+        length, crc = _HEADER.unpack_from(buf, off)
+        body = buf[off + _HEADER.size:off + _HEADER.size + length]
+        if len(body) < length or zlib.crc32(body) != crc:
+            yield None, True
+            return
+        yield body, False
+        off += _HEADER.size + length
+
+
+@dataclass
+class LoadResult:
+    """What ``DurableLog.load()`` reconstructed: the object map in the
+    Store's internal shape ({kind: {key: obj}}), the resource-version
+    high-water mark, and the replay provenance the recovery report
+    surfaces (RESILIENCE.md §6)."""
+
+    objects: dict = field(default_factory=dict)
+    rv: int = 0
+    checkpoint_loaded: bool = False
+    records_replayed: int = 0
+    torn_records: int = 0
+    warnings: list = field(default_factory=list)
+
+
+class DurableLog:
+    """The Store's durability sink. Thread-safe; the Store appends
+    while holding its own lock, so record order always matches the
+    watch-event order the live process observed."""
+
+    def __init__(self, dir: Optional[str] = None,
+                 checkpoint_every: int = 0):
+        self.dir = dir
+        self.checkpoint_every = checkpoint_every
+        self._lock = threading.Lock()
+        self.appends = 0
+        self.checkpoints = 0
+        self.records_since_checkpoint = 0
+        self.log = vlog.logger("durable")
+        if dir is None:
+            self._wal = bytearray()
+            self._ckpt: Optional[bytes] = None
+            self._wal_file = None
+        else:
+            os.makedirs(dir, exist_ok=True)
+            self._wal = None
+            self._ckpt = None
+            # Buffered append handle, flushed per record but never
+            # fsynced — the fsync-free contract; a torn tail is the
+            # accepted (and handled) failure shape.
+            self._wal_file = open(os.path.join(dir, WAL_FILE), "ab")
+            self.records_since_checkpoint = self._count_records()
+
+    # -- append path ---------------------------------------------------
+
+    def append(self, event: str, kind: str, key: str, obj) -> None:
+        """One committed store mutation: ``event`` is the watch event
+        type (ADDED/MODIFIED/DELETED), ``obj`` the post-mutation stored
+        object (the DELETED record carries the final image so replay
+        can drop finalized deletes by key)."""
+        body = pickle.dumps((event, kind, key, obj),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        rec = _frame(body)
+        with self._lock:
+            if self._wal_file is not None:
+                self._wal_file.write(rec)
+                self._wal_file.flush()
+            else:
+                self._wal += rec
+            self.appends += 1
+            self.records_since_checkpoint += 1
+
+    def should_checkpoint(self) -> bool:
+        return (self.checkpoint_every > 0
+                and self.records_since_checkpoint >= self.checkpoint_every)
+
+    def checkpoint(self, objects: dict, rv: int) -> None:
+        """Full image ({kind: {key: obj}}, rv); the WAL restarts empty.
+        The caller (Store.checkpoint_now) holds the store lock, so the
+        image is a consistent cut of the committed state."""
+        body = pickle.dumps((objects, rv),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            if self.dir is not None:
+                tmp = os.path.join(self.dir, CHECKPOINT_FILE + ".tmp")
+                with open(tmp, "wb") as f:
+                    f.write(_frame(body))
+                os.replace(tmp, os.path.join(self.dir, CHECKPOINT_FILE))
+                self._wal_file.close()
+                self._wal_file = open(
+                    os.path.join(self.dir, WAL_FILE), "wb")
+            else:
+                self._ckpt = _frame(body)
+                self._wal = bytearray()
+            self.checkpoints += 1
+            self.records_since_checkpoint = 0
+
+    # -- load path -----------------------------------------------------
+
+    def load(self) -> LoadResult:
+        """Reconstruct the newest recoverable state: checkpoint (when
+        one exists) + every intact WAL record after it. A torn final
+        record falls back to the state up to the last intact one, with
+        a counted warning — never an exception; losing the in-flight
+        tail write is the crash the log is FOR."""
+        res = LoadResult()
+        with self._lock:
+            ckpt = self._read_checkpoint()
+            wal = self._read_wal()
+        if ckpt is not None:
+            body, torn = next(_iter_records(ckpt), (None, False))
+            if body is not None:
+                objects, rv = pickle.loads(body)
+                res.objects = {k: dict(v) for k, v in objects.items()}
+                res.rv = rv
+                res.checkpoint_loaded = True
+            elif torn:
+                # A torn CHECKPOINT (crash mid-compaction before the
+                # atomic replace — only reachable in memory mode) is
+                # unrecoverable state loss for everything before it;
+                # surface loudly but still replay the WAL tail.
+                res.torn_records += 1
+                res.warnings.append("checkpoint torn; replaying WAL only")
+        for body, torn in _iter_records(bytes(wal)):
+            if torn:
+                res.torn_records += 1
+                res.warnings.append(
+                    "torn WAL tail record dropped (crash mid-append); "
+                    "recovered to the last intact record")
+                self.log.v(1, "durable.tornTail",
+                           records=res.records_replayed)
+                break
+            event, kind, key, obj = pickle.loads(body)
+            bucket = res.objects.setdefault(kind, {})
+            if event == "DELETED":
+                bucket.pop(key, None)
+            else:
+                bucket[key] = obj
+            if obj is not None:
+                rv = getattr(obj.metadata, "resource_version", 0) or 0
+                res.rv = max(res.rv, rv)
+            res.records_replayed += 1
+        return res
+
+    def _read_checkpoint(self) -> Optional[bytes]:
+        if self.dir is None:
+            return self._ckpt
+        path = os.path.join(self.dir, CHECKPOINT_FILE)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def _read_wal(self) -> bytes:
+        if self.dir is None:
+            return bytes(self._wal)
+        self._wal_file.flush()
+        with open(os.path.join(self.dir, WAL_FILE), "rb") as f:
+            return f.read()
+
+    def _count_records(self) -> int:
+        n = 0
+        for _body, torn in _iter_records(self._read_wal()):
+            if torn:
+                break
+            n += 1
+        return n
+
+    # -- test helpers ----------------------------------------------------
+
+    def truncate_tail(self, nbytes: int) -> None:
+        """Simulate a torn write: chop ``nbytes`` off the WAL tail (the
+        bytes a crashed process never finished flushing)."""
+        with self._lock:
+            if self.dir is None:
+                del self._wal[max(0, len(self._wal) - nbytes):]
+                return
+            self._wal_file.flush()
+            path = os.path.join(self.dir, WAL_FILE)
+            size = os.path.getsize(path)
+            with open(path, "ab") as f:
+                f.truncate(max(0, size - nbytes))
+
+    def wal_size(self) -> int:
+        with self._lock:
+            if self.dir is None:
+                return len(self._wal)
+            self._wal_file.flush()
+            return os.path.getsize(os.path.join(self.dir, WAL_FILE))
+
+    def status(self) -> dict:
+        return {
+            "dir": self.dir or "memory",
+            "appends": self.appends,
+            "checkpoints": self.checkpoints,
+            "records_since_checkpoint": self.records_since_checkpoint,
+            "checkpoint_every": self.checkpoint_every,
+            "wal_bytes": self.wal_size(),
+        }

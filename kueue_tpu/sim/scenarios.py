@@ -25,6 +25,10 @@ The catalog (sim/SCENARIOS.md documents each in detail):
                         rejoin, orphan GC (SURVEY.md §5)
 - ``mixed_jobs``    (f) jobset/kubeflow/ray/batch-job traffic under
                         load, parity with the plain-workload path
+- ``restart_storm`` (g) the control plane crashes at seeded mid-cycle
+                        points and restores from the durable store
+                        (RESILIENCE.md §6); gated on zero starvation +
+                        recovery-to-first-admission
 
 Run one via ``run_scenario(name, seed=..., scale="smoke"|"full")`` or
 end-to-end with artifacts via ``tools/scenario_run.py``.
@@ -46,6 +50,7 @@ from kueue_tpu.api.meta import (Condition, FakeClock, LabelSelector,
                                 ObjectMeta, find_condition, set_condition)
 from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.perf.checker import SLOSpec, check_slo
+from kueue_tpu.sim import AlreadyExists
 from kueue_tpu.sim.traces import (TraceArrival, burst_trace, diurnal_trace,
                                   steady_trace, storm_trace)
 
@@ -80,6 +85,11 @@ class ScenarioResult:
     # normal rung; None = engaged but never recovered (an SLO violation
     # when the spec bounds recovery).
     ladder_recovery_cycles: Optional[int] = 0
+    # Crash-restart scenario (g): how often the control plane was
+    # killed + restored, and the virtual seconds from each restore back
+    # to the next admission grant (the recovery-to-first-admission SLO).
+    restarts: int = 0
+    recovery_to_first_admission_s: list = field(default_factory=list)
     requeue_amplification: float = 0.0
     counters: dict = field(default_factory=dict)
     violations: list = field(default_factory=list)
@@ -100,6 +110,9 @@ class ScenarioResult:
             "class_p99_tta_s": {k: round(v, 3)
                                 for k, v in self.class_p99_tta_s.items()},
             "ladder_recovery_cycles": self.ladder_recovery_cycles,
+            "restarts": self.restarts,
+            "recovery_to_first_admission_s": [
+                round(v, 3) for v in self.recovery_to_first_admission_s],
             "requeue_amplification": round(self.requeue_amplification, 3),
             "counters": dict(self.counters),
             "ok": self.ok, "violations": list(self.violations),
@@ -138,12 +151,14 @@ class ScenarioHarness:
                  cycle_s: float = 5.0,
                  reclaim_within_cohort: str = api.PREEMPTION_ANY,
                  remote_clusters: Optional[list] = None,
-                 mk_check: bool = False, solver=None):
+                 mk_check: bool = False, solver=None,
+                 durable: bool = False):
         from kueue_tpu.manager import KueueManager
         self.name = name
         self.seed = seed
         self.tenants = tenants
         self.cycle_s = cycle_s
+        self._cfg = cfg
         self.clock = FakeClock(1000.0)
         self.workers: dict = {}
         for cname in remote_clusters or []:
@@ -158,6 +173,27 @@ class ScenarioHarness:
         self.mgr = KueueManager(
             cfg=cfg, clock=self.clock, solver=solver,
             remote_clusters=self.workers or None)
+        # Crash-restart support (scenario g / RESILIENCE.md §6): with
+        # durable=True every store mutation journals to an in-memory
+        # checkpoint/WAL log — the "disk" that survives a simulated
+        # process death — and step() restores a fresh manager from it
+        # when an InjectedCrash kills the control plane mid-cycle.
+        self.durable = None
+        if durable:
+            from kueue_tpu.sim.durable import DurableLog
+            self.durable = DurableLog(checkpoint_every=4096)
+            self.mgr.store.attach_durable(self.durable)
+            self.mgr.durable = self.durable
+        self._solver = solver
+        self.restarts = 0
+        self.recovery_ttas: list = []      # virtual s, restore -> admit
+        self._recovery_pending: Optional[float] = None
+        self._adm_at_restore = 0
+        # Lifetime event counts observed from managers that have since
+        # crashed: the EventRecorder dies with its process, but the
+        # harness (the outside observer) saw the events live — SLO
+        # gates on evictions/requeues must count across restarts.
+        self._evictions_carry = 0
         # Per-cycle (tag, route, regime) stream read off the flight
         # recorder as cycles seal — the ring is bounded, so sampling at
         # step() time survives rotation on long scenarios. Feeds the
@@ -249,13 +285,35 @@ class ScenarioHarness:
         self.set_phase("recovery")
 
     def submit(self, arr: TraceArrival) -> None:
+        """Deliver one arrival. On a durable harness (scenario g) this
+        survives the apiserver dying mid-create: the ``store_write``
+        crash window sits AFTER the WAL append, so the object can be
+        durable even though the create never returned — the client
+        restores the plane and retries UNDER THE SAME NAME, treating
+        AlreadyExists as success (idempotent re-reconcile, like any
+        real job controller; a fresh-name retry would mint a duplicate
+        workload for one logical arrival). Bookkeeping runs only after
+        the object exists, so a lost create never leaves a dangling
+        arrival_info entry or an inflated submitted count."""
+        from kueue_tpu.resilience.faultinject import InjectedCrash
         self._seq += 1
         name = f"{arr.kind}{self._seq}-t{arr.tenant}"
-        now = self.clock.now()
+        builder = _BUILDERS[arr.kind]
+        while True:
+            try:
+                self.mgr.store.create(builder(name, f"lq-t{arr.tenant}",
+                                              arr, self.clock.now()))
+                break
+            except AlreadyExists:
+                if self.durable is None:
+                    raise  # a real naming bug, not a crash retry
+                break  # the pre-crash create reached the WAL
+            except InjectedCrash:
+                if self.durable is None:
+                    raise
+                self._restore_after_crash()
         self.arrival_info[name] = arr
         self.submitted += 1
-        builder = _BUILDERS[arr.kind]
-        self.mgr.store.create(builder(name, f"lq-t{arr.tenant}", arr, now))
 
     # -- the cycle loop ------------------------------------------------
 
@@ -305,6 +363,32 @@ class ScenarioHarness:
         return True
 
     def step(self) -> None:
+        from kueue_tpu.resilience.faultinject import InjectedCrash
+        # Progress markers so the crash handler completes EXACTLY what
+        # the dying step didn't: a kill landing in the timer drain —
+        # after the body already counted the cycle and advanced the
+        # clock — must not count or advance a second time (it would
+        # inflate every virtual-time SLO sample for that kill).
+        self._step_counted = False
+        self._step_advanced = False
+        try:
+            self._step_body()
+        except InjectedCrash:
+            # Simulated process death mid-step (scenario g): store
+            # writes happen in reconciles, the admission cycle AND the
+            # timer drain, so the crash can surface anywhere in the
+            # body. Only a durable harness can survive it; the lost
+            # step's in-memory work is gone by design — the store
+            # replay on restore is the recovery contract under test.
+            if self.durable is None:
+                raise
+            self._restore_after_crash()
+            if not self._step_counted:
+                self.cycles += 1
+            if not self._step_advanced:
+                self.clock.advance(self.cycle_s)
+
+    def _step_body(self) -> None:
         self.mgr.run_until_idle()
         self.mgr.scheduler.schedule(timeout=0)
         self.mgr.run_until_idle()
@@ -318,13 +402,50 @@ class ScenarioHarness:
         if tr is not None and tr.cycle_id not in self._seen_trace_ids:
             self._seen_trace_ids.add(tr.cycle_id)
             self.cycle_routes.append((tr.tag, tr.route, tr.regime))
+        if self._recovery_pending is not None \
+                and self.admissions > self._adm_at_restore:
+            # First admission grant since the restore: the
+            # recovery-to-first-admission SLO sample (virtual seconds).
+            self.recovery_ttas.append(
+                self.clock.now() - self._recovery_pending)
+            self._recovery_pending = None
         self.cycles += 1
+        self._step_counted = True
         self._track_ladder()
         self.mgr.advance(self.cycle_s)
+        self._step_advanced = True
         for worker in self.workers.values():
             worker.runtime.advance(0.0)
         if self.workers:
             self.mgr.run_until_idle()
+
+    def _restore_after_crash(self) -> None:
+        """The simulated process died (InjectedCrash propagated out of
+        a cycle): throw the manager away and rebuild it from the
+        durable log on the shared virtual clock. The harness's
+        observation maps (arrivals, first-admit times, reserved set)
+        model the OUTSIDE world — jobs and operators — so they survive
+        the restart; everything inside the dead manager must come back
+        from the store alone (resilience/recovery.py)."""
+        from kueue_tpu.resilience import faultinject, recovery
+        faultinject.uninstall()
+        # The dead manager's EventRecorder dies with it; bank the
+        # lifetime counts the harness already observed so SLO gates
+        # stay exact across restarts.
+        self._evictions_carry += self.mgr.recorder.count_by_reason_prefix(
+            "EvictedDueTo")
+        self.mgr = recovery.restore(
+            self.durable, cfg=self._cfg, clock=self.clock,
+            solver=self._solver,
+            remote_clusters=self.workers or None)
+        self.mgr.flight_recorder.set_tag("recovery")
+        # The fresh scheduler's cycle ids restart at 0/1 and would
+        # collide with the dead manager's in _seen_trace_ids, silently
+        # ending the (tag, route, regime) stream after the first crash.
+        self._seen_trace_ids = set()
+        self.restarts += 1
+        self._recovery_pending = self.clock.now()
+        self._adm_at_restore = self.admissions
 
     # -- observation: the job-framework role for plain workloads -------
 
@@ -477,7 +598,9 @@ class ScenarioHarness:
         res.submitted = self.submitted
         res.admitted = len(self.first_admit)
         res.admissions = self.admissions
-        res.evictions = self.mgr.recorder.count_by_reason_prefix("EvictedDueTo")
+        res.evictions = (self._evictions_carry
+                         + self.mgr.recorder.count_by_reason_prefix(
+                             "EvictedDueTo"))
         res.slo = slo
 
         by_class: dict = {}
@@ -503,6 +626,10 @@ class ScenarioHarness:
                        and (wl.metadata.name not in self.first_admit
                             or not wlpkg.has_quota_reservation(wl))]
 
+        res.restarts = self.restarts
+        res.recovery_to_first_admission_s = list(self.recovery_ttas)
+        if self.restarts:
+            res.counters["restarts"] = self.restarts
         if res.admitted:
             res.requeue_amplification = \
                 (res.admissions + res.evictions) / res.admitted
@@ -1118,6 +1245,76 @@ def run_mixed_jobs(seed: int = 0, scale: str = "full") -> ScenarioResult:
 # ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
+# scenario (g): restart storm (crash-restart durability,
+# RESILIENCE.md §6)
+# ----------------------------------------------------------------------
+
+def run_restart_storm(seed: int = 0, scale: str = "full") -> ScenarioResult:
+    """The control plane is killed at seeded mid-cycle points — an
+    ``InjectedCrash`` at the ``store_write`` commit window, so some
+    kills land between a WAL append and the watch event, others inside
+    an admission apply — and restored from the durable checkpoint/WAL
+    log each time, while steady per-tenant traffic keeps flowing.
+
+    Gates: zero starvation after the drain (no admission lost, no
+    workload stranded by a crash-orphaned in-flight decision), bounded
+    per-class p99 time-to-admission (the crashes cost cycles, not
+    correctness), amplification ~1 (a restore must not re-admit or
+    re-evict anything the store already settled), and bounded
+    recovery-to-first-admission in virtual seconds per restart."""
+    import random as _random
+
+    from kueue_tpu.resilience import faultinject
+    from kueue_tpu.resilience.faultinject import FaultInjector
+
+    p = {"smoke": dict(duration=160.0, tenants=3, quota=10,
+                       interval=20.0, kills=2),
+         "full": dict(duration=800.0, tenants=6, quota=12,
+                      interval=12.0, kills=5),
+         }[scale]
+    h = ScenarioHarness("restart_storm", seed, tenants=p["tenants"],
+                        quota_units=p["quota"], durable=True)
+    arrivals = steady_trace(seed, duration_s=p["duration"],
+                            tenants=p["tenants"],
+                            interval_s=p["interval"])
+    rng = _random.Random(seed ^ 0x5EED)
+
+    def arm_kill():
+        # The next crash fires at a seeded store-write hit counted from
+        # NOW — deep enough to land mid-admission-wave, shallow enough
+        # to fire before the next arm point replaces the schedule.
+        hit = rng.randint(2, 30)
+        faultinject.install(FaultInjector(
+            {faultinject.SITE_STORE: {hit: faultinject.CRASH}}))
+
+    # Kill points spread over the middle of the run (never during the
+    # drain: the LAST restore must still prove recovery-to-first-
+    # admission against live traffic).
+    span = p["duration"] / (p["kills"] + 1)
+    hooks = [(span * (k + 1), arm_kill) for k in range(p["kills"])]
+    h.set_phase("storm")
+    try:
+        h.run(arrivals, p["duration"], hooks=hooks)
+        h.set_phase("drain")
+        h.drain()
+    finally:
+        faultinject.uninstall()
+    slo = SLOSpec(
+        min_admitted=len(arrivals),
+        class_max_p99_tta_s={"prod": 240.0, "standard": 480.0,
+                             "batch": 900.0},
+        max_requeue_amplification=1.1,
+        max_evictions=0,
+        max_recovery_to_first_admission_s=6 * h.cycle_s)
+    res = h.result(scale, slo)
+    if h.restarts < min(1, p["kills"]):
+        res.violations.append(
+            f"restart storm never crashed (restarts={h.restarts}; "
+            "kill schedule mis-armed?)")
+    return res
+
+
+# ----------------------------------------------------------------------
 
 SCENARIOS = {
     "diurnal": run_diurnal,
@@ -1126,6 +1323,7 @@ SCENARIOS = {
     "requeue_flood": run_requeue_flood,
     "cluster_loss": run_cluster_loss,
     "mixed_jobs": run_mixed_jobs,
+    "restart_storm": run_restart_storm,
 }
 
 

@@ -23,6 +23,7 @@ from typing import Callable, Optional
 from kueue_tpu.api.kueue import (clone_cluster_queue, clone_local_queue,
                                  clone_workload)
 from kueue_tpu.api.meta import Clock, REAL_CLOCK, new_uid
+from kueue_tpu.resilience import faultinject
 
 # Hand-rolled per-kind deep clones for the hottest objects: semantically
 # identical to copy.deepcopy, ~10x faster (reconciler reads + status
@@ -74,13 +75,70 @@ class Store:
     written object is owned by the store and callers must re-`get` to
     observe the persisted state."""
 
-    def __init__(self, clock: Clock = REAL_CLOCK):
+    def __init__(self, clock: Clock = REAL_CLOCK, durable=None):
         self._clock = clock
         self._lock = threading.RLock()
         self._objects: dict[str, dict[str, object]] = {}
         self._watchers: dict[str, list[Callable]] = {}
         self._admission_hooks: dict[str, list[Callable]] = {}
         self._rv = 0
+        # Optional durability sink (sim/durable.py): every committed
+        # mutation appends one WAL record BEFORE its watch event fires,
+        # so the log's order is exactly the event order the live
+        # controllers consumed — replaying it rebuilds this store
+        # bit-for-bit (resilience/recovery.py).
+        self._durable = durable
+
+    # -- durability (sim/durable.py + resilience/recovery.py) ---------------
+
+    def attach_durable(self, durable) -> None:
+        """Attach a DurableLog mid-life (recovery re-attaches after the
+        replay so restored objects are not re-logged; scenario harnesses
+        attach before seeding capacity)."""
+        self._durable = durable
+
+    def checkpoint_now(self) -> None:
+        """Take a full durable checkpoint of the committed state (the
+        WAL restarts empty). No-op without an attached log."""
+        with self._lock:
+            if self._durable is not None:
+                self._durable.checkpoint(self._objects, self._rv)
+
+    def _persist(self, event: str, kind: str, key: str, stored) -> None:
+        """The commit point every mutation passes through, just before
+        its watch event fires: append the WAL record, then cross the
+        ``store_write`` crash window (RESILIENCE.md §6 — a crash AFTER
+        the append is durable-but-unobserved: the write survives
+        restart even though no watcher ever saw it), then maybe
+        compact. Caller holds the store lock."""
+        d = self._durable
+        if d is not None:
+            d.append(event, kind, key, stored)
+        faultinject.site(faultinject.SITE_STORE)
+        if d is not None and d.should_checkpoint():
+            d.checkpoint(self._objects, self._rv)
+
+    def load_object(self, obj) -> object:
+        """Recovery-path insert (resilience/recovery.py): place an
+        object reconstructed from the durable log into the store
+        VERBATIM — uid, resourceVersion and timestamps preserved,
+        admission webhooks skipped (they ran before the object was
+        first persisted; re-defaulting a restored status would fight
+        the durable truth) — and fire the ADDED watch event so the
+        derived caches rebuild through the normal event path. Not
+        re-logged: the record that produced ``obj`` is already
+        durable."""
+        kind = kind_of(obj)
+        with self._lock:
+            key = obj_key(obj)
+            bucket = self._objects.setdefault(kind, {})
+            if key in bucket:
+                raise AlreadyExists(f"{kind} {key} already exists")
+            bucket[key] = obj
+            self._rv = max(self._rv,
+                           obj.metadata.resource_version or 0)
+            self._notify(kind, ADDED, obj, None)
+            return obj
 
     # -- admission webhooks -------------------------------------------------
 
@@ -131,6 +189,7 @@ class Store:
             self._rv += 1
             stored.metadata.resource_version = self._rv
             bucket[key] = stored
+            self._persist(ADDED, kind, key, stored)
             self._notify(kind, ADDED, stored, None)
             return _clone(stored)
 
@@ -197,9 +256,11 @@ class Store:
             if stored.metadata.deletion_timestamp is not None and not stored.metadata.finalizers:
                 # last finalizer removed -> actually delete
                 del bucket[key]
+                self._persist(DELETED, kind, key, stored)
                 self._notify(kind, DELETED, stored, old)
                 return None
             bucket[key] = stored
+            self._persist(MODIFIED, kind, key, stored)
             self._notify(kind, MODIFIED, stored, old)
             return None
 
@@ -238,6 +299,7 @@ class Store:
             self._rv += 1
             stored.metadata.resource_version = self._rv
             bucket[key] = stored
+            self._persist(MODIFIED, kind, key, stored)
             self._notify(kind, MODIFIED, stored, old)
             return None
 
@@ -255,9 +317,11 @@ class Store:
                     self._rv += 1
                     stored.metadata.resource_version = self._rv
                     bucket[key] = stored
+                    self._persist(MODIFIED, kind, key, stored)
                     self._notify(kind, MODIFIED, stored, old)
                 return
             del bucket[key]
+            self._persist(DELETED, kind, key, old)
             self._notify(kind, DELETED, old, old)
 
     def list(self, kind: str, namespace: Optional[str] = None,

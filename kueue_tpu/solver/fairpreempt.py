@@ -248,46 +248,46 @@ def solve_fair_impl(topo, usage, cohort_usage, gq, gf, gr, gc, chain_local,
         arange_ql = jnp.arange(QL)
         valid_q = gq_b >= 0
 
-        def shares(u):
-            """dominantResourceShare per local CQ
-            (clusterqueue.go:503-564). u: [QL,RF]."""
+        def share_of_rows(u_rows, nom_rows, base_rows, floor_rows,
+                          floor_any_rows, weight_rows):
+            """dominantResourceShare over a leading rows axis
+            (clusterqueue.go:503-564): the masked max-ratio reduction
+            per [RF] usage row. THE one copy of the share math — the
+            full-vector ``shares`` and the single-row ``share_of_row``
+            below are its [QL] and K=1 instances, so the two can never
+            diverge (ROADMAP carried thread; per-resource sums stay
+            masked reductions, NOT a matmul — XLA's x64 rewrite can't
+            lower an s64 dot_general on TPU)."""
             borrow_fr = jnp.where(valid_fr[None, :],
-                                  jnp.maximum(0, u - nominal), 0)  # [QL,RF]
-            # per-resource sums via masked reduction (NOT a matmul: XLA's
-            # x64 rewrite can't lower an s64 dot_general on TPU)
+                                  jnp.maximum(0, u_rows - nom_rows), 0)
             borrow_res = jnp.sum(
                 jnp.where(same_res[None, :, :], borrow_fr[:, None, :], 0),
-                axis=2) + base_b
+                axis=2) + base_rows
             ratio = jnp.where((borrow_res > 0) & (lendable_b[None, :] > 0),
                               borrow_res * 1000
                               // jnp.maximum(lendable_b[None, :], 1),
                               jnp.int64(-1))
-            drs = jnp.maximum(jnp.max(ratio, axis=1), floor_b)     # [QL]
-            any_b = jnp.any(borrow_res > 0, axis=1) | floor_any_b
+            drs = jnp.maximum(jnp.max(ratio, axis=1), floor_rows)
+            any_b = jnp.any(borrow_res > 0, axis=1) | floor_any_rows
             share = jnp.where(any_b, drs * 1000
-                              // jnp.maximum(weight_b, 1), 0)
-            return jnp.where(weight_b == 0, MAXSHARE, share)
+                              // jnp.maximum(weight_rows, 1), 0)
+            return jnp.where(weight_rows == 0, MAXSHARE, share)
+
+        def shares(u):
+            """dominantResourceShare per local CQ. u: [QL,RF]."""
+            return share_of_rows(u, nominal, base_b, floor_b,
+                                 floor_any_b, weight_b)
 
         def share_of_row(u_row, nom_row, base_row, floor_q, floor_any_q,
                          weight_q):
-            """One CQ's dominantResourceShare — the masked max-ratio
-            reduction on a single [RF] usage row. Removals only move the
-            popped CQ's row, so the heap loop updates ONE row's share per
-            step instead of recomputing the whole [QL] vector (same
-            integer math; bit-identical to shares())."""
-            borrow_fr = jnp.where(valid_fr,
-                                  jnp.maximum(0, u_row - nom_row), 0)
-            borrow_res = jnp.sum(jnp.where(same_res, borrow_fr[None, :], 0),
-                                 axis=1) + base_row        # [RF]
-            ratio = jnp.where((borrow_res > 0) & (lendable_b > 0),
-                              borrow_res * 1000
-                              // jnp.maximum(lendable_b, 1),
-                              jnp.int64(-1))
-            drs = jnp.maximum(jnp.max(ratio), floor_q)
-            any_b = jnp.any(borrow_res > 0) | floor_any_q
-            share = jnp.where(any_b, drs * 1000
-                              // jnp.maximum(weight_q, 1), 0)
-            return jnp.where(weight_q == 0, MAXSHARE, share)
+            """One CQ's dominantResourceShare. Removals only move the
+            popped CQ's row, so the heap loop updates ONE row's share
+            per step instead of recomputing the whole [QL] vector —
+            the K=1 instance of share_of_rows (bit-identical: same
+            integer ops, reduced over a length-1 leading axis)."""
+            return share_of_rows(
+                u_row[None, :], nom_row[None, :], base_row[None, :],
+                floor_q[None], floor_any_q[None], weight_q[None])[0]
 
         req_row = jnp.where(arange_ql[:, None] == 0, req_b[None, :], 0)
 

@@ -351,32 +351,23 @@ def make_problem_sim(topo, usage, cohort_usage, gq_b, gf_b, gr_b, gc_b,
         """oh [C] bool one-hot, t [C,RF] -> t[c] as [RF] dense."""
         return jnp.sum(jnp.where(oh[:, None], t, 0), axis=0)
 
+    # The availability chain walk exists ONCE: _avail_cq0_prefix (the
+    # K-vectorized form the prefix/auction solver uses). The scalar
+    # case below is its K=1 instance, so a future semantic fix to the
+    # resource_node.go math cannot silently diverge the device victim
+    # sets from the CPU oracle between the minimal/fair kernels and
+    # the fill-back oracle (ROADMAP carried thread; the randomized
+    # differentials in tests/test_preempt_batched.py pin bit-identity).
+    sim_view = {"chain_oh": chain_oh, "c_subtree": c_subtree,
+                "c_guar": c_guar, "c_bl": c_bl, "nominal": nominal,
+                "guaranteed": guaranteed, "borrow_limit": borrow_limit}
+
     def avail_cq0(u, cu):
         """available() for local CQ 0 (the preemptor's), walking its
-        cohort chain (reference: resource_node.go:89-104)."""
-        parent = jnp.zeros(RF, jnp.int64)
-        started = jnp.zeros((), bool)
-        for d in range(DC - 1, -1, -1):
-            oh = chain_oh[0, d]                      # [C]
-            ok = jnp.any(oh)
-            cuc = oh_rows(oh, cu)
-            sub = oh_rows(oh, c_subtree)
-            gua = oh_rows(oh, c_guar)
-            bl = jnp.sum(jnp.where(oh[:, None], c_bl, 0), axis=0)
-            root_avail = sub - cuc
-            local = jnp.maximum(0, gua - cuc)
-            cap = (sub - gua) - jnp.maximum(0, cuc - gua) \
-                + jnp.minimum(bl, NOLIM // 4)
-            child = local + jnp.minimum(parent, cap)
-            new = jnp.where(started, child, root_avail)
-            parent = jnp.where(ok, new, parent)
-            started = started | ok
-        local0 = jnp.maximum(0, guaranteed[0] - u[0])
-        cap0 = (nominal[0] - guaranteed[0]) \
-            - jnp.maximum(0, u[0] - guaranteed[0]) \
-            + jnp.minimum(borrow_limit[0], NOLIM // 4)
-        with_cohort = local0 + jnp.minimum(parent, cap0)
-        return jnp.where(has_cohort_b, with_cohort, nominal[0] - u[0])
+        cohort chain (reference: resource_node.go:89-104) — the K=1
+        instance of the vectorized walk."""
+        return _avail_cq0_prefix(sim_view, has_cohort_b, u[0][None, :],
+                                 cu[:, None, :])[0]
 
     def fits(u, cu, ab):
         """workload_fits (reference: preemption.go:576-585)."""

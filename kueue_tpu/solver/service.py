@@ -331,6 +331,24 @@ class BatchSolver:
         self._queues = queues
         queues.add_workload_listener(self._arena.note)
 
+    def detach(self) -> None:
+        """Forget everything bound to a (dead) control plane: device
+        residency, the encode arena (host rows AND device twin), the
+        topology cache, and the cache/queue bindings. Crash-restart
+        recovery (resilience/recovery.py) reuses the solver object —
+        its jit caches and the persistent XLA compilation cache are the
+        "restart is cheap" carry-over — while ALL state derived from
+        the old manager is rebuilt from the new one: the next
+        Scheduler.__init__ rebinds cache/queues/recorder and the first
+        prepare() re-establishes residency from a fresh snapshot,
+        re-warming lazily through the compile governor."""
+        self.invalidate_resident()
+        self._arena = WorkloadArena(self.max_podsets)
+        self._topo_cache = None
+        self._topo_key = None
+        self._cache = None
+        self._queues = None
+
     def release_workload(self, key: str) -> None:
         """Scheduler hook: the workload was admitted (it holds quota and
         leaves the pending set without a queue-manager delete), so its
@@ -785,15 +803,29 @@ class BatchSolver:
         # keying on them rebuilt the topology every cycle under load.
         key = snapshot.topology_epoch
         if key != self._topo_key or self._topo_cache is None:
-            if getattr(snapshot, "light", False) and self._cache is not None:
-                # topology encode iterates whole resource trees — never
-                # off a light snapshot's shared live structures; take a
-                # full (frozen) one for the rebuild
-                snapshot = self._cache.snapshot()
-                key = snapshot.topology_epoch
-            self._topo_key = key
-            topo = encode.encode_topology(snapshot)
-            self._topo_cache = (topo, topo_to_device(topo))
+            own_snap = None
+            try:
+                if getattr(snapshot, "light", False) \
+                        and self._cache is not None:
+                    # topology encode iterates whole resource trees —
+                    # never off a light snapshot's shared live
+                    # structures; take a full (frozen) one for the
+                    # rebuild
+                    snapshot = own_snap = self._cache.snapshot()
+                    key = snapshot.topology_epoch
+                topo = encode.encode_topology(snapshot)
+                self._topo_cache = (topo, topo_to_device(topo))
+                # Key stamped only AFTER the cache tuple is built: a
+                # contained encode/upload fault must leave the old
+                # (key, cache) pair consistent, or the next cycle at
+                # this epoch would silently serve the stale topology.
+                self._topo_key = key
+            finally:
+                if own_snap is not None:
+                    # internal handout, fully consumed by the encode —
+                    # released on the fault paths too, or a contained
+                    # backend error would leak it forever
+                    self._cache.release_snapshot(own_snap)
         return self._topo_cache
 
     def prepare(self, snapshot: Snapshot, entries: list) -> Optional[Plan]:
@@ -813,35 +845,49 @@ class BatchSolver:
         t0 = _t.perf_counter()
         self.counters["prepares"] += 1
         topo, topo_dev = self._topology(snapshot)
+        cycle_snapshot = snapshot
         state, deltas, resident, snapshot = self._state_for_cycle(snapshot,
                                                                   topo)
-        if resident:
-            self.counters["resident_cycles"] += 1
-        slots = None
-        if self._queues is not None:
-            # Arena path: O(changed) row encodes + a vectorized gather
-            # instead of the per-head reassembly loop.
-            self._arena.begin_cycle(topo)
-            batch, slots = self._arena.assemble(entries, snapshot, topo,
-                                                self.ordering,
-                                                self.max_podsets)
-            slot_gens = self._arena.gen[np.asarray(slots, np.int64)].copy()
-            self.counters["arena_rows_encoded"] = self._arena.encoded_rows
-            self.counters["arena_gathers"] = self._arena.gathers
-        else:
-            batch = encode.encode_workloads(entries, snapshot, topo,
-                                            ordering=self.ordering,
-                                            max_podsets=self.max_podsets)
-        t1 = _t.perf_counter()
-        self._phase("encode", t0, t1)
-        if len(self.encode_samples) >= (1 << 20):
-            del self.encode_samples[: 1 << 19]
-        self.encode_samples.append(t1 - t0)
-        if not batch.solvable.any():
-            return None
-        start_rank = batch.start_rank if batch.start_rank.any() else None
-        fit_pred = self._route(topo, state, batch, start_rank)
-        self._phase("route", t1, _t.perf_counter())
+        # The establishing path may have swapped a light snapshot for a
+        # fresh full handout of its own — released on EVERY exit, fault
+        # paths included (the encoded batch/state copy everything they
+        # need; an un-released handout on a contained device fault
+        # would leak forever).
+        own_snap = snapshot if snapshot is not cycle_snapshot else None
+        try:
+            if resident:
+                self.counters["resident_cycles"] += 1
+            slots = None
+            if self._queues is not None:
+                # Arena path: O(changed) row encodes + a vectorized
+                # gather instead of the per-head reassembly loop.
+                self._arena.begin_cycle(topo)
+                batch, slots = self._arena.assemble(entries, snapshot,
+                                                    topo, self.ordering,
+                                                    self.max_podsets)
+                slot_gens = self._arena.gen[
+                    np.asarray(slots, np.int64)].copy()
+                self.counters["arena_rows_encoded"] = \
+                    self._arena.encoded_rows
+                self.counters["arena_gathers"] = self._arena.gathers
+            else:
+                batch = encode.encode_workloads(
+                    entries, snapshot, topo, ordering=self.ordering,
+                    max_podsets=self.max_podsets)
+            t1 = _t.perf_counter()
+            self._phase("encode", t0, t1)
+            if len(self.encode_samples) >= (1 << 20):
+                del self.encode_samples[: 1 << 19]
+            self.encode_samples.append(t1 - t0)
+            if not batch.solvable.any():
+                return None
+            start_rank = batch.start_rank if batch.start_rank.any() \
+                else None
+            fit_pred = self._route(topo, state, batch, start_rank)
+            self._phase("route", t1, _t.perf_counter())
+        finally:
+            if own_snap is not None:
+                self._cache.release_snapshot(own_snap)
         plan = Plan(topo, topo_dev, state, batch, start_rank, fit_pred)
         plan.slots = slots
         if slots is not None:
@@ -900,6 +946,7 @@ class BatchSolver:
         if getattr(snapshot, "light", False):
             snapshot = self._cache.snapshot()
             if snapshot.topology_epoch != self._topo_key:
+                self._cache.release_snapshot(snapshot)
                 raise RuntimeError("topology moved during establish")
         self._cache.drain_usage_journal(snapshot.journal_seq)
         state = encode.encode_state(snapshot, topo)

@@ -1,0 +1,540 @@
+"""Crash-restart durability suite (ISSUE 10 acceptance).
+
+Layers under test, bottom up:
+
+1. the durable checkpoint/WAL log itself (sim/durable.py) — roundtrip
+   in memory and on disk, empty-WAL / checkpoint-with-no-tail /
+   torn-final-record edge cases (the torn tail falls back to the last
+   intact record with a counted warning, never an exception);
+2. the Store's event journaling (every committed mutation, no-op
+   writes excluded, finalizer parks included);
+3. kill -> restore -> convergence through the FULL KueueManager: a
+   seeded ``InjectedCrash`` mid-cycle, recovery from the durable store
+   (resilience/recovery.py), and the replayed run converging to the
+   uncrashed oracle's exact admitted set with no lost admissions, no
+   double admissions (store-vs-cache usage cross-check) and no
+   stranded state — the tier-1 smoke drives one seeded kill point;
+   the multi-seed kill-point sweep over EVERY injection site rides
+   ``@slow`` (tools/crash_run.py --sweep is the CLI twin);
+4. the ISSUE 10 satellites: abandoned in-flight speculative cycles
+   release their snapshot handout and residency at shutdown (live
+   handout counter), and a reused solver ``detach()``-es cleanly into
+   the restored control plane.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+from kueue_tpu import config as cfgpkg
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.corev1 import Container, PodSpec, PodTemplateSpec
+from kueue_tpu.api.meta import FakeClock, LabelSelector, ObjectMeta
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.manager import KueueManager
+from kueue_tpu.resilience import faultinject
+from kueue_tpu.resilience.faultinject import (CRASH, FaultInjector,
+                                              InjectedCrash)
+from kueue_tpu.sim import Store
+from kueue_tpu.sim.durable import DurableLog
+
+
+def _load_crash_run():
+    spec = importlib.util.spec_from_file_location(
+        "crash_run", os.path.join(os.path.dirname(__file__),
+                                  "..", "tools", "crash_run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _no_injector_leak():
+    yield
+    faultinject.uninstall()
+
+
+def make_flavor(name="f0"):
+    return api.ResourceFlavor(metadata=ObjectMeta(name=name,
+                                                  uid=f"rf-{name}"))
+
+
+def make_cq(name, cohort=None, cpu_quota=8000):
+    cq = api.ClusterQueue(metadata=ObjectMeta(name=name, uid=name))
+    cq.spec.namespace_selector = LabelSelector()
+    if cohort:
+        cq.spec.cohort = cohort
+    cq.spec.resource_groups.append(api.ResourceGroup(
+        covered_resources=["cpu"],
+        flavors=[api.FlavorQuotas(name="f0", resources=[
+            api.ResourceQuota(name="cpu", nominal_quota=cpu_quota)])]))
+    return cq
+
+
+def make_lq(name, cq):
+    lq = api.LocalQueue(metadata=ObjectMeta(name=name,
+                                            namespace="default",
+                                            uid=name))
+    lq.spec.cluster_queue = cq
+    return lq
+
+
+def make_workload(name, lq, cpu=2000, creation=0.0):
+    wl = api.Workload(metadata=ObjectMeta(
+        name=name, namespace="default", uid=name,
+        creation_timestamp=creation))
+    wl.spec.queue_name = lq
+    wl.spec.pod_sets.append(api.PodSet(
+        name="main", count=1, template=PodTemplateSpec(spec=PodSpec(
+            containers=[Container(name="c", requests={"cpu": cpu})]))))
+    return wl
+
+
+def admitted_keys(mgr):
+    return sorted(wlpkg.key(wl) for wl in mgr.store.list("Workload")
+                  if wlpkg.has_quota_reservation(wl))
+
+
+# ----------------------------------------------------------------------
+# 1. the durable log (satellite: replay edge cases)
+# ----------------------------------------------------------------------
+
+class TestDurableLog:
+    def _seeded_store(self, durable):
+        s = Store(durable=durable)
+        s.create(make_flavor())
+        s.create(make_workload("w0", "lq0"))
+        w = s.get("Workload", "default", "w0")
+        w.spec.priority = 7
+        s.update(w)
+        return s
+
+    def test_empty_wal(self):
+        res = DurableLog().load()
+        assert res.objects == {} and res.rv == 0
+        assert not res.checkpoint_loaded
+        assert res.records_replayed == 0 and res.torn_records == 0
+        assert res.warnings == []
+
+    def test_memory_roundtrip(self):
+        d = DurableLog()
+        s = self._seeded_store(d)
+        res = d.load()
+        assert res.records_replayed == 3 and res.torn_records == 0
+        assert res.rv == s._rv
+        wl = res.objects["Workload"]["default/w0"]
+        assert wl.spec.priority == 7
+        assert wl.metadata.resource_version == 3
+        assert set(res.objects) == {"ResourceFlavor", "Workload"}
+
+    def test_file_roundtrip(self, tmp_path):
+        d = DurableLog(dir=str(tmp_path))
+        self._seeded_store(d)
+        # a new log object over the same dir (the real restart shape)
+        res = DurableLog(dir=str(tmp_path)).load()
+        assert res.records_replayed == 3
+        assert res.objects["Workload"]["default/w0"].spec.priority == 7
+
+    def test_checkpoint_with_no_tail(self):
+        d = DurableLog()
+        s = self._seeded_store(d)
+        s.checkpoint_now()
+        res = d.load()
+        assert res.checkpoint_loaded
+        assert res.records_replayed == 0 and res.torn_records == 0
+        assert res.objects["Workload"]["default/w0"].spec.priority == 7
+        assert res.rv == s._rv
+
+    def test_checkpoint_plus_tail(self):
+        d = DurableLog()
+        s = self._seeded_store(d)
+        s.checkpoint_now()
+        s.create(make_workload("w1", "lq0"))
+        res = d.load()
+        assert res.checkpoint_loaded and res.records_replayed == 1
+        assert set(res.objects["Workload"]) == {"default/w0",
+                                                "default/w1"}
+
+    @pytest.mark.parametrize("chop", [1, 5])
+    def test_torn_tail_falls_back(self, chop):
+        """A crash mid-append leaves a short/garbled final record: the
+        load must fall back to the last INTACT record with a counted
+        warning instead of raising (ISSUE 10 satellite)."""
+        d = DurableLog()
+        self._seeded_store(d)
+        d.truncate_tail(chop)
+        res = d.load()
+        assert res.torn_records == 1
+        assert res.records_replayed == 2  # the final update was torn
+        assert res.objects["Workload"]["default/w0"].spec.priority != 7
+        assert any("torn" in w for w in res.warnings)
+
+    def test_torn_tail_file(self, tmp_path):
+        d = DurableLog(dir=str(tmp_path))
+        self._seeded_store(d)
+        d.truncate_tail(3)
+        res = DurableLog(dir=str(tmp_path)).load()
+        assert res.torn_records == 1 and res.records_replayed == 2
+
+    def test_corrupt_mid_record_stops_replay(self, tmp_path):
+        """A flipped bit inside the WAL (not just a short tail) fails
+        the CRC and stops replay at the last intact record."""
+        d = DurableLog(dir=str(tmp_path))
+        self._seeded_store(d)
+        path = tmp_path / "wal.log"
+        raw = bytearray(path.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        path.write_bytes(bytes(raw))
+        res = DurableLog(dir=str(tmp_path)).load()
+        assert res.torn_records == 1
+        assert res.records_replayed < 3
+
+    def test_auto_checkpoint_compacts(self):
+        d = DurableLog(checkpoint_every=2)
+        s = Store(durable=d)
+        for i in range(5):
+            s.create(make_workload(f"w{i}", "lq0"))
+        assert d.checkpoints >= 2
+        assert d.records_since_checkpoint < 2
+        res = d.load()
+        assert set(res.objects["Workload"]) == {
+            f"default/w{i}" for i in range(5)}
+
+    def test_noop_update_not_logged(self):
+        d = DurableLog()
+        s = Store(durable=d)
+        s.create(make_workload("w0", "lq0"))
+        before = d.appends
+        w = s.get("Workload", "default", "w0")
+        s.update(w)  # byte-identical: apiserver no-op semantics
+        assert d.appends == before
+
+    def test_delete_and_finalizer_park_logged(self):
+        d = DurableLog()
+        s = Store(durable=d)
+        s.create(make_workload("w0", "lq0"))
+        w = s.get("Workload", "default", "w0")
+        w.metadata.finalizers = ["kueue.x-k8s.io/resource-in-use"]
+        s.update(w)
+        s.delete("Workload", "default", "w0")  # parks (finalizer)
+        res = d.load()
+        parked = res.objects["Workload"]["default/w0"]
+        assert parked.metadata.deletion_timestamp is not None
+        w = s.get("Workload", "default", "w0")
+        w.metadata.finalizers = []
+        s.update(w)  # final finalizer stripped -> real delete
+        res = d.load()
+        assert "default/w0" not in res.objects.get("Workload", {})
+
+
+# ----------------------------------------------------------------------
+# 2/3. kill -> restore -> convergence (tier-1 smoke: one seeded point)
+# ----------------------------------------------------------------------
+
+def _mk_manager(clock, durable=True, solver=None, pipeline=None):
+    cfg = cfgpkg.Configuration()
+    cfg.store.durable = durable
+    if solver is not None:
+        cfg.solver.enable = True
+        cfg.solver.min_heads = 0
+        cfg.solver.routing = "always"
+        if pipeline is not None:
+            cfg.solver.pipeline = pipeline
+    mgr = KueueManager(cfg=cfg, clock=clock, solver=solver)
+    mgr.store.create(make_flavor())
+    for i in range(2):
+        mgr.store.create(make_cq(f"cq{i}", cohort="co"))
+        mgr.store.create(make_lq(f"lq{i}", f"cq{i}"))
+    mgr.run_until_idle()
+    return mgr
+
+
+def _submit(mgr, waves, start=0):
+    n = start * 2
+    for w in range(start, start + waves):
+        for i in range(2):
+            mgr.store.create(make_workload(f"w{w}-{i}", f"lq{i}",
+                                           creation=float(n)))
+            n += 1
+    mgr.run_until_idle()
+
+
+def _drive(mgr, clock, cycles=8):
+    for _ in range(cycles):
+        mgr.scheduler.schedule(timeout=0)
+        mgr.run_until_idle()
+        clock.advance(1.0)
+
+
+class TestKillRestoreSmoke:
+    """Sub-second tier-1 smoke: one seeded kill point (CI satellite).
+    The multi-seed, every-site sweep is TestCrashSweep (@slow)."""
+
+    def _oracle(self):
+        clock = FakeClock(1000.0)
+        mgr = _mk_manager(clock, durable=False)
+        _submit(mgr, 3)
+        _drive(mgr, clock)
+        return admitted_keys(mgr)
+
+    @pytest.mark.parametrize("site,hit", [
+        (faultinject.SITE_STORE, 9),
+        (faultinject.SITE_APPLY, 1),
+    ])
+    def test_kill_restore_converges(self, site, hit):
+        oracle = self._oracle()
+        clock = FakeClock(1000.0)
+        mgr = _mk_manager(clock)
+        _submit(mgr, 3)
+        faultinject.install(FaultInjector({site: {hit: CRASH}}))
+        with pytest.raises(InjectedCrash):
+            _drive(mgr, clock)
+        faultinject.uninstall()
+        durable = mgr.durable
+        pre = sorted(
+            wlpkg.key(wl)
+            for wl in durable.load().objects.get("Workload", {}).values()
+            if wlpkg.has_quota_reservation(wl))
+        mgr2 = KueueManager.restore(durable, clock=clock)
+        _drive(mgr2, clock)
+        final = admitted_keys(mgr2)
+        # convergence + never-lose + exactly-once
+        assert final == oracle
+        assert set(pre) <= set(final)
+        crash_run = _load_crash_run()
+        ok, msg = crash_run.usage_consistent(mgr2)
+        assert ok, msg
+
+    def test_recovery_surface(self):
+        """The operator surface of a restore: report, /debug/recovery,
+        metrics, flight-recorder trace, system event."""
+        clock = FakeClock(1000.0)
+        mgr = _mk_manager(clock)
+        _submit(mgr, 2)
+        _drive(mgr, clock, cycles=2)
+        mgr.shutdown()
+        mgr2 = KueueManager.restore(mgr.durable, clock=clock)
+        rep = mgr2.last_recovery
+        assert rep.checkpoint_loaded  # shutdown() checkpointed
+        assert rep.wal_records_replayed == 0
+        assert rep.admitted_restored == 4 and rep.pending_restored == 0
+        assert rep.objects["Workload"] == 4
+        assert mgr2.metrics.restarts_total.value() == 1
+        assert mgr2.metrics.recovery_seconds.count() == 1
+        from kueue_tpu.obs import DebugEndpoints
+        payload = DebugEndpoints(mgr2.scheduler, mgr2.metrics).handle(
+            "/debug/recovery", {})
+        assert payload["restored"] and payload["admitted_restored"] == 4
+        traces = [t for t in mgr2.flight_recorder.traces()
+                  if t.route == "recovery"]
+        assert len(traces) == 1
+        spans = {name.split(".")[0] for name, _s, _d in traces[0].spans}
+        assert "recovery" in spans
+        names = {name for name, _s, _d in traces[0].spans}
+        assert {"recovery.load", "recovery.replay",
+                "recovery.settle"} <= names
+        assert mgr2.recorder.by_reason("Restarted")
+        assert "-- recovery --" in mgr2.dumper().dump()
+        # a cold-started manager reports not-restored
+        cold = DebugEndpoints(mgr.scheduler, mgr.metrics).handle(
+            "/debug/recovery", {})
+        assert cold == {"restored": False}
+        assert "-- recovery --" not in mgr.dumper().dump()
+
+    def test_torn_tail_recovery_warns(self):
+        """Crash mid-append: restore falls back to the last intact
+        record, counts the torn record, and still converges once the
+        lost traffic is resubmitted by its owner (jobs re-create their
+        workloads; the store never lies about what it persisted)."""
+        clock = FakeClock(1000.0)
+        mgr = _mk_manager(clock)
+        _submit(mgr, 2)
+        _drive(mgr, clock, cycles=2)
+        mgr.durable.truncate_tail(7)
+        mgr2 = KueueManager.restore(mgr.durable, clock=clock)
+        assert mgr2.last_recovery.torn_records == 1
+        assert mgr2.last_recovery.warnings
+        ev = mgr2.recorder.by_reason("Restarted")
+        assert ev and ev[0].type == "Warning"
+
+    def test_restore_rv_high_water_survives_deletes(self):
+        """A deleted object can hold the resourceVersion high-water
+        mark; the restored store must continue ABOVE it, never re-mint
+        a used rv."""
+        clock = FakeClock(1000.0)
+        mgr = _mk_manager(clock)
+        _submit(mgr, 1)
+        w = mgr.store.get("Workload", "default", "w0-0")
+        w.spec.priority = 9
+        mgr.store.update(w)  # w0-0 now holds the max rv
+        rv_max = mgr.store._rv
+        mgr.store.delete("Workload", "default", "w0-0")
+        mgr.run_until_idle()
+        mgr2 = KueueManager.restore(mgr.durable, clock=clock)
+        assert mgr2.store._rv >= rv_max
+        created = mgr2.store.create(make_workload("fresh", "lq0"))
+        assert created.metadata.resource_version > rv_max
+
+    def test_restore_preserves_metadata(self):
+        clock = FakeClock(1000.0)
+        mgr = _mk_manager(clock)
+        _submit(mgr, 1)
+        _drive(mgr, clock, cycles=2)
+        orig = mgr.store.get("Workload", "default", "w0-0")
+        mgr2 = KueueManager.restore(mgr.durable, clock=clock)
+        rest = mgr2.store.get("Workload", "default", "w0-0")
+        assert rest.metadata.uid == orig.metadata.uid
+        assert rest.metadata.resource_version \
+            == orig.metadata.resource_version
+        assert rest.metadata.creation_timestamp \
+            == orig.metadata.creation_timestamp
+        assert rest.status.admission is not None
+        # store-side RV counter continues past the restored high-water
+        w = mgr2.store.get("Workload", "default", "w0-0")
+        w.spec.priority = 3
+        mgr2.store.update(w)
+        assert mgr2.store.get("Workload", "default",
+                              "w0-0").metadata.resource_version \
+            > orig.metadata.resource_version
+
+
+# ----------------------------------------------------------------------
+# 4. satellites: in-flight drop at shutdown, solver detach
+# ----------------------------------------------------------------------
+
+class TestInflightShutdown:
+    def _pipelined_mgr(self, clock, solver):
+        mgr = _mk_manager(clock, solver=solver, pipeline=True)
+        mgr.scheduler.solver_sync_floor_ms = 0
+        return mgr
+
+    def test_shutdown_drops_inflight_and_releases(self):
+        """ISSUE 10 satellite: a speculative cycle in flight at
+        shutdown must release its snapshot handout and invalidate its
+        residency/arena claims — previously both leaked until process
+        exit."""
+        from kueue_tpu.solver import BatchSolver
+        clock = FakeClock(1000.0)
+        solver = BatchSolver()
+        mgr = self._pipelined_mgr(clock, solver)
+        _submit(mgr, 2)
+        mgr.scheduler.schedule(timeout=0)  # dispatch-only: in flight
+        assert mgr.scheduler._inflight is not None
+        assert mgr.cache.live_handouts == 0  # steady state leaks none
+        mgr.shutdown()
+        assert mgr.scheduler._inflight is None
+        assert solver._resident is None
+        assert mgr.cache.live_handouts == 0
+        assert mgr.cache.handouts_taken == mgr.cache.handouts_released
+
+    def test_restore_reuses_solver_after_detach(self):
+        """Crash with a cycle in flight; restore with the SAME solver
+        object. detach() must drop residency/arena/cache bindings so
+        the restored manager's first cycles re-establish from its own
+        store — and still converge to the oracle."""
+        from kueue_tpu.solver import BatchSolver
+        oracle_clock = FakeClock(1000.0)
+        omgr = _mk_manager(oracle_clock, durable=False,
+                           solver=BatchSolver(), pipeline=True)
+        omgr.scheduler.solver_sync_floor_ms = 0
+        _submit(omgr, 3)
+        _drive(omgr, oracle_clock)
+        oracle = admitted_keys(omgr)
+        assert oracle  # the scenario admits
+
+        clock = FakeClock(1000.0)
+        solver = BatchSolver()
+        mgr = self._pipelined_mgr(clock, solver)
+        _submit(mgr, 3)
+        mgr.scheduler.schedule(timeout=0)  # put a cycle in flight
+        faultinject.install(FaultInjector(
+            {faultinject.SITE_STORE: {3: CRASH}}))
+        with pytest.raises(InjectedCrash):
+            _drive(mgr, clock)
+        faultinject.uninstall()
+        mgr2 = KueueManager.restore(mgr.durable, clock=clock,
+                                    solver=solver)
+        assert solver._cache is mgr2.cache  # rebound to the new plane
+        _drive(mgr2, clock)
+        assert admitted_keys(mgr2) == oracle
+        crash_run = _load_crash_run()
+        ok, msg = crash_run.usage_consistent(mgr2)
+        assert ok, msg
+        mgr2.shutdown()
+        assert mgr2.cache.live_handouts == 0
+
+
+# ----------------------------------------------------------------------
+# 5. the kill-point sweep: every site x many seeds (@slow; the CLI
+#    twin is `tools/crash_run.py --sweep`)
+# ----------------------------------------------------------------------
+
+def _sweep_site(site, seeds=20):
+    crash_run = _load_crash_run()
+    import random
+    import zlib
+    fired = 0
+    oracle_by_seed = {}
+    for seed in range(seeds):
+        # crc32, not hash(): string hashing is randomized per process
+        rng = random.Random(
+            (zlib.crc32(site.encode()) & 0xFFFF) * 100_000 + seed)
+        hit = (rng.randint(5, 120) if site == faultinject.SITE_STORE
+               else rng.randint(0, 8))
+        if seed not in oracle_by_seed:
+            oracle_by_seed[seed] = crash_run.run_oracle(seed)
+        crash = crash_run.run_crash(seed, site, hit)
+        v = crash_run.verdict(oracle_by_seed[seed], crash)
+        fired += 1 if v["crashed"] else 0
+        assert v["converged"], (site, seed, hit, crash["recovery"])
+        assert not v["lost_admissions"], (site, seed, hit)
+        assert not v["double_admission"], (site, seed, hit)
+        assert not v["stranded"], (site, seed, hit)
+    assert fired > 0, f"site {site} never fired across {seeds} seeds"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", [
+    faultinject.SITE_STORE, faultinject.SITE_APPLY,
+    faultinject.SITE_DISPATCH, faultinject.SITE_COLLECT,
+    faultinject.SITE_SCATTER, faultinject.SITE_REPLAY,
+    faultinject.SITE_SPECULATION,
+])
+def test_crash_sweep(site):
+    """ISSUE 10 acceptance: for every injection site and >= 20 seeds,
+    kill -> restore -> replay converges to the uncrashed oracle's
+    admitted set with zero double admissions, zero lost admissions,
+    and zero stranded state."""
+    _sweep_site(site, seeds=20)
+
+
+@pytest.mark.slow
+def test_crash_during_warmup_walk():
+    """A crash inside the compile governor's warm body (SITE_WARMUP)
+    during a synchronous walk propagates like any process death (the
+    supervised worker relays BaseException) and the restored plane
+    re-warms from the persistent cache."""
+    from kueue_tpu.solver import BatchSolver
+    clock = FakeClock(1000.0)
+    solver = BatchSolver()
+    mgr = _mk_manager(clock, solver=solver)
+    _submit(mgr, 2)
+    gov = mgr.warm_governor
+    if gov is None:
+        from kueue_tpu.solver.warmgov import CompileGovernor
+        gov = CompileGovernor(solver, mgr.cache, metrics=mgr.metrics)
+    faultinject.install(FaultInjector(
+        {faultinject.SITE_WARMUP: {0: CRASH}}))
+    with pytest.raises(InjectedCrash):
+        gov.run_sync()
+    faultinject.uninstall()
+    mgr2 = KueueManager.restore(mgr.durable, clock=clock, solver=solver)
+    _drive(mgr2, clock)
+    assert admitted_keys(mgr2)  # the restored plane still admits
+
+
+@pytest.mark.slow
+def test_crash_run_cli_single():
+    crash_run = _load_crash_run()
+    assert crash_run.one_run(7, faultinject.SITE_STORE, 30) == 0

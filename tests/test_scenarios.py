@@ -199,10 +199,11 @@ class TestEventRecorderRing:
 # ----------------------------------------------------------------------
 
 class TestScenarioSmoke:
-    def test_catalog_lists_all_six(self):
+    def test_catalog_lists_all_seven(self):
         assert list_scenarios() == ["cluster_loss", "diurnal",
                                     "flavor_churn", "mixed_jobs",
-                                    "requeue_flood", "tenant_storm"]
+                                    "requeue_flood", "restart_storm",
+                                    "tenant_storm"]
 
     def test_unknown_scenario_and_scale_rejected(self):
         with pytest.raises(KeyError):
@@ -222,6 +223,21 @@ class TestScenarioSmoke:
         assert a == b
         c = run_scenario("diurnal", seed=4, scale="smoke").to_dict()
         assert a != c
+
+    def test_restart_storm_survives_kills(self):
+        res = run_scenario("restart_storm", seed=3, scale="smoke")
+        assert res.ok, res.violations
+        assert res.restarts >= 1
+        # every restart re-admitted within the SLO bound, in virtual s
+        assert len(res.recovery_to_first_admission_s) == res.restarts
+        assert res.admitted == res.submitted and not res.starved
+        # the store never re-admits what it already settled
+        assert res.requeue_amplification == 1.0
+
+    def test_restart_storm_deterministic_per_seed(self):
+        a = run_scenario("restart_storm", seed=5, scale="smoke").to_dict()
+        b = run_scenario("restart_storm", seed=5, scale="smoke").to_dict()
+        assert a == b
 
     def test_tenant_storm_no_cross_tenant_starvation(self):
         res = run_scenario("tenant_storm", seed=0, scale="smoke")
@@ -353,7 +369,8 @@ class TestScenarioRunCLI:
 class TestFullSweep:
     @pytest.mark.parametrize("name", ["cluster_loss", "diurnal",
                                       "flavor_churn", "mixed_jobs",
-                                      "requeue_flood", "tenant_storm"])
+                                      "requeue_flood", "restart_storm",
+                                      "tenant_storm"])
     def test_full_scale_green(self, name):
         res = run_scenario(name, seed=0, scale="full")
         assert res.ok, (name, res.violations)
@@ -361,6 +378,6 @@ class TestFullSweep:
 
     @pytest.mark.parametrize("seed", [1, 2])
     def test_failure_scenarios_hold_across_seeds(self, seed):
-        for name in ("requeue_flood", "cluster_loss"):
+        for name in ("requeue_flood", "cluster_loss", "restart_storm"):
             res = run_scenario(name, seed=seed, scale="full")
             assert res.ok, (name, seed, res.violations)

@@ -1,0 +1,331 @@
+"""Crash-restart chaos driver: seeded kill points end-to-end (ISSUE 10).
+
+Runs the FULL control plane (KueueManager over a durable store:
+checkpoint/WAL sim apiserver, controllers, webhooks, scheduler +
+pipelined solver) over a fixed arrival schedule three ways:
+
+- an **oracle** run that never crashes,
+- a **crash** run killed by an ``InjectedCrash`` at a seeded
+  ``(site, hit)`` — any resilience injection site, including the new
+  ``store_write`` (durable-but-unobserved window) and ``apply_commit``
+  (assumed-but-unwritten window) — then restored from the durable
+  store (``resilience/recovery.py``) with the SAME solver object
+  (exercising ``detach()``) and driven over the remaining schedule.
+
+Verifies the recovery contract (RESILIENCE.md §6):
+
+- **convergence**: the post-recovery admitted set is exactly the
+  uncrashed oracle's,
+- **no lost admissions**: everything durably admitted before the kill
+  stays admitted,
+- **no double admissions**: per-CQ cache usage equals the sum of the
+  store's admitted workloads (a double admit double-counts usage),
+- **no stranded state**: the run settles, the post-shutdown manager
+  holds no in-flight cycle and no live snapshot handouts.
+
+Usage:
+  python tools/crash_run.py [seed] [site] [hit]     one seeded kill
+  python tools/crash_run.py --sweep [seeds]         every site x seeds
+
+Prints one JSON line per run to stderr plus a final verdict line to
+stdout; exits non-zero on any divergence. Deterministic for a given
+seed (FakeClock + seeded schedules).
+"""
+
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kueue_tpu import config as cfgpkg  # noqa: E402
+from kueue_tpu.api import kueue as api  # noqa: E402
+from kueue_tpu.api.corev1 import (  # noqa: E402
+    Container, PodSpec, PodTemplateSpec)
+from kueue_tpu.api.meta import FakeClock, LabelSelector, ObjectMeta  # noqa: E402
+from kueue_tpu.core import workload as wlpkg  # noqa: E402
+from kueue_tpu.manager import KueueManager  # noqa: E402
+from kueue_tpu.resilience import faultinject, recovery  # noqa: E402
+from kueue_tpu.resilience.faultinject import (  # noqa: E402
+    CRASH, FaultInjector, InjectedCrash)
+from kueue_tpu.solver import BatchSolver  # noqa: E402
+
+NUM_CQS = 4
+WAVES = 5
+MAX_CYCLES = 60
+
+# Every site a crash can fire at from the driving thread. compile_warmup
+# runs on the governor's background worker — a crash there cannot
+# propagate to the driver (a real SIGKILL has no such limit, but the
+# in-process simulation does); its kill coverage lives in
+# tests/test_recovery.py via the governor's synchronous walk.
+CRASH_SITES = (faultinject.SITE_STORE, faultinject.SITE_APPLY,
+               faultinject.SITE_DISPATCH, faultinject.SITE_COLLECT,
+               faultinject.SITE_SCATTER, faultinject.SITE_REPLAY,
+               faultinject.SITE_SPECULATION)
+
+
+def make_objects():
+    rf = api.ResourceFlavor(metadata=ObjectMeta(name="f0", uid="rf-f0"))
+    out = [rf]
+    for i in range(NUM_CQS):
+        cq = api.ClusterQueue(metadata=ObjectMeta(name=f"cq{i}",
+                                                  uid=f"cq-{i}"))
+        cq.spec.namespace_selector = LabelSelector()
+        cq.spec.cohort = f"cohort-{i % 2}"
+        cq.spec.resource_groups.append(api.ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[api.FlavorQuotas(name="f0", resources=[
+                api.ResourceQuota(name="cpu", nominal_quota=8000)])]))
+        lq = api.LocalQueue(metadata=ObjectMeta(
+            name=f"lq{i}", namespace="default", uid=f"lq-{i}"))
+        lq.spec.cluster_queue = f"cq{i}"
+        out += [cq, lq]
+    return out
+
+
+def make_workload(wave, i, n):
+    wl = api.Workload(metadata=ObjectMeta(
+        name=f"w{wave}-{i}", namespace="default", uid=f"wl-{wave}-{i}",
+        creation_timestamp=float(n)))
+    wl.spec.queue_name = f"lq{i}"
+    wl.spec.pod_sets.append(api.PodSet(
+        name="main", count=1, template=PodTemplateSpec(spec=PodSpec(
+            containers=[Container(name="c", requests={"cpu": 2000})]))))
+    return wl
+
+
+def make_config():
+    cfg = cfgpkg.Configuration()
+    cfg.solver.enable = True
+    cfg.solver.min_heads = 0
+    cfg.solver.routing = "always"
+    cfg.store.durable = True
+    cfg.store.checkpoint_every = 64
+    return cfg
+
+
+def admitted_keys(mgr):
+    return sorted(wlpkg.key(wl) for wl in mgr.store.list("Workload")
+                  if wlpkg.has_quota_reservation(wl))
+
+
+def usage_consistent(mgr):
+    """Per-CQ reservation usage in the cache must equal the sum of the
+    STORE's admitted workloads — the double-admission detector (a
+    workload admitted twice double-counts its usage)."""
+    expected: dict = {}
+    for wl in mgr.store.list("Workload", copy_objects=False):
+        if not wlpkg.has_quota_reservation(wl):
+            continue
+        info = wlpkg.Info(wl)
+        cq = wl.status.admission.cluster_queue
+        bucket = expected.setdefault(cq, {})
+        for fr, v in info.flavor_resource_usage().items():
+            bucket[fr] = bucket.get(fr, 0) + v
+    for cq in mgr.cache.hm.cluster_queues:
+        reserved, _admitted = mgr.cache.usage_for_cluster_queue(cq)
+        want = {fr: v for fr, v in expected.get(cq, {}).items() if v}
+        got = {fr: v for fr, v in reserved.items() if v}
+        if want != got:
+            return False, f"{cq}: store says {want}, cache says {got}"
+    return True, ""
+
+
+def deliver_wave(mgr, wave):
+    """Create wave ``wave``'s workloads, skipping any that already
+    exist: after a crash the 'client' (the job controllers feeding the
+    apiserver) re-submits whatever its in-flight creates lost, exactly
+    like a real controller re-reconciling its desired state — and the
+    deterministic creation timestamps keep the admission order
+    identical to the oracle's."""
+    n = wave * NUM_CQS
+    for i in range(NUM_CQS):
+        if mgr.store.try_get("Workload", "default",
+                             f"w{wave}-{i}") is None:
+            mgr.store.create(make_workload(wave, i, n + i))
+
+
+def drive(mgr, clock, next_wave, waves, max_cycles=MAX_CYCLES):
+    """Run cycles, trickling remaining arrival waves; returns (next
+    undelivered wave, settled?). Raises InjectedCrash through."""
+    settled = 0
+    for cycle in range(max_cycles):
+        if next_wave < waves:
+            deliver_wave(mgr, next_wave)
+            next_wave += 1
+            mgr.run_until_idle(max_iterations=1_000_000)
+        before = len(admitted_keys(mgr))
+        mgr.scheduler.schedule(timeout=0)
+        mgr.run_until_idle(max_iterations=1_000_000)
+        clock.advance(1.0)
+        progressed = len(admitted_keys(mgr)) > before
+        busy = (progressed or next_wave < waves
+                or mgr.scheduler._inflight is not None)
+        settled = 0 if busy else settled + 1
+        if settled >= 3:
+            return next_wave, True
+    return next_wave, False
+
+
+def run_oracle(seed: int) -> dict:
+    clock = FakeClock(1000.0)
+    mgr = KueueManager(cfg=make_config(), clock=clock,
+                       solver=BatchSolver())
+    for obj in make_objects():
+        mgr.store.create(obj)
+    mgr.run_until_idle(max_iterations=1_000_000)
+    _, settled = drive(mgr, clock, 0, WAVES)
+    out = {"mode": "oracle", "seed": seed, "settled": settled,
+           "admitted": admitted_keys(mgr)}
+    mgr.shutdown()
+    return out
+
+
+def run_crash(seed: int, site: str, hit: int) -> dict:
+    clock = FakeClock(1000.0)
+    solver = BatchSolver()
+    mgr = KueueManager(cfg=make_config(), clock=clock, solver=solver)
+    for obj in make_objects():
+        mgr.store.create(obj)
+    mgr.run_until_idle(max_iterations=1_000_000)
+    durable = mgr.durable
+
+    faultinject.install(FaultInjector({site: {hit: CRASH}}))
+    crashed = False
+    next_wave = 0
+    try:
+        next_wave, settled = drive(mgr, clock, 0, WAVES)
+    except InjectedCrash:
+        crashed = True
+    finally:
+        faultinject.uninstall()
+
+    pre_admitted = []
+    if crashed:
+        # The durable store is the ONLY state that survives; the dead
+        # manager is discarded un-inspected (its queues/cache/solver
+        # bindings are the in-memory state a real SIGKILL loses).
+        loaded = durable.load()
+        pre_admitted = sorted(
+            wlpkg.key(wl)
+            for wl in loaded.objects.get("Workload", {}).values()
+            if wlpkg.has_quota_reservation(wl))
+        mgr = recovery.restore(durable, cfg=make_config(), clock=clock,
+                               solver=solver)
+        # Re-deliver from the first wave with ANY member missing: the
+        # crash may have killed the process mid-wave, losing some of
+        # the client's in-flight creates — the client's job is to
+        # re-submit them (deliver_wave skips the durable survivors).
+        created = {wl.metadata.name
+                   for wl in mgr.store.list("Workload",
+                                            copy_objects=False)}
+        next_wave = 0
+        while next_wave < WAVES and all(
+                f"w{next_wave}-{i}" in created
+                for i in range(NUM_CQS)):
+            next_wave += 1
+    _, settled = drive(mgr, clock, next_wave, WAVES)
+
+    ok_usage, usage_msg = usage_consistent(mgr)
+    out = {
+        "mode": "crash", "seed": seed, "site": site, "hit": hit,
+        "crashed": crashed, "settled": settled,
+        "admitted": admitted_keys(mgr),
+        "pre_crash_admitted": pre_admitted,
+        "usage_consistent": ok_usage, "usage_msg": usage_msg,
+        "recovery": (mgr.last_recovery.to_dict()
+                     if mgr.last_recovery is not None else None),
+    }
+    mgr.shutdown()
+    out["inflight_after_shutdown"] = mgr.scheduler._inflight is not None
+    out["live_handouts"] = mgr.cache.live_handouts
+    return out
+
+
+def verdict(oracle: dict, crash: dict) -> dict:
+    lost = sorted(set(crash["pre_crash_admitted"])
+                  - set(crash["admitted"]))
+    return {
+        "converged": crash["admitted"] == oracle["admitted"],
+        "lost_admissions": lost,
+        "double_admission": not crash["usage_consistent"],
+        "stranded": (not crash["settled"]
+                     or crash["inflight_after_shutdown"]
+                     or crash["live_handouts"] != 0),
+        "crashed": crash["crashed"],
+    }
+
+
+def one_run(seed: int, site: str, hit: int) -> int:
+    oracle = run_oracle(seed)
+    crash = run_crash(seed, site, hit)
+    for r in (oracle, crash):
+        print(json.dumps({**r, "admitted": len(r["admitted"])}),
+              file=sys.stderr)
+    v = verdict(oracle, crash)
+    ok = (v["converged"] and not v["lost_admissions"]
+          and not v["double_admission"] and not v["stranded"])
+    print(json.dumps({"tool": "crash_run", "seed": seed, "site": site,
+                      "hit": hit, "ok": ok, **v,
+                      "admitted": len(crash["admitted"])}))
+    return 0 if ok else 1
+
+
+def sweep(seeds: int) -> int:
+    """Every crash site x ``seeds`` seeded kill points. A seeded hit
+    that is never reached (the site didn't fire before settle) still
+    must converge — it degenerates to a clean run — but each site must
+    fire at least once across its seeds or the sweep is vacuous."""
+    failures = []
+    fired_by_site = {s: 0 for s in CRASH_SITES}
+    oracle_by_seed: dict = {}
+    import zlib
+    for site in CRASH_SITES:
+        for seed in range(seeds):
+            # crc32, not hash(): string hashing is randomized per
+            # process, and the sweep must be reproducible
+            rng = random.Random(
+                (zlib.crc32(site.encode()) & 0xFFFF) * 100_000 + seed)
+            # store writes are dense (tens per cycle); device-path
+            # sites see a handful of hits per cycle — keep kill points
+            # shallow enough to land inside the run for every site
+            hit = (rng.randint(5, 120)
+                   if site == faultinject.SITE_STORE
+                   else rng.randint(0, 8))
+            if seed not in oracle_by_seed:
+                oracle_by_seed[seed] = run_oracle(seed)
+            crash = run_crash(seed, site, hit)
+            v = verdict(oracle_by_seed[seed], crash)
+            fired_by_site[site] += 1 if crash["crashed"] else 0
+            ok = (v["converged"] and not v["lost_admissions"]
+                  and not v["double_admission"] and not v["stranded"])
+            line = {"site": site, "seed": seed, "hit": hit, "ok": ok,
+                    **{k: v[k] for k in ("converged", "crashed")}}
+            print(json.dumps(line), file=sys.stderr)
+            if not ok:
+                failures.append(line)
+    vacuous = [s for s, n in fired_by_site.items() if n == 0]
+    ok = not failures and not vacuous
+    print(json.dumps({"tool": "crash_run", "mode": "sweep",
+                      "seeds": seeds, "sites": len(CRASH_SITES),
+                      "ok": ok, "failures": failures,
+                      "fired_by_site": fired_by_site,
+                      "vacuous_sites": vacuous}))
+    return 0 if ok else 1
+
+
+def main():
+    args = [a for a in sys.argv[1:] if a != "--sweep"]
+    if "--sweep" in sys.argv[1:]:
+        return sweep(int(args[0]) if args else 20)
+    seed = int(args[0]) if args else 1234
+    site = args[1] if len(args) > 1 else faultinject.SITE_STORE
+    hit = int(args[2]) if len(args) > 2 else 40
+    return one_run(seed, site, hit)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -245,6 +245,13 @@ class SolverConfig:
     # overlap the decision fetch of cycle N with dispatch of cycle N+1
     # (all-fit cycles; decisions land one cycle later)
     pipeline: bool = True
+    # speculative dispatch depth: how many cycles may be in flight at
+    # once. 2 (the production default) overlaps the donated arena
+    # upload + next solve with TWO outstanding round trips; only
+    # honored when every queued dispatch carries a SpeculationToken
+    # (the scheduler collapses to 1 otherwise). 1 = the single-slot
+    # pipeline.
+    pipeline_depth: int = 2
     # "adaptive": measure admitted/sec per engine and run each cycle on
     # the faster one; "always"/"never" pin the device/CPU path
     routing: str = "adaptive"
@@ -375,6 +382,8 @@ def validate(cfg: Configuration) -> list[str]:
                     "(0 disables the starvation bound)")
     if cfg.solver.routing not in ("adaptive", "always", "never"):
         errs.append("solver.routing must be adaptive, always, or never")
+    if cfg.solver.pipeline_depth < 1:
+        errs.append("solver.pipelineDepth must be >= 1")
     if cfg.solver.watchdog_safety_factor <= 0 \
             or cfg.solver.watchdog_min_deadline_s <= 0 \
             or cfg.solver.watchdog_max_deadline_s \
@@ -521,6 +530,7 @@ def load(raw: dict) -> Configuration:
             device=s.get("device", ""),
             fallback_on_error=s.get("fallbackOnError", True),
             pipeline=s.get("pipeline", True),
+            pipeline_depth=s.get("pipelineDepth", 2),
             routing=s.get("routing", "adaptive"),
             strict_after_blocked_cycles=s.get(
                 "strictAfterBlockedCycles",

@@ -216,6 +216,7 @@ class KueueManager:
             # Production solver wiring: pipelined dispatch + adaptive
             # engine routing + the persistent compilation cache.
             self.scheduler.pipeline_enabled = self.cfg.solver.pipeline
+            self.scheduler.pipeline_depth = self.cfg.solver.pipeline_depth
             self.scheduler.solver_routing = self.cfg.solver.routing
             self.scheduler.strict_after_blocked_cycles = \
                 self.cfg.solver.strict_after_blocked_cycles

@@ -41,7 +41,8 @@ class CycleTrace:
 
     __slots__ = ("cycle_id", "t_wall", "t0", "duration_s", "route",
                  "regime", "heads", "admitted", "evictions", "faults",
-                 "breaker", "degraded", "tag", "spans", "annotations")
+                 "breaker", "degraded", "tag", "spans", "annotations",
+                 "upload_bytes", "fetch_bytes", "dispatches", "collects")
 
     def __init__(self, cycle_id: int, t_wall: float, t0: float):
         self.cycle_id = cycle_id
@@ -57,6 +58,15 @@ class CycleTrace:
         self.breaker = ""
         self.degraded = ""            # ladder rung the cycle ran under
         self.tag = ""                 # driver context (scenario phase)
+        # Per-cycle host<->device transport (solver counter deltas over
+        # the cycle): bytes on the wire and round trips — the steady-
+        # state contract is ONE dispatch + ONE collect per device cycle
+        # with a decision-sized fetch, and these fields make every
+        # violation visible per trace (tools/transport_probe.py).
+        self.upload_bytes = 0
+        self.fetch_bytes = 0
+        self.dispatches = 0
+        self.collects = 0
         self.spans: list = []         # (name, start_s, dur_s)
         self.annotations: list = []   # dicts: {"kind", "message", ...}
 
@@ -84,6 +94,10 @@ class CycleTrace:
             "breaker": self.breaker,
             "degraded": self.degraded,
             "tag": self.tag,
+            "upload_bytes": self.upload_bytes,
+            "fetch_bytes": self.fetch_bytes,
+            "dispatches": self.dispatches,
+            "collects": self.collects,
             "spans": [{"name": n, "start_ms": round(s * 1e3, 3),
                        "dur_ms": round(d * 1e3, 3)}
                       for n, s, d in self.spans],

@@ -90,6 +90,8 @@ def pipeline_status(scheduler) -> dict:
     return {
         "enabled": scheduler.pipeline_enabled,
         "inflight": scheduler._inflight is not None,
+        "depth": scheduler.pipeline_depth,
+        "inflight_depth": len(scheduler._inflight_q),
         "cooldown": scheduler._pipeline_cooldown,
         "pipelined_cycles": pipelined,
         "sync_device_cycles": device_sync,

@@ -55,6 +55,55 @@ class RangeSpec:
     # judging (BENCH_r05 ran cpu_fallback; its rows are not comparable).
     # 0 = unchecked.
     min_device_speedup: float = 0.0
+    # Steady-state transport bounds (decision-only fetch / donated
+    # uploads): max bytes per device cycle on the wire, averaged over
+    # the run's dispatches/collects. The BOUNDS are calibrated per
+    # deployment shape so a spec carrying them declares its backend
+    # (tunnel transports frame differently); a transport regression —
+    # e.g. the fetch silently reverting to dense [W,...] arrays —
+    # fails loudly instead of hiding in a wall-time wash. 0 =
+    # unchecked.
+    max_fetch_bytes_per_cycle: int = 0
+    max_upload_bytes_per_cycle: int = 0
+
+
+# --- device-witness debt manifest -----------------------------------------
+#
+# Every rangespec/SLO gate that REFUSES on cpu_fallback (the bench-env
+# honesty policy) is a bound that has NEVER been witnessed on a device
+# backend: the PR-9 PREEMPT_SPEEDUP_FLOORS, the tenant-storm device
+# route gate, the r05 e2e re-baseline, and the fused-route transport
+# floors. The registry below consolidates every refusal recorded during
+# a perf/bench run into one manifest the JSON artifacts carry, so a
+# future run on a real device knows exactly which gates it must
+# witness — instead of re-deriving the debt from scattered
+# rangespec_refused fields.
+
+_WITNESS_DEBT: list = []
+
+
+def record_refusal(context: str, kind: str, reason: str,
+                   spec_backend: str = "") -> dict:
+    """Record one refused comparison into the device-witness debt
+    manifest. Returns the entry (already appended). Deduplicates on
+    (context, kind) — a gate refused twice in one run is one debt."""
+    entry = {"context": context, "kind": kind, "reason": reason,
+             "calibrated_backend": spec_backend}
+    for e in _WITNESS_DEBT:
+        if e["context"] == context and e["kind"] == kind:
+            return e
+    _WITNESS_DEBT.append(entry)
+    return entry
+
+
+def witness_debt() -> list:
+    """The consolidated manifest of every gate this process refused to
+    judge (copy — callers may serialize it into artifacts)."""
+    return [dict(e) for e in _WITNESS_DEBT]
+
+
+def reset_witness_debt() -> None:
+    _WITNESS_DEBT.clear()
 
 
 def check_device_speedup(speedup: float, spec: RangeSpec,
@@ -268,6 +317,21 @@ def check(result: RunResult, spec: RangeSpec) -> list:
             violations.append(
                 f"cycle phase {phase!r} p99 {p99:.3f}ms "
                 f"exceeds {bound:.3f}ms")
+    if spec.max_fetch_bytes_per_cycle \
+            and result.fetch_bytes_per_cycle is not None \
+            and result.fetch_bytes_per_cycle \
+            > spec.max_fetch_bytes_per_cycle:
+        violations.append(
+            f"steady-state fetch {result.fetch_bytes_per_cycle:.0f} "
+            f"bytes/cycle exceeds {spec.max_fetch_bytes_per_cycle} — "
+            f"the decision-only fetch regressed toward dense tensors")
+    if spec.max_upload_bytes_per_cycle \
+            and result.upload_bytes_per_cycle is not None \
+            and result.upload_bytes_per_cycle \
+            > spec.max_upload_bytes_per_cycle:
+        violations.append(
+            f"steady-state upload {result.upload_bytes_per_cycle:.0f} "
+            f"bytes/cycle exceeds {spec.max_upload_bytes_per_cycle}")
     if spec.max_mid_traffic_compiles is not None \
             and result.mid_traffic_compiles is not None \
             and result.mid_traffic_compiles > spec.max_mid_traffic_compiles:

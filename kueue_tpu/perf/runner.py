@@ -82,8 +82,19 @@ class RunResult:
     # Speculative-pipeline outcomes (scheduler/PIPELINE.md): validated
     # commits vs mis-speculation aborts by validation reason.
     speculation: dict = field(default_factory=dict)
+    # Phase attribution note: solver_phase_s mirrors the flight
+    # recorder's span tree exactly — dotted keys ("dispatch.scatter")
+    # are sub-spans already included inside their prefix phase, so
+    # summing the TOP-LEVEL keys gives total solver time and the
+    # artifact agrees with /debug/cycles by construction.
     solver_phase_s: dict = field(default_factory=dict)
     solver_counters: dict = field(default_factory=dict)
+    # Per-cycle transport (the device round-trip story): average bytes
+    # on the wire per dispatch/collect across the run. None for
+    # solver-less runs or runs that never round-tripped. The
+    # decision-only fetch rangespec bounds these.
+    upload_bytes_per_cycle: Optional[float] = None
+    fetch_bytes_per_cycle: Optional[float] = None
     # Snapshot-build attribution (incremental journal-replay snapshots):
     # per-snapshot build latency and which path served each call
     # (incremental advance vs full rebuild vs light view).
@@ -284,6 +295,13 @@ class Runner:
                 getattr(self.solver, "counters", {}))
             result.mid_traffic_compiles = result.solver_counters.get(
                 "mid_traffic_compiles")
+            c = result.solver_counters
+            if c.get("dispatches"):
+                result.upload_bytes_per_cycle = (
+                    c.get("upload_bytes", 0) / c["dispatches"])
+            if c.get("collects"):
+                result.fetch_bytes_per_cycle = (
+                    c.get("fetch_bytes", 0) / c["collects"])
         gov = self.mgr.warm_governor
         if gov is not None:
             st = gov.status()

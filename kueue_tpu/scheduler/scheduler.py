@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import threading
 import time as _time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -147,7 +148,18 @@ class Scheduler:
         # latency-sensitive deployments keep the synchronous cycle; the
         # manager/bench production wiring turns it on.
         self.pipeline_enabled = False
-        self._inflight: Optional[stages.InFlightCycle] = None
+        # In-flight speculative cycles, oldest first. Depth 1 (the
+        # default) reproduces the single-slot pipeline exactly; depth 2
+        # lets dispatch N+2 launch while N's decisions are still on the
+        # wire — the donated arena upload and the next solve overlap
+        # TWO round trips instead of one. Deepening past 1 is only
+        # honored when every queued dispatch carries a SpeculationToken
+        # (the full staleness witness); a token-less dispatch collapses
+        # the effective depth to 1. One mis-speculation aborts EVERY
+        # queued cycle (they chain on the same device state), so no
+        # stale admission can ride out on the deeper queue.
+        self._inflight_q: deque = deque()
+        self.pipeline_depth = 1
         self._pipeline_cooldown = 0
         # Speculation outcome counters (the pipelined hit-rate story):
         # hits = validated-and-committed speculative cycles, aborts =
@@ -240,6 +252,12 @@ class Scheduler:
         self.preempt_plans_deferred = 0  # deferred preempt plans (total)
         self._drain_cost = 0.0  # pipeline-drain seconds within this cycle
         self._cycle_evictions = 0  # evictions issued within this cycle
+        # Transport accounting baseline at cycle start (solver counter
+        # snapshot): _finish_trace stamps the per-cycle DELTAS — bytes
+        # on the wire and device round trips — onto the cycle trace,
+        # so /debug/cycles and tools/transport_probe.py can price every
+        # cycle's host<->device traffic without lifetime-counter math.
+        self._cycle_io0 = (0, 0, 0, 0)
         # Below this head count the accelerator dispatch overhead exceeds
         # the win; narrow cycles go through the CPU path even with a
         # solver configured (SolverConfig.min_heads; 0 = always solve).
@@ -333,6 +351,7 @@ class Scheduler:
                 trace = self.recorder.begin_cycle(self.attempt_count)
                 self._cycle_evictions = 0
                 self._cycle_faults = 0
+                self._cycle_io0 = self._io_counters()
                 self._cycle_degraded = self.ladder.state
                 sig = self._drain_pipeline()
                 self._finish_trace(trace, "drain", heads=0,
@@ -349,6 +368,7 @@ class Scheduler:
         self._drain_cost = 0.0
         self._cycle_evictions = 0
         self._cycle_faults = 0
+        self._cycle_io0 = self._io_counters()
         self._degrade_deferred = 0
         # The ladder rung this cycle RUNS under (transitions only happen
         # at cycle end, in _observe_budget): shed/survival cap the heads
@@ -723,6 +743,16 @@ class Scheduler:
         self.recorder.span(name, t0, t1 - t0)
         return t1
 
+    def _io_counters(self) -> tuple:
+        """(upload_bytes, fetch_bytes, dispatches, collects) from the
+        solver's lifetime counters — the transport baseline snapshotted
+        at cycle start so _finish_trace can stamp per-cycle deltas."""
+        c = getattr(self.solver, "counters", None)
+        if not c:
+            return (0, 0, 0, 0)
+        return (c.get("upload_bytes", 0), c.get("fetch_bytes", 0),
+                c.get("dispatches", 0), c.get("collects", 0))
+
     def _finish_trace(self, trace, route: str, heads: int,
                       admitted: Optional[int]) -> None:
         """Seal this cycle's trace and feed the observability metrics.
@@ -743,6 +773,12 @@ class Scheduler:
         trace.faults = self._cycle_faults
         trace.breaker = self.breaker.state
         trace.degraded = self._cycle_degraded
+        io = self._io_counters()
+        base = self._cycle_io0
+        trace.upload_bytes = io[0] - base[0]
+        trace.fetch_bytes = io[1] - base[1]
+        trace.dispatches = io[2] - base[2]
+        trace.collects = io[3] - base[3]
         self.recorder.finish(trace)
         if self.metrics is not None:
             self.metrics.cycle_observed(route, heads, trace.phase_sums())
@@ -1009,6 +1045,15 @@ class Scheduler:
         if rel is not None:
             rel(key)
 
+    @property
+    def _inflight(self) -> Optional[stages.InFlightCycle]:
+        """The OLDEST in-flight speculative cycle (the one the next
+        collect processes), or None when the pipeline is empty.
+        Read-only: mutation goes through _inflight_q, which carries the
+        dispatch-depth queue."""
+        q = self._inflight_q
+        return q[0] if q else None
+
     def _pipeline_ok(self, heads: list) -> bool:
         s = self.solver
         # Breaker not CLOSED => the cycle is a half-open probe: it must
@@ -1033,16 +1078,29 @@ class Scheduler:
         cycle has been drained first)."""
         solver = self.solver
         self._pipeline_trace_route = "device-pipelined"
-        early = self._inflight
-        if early is not None and early.token is not None:
-            # Validate the in-flight speculation BEFORE dispatching the
-            # next cycle: a new dispatch chains on the in-flight device
-            # state, so aborting the predecessor after the fact would
-            # doom the successor too (one abort, not a cascade).
+        # Validate EVERY in-flight speculation BEFORE dispatching the
+        # next cycle: a new dispatch chains on the in-flight device
+        # state, so aborting a predecessor after the fact would doom
+        # the successor too. The chain runs old->new: an invalid token
+        # dooms the failing cycle and everything dispatched AFTER it
+        # (flushed as "chained" by _abort_speculation), while validated
+        # PREDECESSORS collect normally first — their results don't
+        # depend on the failing cycle, and the sync fallback cycle must
+        # not run with their admissions still un-collected.
+        for early in tuple(self._inflight_q):
+            if early.token is None:
+                continue
             ok, reason = self._validate_speculation(early)
             if not ok:
-                self._inflight = None
-                self._abort_speculation(early, reason)
+                while self._inflight_q and self._inflight_q[0] is not early:
+                    self._drain_one(self._inflight_q.popleft(),
+                                    sample=True)
+                if self._inflight_q and self._inflight_q[0] is early:
+                    # (a predecessor's own processing may have aborted
+                    # and flushed the queue — then there is nothing
+                    # left to abort here)
+                    self._inflight_q.popleft()
+                    self._abort_speculation(early, reason)
                 return None  # sync path owns this cycle's heads
         # Light snapshot: the all-fit pipelined cycle never simulates on
         # it (usage truth is the device-resident state); cloning 2k
@@ -1186,10 +1244,17 @@ class Scheduler:
         # _process_inflight before the result may commit (PIPELINE.md).
         token = stages.SpeculationToken.stamp(self.cache, solver, plan,
                                               snapshot)
-        prev, self._inflight = self._inflight, stages.InFlightCycle(
+        self._inflight_q.append(stages.InFlightCycle(
             inflight=inflight, snapshot=snapshot, nofit_idx=nofit_idx,
-            pend_idx=pend_idx, pmeta=pmeta, token=token)
-        if prev is None:
+            pend_idx=pend_idx, pmeta=pmeta, token=token))
+        # Effective dispatch depth: deepening past one in-flight cycle
+        # is only sound when EVERY queued dispatch carries the full
+        # SpeculationToken staleness witness — a token-less dispatch
+        # (custom solver, no arena feed) collapses the depth to 1.
+        depth = max(1, self.pipeline_depth)
+        if any(ic.token is None for ic in self._inflight_q):
+            depth = 1
+        if len(self._inflight_q) <= depth:
             if prev_signal is not None:
                 # Mixed-cycle pre-drain: _last_cycle_admitted still
                 # holds the drained admissions — schedule() charges them
@@ -1200,8 +1265,12 @@ class Scheduler:
             self.cycle_counts["device-dispatch-only"] = \
                 self.cycle_counts.get("device-dispatch-only", 0) + 1
             self._pipeline_trace_route = "device-dispatch-only"
-            return KeepGoing  # first pipelined cycle: results next call
-        return self._process_inflight(prev, start)
+            return KeepGoing  # pipeline deepening: results a call later
+        signal = KeepGoing
+        while len(self._inflight_q) > depth:
+            prev = self._inflight_q.popleft()
+            signal = self._process_inflight(prev, start)
+        return signal
 
     def _abandon_pipeline(self) -> None:
         """Drop the in-flight cycle WITHOUT applying its decisions
@@ -1209,10 +1278,10 @@ class Scheduler:
         invalidate residency — the device state includes admissions that
         will never be confirmed, and the store may move under another
         leader before we see it again."""
-        prev, self._inflight = self._inflight, None
-        if prev is None:
+        if not self._inflight_q:
             return
-        self._requeue_inflight(prev)
+        while self._inflight_q:
+            self._requeue_inflight(self._inflight_q.popleft())
         self._solver_invalidate()
 
     def _requeue_inflight(self, prev: stages.InFlightCycle) -> None:
@@ -1229,6 +1298,23 @@ class Scheduler:
                 continue  # already requeued at dispatch time
             self.queues.requeue_workload(
                 w, RequeueReason.FAILED_AFTER_NOMINATION)
+
+    def _flush_inflight_queue(self, why: str) -> None:
+        """Discard every still-queued in-flight cycle un-decoded
+        (collateral of a device fault on an older chained cycle):
+        requeue their heads and release their deferred snapshots. Not
+        speculation aborts — nothing about THEIR state was proven
+        stale; the chain they rode was simply invalidated."""
+        if not self._inflight_q:
+            return
+        flushed = 0
+        while self._inflight_q:
+            self._requeue_inflight(self._inflight_q.popleft())
+            flushed += 1
+        self.recorder.annotate(
+            "pipeline-flush",
+            f"{flushed} chained in-flight cycle(s) discarded: {why}",
+            reason=why, flushed=flushed)
 
     def _prepare_pipelined_preempt(self, plan, pend_ws: list):
         """Nominate predicted-non-fit, preempt-capable entries against a
@@ -1288,32 +1374,46 @@ class Scheduler:
         the drained admissions against the FULL cycle cost — recording a
         cheap decode-only sample here made the device engine look fast
         exactly when its cycles were slowest)."""
-        prev, self._inflight = self._inflight, None
-        if prev is None:
+        if not self._inflight_q:
             return KeepGoing
+        sig = KeepGoing
+        drained_total = None
+        while self._inflight_q:
+            prev = self._inflight_q.popleft()
+            sig, admitted = self._drain_one(prev, sample)
+            if admitted is not None:
+                drained_total = (drained_total or 0) + admitted
+        # The drained admissions, surviving _drain_one's sample-branch
+        # consumption (the headless-drain trace reports them; at
+        # depth 2 a drain can collect two cycles' worth).
+        self._drained_admitted = drained_total
+        if not sample:
+            self._last_cycle_admitted = drained_total
+        return sig
+
+    def _drain_one(self, prev: stages.InFlightCycle,
+                   sample: bool) -> tuple:
+        """Process one in-flight cycle with drain accounting; returns
+        (signal, admitted-or-None). With ``sample``, the drained cycle
+        is recorded as DEVICE work even when the draining cycle was
+        routed to CPU (exploration) — and its time (via _drain_cost)
+        AND its evictions are excluded from the enclosing cycle's own
+        sample, so each engine's rate reflects only its own progress
+        per second. _process_inflight sets _cycle_regime to the
+        drained cycle's regime."""
         t0 = _time.perf_counter()
         ev0 = self._cycle_evictions
         sig = self._process_inflight(prev, self.clock.now())
-        # The drained cycle's admissions, surviving the sample branch's
-        # consumption below (the headless-drain trace reports them).
-        self._drained_admitted = self._last_cycle_admitted
+        admitted = self._last_cycle_admitted
         if sample:
             dt = _time.perf_counter() - t0
-            # The drained cycle is DEVICE work even when the draining
-            # cycle was routed to CPU (exploration): record it here —
-            # and exclude its time (via _drain_cost) AND its evictions
-            # from the enclosing cycle's own sample — so each engine's
-            # rate reflects only its own progress per second.
-            # _process_inflight already set _cycle_regime to the
-            # drained cycle's regime.
             drained_ev = self._cycle_evictions - ev0
             self._cycle_evictions = ev0
             self._drain_cost += dt
-            if self._last_cycle_admitted is not None:
-                self._route_record(
-                    "device", self._last_cycle_admitted + drained_ev, dt)
+            if admitted is not None:
+                self._route_record("device", admitted + drained_ev, dt)
             self._last_cycle_admitted = None  # consumed
-        return sig
+        return sig, admitted
 
     def _process_inflight(self, prev: stages.InFlightCycle,
                           start) -> SpeedSignal:
@@ -1345,6 +1445,10 @@ class Scheduler:
             # on a wedged device_get.
             self._solver_fault("collect", exc)
             self._requeue_inflight(prev)
+            # Deeper pipeline: every still-queued cycle chained on the
+            # residency the fault just invalidated — flush them too
+            # (heads re-heap, nothing decoded, no double admission).
+            self._flush_inflight_queue("collect-fault")
             self._pipeline_cooldown = 1
             # An aborted collect admitted nothing: a previous cycle's
             # count must not leak into the drain trace or the drain
@@ -1460,6 +1564,18 @@ class Scheduler:
         self.log.v(2, "speculation.abort", reason=reason,
                    aborts=self.speculation_aborts)
         self._requeue_inflight(prev)
+        # Deeper pipeline: the still-queued cycles chained Phase B on
+        # the same speculated state — one abort dooms them all (depth 2
+        # aborts BOTH in-flight cycles; neither decodes, neither can
+        # double-admit). Counted as their own aborts under "chained".
+        while self._inflight_q:
+            chained = self._inflight_q.popleft()
+            self.speculation_aborts += 1
+            self.speculation_abort_reasons["chained"] = \
+                self.speculation_abort_reasons.get("chained", 0) + 1
+            if self.metrics is not None:
+                self.metrics.speculation_abort("chained")
+            self._requeue_inflight(chained)
         self._solver_invalidate()
         self._pipeline_cooldown = 1
         # An aborted speculation admitted nothing: the drain trace and

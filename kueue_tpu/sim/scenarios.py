@@ -887,9 +887,15 @@ def run_tenant_storm(seed: int = 0, scale: str = "full",
         import jax
         on_device = jax.default_backend() != "cpu"
         if not on_device:
-            res.counters["route_gate_refused"] = (
+            reason = (
                 "cpu backend: device-vs-cpu route economics are not the "
                 "production ones; route mix recorded, gate refused")
+            res.counters["route_gate_refused"] = reason
+            # consolidated device-witness debt (perf.checker): a future
+            # device run must witness this gate
+            from kueue_tpu.perf import checker as checkerpkg
+            checkerpkg.record_refusal("scenario.tenant_storm.route_gate",
+                                      "device_route_gate", reason, "tpu")
         elif preempt_cycles and not device_preempt:
             res.violations.append(
                 "storm preemption-heavy cycles never routed to the "

@@ -525,7 +525,6 @@ class WorkloadArena:
             # fresh per-size scatter compile.
             return self._full_upload()
         self.dirty.clear()
-        from kueue_tpu.solver.kernel import scatter_arena_rows
         for D in _UPD_BUCKETS:
             if len(rows) <= D:
                 break
@@ -551,5 +550,14 @@ class WorkloadArena:
         # from the host arrays, which faults never touch.
         upd_rows = faultinject.site(faultinject.SITE_SCATTER, upd_rows,
                                     corrupt=_scramble_rows)
-        self.dev = scatter_arena_rows(self.dev, upd_slots, upd_rows)
+        # DONATED scatter: the old twin's buffers alias into the new
+        # generation instead of a second full twin + copy — the upload
+        # double-buffers in place while the previous cycle's collect is
+        # still in flight (kernel.scatter_arena_rows_donated; the
+        # donated dict is dead after this line, replaced atomically).
+        # An injected raise above leaves self.dev untouched (undonated),
+        # so the fault path's drop_device/full re-upload stays sound.
+        from kueue_tpu.solver.kernel import scatter_arena_rows_donated
+        self.dev = scatter_arena_rows_donated(self.dev, upd_slots,
+                                              upd_rows)
         return self.dev, nbytes

@@ -315,6 +315,81 @@ solve_cycle = partial(jax.jit, static_argnames=("num_podsets", "fair_sharing"))(
 
 
 # ---------------------------------------------------------------------------
+# Decision-only fetch: on-device compaction of the per-cycle outputs
+# ---------------------------------------------------------------------------
+#
+# The staged fetch shipped five dense arrays per cycle — admitted/fit/
+# borrows [W] bool plus chosen [W,P,R] int32 and chosen_borrow [W,P,R]
+# bool — ~(3 + 5*P*R) bytes per batch row, even though decode only needs
+# a flavor index (< F) and a handful of bits per row. The fused programs
+# can instead compact the decisions ON DEVICE into the wire format below
+# and the fetch ships only that (>5x smaller at every P*R):
+#
+# - dec_pr   uint8 [W, P*R]: (chosen + 1) | (chosen_borrow << 7) per
+#   (podset, resource) lane — 0 means "no flavor" (chosen == -1), so the
+#   format holds any F <= MAX_COMPACT_FLAVORS. Static shape: the batch
+#   width is already bucketed, so the ladder warms one program per
+#   bucket exactly like the dense variants.
+# - dec_bits uint8 [3, ceil(W/8)]: the fit / admitted / borrows rows as
+#   little-endian bit planes.
+#
+# Host-side unpack (service.unpack_decisions) restores the exact dense
+# arrays, so decode and the output-invariant validation are bit-identical
+# to the staged path (pinned by tests/test_transport.py).
+
+# chosen + 1 must fit in 7 bits (bit 7 carries chosen_borrow)
+MAX_COMPACT_FLAVORS = 126
+
+# the packed decision keys, in fetch order (service imports this so the
+# dispatch keys and the unpacker can never drift)
+DECISION_KEYS = ("dec_pr", "dec_bits")
+
+
+def dense_decision_nbytes(W: int, P: int, R: int) -> int:
+    """Bytes the STAGED decision fetch ships for a [W] batch:
+    admitted/fit/borrows [W] bool + chosen [W,P,R] int32 +
+    chosen_borrow [W,P,R] bool. The one definition of the dense
+    equivalent the >5x transport gates (bench transport_bytes row,
+    tests/test_transport.py) measure the compact wire format against —
+    if the staged key set ever changes, this is the only place the
+    ratio's denominator lives."""
+    return 3 * W + 5 * W * P * R
+
+
+def _pack_bits(rows):
+    """[N, W] bool -> [N, ceil(W/8)] uint8, little-endian within each
+    byte (numpy.unpackbits(bitorder="little") inverts it exactly)."""
+    N, W = rows.shape
+    pad = (-W) % 8
+    if pad:
+        rows = jnp.concatenate(
+            [rows, jnp.zeros((N, pad), bool)], axis=1)
+    grouped = rows.reshape(N, -1, 8).astype(jnp.uint8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+    return jnp.sum(grouped * weights, axis=2, dtype=jnp.uint8)
+
+
+def pack_decisions_impl(out: dict) -> dict:
+    """Replace the five dense decision arrays in a solve output dict
+    with the compact wire format (docstring above). Non-decision
+    entries (usage/cohort_usage residency chain, preemption targets and
+    stats) pass through untouched."""
+    chosen = out["chosen"]                     # [W,P,R] int32
+    cb = out["chosen_borrow"]                  # [W,P,R] bool
+    W = chosen.shape[0]
+    pr = (chosen + 1).astype(jnp.uint8).reshape(W, -1)
+    pr = pr | (cb.reshape(W, -1).astype(jnp.uint8) << 7)
+    bits = _pack_bits(jnp.stack([out["fit"], out["admitted"],
+                                 out["borrows"]]))
+    packed = {k: v for k, v in out.items()
+              if k not in ("admitted", "fit", "borrows", "chosen",
+                           "chosen_borrow")}
+    packed["dec_pr"] = pr
+    packed["dec_bits"] = bits
+    return packed
+
+
+# ---------------------------------------------------------------------------
 # Cohort-parallel admit (v2): the TPU-first Phase B
 # ---------------------------------------------------------------------------
 #
@@ -401,7 +476,8 @@ solve_phase_b_domains = jax.jit(solve_phase_b_domains_impl)
 def solve_cycle_fused_impl(topo, usage, cohort_usage, requests, podset_active,
                            wl_cq, priority, timestamp, eligible, solvable,
                            num_podsets: int, max_rank: int,
-                           fair_sharing: bool = False, start_rank=None):
+                           fair_sharing: bool = False, start_rank=None,
+                           compact: bool = False):
     """The production single-chip path, fully fused: Phase A, the
     domain-rank order grid, and the cohort-parallel Phase B run as ONE
     device program — no host round-trip between phases.
@@ -450,13 +526,15 @@ def solve_cycle_fused_impl(topo, usage, cohort_usage, requests, podset_active,
 
     admitted, usage_out, cohort_out = solve_phase_b_domains_impl(
         topo, usage, cohort_usage, asg_usage, fit, wl_cq, grid)
-    return {"admitted": admitted, "chosen": chosen, "borrows": borrows,
-            "chosen_borrow": chosen_borrow, "fit": fit, "usage": usage_out,
-            "cohort_usage": cohort_out}
+    out = {"admitted": admitted, "chosen": chosen, "borrows": borrows,
+           "chosen_borrow": chosen_borrow, "fit": fit, "usage": usage_out,
+           "cohort_usage": cohort_out}
+    return pack_decisions_impl(out) if compact else out
 
 
 solve_cycle_fused = partial(
-    jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing"))(
+    jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing",
+                              "compact"))(
     solve_cycle_fused_impl)
 
 
@@ -466,7 +544,8 @@ def solve_cycle_with_preempt_impl(topo, usage, cohort_usage, requests,
                                   num_podsets: int, max_rank: int,
                                   fair_sharing: bool = False,
                                   start_rank=None, fair_preempt_args=None,
-                                  fs_strategies: tuple = ()):
+                                  fs_strategies: tuple = (),
+                                  compact: bool = False):
     """Mixed admission + preemption cycle as ONE device program: the fused
     fit solve plus the batched preemption target selection
     (preempt.solve_preempt_impl, and fairpreempt.solve_fair_impl for
@@ -496,12 +575,12 @@ def solve_cycle_with_preempt_impl(topo, usage, cohort_usage, requests,
         out["fair_feasible"] = ff
         out["fair_reasons"] = frs
         out["fair_stats"] = fstats
-    return out
+    return pack_decisions_impl(out) if compact else out
 
 
 solve_cycle_with_preempt = partial(
     jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing",
-                              "fs_strategies"))(
+                              "fs_strategies", "compact"))(
     solve_cycle_with_preempt_impl)
 
 
@@ -666,7 +745,8 @@ def solve_cycle_resident_impl(topo, usage, cohort_usage, deltas, requests,
                               max_rank: int, fair_sharing: bool = False,
                               start_rank=None, preempt_args=None,
                               fair_preempt_args=None,
-                              fs_strategies: tuple = ()):
+                              fs_strategies: tuple = (),
+                              compact: bool = False):
     """The device-resident production cycle: sparse correction prologue +
     the fused fit solve (+ the batched preemption programs when present),
     all ONE device program. usage/cohort_usage stay on device across
@@ -680,18 +760,20 @@ def solve_cycle_resident_impl(topo, usage, cohort_usage, deltas, requests,
             topo, usage, cohort_usage, requests, podset_active, wl_cq,
             priority, timestamp, eligible, solvable,
             num_podsets=num_podsets, max_rank=max_rank,
-            fair_sharing=fair_sharing, start_rank=start_rank)
+            fair_sharing=fair_sharing, start_rank=start_rank,
+            compact=compact)
     return solve_cycle_with_preempt_impl(
         topo, usage, cohort_usage, requests, podset_active, wl_cq,
         priority, timestamp, eligible, solvable, preempt_args,
         num_podsets=num_podsets, max_rank=max_rank,
         fair_sharing=fair_sharing, start_rank=start_rank,
-        fair_preempt_args=fair_preempt_args, fs_strategies=fs_strategies)
+        fair_preempt_args=fair_preempt_args, fs_strategies=fs_strategies,
+        compact=compact)
 
 
 solve_cycle_resident = partial(
     jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing",
-                              "fs_strategies"))(
+                              "fs_strategies", "compact"))(
     solve_cycle_resident_impl)
 
 
@@ -725,6 +807,21 @@ def scatter_arena_rows_impl(arena: dict, upd_slots, upd_rows: dict):
 
 scatter_arena_rows = jax.jit(scatter_arena_rows_impl)
 
+# The production upload path (arena.prepare_device) DONATES the previous
+# twin into the scatter: XLA aliases the output buffers onto the donated
+# input instead of allocating a second full twin and copying the
+# untouched rows — the twin double-buffers in place (at most the
+# donated-in and the returned generation alive at once), so the
+# changed-row upload overlaps the previous cycle's in-flight collect
+# without doubling device memory. Backends without donation support
+# (CPU) silently copy — same results, no aliasing win. After the call
+# the donated arrays are DELETED (jax contract): callers must replace
+# every reference with the returned dict, which prepare_device does
+# atomically under the arena lock. The undonated variant above stays for
+# read-only callers (tests, repeated warms against one zero twin).
+scatter_arena_rows_donated = partial(jax.jit, donate_argnums=(0,))(
+    scatter_arena_rows_impl)
+
 
 def gather_arena_impl(arena: dict, slots):
     """[W]-padded slot indices (-1 = padding) -> the batch tensors,
@@ -749,7 +846,8 @@ def solve_cycle_resident_arena_impl(topo, usage, cohort_usage, deltas,
                                     fair_sharing: bool = False,
                                     start_rank=None, preempt_args=None,
                                     fair_preempt_args=None,
-                                    fs_strategies: tuple = ()):
+                                    fs_strategies: tuple = (),
+                                    compact: bool = False):
     """The arena-resident production cycle: gather the head slots from
     the device arena twin into the batch tensors, then run the resident
     solve — one device program, with no per-cycle batch upload (changed
@@ -760,12 +858,12 @@ def solve_cycle_resident_arena_impl(topo, usage, cohort_usage, deltas,
         num_podsets=num_podsets, max_rank=max_rank,
         fair_sharing=fair_sharing, start_rank=start_rank,
         preempt_args=preempt_args, fair_preempt_args=fair_preempt_args,
-        fs_strategies=fs_strategies)
+        fs_strategies=fs_strategies, compact=compact)
 
 
 solve_cycle_resident_arena = partial(
     jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing",
-                              "fs_strategies"))(
+                              "fs_strategies", "compact"))(
     solve_cycle_resident_arena_impl)
 
 

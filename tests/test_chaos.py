@@ -363,6 +363,71 @@ class TestSpeculationAborts:
         _assert_host_state_clean(chaos)
 
 
+class TestDepthTwoSpeculationAborts:
+    def test_depth2_mis_speculation_aborts_both_inflight_cleanly(self):
+        # ISSUE 11 satellite: at dispatch depth 2 TWO cycles ride the
+        # chained device state; a scripted mis-speculation must abort
+        # BOTH in-flight cycles (the younger as "chained"), fall back
+        # to the synchronous path, converge to the fault-free oracle's
+        # admitted set, and never double-admit. The injector installs
+        # only once the pipeline has genuinely deepened to two
+        # outstanding dispatches, so the abort-both path is exercised
+        # deterministically.
+        results = {}
+        for chaotic in (False, True):
+            env = build_env(_setup(), solver=True)
+            s = env.scheduler
+            s.pipeline_enabled = True
+            s.pipeline_depth = 2
+            wave = 0
+            try:
+                if chaotic:
+                    # ramp until two cycles are in flight
+                    for _ in range(8):
+                        _submit_waves(env, 1, start_wave=wave)
+                        wave += 1
+                        env.cycle()
+                        env.clock.advance(1.0)
+                        if len(s._inflight_q) == 2:
+                            break
+                    assert len(s._inflight_q) == 2
+                    # the very next validation call (the OLDEST queued
+                    # token, checked before the next dispatch) raises
+                    faultinject.install(FaultInjector(
+                        {faultinject.SITE_SPECULATION:
+                         {0: faultinject.RAISE}}))
+                    _submit_waves(env, 1, start_wave=wave)
+                    wave += 1
+                    env.cycle()
+                    env.clock.advance(1.0)
+                    faultinject.uninstall()
+                    assert not s._inflight_q  # both aborted, none left
+                while wave < 8:  # both runs see the same total load
+                    _submit_waves(env, 1, start_wave=wave)
+                    wave += 1
+                    env.cycle()
+                    env.clock.advance(1.0)
+                _run_to_settled(env, None)
+            finally:
+                faultinject.uninstall()
+            results[chaotic] = env
+        oracle, chaos = results[False], results[True]
+        s = chaos.scheduler
+        assert s.speculation_abort_reasons.get("injected", 0) >= 1
+        # the younger in-flight cycle aborted as collateral of the
+        # older one's mis-speculation — the depth-2 abort-both contract
+        assert s.speculation_abort_reasons.get("chained", 0) >= 1
+        assert not s._inflight_q  # nothing stranded in flight
+        assert set(admitted_map(chaos)) == set(admitted_map(oracle))
+        reserved: dict = {}
+        for key, reason in chaos.client.events:
+            if reason == "QuotaReserved":
+                reserved[key] = reserved.get(key, 0) + 1
+        assert all(c == 1 for c in reserved.values())
+        assert s.breaker.trips == 0 and s.solver_faults == 0
+        _assert_host_state_clean(chaos)
+
+
 @pytest.mark.slow
 class TestChaosSweep:
     @pytest.mark.parametrize("seed", [7, 99, 4242])

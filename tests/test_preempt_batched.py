@@ -485,8 +485,11 @@ class TestWarmPreemptLadder:
 
         for fn in ("solve_cycle_with_preempt", "solve_cycle_resident",
                    "solve_cycle_resident_arena"):
+            # both wire formats: the warm helper blocks on "dec_bits"
+            # for compact-capable topologies, "admitted" otherwise
             monkeypatch.setattr(service, fn,
-                                lambda *a, **k: {"admitted": _Done()})
+                                lambda *a, **k: {"admitted": _Done(),
+                                                 "dec_bits": _Done()})
         keys = []
         monkeypatch.setattr(service, "note_program",
                             lambda key: keys.append(key) or True)
@@ -498,7 +501,7 @@ class TestWarmPreemptLadder:
                                fair_sharing=True, fs_flags=flags)
         sync = [k for k in keys if k[0] == "preempt"]
         # key layout: ("preempt", dims, W, P, max_rank, fair_sharing,
-        #              sr, pshapes, fshapes, flags)
+        #              sr, pshapes, fshapes, flags, compact)
         minimal_only = [k for k in sync if k[7] and not k[8]]
         fair_only = [k for k in sync if not k[7] and k[8]]
         mixed = [k for k in sync if k[7] and k[8]]
@@ -512,11 +515,12 @@ class TestWarmPreemptLadder:
             # heterogeneous pairing: within-CQ minimal (QL bucket 1)
             # with a cohort-wide fair batch (QL bucket > 1)
             assert k[7][0][1] == 1 and k[8][0][1] > 1
-        # resident/arena variants mirror the same families
+        # resident/arena variants mirror the same families (key tail:
+        # ..., pshapes, fshapes, flags, compact)
         res = [k for k in keys if k[0] in ("resident", "arena")]
-        assert any(k[-3] and not k[-2] for k in res)
-        assert any(not k[-3] and k[-2] for k in res)
-        assert any(k[-3] and k[-2] for k in res)
+        assert any(k[-4] and not k[-3] for k in res)
+        assert any(not k[-4] and k[-3] for k in res)
+        assert any(k[-4] and k[-3] for k in res)
 
 
 class TestTenantStormRouteCoverage:

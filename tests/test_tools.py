@@ -255,6 +255,35 @@ class TestVisibilityHTTP:
         assert mod.main([str(f)]) == 0
 
 
+class TestTransportProbe:
+    def test_probe_smoke_one_round_trip_per_cycle(self, capsys):
+        """Tier-1 smoke for tools/transport_probe.py (chaos_run CLI
+        contract): a tiny run must render the per-cycle transport
+        table, report a parseable verdict, and find zero round-trip
+        violations — the steady-state one-dispatch/one-collect
+        contract."""
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "transport_probe",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "transport_probe.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main(["3", "4"]) == 0
+        captured = capsys.readouterr()
+        assert "fetch_B" in captured.err      # the operator table
+        verdict = json.loads(captured.out.strip().splitlines()[-1])
+        assert verdict["ok"] is True
+        assert verdict["round_trip_violations"] == []
+        assert verdict["dispatch_collect_balanced"] is True
+        assert verdict["device_cycles"] >= 1
+        assert verdict["fetch_bytes_per_cycle_p50"] is not None
+        # decision-sized: the steady-state fetch is tens of bytes at
+        # this shape, nowhere near the dense [W,...] tensors
+        assert verdict["fetch_bytes_per_cycle_p50"] < 1000
+
+
 class TestDumper:
     def test_dump_contains_state(self, mgr):
         submit_n(mgr, 2)

@@ -1,0 +1,340 @@
+"""Transport tests for the fully device-resident admission cycle
+(ISSUE 11): decision-only fetch bit-identity against the staged dense
+path, one-dispatch/one-collect round-trip accounting (preempt-needing
+cycles included), the >5x packed-vs-dense fetch ratio, donated arena
+uploads, dispatch depth 2, and the per-trace transport fields."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.solver import BatchSolver
+from tests.test_solver import admitted_map, build_env
+from tests.wrappers import (ClusterQueueWrapper, WorkloadWrapper,
+                            flavor_quotas)
+
+
+# --- kernel-level pack/unpack bit-identity ---------------------------------
+
+class TestDecisionPacking:
+    def _solve(self, seed, compact):
+        import jax.numpy as jnp
+        from kueue_tpu.solver.kernel import solve_cycle_fused
+        from kueue_tpu.solver.synth import synth_solver_inputs
+        topo, usage, cu, wl = synth_solver_inputs(
+            num_cqs=16, num_cohorts=4, num_flavors=5, num_resources=2,
+            num_workloads=32, num_podsets=2, seed=seed)
+        td = {k: jnp.asarray(v) for k, v in topo.items()}
+        return solve_cycle_fused(
+            td, usage, cu, wl["requests"], wl["podset_active"],
+            wl["wl_cq"], wl["priority"], wl["timestamp"], wl["eligible"],
+            wl["solvable"], num_podsets=2, max_rank=32, compact=compact)
+
+    @pytest.mark.parametrize("seed", [0, 7, 23])
+    def test_roundtrip_bit_identity(self, seed):
+        from kueue_tpu.solver.service import unpack_decisions
+        dense = self._solve(seed, compact=False)
+        packed = self._solve(seed, compact=True)
+        assert "admitted" not in packed and "dec_pr" in packed
+        got = unpack_decisions(
+            {k: np.asarray(v) for k, v in packed.items()
+             if k in ("dec_pr", "dec_bits")}, 2, 2)
+        for key in ("fit", "admitted", "borrows", "chosen",
+                    "chosen_borrow"):
+            assert np.array_equal(got[key], np.asarray(dense[key])), key
+        # residency chain untouched by packing
+        assert np.array_equal(np.asarray(packed["usage"]),
+                              np.asarray(dense["usage"]))
+
+    def test_wire_format_beats_dense_by_5x(self, seed=3):
+        dense = self._solve(seed, compact=False)
+        packed = self._solve(seed, compact=True)
+        dense_bytes = sum(
+            np.asarray(dense[k]).nbytes
+            for k in ("fit", "admitted", "borrows", "chosen",
+                      "chosen_borrow"))
+        packed_bytes = (np.asarray(packed["dec_pr"]).nbytes
+                        + np.asarray(packed["dec_bits"]).nbytes)
+        assert dense_bytes > 5 * packed_bytes, (dense_bytes, packed_bytes)
+
+
+# --- end-to-end: compact path vs the staged dense oracle -------------------
+
+def _mixed_setup(preemption=True):
+    def setup(env):
+        env.add_flavor("default")
+        kwargs = dict(within_cluster_queue=api.PREEMPTION_LOWER_PRIORITY,
+                      reclaim_within_cohort=api.PREEMPTION_ANY)
+        for i in range(4):
+            cq = ClusterQueueWrapper(f"cq{i}").cohort("co")
+            if preemption:
+                cq = cq.preemption(**kwargs)
+            env.add_cq(cq.resource_group(
+                flavor_quotas("default", cpu="8")).obj(), f"lq-cq{i}")
+    return setup
+
+
+def _run_stream(compact, seed, fair_sharing=False, cycles=10):
+    """Randomized multi-wave stream with victims occupying quota so
+    preempt-needing cycles occur; compact=False forces the staged dense
+    fetch (the oracle)."""
+    env = build_env(_mixed_setup(), solver=True, fair_sharing=fair_sharing)
+    if not compact:
+        env.scheduler.solver.compact_fetch = False
+    rng = random.Random(seed)
+    n = 0
+    for i in range(4):
+        env.admit_existing(
+            WorkloadWrapper(f"victim{i}").queue(f"lq-cq{i}")
+            .priority(0).pod_set(count=1, cpu="6")
+            .reserve(f"cq{i}").obj())
+    for wave in range(4):
+        for i in range(4):
+            env.submit(WorkloadWrapper(f"w{wave}-{i}")
+                       .queue(f"lq-cq{i}")
+                       .priority(rng.randrange(0, 10))
+                       .creation(float(n))
+                       .pod_set(count=1, cpu=str(rng.choice([2, 4, 6])))
+                       .obj())
+            n += 1
+    for _ in range(cycles):
+        env.cycle()
+        env.clock.advance(1.0)
+    return env
+
+
+class TestCompactVsStagedDifferential:
+    """The fused compact path must be bit-identical to the staged dense
+    path: same admitted set, same flavor assignments, same preempt
+    targets (evictions), including preempt-needing and fair-sharing
+    cycles."""
+
+    @pytest.mark.parametrize("seed", [1, 5, 11])
+    def test_preempt_stream_matches_dense_oracle(self, seed):
+        dense = _run_stream(compact=False, seed=seed)
+        packed = _run_stream(compact=True, seed=seed)
+        assert dense.scheduler.solver.counters["collects"] > 0
+        assert admitted_map(dense) == admitted_map(packed)
+        assert set(dense.client.evicted) == set(packed.client.evicted)
+        for i in range(4):
+            assert dense.usage(f"cq{i}") == packed.usage(f"cq{i}")
+
+    def test_fair_sharing_stream_matches_dense_oracle(self):
+        dense = _run_stream(compact=False, seed=2, fair_sharing=True)
+        packed = _run_stream(compact=True, seed=2, fair_sharing=True)
+        assert admitted_map(dense) == admitted_map(packed)
+        assert set(dense.client.evicted) == set(packed.client.evicted)
+
+
+# --- round-trip accounting -------------------------------------------------
+
+class TestSingleRoundTripPerCycle:
+    def test_preempt_needing_sync_cycle_is_one_dispatch_one_collect(self):
+        """The acceptance contract: a steady-state single-chip cycle —
+        including one that needs preemption planning — issues exactly
+        ONE dispatch and ONE collect (the fused program ships fit +
+        preempt target selection in one execute)."""
+        env = build_env(_mixed_setup(), solver=True)
+        for i in range(4):
+            env.admit_existing(
+                WorkloadWrapper(f"victim{i}").queue(f"lq-cq{i}")
+                .priority(0).pod_set(count=1, cpu="8")
+                .reserve(f"cq{i}").obj())
+            env.submit(WorkloadWrapper(f"preemptor{i}")
+                       .queue(f"lq-cq{i}").priority(10)
+                       .creation(float(i)).pod_set(count=1, cpu="8")
+                       .obj())
+        c = env.scheduler.solver.counters
+        d0, c0 = c["dispatches"], c["collects"]
+        env.cycle()  # preempt-needing cycle: fit + targets, one execute
+        assert c["dispatches"] == d0 + 1
+        assert c["collects"] == c0 + 1
+        assert len(env.client.evicted) == 4  # targets decoded + issued
+
+    def test_fit_cycle_is_one_dispatch_one_collect(self):
+        env = build_env(_mixed_setup(preemption=False), solver=True)
+        for i in range(4):
+            env.submit(WorkloadWrapper(f"w{i}").queue(f"lq-cq{i}")
+                       .pod_set(count=1, cpu="2").obj())
+        c = env.scheduler.solver.counters
+        d0, c0 = c["dispatches"], c["collects"]
+        env.cycle()
+        assert c["dispatches"] == d0 + 1
+        assert c["collects"] == c0 + 1
+
+
+class TestTraceTransportFields:
+    def test_traces_carry_bytes_and_round_trips(self):
+        env = build_env(_mixed_setup(preemption=False), solver=True)
+        for i in range(4):
+            env.submit(WorkloadWrapper(f"w{i}").queue(f"lq-cq{i}")
+                       .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        tr = env.scheduler.recorder.last()
+        assert tr is not None
+        assert tr.dispatches == 1 and tr.collects == 1
+        assert tr.upload_bytes > 0 and tr.fetch_bytes > 0
+        d = tr.to_dict()
+        for key in ("upload_bytes", "fetch_bytes", "dispatches",
+                    "collects"):
+            assert key in d
+        # the solver's per-cycle numbers reconcile with the trace
+        s = env.scheduler.solver
+        assert tr.fetch_bytes == s.last_fetch_bytes
+        assert tr.upload_bytes == s.last_upload_bytes
+
+    def test_fetch_is_5x_under_dense_equivalent(self):
+        env = build_env(_mixed_setup(preemption=False), solver=True)
+        for i in range(4):
+            env.submit(WorkloadWrapper(f"w{i}").queue(f"lq-cq{i}")
+                       .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        s = env.scheduler.solver
+        topo = s._topo_cache[0]
+        from kueue_tpu.solver import encode
+        from kueue_tpu.solver.kernel import dense_decision_nbytes
+        W = encode._bucket(4)
+        P, R = s.max_podsets, topo.nominal.shape[2]
+        dense = dense_decision_nbytes(W, P, R)
+        assert dense > 5 * s.last_fetch_bytes, (dense, s.last_fetch_bytes)
+
+
+# --- donated arena uploads -------------------------------------------------
+
+class TestDonatedArenaUpload:
+    def test_donated_scatter_keeps_twin_bit_identical(self):
+        """prepare_device's donated scatter must leave the device twin
+        bit-identical to the host arrays across repeated dirty-row
+        uploads (the double-buffer aliases in place; a stale or
+        corrupted generation would diverge here)."""
+        from kueue_tpu.solver.arena import ARENA_FIELDS
+        env = build_env(_mixed_setup(preemption=False), solver=True)
+        for i in range(4):
+            env.submit(WorkloadWrapper(f"w{i}").queue(f"lq-cq{i}")
+                       .pod_set(count=1, cpu="2").obj())
+        env.cycle()  # establishes the twin (full upload)
+        arena = env.scheduler.solver._arena
+        assert arena.dev is not None
+        # churn: fresh workloads dirty new rows -> donated scatter
+        for wave in range(1, 3):
+            for i in range(4):
+                env.submit(WorkloadWrapper(f"c{wave}-{i}")
+                           .queue(f"lq-cq{i}")
+                           .pod_set(count=1, cpu="2").obj())
+            env.cycle()
+        assert arena.row_uploads > 0  # the scatter path actually ran
+        for name in ARENA_FIELDS:
+            assert np.array_equal(np.asarray(arena.dev[name]),
+                                  getattr(arena, name)), name
+        # satellite: the perf artifact's phase breakdown carries the
+        # scatter sub-span in lockstep with the flight recorder's
+        # span tree (dotted key nested under dispatch)
+        s = env.scheduler.solver
+        span_total = sum(
+            d for t in env.scheduler.recorder.traces()
+            for n, _s, d in t.spans if n == "dispatch.scatter")
+        assert span_total > 0
+        assert span_total == pytest.approx(s.phase_s["dispatch.scatter"],
+                                           rel=1e-9)
+        assert s.phase_s["dispatch.scatter"] <= s.phase_s["dispatch"]
+
+
+# --- dispatch depth 2 ------------------------------------------------------
+
+class TestDispatchDepthTwo:
+    def _run(self, waves, depth, cpu="2"):
+        def setup(env):
+            env.add_flavor("default")
+            for i in range(4):
+                env.add_cq(
+                    ClusterQueueWrapper(f"cq{i}").cohort("co")
+                    .resource_group(flavor_quotas("default", cpu="8"))
+                    .obj(), f"lq-cq{i}")
+        env = build_env(setup, solver=depth > 0)
+        if depth:
+            env.scheduler.pipeline_enabled = True
+            env.scheduler.pipeline_depth = depth
+        n = 0
+        for wave in range(waves):
+            for i in range(4):
+                env.submit(WorkloadWrapper(f"w{wave}-{i}")
+                           .queue(f"lq-cq{i}").priority(n % 3)
+                           .creation(float(n)).pod_set(count=1, cpu=cpu)
+                           .obj())
+                n += 1
+        for _ in range(waves + 6):
+            env.cycle()
+        return env
+
+    def test_depth2_matches_cpu(self):
+        cpu = self._run(waves=4, depth=0)
+        deep = self._run(waves=4, depth=2)
+        assert admitted_map(cpu) == admitted_map(deep)
+        for i in range(4):
+            assert cpu.usage(f"cq{i}") == deep.usage(f"cq{i}")
+        assert not deep.scheduler._inflight_q  # fully drained
+
+    def test_depth2_contention_set_matches_cpu(self):
+        cpu = self._run(waves=5, depth=0, cpu="3")
+        deep = self._run(waves=5, depth=2, cpu="3")
+        assert set(admitted_map(cpu)) == set(admitted_map(deep))
+        for i in range(4):
+            assert cpu.usage(f"cq{i}") == deep.usage(f"cq{i}")
+
+    def test_depth2_keeps_two_cycles_in_flight(self):
+        env = self._run(waves=8, depth=2)
+        # the pipeline deepened to two outstanding dispatches at least
+        # once: two dispatch-only fills before the first collect
+        assert env.scheduler.cycle_counts.get(
+            "device-dispatch-only", 0) >= 2
+        assert env.scheduler.cycle_counts.get("device-pipelined", 0) >= 1
+
+    def test_tokenless_dispatch_collapses_depth(self):
+        env = self._run(waves=4, depth=2)
+        s = env.scheduler
+        # a token-less in-flight entry forces effective depth 1: after
+        # one more schedule() the queue must not exceed one entry
+        from kueue_tpu.scheduler import stages
+        for ic in s._inflight_q:
+            ic.token = None
+        for i in range(4):
+            env.submit(WorkloadWrapper(f"late{i}").queue(f"lq-cq{i}")
+                       .pod_set(count=1, cpu="2").obj())
+        env.cycle()
+        assert len(s._inflight_q) <= 1
+
+
+# --- warm ladder key agreement (mirrors the PR-9 pin) ----------------------
+
+class TestWarmFusedKeyAgreement:
+    def test_warmed_pipelined_dispatch_counts_no_mid_traffic_compiles(self):
+        """Warm->fused-dispatch pin for the compact decision-output
+        programs: a real governor warm followed by real PIPELINED
+        (depth-2) device dispatches must find every variant key already
+        registered — mid_traffic_compiles stays 0 (the ladder warms the
+        packed-output programs, not their dense twins)."""
+        from kueue_tpu.solver.warmgov import GOV_WARM, CompileGovernor
+        from tests.test_warmgov import simple_env
+        env = simple_env()
+        solver = BatchSolver()
+        env.scheduler.solver = solver
+        env.scheduler.solver_min_heads = 0
+        env.scheduler.pipeline_enabled = True
+        env.scheduler.pipeline_depth = 2
+        solver.bind_cache(env.cache)
+        solver.bind_queues(env.scheduler.queues)
+        gov = CompileGovernor(solver, env.cache, warm_preempt=False)
+        assert gov.run_sync() > 0
+        assert gov.state == GOV_WARM
+        env.scheduler.warm_gov = gov
+        for i in range(4):
+            env.submit(WorkloadWrapper(f"w{i}").queue("lq0")
+                       .creation(float(i)).pod_set(count=1, cpu="1")
+                       .obj())
+        for _ in range(8):
+            env.cycle()
+        assert "default/w0" in env.client.applied
+        assert env.scheduler.cycle_counts.get("device-pipelined", 0) >= 1
+        assert solver.counters["mid_traffic_compiles"] == 0

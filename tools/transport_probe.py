@@ -1,0 +1,191 @@
+"""Transport probe: per-cycle host<->device byte and round-trip table.
+
+Operator tooling for the fully device-resident admission cycle
+(ISSUE 11): drives the FULL control plane (KueueManager: sim store,
+controllers, scheduler + solver in the production pipelined config)
+through a few waves of traffic, then prints one row per recorded
+scheduler cycle from the flight recorder's transport fields —
+
+    cycle  route              heads  adm  disp  coll  upload_B  fetch_B
+
+— plus a steady-state summary (device-cycle round-trip counts and
+bytes-per-cycle percentiles). The steady-state contract this makes
+visible: exactly ONE dispatch and ONE collect per device cycle
+(preempt-needing cycles included) and a decision-sized fetch; any
+cycle violating it stands out as its own row.
+
+Same CLI contract as tools/chaos_run.py: prints one JSON line per
+section to stderr, a final parseable JSON verdict line to stdout, and
+exits non-zero when the probe itself detects a transport violation —
+a device cycle issuing more than one dispatch, or a lifetime
+dispatch/collect imbalance (every dispatch must be collected exactly
+once; a single drain trace may legitimately collect several
+previously-dispatched cycles at depth 2).
+
+Usage: python tools/transport_probe.py [waves] [cqs] [--json]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kueue_tpu import config as cfgpkg  # noqa: E402
+from kueue_tpu.api import kueue as api  # noqa: E402
+from kueue_tpu.api.corev1 import (  # noqa: E402
+    Container, PodSpec, PodTemplateSpec)
+from kueue_tpu.api.meta import FakeClock, LabelSelector, ObjectMeta  # noqa: E402
+from kueue_tpu.core import workload as wlpkg  # noqa: E402
+from kueue_tpu.manager import KueueManager  # noqa: E402
+from kueue_tpu.solver import BatchSolver  # noqa: E402
+
+DEFAULT_WAVES = 6
+DEFAULT_CQS = 8
+MAX_CYCLES = 64
+
+
+def make_objects(num_cqs: int):
+    rf = api.ResourceFlavor(metadata=ObjectMeta(name="f0", uid="rf-f0"))
+    out = [rf]
+    for i in range(num_cqs):
+        cq = api.ClusterQueue(metadata=ObjectMeta(name=f"cq{i}",
+                                                  uid=f"cq-{i}"))
+        cq.spec.namespace_selector = LabelSelector()
+        cq.spec.cohort = f"cohort-{i % 2}"
+        cq.spec.resource_groups.append(api.ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[api.FlavorQuotas(name="f0", resources=[
+                api.ResourceQuota(name="cpu", nominal_quota=8000)])]))
+        lq = api.LocalQueue(metadata=ObjectMeta(
+            name=f"lq{i}", namespace="default", uid=f"lq-{i}"))
+        lq.spec.cluster_queue = f"cq{i}"
+        out += [cq, lq]
+    return out
+
+
+def make_workload(wave: int, i: int, n: int):
+    wl = api.Workload(metadata=ObjectMeta(
+        name=f"w{wave}-{i}", namespace="default", uid=f"wl-{wave}-{i}",
+        creation_timestamp=float(n)))
+    wl.spec.queue_name = f"lq{i}"
+    wl.spec.pod_sets.append(api.PodSet(
+        name="main", count=1, template=PodTemplateSpec(spec=PodSpec(
+            containers=[Container(name="c", requests={"cpu": 2000})]))))
+    return wl
+
+
+def probe(waves: int = DEFAULT_WAVES, num_cqs: int = DEFAULT_CQS) -> dict:
+    cfg = cfgpkg.Configuration()
+    cfg.solver.enable = True
+    cfg.solver.min_heads = 0
+    clock = FakeClock(1000.0)
+    mgr = KueueManager(cfg=cfg, clock=clock, solver=BatchSolver())
+    for obj in make_objects(num_cqs):
+        mgr.store.create(obj)
+    mgr.run_until_idle(max_iterations=1_000_000)
+    def admitted_count():
+        return sum(1 for wl in mgr.store.list("Workload")
+                   if wlpkg.has_quota_reservation(wl))
+
+    n = 0
+    idle = 0
+    for cycle in range(MAX_CYCLES):
+        if cycle < waves:
+            for i in range(num_cqs):
+                mgr.store.create(make_workload(cycle, i, n))
+                n += 1
+            mgr.run_until_idle(max_iterations=1_000_000)
+        before = admitted_count()
+        mgr.scheduler.schedule(timeout=0)
+        mgr.run_until_idle(max_iterations=1_000_000)
+        clock.advance(1.0)
+        busy = (cycle < waves
+                or mgr.scheduler._inflight is not None
+                or admitted_count() > before)
+        idle = 0 if busy else idle + 1
+        if idle >= 3:
+            break
+
+    traces = [t.to_dict() for t in mgr.scheduler.recorder.traces()]
+    device = [t for t in traces
+              if t["route"].startswith("device") and t["collects"]]
+    fetches = sorted(t["fetch_bytes"] / t["collects"] for t in device)
+    uploads = sorted(t["upload_bytes"] / max(t["dispatches"], 1)
+                     for t in device)
+
+    def pct(sorted_vals, q):
+        if not sorted_vals:
+            return None
+        return sorted_vals[min(len(sorted_vals) - 1,
+                               int(q * len(sorted_vals)))]
+
+    # The steady-state contract: at most ONE dispatch per cycle, and
+    # every dispatch collected exactly once overall. A single trace may
+    # legitimately collect MORE than one previously-dispatched cycle
+    # (a depth-2 drain, or a mixed preempt cycle's pre-drain) — those
+    # collects belong to earlier dispatches, so the 1:1 balance is a
+    # lifetime-counter invariant, not a per-trace one.
+    counters = dict(mgr.scheduler.solver.counters)
+    violations = [t for t in device if t["dispatches"] > 1]
+    balanced = (counters.get("dispatches", 0)
+                == counters.get("collects", 0))
+    report = {
+        "waves": waves,
+        "cqs": num_cqs,
+        "cycles_recorded": len(traces),
+        "device_cycles": len(device),
+        "round_trip_violations": [t["cycle"] for t in violations],
+        "dispatch_collect_balanced": balanced,
+        "fetch_bytes_per_cycle_p50": pct(fetches, 0.5),
+        "fetch_bytes_per_cycle_p99": pct(fetches, 0.99),
+        "upload_bytes_per_cycle_p50": pct(uploads, 0.5),
+        "upload_bytes_per_cycle_p99": pct(uploads, 0.99),
+        "lifetime": {k: counters.get(k, 0) for k in (
+            "dispatches", "collects", "upload_bytes", "fetch_bytes",
+            "establishes", "mid_traffic_compiles")},
+        "traces": traces,
+    }
+    mgr.scheduler.stop()
+    return report
+
+
+def render_table(report: dict) -> str:
+    head = (f"{'cycle':>6} {'route':<22} {'heads':>5} {'adm':>4} "
+            f"{'disp':>4} {'coll':>4} {'upload_B':>9} {'fetch_B':>8}")
+    lines = [head, "-" * len(head)]
+    for t in report["traces"]:
+        lines.append(
+            f"{t['cycle']:>6} {t['route']:<22} {t['heads']:>5} "
+            f"{t['admitted'] if t['admitted'] is not None else '-':>4} "
+            f"{t['dispatches']:>4} {t['collects']:>4} "
+            f"{t['upload_bytes']:>9} {t['fetch_bytes']:>8}")
+    lines.append("-" * len(head))
+    lines.append(
+        f"device cycles: {report['device_cycles']}  "
+        f"fetch/cycle p50: {report['fetch_bytes_per_cycle_p50']}  "
+        f"upload/cycle p50: {report['upload_bytes_per_cycle_p50']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    waves = int(argv[0]) if len(argv) > 0 else DEFAULT_WAVES
+    num_cqs = int(argv[1]) if len(argv) > 1 else DEFAULT_CQS
+    report = probe(waves, num_cqs)
+    if as_json:
+        print(json.dumps(report), file=sys.stderr, flush=True)
+    else:
+        print(render_table(report), file=sys.stderr, flush=True)
+    verdict = {k: v for k, v in report.items() if k != "traces"}
+    verdict["ok"] = (not report["round_trip_violations"]
+                     and report["dispatch_collect_balanced"])
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

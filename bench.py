@@ -1149,6 +1149,251 @@ def bench_transport_bytes():
     log(row)
 
 
+def bench_visibility_storm(pending_waves=25, timed_cycles=10,
+                           reader_threads=4, target_qps=240):
+    """Snapshot-backed query plane under the north-star admission storm
+    (ISSUE 12): 2048 CQs x 32 flavors with ~50k pending workloads, the
+    identical storm run twice — no readers (baseline) vs the query
+    plane attached with reader threads sustaining a bounded read QPS
+    against sealed views while the admission cycles run.
+
+    Gates (the read plane must be FREE for the write plane):
+    - HARD: the seal-side publish cost (the only query-plane work on
+      the admission cycle's critical path) <= 1% of the baseline cycle
+      p50 — microbenched like trace_overhead, so the gate is
+      deterministic;
+    - HARD: every sampled response carried a generation token whose lag
+      vs the live cache never exceeded ONE structural generation (a
+      mid-run quota edit makes the gate non-vacuous), and zero snapshot
+      handouts leak after the plane closes;
+    - in-process rangespec (backend-stamped per the honesty policy):
+      measured concurrent p50/p99 admission-cycle overhead <= 1% vs
+      the no-readers baseline. Wall-clock A/B on a shared box is
+      noise-bound, so a run whose baseline halves drift >3% REFUSES
+      the comparison into the witness-debt manifest instead of
+      reporting a regression (or a pass) that is really scheduler
+      jitter.
+
+    Read capacity (storm QPS) is measured separately with the
+    admission loop idle: spinning readers against the last sealed
+    view's cached tables — the plane's saturation ceiling, GIL-shared
+    with nothing."""
+    import threading
+
+    from kueue_tpu.obs.queryplane import QueryPlane
+    from kueue_tpu.perf.checker import record_refusal
+
+    flavors = [f"f{i}" for i in range(NUM_FLAVORS)]
+
+    def run_storm(attach_plane):
+        # Stationary storm: small workloads against deep quota, so every
+        # timed cycle admits a full 2048-head wave off a backlog that
+        # stays tens-of-thousands deep — cycle times are comparable
+        # across the run (the progressive-fill shape's depth ramp would
+        # swamp a 1% A/B bound in systematic drift).
+        sched, cache, queues, client, clock = build_env(
+            NUM_CQS, NUM_COHORTS, flavors, nominal_units=4000)
+        plane = None
+        if attach_plane:
+            plane = QueryPlane(cache, queues)
+            sched.query_plane = plane
+        n = 0
+        for wave in range(pending_waves):
+            for i in range(NUM_CQS):
+                wl = make_workload(f"w{wave}-{i}", f"lq{i}", cpu_units=2,
+                                   priority=n % 5, creation=float(n))
+                queues.add_or_update_workload(wl)
+                n += 1
+        def run_cycle():
+            # Steady state: last cycle's admissions complete (the
+            # bench_fair_sharing idiom) so the cache's workload maps —
+            # and with them the per-cycle snapshot replay cost — stay
+            # stationary; without completions every cycle is slower
+            # than the last and an A/B p50 comparison drowns in drift.
+            for wl in client.drain_applied():
+                cache.delete_workload(wl)
+                queues.queue_associated_inadmissible_workloads_after(wl)
+            sched.schedule(timeout=0)
+            clock.advance(1.0)
+
+        for _ in range(2):  # warmup cycles (cold caches / first snapshot)
+            run_cycle()
+
+        stop = threading.Event()
+        per_thread = [[] for _ in range(reader_threads)]
+        warming = [0]
+
+        # Readers poll a HOT set of queues (a storm is many users
+        # watching few queues): the first read of a CQ per sealed view
+        # pays its table build on the READER thread, every later read
+        # hits the cached immutable table — the amortization the plane
+        # exists for. Cold-CQ cost shows up in tables_built and the
+        # idle-capacity section instead.
+        hot_cqs = 64
+
+        def reader(idx):
+            samples = per_thread[idx]
+            period = reader_threads / float(target_qps)
+            next_t = time.perf_counter() + idx * period / reader_threads
+            k = idx
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                view = plane.acquire()
+                if view is None:
+                    warming[0] += 1
+                else:
+                    try:
+                        plane.pending_cq(view, f"cq{k % hot_cqs}", 20, 0)
+                        lag = cache.generation_lag(view.generation)
+                        samples.append((time.perf_counter() - t0, lag))
+                    finally:
+                        plane.release(view)
+                k += reader_threads
+                next_t += period
+                delay = next_t - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+
+        threads = []
+        if attach_plane:
+            threads = [threading.Thread(target=reader, args=(i,),
+                                        daemon=True)
+                       for i in range(reader_threads)]
+            for t in threads:
+                t.start()
+        import gc
+        times = []
+        t_run0 = time.perf_counter()
+        for c in range(timed_cycles):
+            if c == timed_cycles // 2:
+                # One structural edit mid-storm (same schedule both
+                # runs): the generation token moves, so the staleness
+                # gate exercises a real lag window.
+                cache.update_cluster_queue(
+                    make_cq("cq0", "cohort-0", flavors,
+                            nominal_units=4001))
+            gc.collect()  # a prior cycle's garbage stays out of this one
+            t0 = time.perf_counter()
+            run_cycle()
+            times.append(time.perf_counter() - t0)
+        run_wall = time.perf_counter() - t_run0
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        reads = [s for lst in per_thread for s in lst]
+        return sched, cache, plane, times, run_wall, reads, warming[0]
+
+    _sched_b, _cache_b, _, base_times, _, _, _ = run_storm(False)
+    sched, cache, plane, read_times, run_wall, reads, warming = \
+        run_storm(True)
+
+    base_p50, base_p99 = p50(base_times), p99(base_times)
+    with_p50, with_p99 = p50(read_times), p99(read_times)
+    overhead_p50 = with_p50 / base_p50 - 1.0
+    overhead_p99 = with_p99 / base_p99 - 1.0
+
+    # HARD staleness/consistency gates (backend-independent).
+    assert reads, "reader storm recorded no samples"
+    lat = sorted(s[0] for s in reads)
+    max_lag = max(s[1] for s in reads)
+    assert max_lag <= 1, (
+        f"read staleness {max_lag} structural generations — a sealed "
+        f"view may lag only between an edit and the next cycle seal")
+
+    # HARD seal-side cost gate (the admission cycle's share of the
+    # query plane): one publish per cycle, microbenched.
+    order = [f"default/w0-{i}" for i in range(HEADS)]
+    t0 = time.perf_counter()
+    n_pub = 50
+    for i in range(n_pub):
+        plane.publish(10_000 + i, "bench", order, snapshot=None)
+    per_publish_s = (time.perf_counter() - t0) / n_pub
+    publish_pct = 100.0 * per_publish_s / max(base_p50, 1e-9)
+    assert publish_pct <= 1.0, (publish_pct, base_p50)
+
+    # Read capacity with the admission loop idle: the plane's ceiling
+    # against CACHED tables (the hot set the storm readers polled —
+    # same amortization; cold-table cost is the storm's tables_built
+    # counter, snapshotted BEFORE this loop so the row reports the
+    # storm's builds, not the bench's own probing).
+    storm_tables_built = plane.tables_built
+    cap_lat = []
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 0.5:
+        r0 = time.perf_counter()
+        view = plane.acquire()
+        try:
+            plane.pending_cq(view, f"cq{len(cap_lat) % 64}", 20, 0)
+        finally:
+            plane.release(view)
+        cap_lat.append(time.perf_counter() - r0)
+    capacity_qps = len(cap_lat) / 0.5
+
+    # Handout hygiene: the plane held the last cycle's snapshot; close
+    # must return every handout (the live_handouts leak contract).
+    plane.close()
+    assert cache.live_handouts == 0, cache.live_handouts
+
+    row = {"bench": "visibility_storm",
+           "pending": pending_waves * NUM_CQS, "cqs": NUM_CQS,
+           "timed_cycles": timed_cycles,
+           "base_cycle_p50_ms": round(base_p50 * 1e3, 1),
+           "base_cycle_p99_ms": round(base_p99 * 1e3, 1),
+           "readers_cycle_p50_ms": round(with_p50 * 1e3, 1),
+           "readers_cycle_p99_ms": round(with_p99 * 1e3, 1),
+           "overhead_p50_pct": round(overhead_p50 * 100, 2),
+           "overhead_p99_pct": round(overhead_p99 * 100, 2),
+           "sustained_read_qps": round(len(reads) / run_wall, 1),
+           "read_latency_p50_us": round(p50(lat) * 1e6, 1),
+           "read_latency_p99_us": round(p99(lat) * 1e6, 1),
+           "read_capacity_qps_idle": round(capacity_qps, 1),
+           "capacity_read_p99_us": round(p99(cap_lat) * 1e6, 1),
+           "reads": len(reads), "warming_reads": warming,
+           "max_token_lag": max_lag,
+           "publish_per_cycle_us": round(per_publish_s * 1e6, 1),
+           "publish_overhead_pct": round(publish_pct, 4),
+           "tables_built": storm_tables_built,
+           "rangespec": {"backend": "cpu", "max_overhead_pct": 1.0}}
+
+    # The wall A/B overhead gate: honesty first. The bound was
+    # calibrated on a quiet cpu-backend box; a cross-backend run or a
+    # noise-bound baseline refuses instead of judging.
+    halves_drift = abs(p50(base_times[:timed_cycles // 2])
+                       - p50(base_times[timed_cycles // 2:])) / base_p50
+    row["baseline_half_drift_pct"] = round(halves_drift * 100, 2)
+    refusal = None
+    if BACKEND.get("backend") not in ("cpu", "unknown"):
+        refusal = (f"overhead bound calibrated on cpu; run on "
+                   f"{BACKEND.get('backend')}")
+    elif halves_drift > 0.03:
+        refusal = (f"baseline cycle p50 drifted {halves_drift * 100:.1f}% "
+                   f"between run halves — the box is too noisy to "
+                   f"resolve a 1% overhead bound")
+    if refusal is not None:
+        row["rangespec_ok"] = None
+        row["rangespec_refused"] = refusal
+        record_refusal("bench.visibility_storm", "cycle_overhead",
+                       refusal, "cpu")
+        log(row)
+        return row
+    violations = []
+    if overhead_p50 > 0.01:
+        violations.append(
+            f"admission-cycle p50 overhead {overhead_p50 * 100:.2f}% "
+            f"with readers attached exceeds 1%")
+    if overhead_p99 > 0.01:
+        violations.append(
+            f"admission-cycle p99 overhead {overhead_p99 * 100:.2f}% "
+            f"with readers attached exceeds 1%")
+    row["rangespec_ok"] = not violations
+    if violations:
+        row["rangespec_violation"] = "; ".join(violations)
+        log(row)
+        raise AssertionError(row["rangespec_violation"])
+    log(row)
+    return row
+
+
 def bench_e2e_shallow(cycles=5):
     """The old light scenario: small workloads, first flavor always fits
     (the sequential assigner's best case — kept for honesty; the solver
@@ -1942,6 +2187,7 @@ def main():
     bench_trace_overhead()
     bench_overload_shed()
     bench_scenario_slo()
+    bench_visibility_storm()
     bench_cold_start()
     bench_restart_recovery()
     hit_rate = bench_speculative_pipeline()

@@ -228,6 +228,17 @@ class Cache:
             return (self.topology_epoch, self.cohort_epoch,
                     self.flavor_spec_epoch)
 
+    def generation_lag(self, token: tuple) -> int:
+        """How many structural generations a consumer's stamped token
+        lags the live cache: the sum of per-epoch deltas (each epoch is
+        monotonic, so the sum is 0 iff the token is current). The query
+        plane (obs/queryplane.py) and tools/visibility_probe.py price
+        read-side staleness with this."""
+        with self._lock:
+            cur = (self.topology_epoch, self.cohort_epoch,
+                   self.flavor_spec_epoch)
+        return sum(abs(c - t) for c, t in zip(cur, tuple(token)))
+
     def snapshot_current(self, snap: Snapshot) -> bool:
         """Cheap generation-token check: True iff no structural epoch
         moved since ``snap`` was produced (see

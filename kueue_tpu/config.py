@@ -212,9 +212,15 @@ class ObservabilityConfig:
     ``flight_recorder_capacity`` cycles, served via /debug/cycles and
     feeding the cycle_phase_seconds histograms. Disabling drops span
     capture to a single compare per phase (the trace_overhead bench row
-    pins both modes at <=1% of a cycle)."""
+    pins both modes at <=1% of a cycle). ``query_plane_enable`` wires
+    the snapshot-backed read plane (obs/queryplane.py): every cycle
+    seal publishes an immutable pending-position view served by the
+    visibility server instead of walking live queue state per request;
+    disabling reverts reads to the live (per-request) visibility API
+    and restores the maintainer's snapshot shell recycling."""
     flight_recorder_enable: bool = True
     flight_recorder_capacity: int = DEFAULT_FLIGHT_RECORDER_CAPACITY
+    query_plane_enable: bool = True
 
 # Device-fault containment defaults (kueue_tpu/resilience) — single
 # source for both the dataclass defaults and load()'s fallbacks.
@@ -559,6 +565,7 @@ def load(raw: dict) -> Configuration:
             flight_recorder_enable=o.get("flightRecorderEnable", True),
             flight_recorder_capacity=o.get(
                 "flightRecorderCapacity", DEFAULT_FLIGHT_RECORDER_CAPACITY),
+            query_plane_enable=o.get("queryPlaneEnable", True),
         )
     cfg.feature_gates = dict(raw.get("featureGates", {}))
     cfg = set_defaults(cfg)

@@ -195,6 +195,18 @@ class KueueManager:
             solver_min_heads=self.cfg.solver.min_heads,
             recorder=self.flight_recorder)
         self.visibility_server = None  # started by serve_visibility()
+        # Snapshot-backed query plane (obs/queryplane.py + ISSUE 12):
+        # every cycle seal publishes an immutable pending-position /
+        # status view (nominate-order column + the cycle's snapshot
+        # handout, ownership transferred from the scheduler), and the
+        # visibility server reads ONLY sealed views — a read storm
+        # never touches the live heaps the admission cycle mutates.
+        self.query_plane = None
+        if self.cfg.observability.query_plane_enable:
+            from kueue_tpu.obs.queryplane import QueryPlane
+            self.query_plane = QueryPlane(self.cache, self.queues,
+                                          metrics=self.metrics)
+            self.scheduler.query_plane = self.query_plane
         # Cycle deadline budget (kueue_tpu/resilience/degrade.py): with
         # scheduler.cycleBudget > 0 the degradation ladder watches every
         # cycle's wall seconds and sheds load (head caps, deferred
@@ -363,6 +375,12 @@ class KueueManager:
         if self.visibility_server is not None:
             self.visibility_server.stop()
             self.visibility_server = None
+        if self.query_plane is not None:
+            # Release the reader-held snapshot handout (the sealed
+            # view's backing): live_handouts must return to zero after
+            # a shutdown — the same leak contract abandoned speculative
+            # cycles honor.
+            self.query_plane.close()
         if checkpoint and self.durable is not None:
             self.store.checkpoint_now()
 
@@ -395,7 +413,8 @@ class KueueManager:
             self.visibility_server.stop()
         server = VisibilityServer(
             VisibilityAPI(self.queues), port=port,
-            debug=DebugEndpoints(self.scheduler, self.metrics))
+            debug=DebugEndpoints(self.scheduler, self.metrics),
+            query_plane=self.query_plane, metrics=self.metrics)
         server.start()
         self.visibility_server = server
         return server

@@ -97,7 +97,12 @@ class _Metric:
                 self._delete(key)
 
 
-class Counter(_Metric):
+class _ValueMetric(_Metric):
+    """Shared scalar-series storage for Counter and Gauge: one float
+    per label key, deltas applied under the metric's own lock
+    (concurrent HTTP threads drive e.g. the in-flight-reads gauge; a
+    read-modify-write through value()/set() would drop counts)."""
+
     def __init__(self, name, help_, label_names=()):
         super().__init__(name, help_, label_names)
         self.values: dict[tuple, float] = {}
@@ -117,23 +122,14 @@ class Counter(_Metric):
         self.values.pop(key, None)
 
 
-class Gauge(_Metric):
-    def __init__(self, name, help_, label_names=()):
-        super().__init__(name, help_, label_names)
-        self.values: dict[tuple, float] = {}
+class Counter(_ValueMetric):
+    pass
 
+
+class Gauge(_ValueMetric):
     def set(self, value: float, **labels) -> None:
         with self._lock:
             self.values[self._key(labels)] = value
-
-    def value(self, **labels) -> float:
-        return self.values.get(self._key(labels), 0.0)
-
-    def _series(self):
-        return list(self.values)
-
-    def _delete(self, key):
-        self.values.pop(key, None)
 
 
 class Histogram(_Metric):
@@ -344,6 +340,27 @@ class Registry:
             "Wall seconds from restore() entry to a settled control "
             "plane (checkpoint load + WAL replay + reconcile drain)",
             buckets=exponential_buckets(0.005, 2.0, 16))
+        # Snapshot-backed query plane (obs/queryplane.py): read-side
+        # saturation — per-route request counts by HTTP code, request
+        # latency, the sealed view's age, and reads in flight. Fed by
+        # the VisibilityServer so the read plane's load shows up in the
+        # SAME registry the admission metrics live in.
+        self.visibility_requests_total = Counter(
+            "kueue_visibility_requests_total",
+            "Visibility/query-plane HTTP requests by route and status "
+            "code (routes: cq_pending|lq_pending|workload|metrics|"
+            "debug|unknown)", ["route", "code"])
+        self.visibility_request_seconds = Histogram(
+            "kueue_visibility_request_seconds",
+            "Visibility/query-plane HTTP request latency by route",
+            ["route"], buckets=_PHASE_BUCKETS)
+        self.visibility_snapshot_age_seconds = Gauge(
+            "kueue_visibility_snapshot_age_seconds",
+            "Age of the query plane's sealed view (seconds since the "
+            "last cycle-seal publish; 0 is written at each publish)")
+        self.visibility_inflight_reads = Gauge(
+            "kueue_visibility_inflight_reads",
+            "Query-plane HTTP reads currently being served")
         # Coarse reconciler latency (ROADMAP PR-4 follow-up: the
         # wall_s - cycle_time_total gap had no signal); fed by the sim
         # Runtime around every reconcile call.
@@ -434,6 +451,20 @@ class Registry:
     def restart_recovered(self, seconds: float) -> None:
         self.restarts_total.inc()
         self.recovery_seconds.observe(seconds)
+
+    def visibility_request(self, route: str, code: int,
+                           seconds: float) -> None:
+        self.visibility_requests_total.inc(route=route, code=str(code))
+        self.visibility_request_seconds.observe(seconds, route=route)
+
+    def visibility_read_begin(self) -> None:
+        self.visibility_inflight_reads.inc(1)
+
+    def visibility_read_end(self) -> None:
+        self.visibility_inflight_reads.inc(-1)
+
+    def set_visibility_snapshot_age(self, seconds: float) -> None:
+        self.visibility_snapshot_age_seconds.set(seconds)
 
     def speculation_hit(self) -> None:
         self.speculation_hits_total.inc()

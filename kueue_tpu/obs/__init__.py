@@ -6,12 +6,14 @@ from kueue_tpu.obs.recorder import (
     CycleTrace,
     FlightRecorder,
 )
+from kueue_tpu.obs.queryplane import QueryPlane, SealedView
 from kueue_tpu.obs.status import (
     DebugEndpoints,
     arena_status,
     breaker_status,
     degrade_status,
     pipeline_status,
+    queryplane_status,
     recovery_status,
     router_status,
     warmup_status,
@@ -21,11 +23,14 @@ __all__ = [
     "DEFAULT_CAPACITY",
     "CycleTrace",
     "FlightRecorder",
+    "QueryPlane",
+    "SealedView",
     "DebugEndpoints",
     "arena_status",
     "breaker_status",
     "degrade_status",
     "pipeline_status",
+    "queryplane_status",
     "recovery_status",
     "router_status",
     "warmup_status",

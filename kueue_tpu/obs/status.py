@@ -134,6 +134,21 @@ def recovery_status(scheduler) -> dict:
     return out
 
 
+def queryplane_status(scheduler) -> dict:
+    """Snapshot-backed query plane state (/debug/queryplane): the
+    sealed view's cycle/generation/age, token lag vs the live cache,
+    reader borrow/table counters, and whether the plane still holds a
+    snapshot handout — the same producer tools/visibility_probe.py and
+    tests read, so every consumer shows the same numbers. ``attached``
+    False = reads fall back to the live visibility API."""
+    plane = getattr(scheduler, "query_plane", None)
+    if plane is None:
+        return {"attached": False}
+    st = plane.status()
+    st["attached"] = True
+    return st
+
+
 def arena_status(solver) -> dict:
     """Encode-arena slot occupancy and churn counters."""
     arena = getattr(solver, "_arena", None)
@@ -171,6 +186,18 @@ class DebugEndpoints:
         return self.metrics.dump() if self.metrics is not None else None
 
     def handle(self, path: str, params: dict) -> Optional[dict]:
+        payload = self._dispatch(path, params)
+        if payload is not None:
+            # Every /debug payload reports the structural generation
+            # token it rendered under (ISSUE 12 satellite): operators
+            # correlating a debug dump against query-plane responses
+            # need the same staleness coordinate system on both.
+            payload.setdefault(
+                "generation",
+                list(self.scheduler.cache.generation_token()))
+        return payload
+
+    def _dispatch(self, path: str, params: dict) -> Optional[dict]:
         if path == "/debug/cycles":
             return self._cycles(params)
         if path == "/debug/breaker":
@@ -185,6 +212,8 @@ class DebugEndpoints:
             return warmup_status(self.scheduler)
         if path == "/debug/recovery":
             return recovery_status(self.scheduler)
+        if path == "/debug/queryplane":
+            return queryplane_status(self.scheduler)
         if path == "/debug/arena":
             if self.scheduler.solver is None:
                 return {"bound": False}

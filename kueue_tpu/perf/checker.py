@@ -220,6 +220,16 @@ class SLOSpec:
     # unchecked; with a bound set, a scenario that restarted but never
     # admitted again is itself a violation.
     max_recovery_to_first_admission_s: Optional[float] = None
+    # Query-plane read side (obs/queryplane.py + ISSUE 12): a scenario
+    # that runs a read storm concurrently with its traffic gates the
+    # read responses here. min_reads = the storm actually read (0 =
+    # unchecked); max_read_staleness_generations bounds the WORST
+    # structural-generation lag any response's token showed vs the live
+    # cache at read time (0 = every read served the current structural
+    # generation; None = unchecked — with a bound set, a run that
+    # recorded no staleness samples is itself a violation).
+    min_reads: int = 0
+    max_read_staleness_generations: Optional[int] = None
 
 
 def check_slo(result, spec: SLOSpec) -> list:
@@ -276,6 +286,23 @@ def check_slo(result, spec: SLOSpec) -> list:
             violations.append(
                 f"recovery-to-first-admission {worst:.1f}s exceeds "
                 f"{spec.max_recovery_to_first_admission_s:.1f}s")
+    if spec.min_reads:
+        reads = getattr(result, "reads", 0)
+        if reads < spec.min_reads:
+            violations.append(
+                f"query plane served {reads} reads, below the "
+                f"{spec.min_reads} the storm was sized for")
+    if spec.max_read_staleness_generations is not None:
+        worst_lag = getattr(result, "read_staleness_generations", None)
+        if worst_lag is None:
+            violations.append(
+                "read-staleness bound set but the run recorded no "
+                "staleness samples (no stamped read responses)")
+        elif worst_lag > spec.max_read_staleness_generations:
+            violations.append(
+                f"worst read staleness {worst_lag} structural "
+                f"generation(s) exceeds bound "
+                f"{spec.max_read_staleness_generations}")
     return violations
 
 

@@ -337,9 +337,26 @@ class Manager:
             return sum(cqh.pending() for cqh in self.cluster_queues.values())
 
     def pending_workloads_info(self, cq_name: str) -> list:
-        with self._lock:
-            cqh = self.cluster_queues.get(cq_name)
-            return cqh.snapshot_sorted() if cqh else []
+        return self.pending_order(cq_name)
+
+    def pending_order(self, cq_name: str) -> list:
+        """One CQ's pending workloads in queue order WITHOUT taking the
+        manager-wide lock: the heap copy runs under the CQ's own lock
+        and the sort outside any lock. This is the query plane's
+        once-per-cycle-per-CQ table source (obs/queryplane.py) — a
+        read-side refresh must never serialize against every other
+        CQ's mutations the way the manager-wide lock would (the old
+        pending_workloads_info held it across the whole sort).
+
+        Sort-consistency note: the unlocked sort is sound because a
+        workload UPDATE replaces its Info object (every mutator builds
+        a fresh Info via _new_info and push_or_update swaps it in) —
+        the comparator's inputs (priority, queue-order timestamp) are
+        immutable per Info instance, so a copied element can never
+        change under the comparator mid-sort. In-place Info writes are
+        limited to non-ordering fields (cluster_queue, _solver_enc)."""
+        cqh = self.cluster_queues.get(cq_name)
+        return cqh.snapshot_sorted() if cqh else []
 
     def pending_workloads_in_local_queue(self, lq_key: str) -> int:
         with self._lock:

@@ -258,6 +258,15 @@ class Scheduler:
         # so /debug/cycles and tools/transport_probe.py can price every
         # cycle's host<->device traffic without lifetime-counter math.
         self._cycle_io0 = (0, 0, 0, 0)
+        # Snapshot-backed query plane (obs/queryplane.py): when attached
+        # (manager wiring), every cycle seal publishes an immutable read
+        # view — the cycle's nominate order, the generation token, and
+        # (sync cycles) the cycle's snapshot handout, whose ownership
+        # transfers to the plane instead of being released back to the
+        # maintainer. None = reads fall back to the live visibility API.
+        self.query_plane = None
+        self._cycle_order: Optional[list] = None  # admission-sorted keys
+        self._seal_snapshot = None  # handout pending transfer at seal
         # Below this head count the accelerator dispatch overhead exceeds
         # the win; narrow cycles go through the CPU path even with a
         # solver configured (SolverConfig.min_heads; 0 = always solve).
@@ -304,6 +313,9 @@ class Scheduler:
         self.queues.broadcast()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # A snapshot parked for a seal that never happened (exception
+        # mid-cycle) must not outlive the scheduler.
+        self._flush_seal_snapshot()
         # Never strand an in-flight speculative cycle at shutdown: its
         # deferred-nomination handout must go back to the snapshot
         # maintainer and its device-residency + arena claims must drop,
@@ -352,6 +364,8 @@ class Scheduler:
                 self._cycle_evictions = 0
                 self._cycle_faults = 0
                 self._cycle_io0 = self._io_counters()
+                self._cycle_order = None
+                self._flush_seal_snapshot()
                 self._cycle_degraded = self.ladder.state
                 sig = self._drain_pipeline()
                 self._finish_trace(trace, "drain", heads=0,
@@ -369,6 +383,8 @@ class Scheduler:
         self._cycle_evictions = 0
         self._cycle_faults = 0
         self._cycle_io0 = self._io_counters()
+        self._cycle_order = None
+        self._flush_seal_snapshot()
         self._degrade_deferred = 0
         # The ladder rung this cycle RUNS under (transitions only happen
         # at cycle end, in _observe_budget): shed/survival cap the heads
@@ -483,6 +499,12 @@ class Scheduler:
         self._stage_apply(nom, timeout)
         applied = self._stage_requeue(nom)
         entries = nom.entries
+        if self.query_plane is not None:
+            # The cycle's nominate order (solver-routed entries first,
+            # then the admission-sorted CPU entries — exactly the order
+            # the apply loop consumed): the query plane's decision-only
+            # position column, captured once per cycle.
+            self._cycle_order = [e.info.key for e in entries]
         result_success = applied.success
         admitted_n = applied.admitted
         skipped_preemptions = nom.skipped_preemptions
@@ -533,9 +555,11 @@ class Scheduler:
         self.cycle_counts[route] = self.cycle_counts.get(route, 0) + 1
         if route == "device":
             self._note_device_cycle(collects0)
-        # The cycle is done with its snapshot: the incremental maintainer
-        # may recycle un-materialized shells into the next handout.
-        self.cache.release_snapshot(snapshot)
+        # The cycle is done with its snapshot: without a query plane the
+        # incremental maintainer may recycle un-materialized shells into
+        # the next handout; with one attached, ownership transfers to
+        # the read plane at seal instead (_finish_trace publishes it).
+        self._retire_cycle_snapshot(snapshot)
         if route in ("device", "cpu"):
             # Progress = admissions + evictions: a pure-eviction cycle
             # admits zero on EITHER engine, and an all-zero rate pair
@@ -763,25 +787,62 @@ class Scheduler:
         if self.metrics is not None:
             self.metrics.set_breaker_state(self.breaker.state)
             self.metrics.set_degraded_state(self.ladder.state)
-        if trace is None:
+        if trace is not None:
+            trace.route = route
+            trace.regime = self._cycle_regime
+            trace.heads = heads
+            trace.admitted = admitted
+            trace.evictions = self._cycle_evictions
+            trace.faults = self._cycle_faults
+            trace.breaker = self.breaker.state
+            trace.degraded = self._cycle_degraded
+            io = self._io_counters()
+            base = self._cycle_io0
+            trace.upload_bytes = io[0] - base[0]
+            trace.fetch_bytes = io[1] - base[1]
+            trace.dispatches = io[2] - base[2]
+            trace.collects = io[3] - base[3]
+            self.recorder.finish(trace)
+            if self.metrics is not None:
+                self.metrics.cycle_observed(route, heads,
+                                            trace.phase_sums())
+        # Query-plane seal rides the same point (independent of the
+        # recorder being enabled): the read plane refreshes atomically
+        # at every cycle seal.
+        self._publish_query_plane(route)
+
+    def _flush_seal_snapshot(self) -> None:
+        """Release a snapshot parked for seal but never published — an
+        exception escaped schedule() between _retire_cycle_snapshot and
+        _finish_trace (the chaos harnesses catch and keep driving).
+        Without this the next cycle's reset would strand the handout
+        and live_handouts could never return to zero."""
+        snap, self._seal_snapshot = self._seal_snapshot, None
+        if snap is not None:
+            self.cache.release_snapshot(snap)
+
+    def _retire_cycle_snapshot(self, snapshot: Snapshot) -> None:
+        """The sync cycle is done with its snapshot handout. Without a
+        query plane it goes straight back to the maintainer (shell
+        recycling); with one attached its ownership transfers to the
+        read plane at seal — readers serve status queries from it until
+        the next full-snapshot view rotates it out, and it stays
+        counted in ``cache.live_handouts`` while held (the SNAPSHOTS.md
+        reader-consumer contract)."""
+        if self.query_plane is None:
+            self.cache.release_snapshot(snapshot)
+        else:
+            self._seal_snapshot = snapshot
+
+    def _publish_query_plane(self, route: str) -> None:
+        qp = self.query_plane
+        snap, self._seal_snapshot = self._seal_snapshot, None
+        order, self._cycle_order = self._cycle_order, None
+        if qp is None:
+            if snap is not None:  # plane detached mid-cycle: don't leak
+                self.cache.release_snapshot(snap)
             return
-        trace.route = route
-        trace.regime = self._cycle_regime
-        trace.heads = heads
-        trace.admitted = admitted
-        trace.evictions = self._cycle_evictions
-        trace.faults = self._cycle_faults
-        trace.breaker = self.breaker.state
-        trace.degraded = self._cycle_degraded
-        io = self._io_counters()
-        base = self._cycle_io0
-        trace.upload_bytes = io[0] - base[0]
-        trace.fetch_bytes = io[1] - base[1]
-        trace.dispatches = io[2] - base[2]
-        trace.collects = io[3] - base[3]
-        self.recorder.finish(trace)
-        if self.metrics is not None:
-            self.metrics.cycle_observed(route, heads, trace.phase_sums())
+        qp.publish(self.attempt_count, route, order, snapshot=snap)
 
     # --- cycle deadline budget (kueue_tpu/resilience/degrade.py) ---
 
@@ -1505,6 +1566,11 @@ class Scheduler:
         else:
             self._cycle_regime = "fit"
         self._last_regime = self._cycle_regime
+        if self.query_plane is not None:
+            # The collected cycle's processing order (batch order + the
+            # pipelined preempt entries): the query plane's nominate-
+            # order column for pipelined cycles.
+            self._cycle_order = [e.info.key for e in entries]
         result_success = False
         admitted_n = 0
         vlog.dump_attempts(self.log, entries)

@@ -29,6 +29,11 @@ The catalog (sim/SCENARIOS.md documents each in detail):
                         points and restores from the durable store
                         (RESILIENCE.md §6); gated on zero starvation +
                         recovery-to-first-admission
+- ``visibility_storm`` (h) reader threads hammer the snapshot-backed
+                        query plane concurrently with admission traffic
+                        and quota churn; gated on read consistency,
+                        bounded response-token staleness, and zero
+                        handout leaks (obs/queryplane.py / ISSUE 12)
 
 Run one via ``run_scenario(name, seed=..., scale="smoke"|"full")`` or
 end-to-end with artifacts via ``tools/scenario_run.py``.
@@ -90,6 +95,11 @@ class ScenarioResult:
     # to the next admission grant (the recovery-to-first-admission SLO).
     restarts: int = 0
     recovery_to_first_admission_s: list = field(default_factory=list)
+    # Query-plane read storm (scenario h / ISSUE 12): reads served and
+    # the worst structural-generation lag any stamped response showed
+    # vs the live cache at read time (None = no samples recorded).
+    reads: int = 0
+    read_staleness_generations: Optional[int] = None
     requeue_amplification: float = 0.0
     counters: dict = field(default_factory=dict)
     violations: list = field(default_factory=list)
@@ -113,6 +123,8 @@ class ScenarioResult:
             "restarts": self.restarts,
             "recovery_to_first_admission_s": [
                 round(v, 3) for v in self.recovery_to_first_admission_s],
+            "reads": self.reads,
+            "read_staleness_generations": self.read_staleness_generations,
             "requeue_amplification": round(self.requeue_amplification, 3),
             "counters": dict(self.counters),
             "ok": self.ok, "violations": list(self.violations),
@@ -1321,6 +1333,208 @@ def run_restart_storm(seed: int = 0, scale: str = "full") -> ScenarioResult:
 
 
 # ----------------------------------------------------------------------
+# scenario (h): query-plane read storm under admission traffic
+# (obs/queryplane.py + ISSUE 12)
+# ----------------------------------------------------------------------
+
+def run_visibility_storm(seed: int = 0, scale: str = "full") -> ScenarioResult:
+    """Reader threads hammer the snapshot-backed query plane — pending
+    positions per CQ/LQ plus point status queries — CONCURRENTLY with
+    steady admission traffic and mid-run single-CQ quota churn (the
+    structural edits that move the generation token, so the staleness
+    gate is non-vacuous).
+
+    Gates: the usual zero-starvation/p99 bounds on the admission side
+    (reads must not break admission), plus the read-plane contract —
+    every response internally consistent (one immutable table per
+    sealed view, duplicate-free, dense per-LQ positions), a floor on
+    reads actually served, and the worst response-token lag vs the live
+    cache bounded at ONE structural generation (a sealed view lags only
+    between an edit and the next cycle seal)."""
+    import threading as _threading
+
+    p = {"smoke": dict(duration=240.0, tenants=4, quota=8, interval=60.0,
+                       readers=2),
+         "full": dict(duration=900.0, tenants=8, quota=10, interval=45.0,
+                      readers=4),
+         }[scale]
+    h = ScenarioHarness("visibility_storm", seed, tenants=p["tenants"],
+                        quota_units=p["quota"])
+    plane = h.mgr.query_plane
+    assert plane is not None, "query plane disabled in manager config"
+    arrivals = steady_trace(seed, p["duration"], p["tenants"],
+                            interval_s=20.0)
+
+    # Mid-run structural churn: one CQ's nominal quota wiggles (the
+    # flavor_churn single-CQ epoch path) so response tokens must chase
+    # a moving generation.
+    edits = {"n": 0}
+
+    def churn():
+        t = edits["n"] % p["tenants"]
+        extra = (edits["n"] % 3)  # 0/1/2 extra units, cycled
+        edits["n"] += 1
+        cq = h.mgr.store.get("ClusterQueue", "", f"cq-t{t}")
+        cq.spec.resource_groups[0].flavors[0].resources[0].nominal_quota = \
+            (p["quota"] + extra) * UNIT
+        h.mgr.store.update(cq)
+        h.mgr.run_until_idle()
+        note_driver_lag()  # an un-sealed edit: the view lags <= 1
+
+    stop = _threading.Event()
+    stats = {"reads": 0, "warming": 0, "max_lag": None, "errors": []}
+    stats_lock = _threading.Lock()
+    # The GATED staleness bound is measured deterministically from the
+    # driver thread (the plane's actual guarantee: the CURRENT view
+    # lags at most the edits since its seal). Reader-side lag samples
+    # additionally ride a hold-window race — a reader descheduled
+    # between acquire and its lag read can observe an extra
+    # edit+seal+edit — so they get their own looser sanity bound below
+    # instead of feeding the SLO gate flakily.
+    driver_lag = {"max": None}
+
+    def note_driver_lag():
+        lag = h.mgr.query_plane.token_lag()
+        if lag is not None and (driver_lag["max"] is None
+                                or lag > driver_lag["max"]):
+            driver_lag["max"] = lag
+
+    def read_once(n: int) -> bool:
+        """One validated plane read (shared by the concurrent reader
+        threads AND the driver's deterministic tail batch). Returns
+        False while the plane is still warming."""
+        cache = h.mgr.cache
+        view = plane.acquire()
+        if view is None:
+            with stats_lock:
+                stats["warming"] += 1
+            return False
+        try:
+            # staleness sampled AT ACQUIRE: the bound under test is
+            # how stale a just-acquired view can be, not how far a
+            # long-held borrow can drift
+            lag = cache.generation_lag(view.generation)
+            cq_name = f"cq-t{n % p['tenants']}"
+            rows = plane.pending_cq(view, cq_name, 100, 0)
+            err = _check_rows(rows)
+            again = plane.pending_cq(view, cq_name, 100, 0)
+            if [r.name for r in again] != [r.name for r in rows]:
+                err = err or (f"{cq_name}: two reads of one sealed "
+                              f"view disagreed (torn table)")
+            if n % 7 == 0 and rows:
+                st = plane.workload_status(view, rows[0].namespace,
+                                           rows[0].name)
+                if not st["found"]:
+                    err = err or (f"{rows[0].name} pending in the "
+                                  f"table but status not found")
+            with stats_lock:
+                stats["reads"] += 1
+                if stats["max_lag"] is None or lag > stats["max_lag"]:
+                    stats["max_lag"] = lag
+                if err and len(stats["errors"]) < 5:
+                    stats["errors"].append(err)
+        finally:
+            plane.release(view)
+        return True
+
+    def reader(idx: int) -> None:
+        import time as _real_time
+        n = idx
+        while not stop.is_set():
+            if not read_once(n):
+                _real_time.sleep(0.001)
+                continue
+            n += 1
+            if n % 64 == 0:
+                _real_time.sleep(0)  # let the scheduler thread run
+        # post-loop: nothing — borrows all returned via finally
+
+    def _check_rows(rows) -> Optional[str]:
+        names = [r.name for r in rows]
+        if len(set(names)) != len(names):
+            return f"duplicate rows in one table: {names}"
+        by_lq: dict = {}
+        for r in rows:
+            lqk = f"{r.namespace}/{r.local_queue_name}"
+            expect = by_lq.get(lqk, 0)
+            if r.position_in_local_queue != expect:
+                return (f"LQ positions not dense for {lqk}: got "
+                        f"{r.position_in_local_queue}, want {expect}")
+            by_lq[lqk] = expect + 1
+        return None
+
+    threads = [_threading.Thread(target=reader, args=(i,), daemon=True)
+               for i in range(p["readers"])]
+    for t in threads:
+        t.start()
+    # Sample the deterministic staleness bound after EVERY step (a
+    # seal must catch the view back up to the live token).
+    orig_step = h.step
+
+    def step_and_note():
+        orig_step()
+        note_driver_lag()
+
+    h.step = step_and_note
+    try:
+        hooks = [(off, churn) for off in
+                 _frange(p["interval"], p["duration"], p["interval"])]
+        h.set_phase("storm")
+        h.run(arrivals, p["duration"], hooks=hooks)
+        h.set_phase("drain")
+        h.drain()
+        # Deterministic tail: the reads floor must not depend on how
+        # much wall time the OS gave the reader threads (a starved
+        # sub-second smoke run could serve a handful) — the driver
+        # issues a full validated batch through the same read path.
+        for k in range(60):
+            read_once(k)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+    slo = SLOSpec(
+        min_admitted=len(arrivals),
+        class_max_p99_tta_s={"prod": 240.0, "standard": 480.0,
+                             "batch": 900.0},
+        max_requeue_amplification=1.5,
+        min_reads=50,
+        max_read_staleness_generations=1)
+    res = h.result(scale, slo)
+    # result() computed violations before the read stats landed on the
+    # result; re-evaluate with them present. The gated staleness bound
+    # is the DRIVER-measured one (deterministic); the reader-observed
+    # max carries a hold-window race allowance of one extra
+    # edit+seal+edit and gets its own sanity bound.
+    res.reads = stats["reads"]
+    res.read_staleness_generations = driver_lag["max"]
+    res.violations = check_slo(res, slo)
+    res.counters["reads"] = stats["reads"]
+    res.counters["warming_reads"] = stats["warming"]
+    res.counters["quota_edits"] = edits["n"]
+    res.counters["tables_built"] = plane.tables_built
+    res.counters["cycles_published"] = plane.cycles_published
+    res.counters["max_reader_observed_lag"] = stats["max_lag"]
+    if stats["max_lag"] is not None and stats["max_lag"] > 2:
+        res.violations.append(
+            f"reader-observed token lag {stats['max_lag']} exceeds the "
+            "hold-window allowance of 2 (one edit+seal+edit past the "
+            "deterministic bound)")
+    for err in stats["errors"]:
+        res.violations.append(f"read consistency: {err}")
+    # Reader-held handouts all returned: after shutdown the leak
+    # detector must read zero (the ISSUE 12 satellite regression,
+    # exercised here under a real concurrent read storm).
+    h.mgr.shutdown(checkpoint=False)
+    if h.mgr.cache.live_handouts != 0:
+        res.violations.append(
+            f"{h.mgr.cache.live_handouts} snapshot handout(s) leaked "
+            "by the read storm (live_handouts != 0 after shutdown)")
+    return res
+
+
+# ----------------------------------------------------------------------
 
 SCENARIOS = {
     "diurnal": run_diurnal,
@@ -1330,6 +1544,7 @@ SCENARIOS = {
     "cluster_loss": run_cluster_loss,
     "mixed_jobs": run_mixed_jobs,
     "restart_storm": run_restart_storm,
+    "visibility_storm": run_visibility_storm,
 }
 
 

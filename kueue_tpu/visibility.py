@@ -1,17 +1,37 @@
-"""Visibility extension API: live pending-workloads views.
+"""Visibility extension API: pending-workloads views.
 
 Equivalent of the reference's pkg/visibility (server.go:46-98,
 api/rest/pending_workloads_cq.go, pending_workloads_lq.go) and
 apis/visibility/v1alpha1 (types.go:64-98): positions in queue with
-limit/offset pagination, served straight from the queue manager's live
-state. `VisibilityServer` optionally exposes the same payloads over
-HTTP (the reference registers an aggregated apiserver on :8082).
+limit/offset pagination.
+
+Two serving modes (ISSUE 12 — the snapshot-backed query plane):
+
+- ``VisibilityAPI`` computes LIVE off the queue manager's heaps — the
+  reference's behavior, kept as the conformance path and the fallback
+  when no query plane is wired (bare ``VisibilityServer``).
+- With a ``QueryPlane`` attached (``KueueManager.serve_visibility``
+  wires it), every pending-position/status request is served from the
+  plane's current SEALED view — an immutable per-cycle publication
+  backed by the cycle's own copy-on-write snapshot handout — so a read
+  storm never contends with the admission cycle's live state. Every
+  response then carries the staleness stamp (``generation`` token,
+  ``cycle`` id, ``age_s``); while the plane is still warming (no cycle
+  sealed yet) the server answers 503 with a Retry-After header instead
+  of blocking.
+
+``VisibilityServer`` also exposes the operator debug surface
+(``/metrics`` + ``/debug/*``, obs.DebugEndpoints) and feeds the
+read-side saturation metrics (``visibility_requests_total{route,code}``,
+request-latency histograms, snapshot-age and in-flight-reads gauges)
+into the same Registry ``/metrics`` serves from.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time as _time
 from dataclasses import asdict, dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -20,6 +40,10 @@ from kueue_tpu.core import priority as prioritypkg
 from kueue_tpu.core import workload as wlpkg
 
 DEFAULT_LIMIT = 1000
+
+# Retry-After seconds the server suggests while the query plane warms
+# (no sealed cycle yet — one admission cycle away from serving).
+WARMING_RETRY_AFTER_S = 1
 
 
 @dataclass
@@ -102,16 +126,38 @@ class VisibilityAPI:
         return PendingWorkloadsSummary(items=items)
 
 
+def _row_payload(row) -> dict:
+    """A query-plane PendingPosition as the wire item: the reference
+    fields plus the nominate-rank column (omitted when None so the
+    payload stays backward-shaped for rows that weren't cycle heads)."""
+    item = {
+        "name": row.name,
+        "namespace": row.namespace,
+        "local_queue_name": row.local_queue_name,
+        "priority": row.priority,
+        "position_in_cluster_queue": row.position_in_cluster_queue,
+        "position_in_local_queue": row.position_in_local_queue,
+    }
+    if row.nominate_rank is not None:
+        item["nominate_rank"] = row.nominate_rank
+    return item
+
+
 class VisibilityServer:
     """Serve the visibility API over HTTP (reference: server on :8082).
 
     GET /apis/visibility.kueue.x-k8s.io/v1alpha1/clusterqueues/<cq>/pendingworkloads
     GET /apis/visibility.kueue.x-k8s.io/v1alpha1/namespaces/<ns>/localqueues/<lq>/pendingworkloads
-    Query params: limit, offset.
+    GET /apis/visibility.kueue.x-k8s.io/v1alpha1/namespaces/<ns>/workloads/<wl>
+    Query params: limit, offset (pendingworkloads routes).
 
-    With a ``debug`` surface wired (obs.DebugEndpoints — the manager's
-    ``serve_visibility`` does this), the server additionally exposes the
-    operator endpoints:
+    With a ``query_plane`` wired (KueueManager.serve_visibility), the
+    pending/status routes serve from the plane's sealed view — 503 +
+    Retry-After while warming — and stamp every response with the
+    generation token / cycle / age. The workloads route exists only on
+    the plane (404 without one). With a ``debug`` surface wired
+    (obs.DebugEndpoints) the server additionally exposes the operator
+    endpoints:
 
     GET /metrics           Prometheus text exposition (Registry.dump)
     GET /debug/cycles      recent flight-recorder traces (?n=K | ?slowest=K)
@@ -120,29 +166,44 @@ class VisibilityServer:
     GET /debug/router      adaptive-router regime samples/medians
     GET /debug/pipeline    speculative-pipeline coverage + abort reasons
     GET /debug/warmup      compile-governor state + per-bucket provenance
+    GET /debug/queryplane  sealed-view state + token lag + read counters
     GET /debug/arena       encode-arena slot occupancy + churn
 
-    Unknown paths are 404; malformed query parameters are 400.
+    Unknown paths are 404; malformed query parameters are 400. Every
+    request (all codes, all routes) lands in the read-side saturation
+    metrics when a Registry is wired.
     """
 
-    def __init__(self, api: VisibilityAPI, port: int = 0, debug=None):
+    def __init__(self, api: VisibilityAPI, port: int = 0, debug=None,
+                 query_plane=None, metrics=None):
         self.api = api
         self.port = port
         self.debug = debug
+        self.query_plane = query_plane
+        self.metrics = metrics
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> int:
         api = self.api
         debug = self.debug
+        plane = self.query_plane
+        metrics = self.metrics
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
                 pass
 
             def _respond(self, code: int, body: bytes = b"",
-                         content_type: str = "application/json"):
+                         content_type: str = "application/json",
+                         headers: tuple = ()):
+                # Record the OUTCOME before the socket write: a client
+                # dropping mid-response must not turn a served 200 into
+                # a phantom 500 in visibility_requests_total.
+                self._code = code
                 self.send_response(code)
+                for name, value in headers:
+                    self.send_header(name, value)
                 if body:
                     self.send_header("Content-Type", content_type)
                     self.send_header("Content-Length", str(len(body)))
@@ -151,17 +212,53 @@ class VisibilityServer:
                     self.wfile.write(body)
 
             def do_GET(self):
+                # Read-side saturation accounting wraps EVERY path —
+                # including 4xx and handler exceptions — so /metrics
+                # reflects the true request mix under a storm.
+                self._code = 500
+                self._route = "unknown"
+                t0 = _time.perf_counter()
+                if metrics is not None:
+                    metrics.visibility_read_begin()
+                try:
+                    self._serve()
+                except ConnectionError:
+                    # Reader went away mid-write (BrokenPipeError or
+                    # ECONNRESET): not a server error, and letting it
+                    # escape would traceback-spam stderr per dropped
+                    # connection at storm QPS.
+                    pass
+                finally:
+                    if metrics is not None:
+                        metrics.visibility_read_end()
+                        metrics.visibility_request(
+                            self._route, self._code,
+                            _time.perf_counter() - t0)
+
+            def _serve(self):
                 from urllib.parse import parse_qs, urlsplit
                 parsed = urlsplit(self.path)
                 path = parsed.path
                 params = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
                 if debug is not None and path == "/metrics":
+                    self._route = "metrics"
+                    if plane is not None and metrics is not None:
+                        # Refresh the snapshot-age gauge at scrape time
+                        # (a publish writes 0; scrapes carry the decay).
+                        view = plane.acquire()
+                        try:
+                            if view is not None:
+                                metrics.set_visibility_snapshot_age(
+                                    view.age_s())
+                        finally:
+                            plane.release(view)
                     text = debug.metrics_text()
                     if text is None:
                         return self._respond(404)
                     return self._respond(200, text.encode(),
                                          "text/plain; version=0.0.4")
                 if debug is not None and path.startswith("/debug/"):
+                    self._route = "debug"
                     try:
                         payload = debug.handle(path, params)
                     except ValueError as exc:
@@ -176,23 +273,77 @@ class VisibilityServer:
                     if limit < 0 or offset < 0:
                         raise ValueError
                 except ValueError:
+                    self._route = self._classify(path)
                     return self._respond(
                         400, b"limit/offset must be non-negative integers",
                         "text/plain")
                 parts = [p for p in path.split("/") if p]
-                summary = None
-                if (len(parts) >= 5 and parts[0] == "apis"
-                        and parts[3] == "clusterqueues"
-                        and parts[5:6] == ["pendingworkloads"]):
-                    summary = api.pending_workloads_cq(parts[4], limit, offset)
-                elif (len(parts) >= 8 and parts[3] == "namespaces"
-                        and parts[5] == "localqueues"
-                        and parts[7] == "pendingworkloads"):
+                route = self._route = self._classify(path, parts)
+                if route == "unknown":
+                    return self._respond(404)
+                if plane is not None:
+                    return self._serve_from_plane(route, parts, limit,
+                                                  offset)
+                if route == "workload":
+                    # Point status queries exist only on the query plane.
+                    return self._respond(404)
+                if route == "cq_pending":
+                    summary = api.pending_workloads_cq(parts[4], limit,
+                                                       offset)
+                else:
                     summary = api.pending_workloads_lq(parts[4], parts[6],
                                                        limit, offset)
-                if summary is None:
-                    return self._respond(404)
                 self._respond(200, json.dumps(asdict(summary)).encode())
+
+            @staticmethod
+            def _classify(path: str, parts: Optional[list] = None):
+                if parts is None:
+                    parts = [p for p in path.split("/") if p]
+                if not (parts and parts[0] == "apis"):
+                    return "unknown"
+                if (len(parts) >= 6 and parts[3] == "clusterqueues"
+                        and parts[5] == "pendingworkloads"):
+                    return "cq_pending"
+                if (len(parts) >= 8 and parts[3] == "namespaces"
+                        and parts[5] == "localqueues"
+                        and parts[7] == "pendingworkloads"):
+                    return "lq_pending"
+                if (len(parts) == 7 and parts[3] == "namespaces"
+                        and parts[5] == "workloads"):
+                    return "workload"
+                return "unknown"
+
+            def _serve_from_plane(self, route, parts, limit, offset):
+                # Reader-held handout contract (ISSUE 12 satellite): the
+                # borrow is returned on EVERY path out of here — 503,
+                # 200, or a handler exception — via try/finally, so a
+                # read storm can never strand snapshot handouts
+                # (cache.live_handouts stays zero after shutdown).
+                view = plane.acquire()
+                if view is None:
+                    return self._respond(
+                        503, b"query plane warming: no admission cycle "
+                             b"sealed yet", "text/plain",
+                        headers=(("Retry-After",
+                                  str(WARMING_RETRY_AFTER_S)),))
+                try:
+                    if route == "cq_pending":
+                        rows = plane.pending_cq(view, parts[4], limit,
+                                                offset)
+                        payload = {"items": [_row_payload(r)
+                                             for r in rows]}
+                    elif route == "lq_pending":
+                        rows = plane.pending_lq(view, parts[4], parts[6],
+                                                limit, offset)
+                        payload = {"items": [_row_payload(r)
+                                             for r in rows]}
+                    else:  # workload status point query
+                        payload = plane.workload_status(view, parts[4],
+                                                        parts[6])
+                    payload.update(view.stamp())
+                    self._respond(200, json.dumps(payload).encode())
+                finally:
+                    plane.release(view)
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self.port = self._httpd.server_address[1]

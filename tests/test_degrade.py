@@ -418,7 +418,12 @@ class TestDegradeStatusSurface:
         assert st["shed_heads_requeued_total"] == 2
         assert "budget_ms" in st and "ewma_ms" in st
         ep = DebugEndpoints(s, env.scheduler.metrics)
-        assert ep.handle("/debug/degrade", {}) == degrade_status(s)
+        payload = ep.handle("/debug/degrade", {})
+        # the endpoint additionally stamps the generation token it
+        # rendered under (ISSUE 12 satellite)
+        assert payload.pop("generation") == \
+            list(s.cache.generation_token())
+        assert payload == degrade_status(s)
 
     def test_metrics_exposition_includes_degrade_series(self):
         env = _env()

@@ -339,6 +339,8 @@ class TestKillRestoreSmoke:
         # a cold-started manager reports not-restored
         cold = DebugEndpoints(mgr.scheduler, mgr.metrics).handle(
             "/debug/recovery", {})
+        assert cold.pop("generation") == \
+            list(mgr.cache.generation_token())
         assert cold == {"restored": False}
         assert "-- recovery --" not in mgr.dumper().dump()
 

@@ -199,11 +199,11 @@ class TestEventRecorderRing:
 # ----------------------------------------------------------------------
 
 class TestScenarioSmoke:
-    def test_catalog_lists_all_seven(self):
+    def test_catalog_lists_all_eight(self):
         assert list_scenarios() == ["cluster_loss", "diurnal",
                                     "flavor_churn", "mixed_jobs",
                                     "requeue_flood", "restart_storm",
-                                    "tenant_storm"]
+                                    "tenant_storm", "visibility_storm"]
 
     def test_unknown_scenario_and_scale_rejected(self):
         with pytest.raises(KeyError):
@@ -292,6 +292,20 @@ class TestScenarioSmoke:
         # lap (deactivate -> evict -> reactivate -> re-admit)
         assert set(res.counters["eviction_lap"]) == \
             {"workload", "Job", "JobSet", "PyTorchJob", "RayJob"}
+
+    def test_visibility_storm_reads_consistent_and_bounded_stale(self):
+        res = run_scenario("visibility_storm", seed=0, scale="smoke")
+        assert res.ok, res.violations
+        # the reader storm actually read, against a plane that kept
+        # publishing sealed views through the traffic
+        assert res.reads >= 50
+        assert res.counters["cycles_published"] > 0
+        assert res.counters["tables_built"] > 0
+        # structural churn happened AND every stamped response stayed
+        # within one generation of the live cache
+        assert res.counters["quota_edits"] > 0
+        assert res.read_staleness_generations is not None
+        assert res.read_staleness_generations <= 1
 
     def test_results_backend_stamped(self):
         res = run_scenario("diurnal", seed=0, scale="smoke")

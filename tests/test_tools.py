@@ -284,6 +284,160 @@ class TestTransportProbe:
         assert verdict["fetch_bytes_per_cycle_p50"] < 1000
 
 
+class TestVisibilityQueryPlaneHTTP:
+    """The snapshot-backed read plane's HTTP behavior (ISSUE 12):
+    stamped responses, warming 503s, the workload status route, and
+    the read-side saturation metrics."""
+
+    PW = ("/apis/visibility.kueue.x-k8s.io/v1alpha1/clusterqueues/cq/"
+          "pendingworkloads")
+
+    def test_responses_are_generation_stamped(self, mgr):
+        submit_n(mgr, 4)
+        mgr.schedule_until_settled()
+        server = mgr.serve_visibility()
+        try:
+            status, body = _get(server.port, self.PW)
+            assert status == 200
+            payload = json.loads(body)
+            assert [i["name"] for i in payload["items"]] == \
+                ["w1", "w2", "w3"]
+            # the staleness stamp (ISSUE 12): token + cycle + age
+            assert payload["generation"] == \
+                list(mgr.cache.generation_token())
+            assert payload["cycle"] > 0 and payload["age_s"] >= 0
+        finally:
+            server.stop()
+
+    def test_warming_returns_503_with_retry_after(self, mgr):
+        # No admission cycle has sealed yet: the plane must answer 503
+        # + Retry-After instead of blocking or serving unstamped data.
+        server = mgr.serve_visibility()
+        try:
+            import urllib.error
+            import urllib.request
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}{self.PW}", timeout=5)
+                raise AssertionError("expected 503 while warming")
+            except urllib.error.HTTPError as err:
+                assert err.code == 503
+                assert err.headers["Retry-After"] == "1"
+            # one sealed cycle later the same route serves
+            submit_n(mgr, 2)
+            mgr.schedule_until_settled()
+            assert _get(server.port, self.PW)[0] == 200
+        finally:
+            server.stop()
+
+    def test_workload_status_route(self, mgr):
+        submit_n(mgr, 3)
+        mgr.schedule_until_settled()   # w0 admits; w1/w2 pending
+        server = mgr.serve_visibility()
+        try:
+            base = "/apis/visibility.kueue.x-k8s.io/v1alpha1/namespaces"
+            status, body = _get(server.port,
+                                base + "/default/workloads/w1")
+            assert status == 200
+            st = json.loads(body)
+            assert st["found"] and st["status"] == "pending"
+            assert st["position_in_cluster_queue"] == 0
+            assert st["cluster_queue"] == "cq"
+            assert st["generation"] == list(mgr.cache.generation_token())
+            status, body = _get(server.port,
+                                base + "/default/workloads/w0")
+            st = json.loads(body)
+            assert st["found"] and st["status"] == "admitted"
+            status, body = _get(server.port,
+                                base + "/default/workloads/nope")
+            assert json.loads(body)["found"] is False
+        finally:
+            server.stop()
+
+    def test_read_side_metrics_feed_the_registry(self, mgr):
+        submit_n(mgr, 2)
+        mgr.schedule_until_settled()
+        server = mgr.serve_visibility()
+        try:
+            _get(server.port, self.PW)
+            _get(server.port, "/nope")            # 404s count too
+            _get(server.port, self.PW + "?limit=bad")  # and 400s
+            reqs = mgr.metrics.visibility_requests_total
+            assert reqs.value(route="cq_pending", code="200") == 1
+            assert reqs.value(route="unknown", code="404") == 1
+            assert reqs.value(route="cq_pending", code="400") == 1
+            assert mgr.metrics.visibility_request_seconds.count(
+                route="cq_pending") == 2
+            assert mgr.metrics.visibility_inflight_reads.value() == 0
+            # the exposition carries the new families
+            status, body = _get(server.port, "/metrics")
+            text = body.decode()
+            assert "kueue_visibility_requests_total" in text
+            assert "kueue_visibility_snapshot_age_seconds" in text
+        finally:
+            server.stop()
+
+    def test_debug_queryplane_endpoint(self, mgr):
+        submit_n(mgr, 2)
+        mgr.schedule_until_settled()
+        server = mgr.serve_visibility()
+        try:
+            status, body = _get(server.port, "/debug/queryplane")
+            assert status == 200
+            st = json.loads(body)
+            assert st["attached"] and not st["warming"]
+            assert st["cycles_published"] > 0
+            assert st["token_lag"] == 0
+            assert st["holds_snapshot_handout"] is True
+            # every /debug payload reports the token it rendered under
+            status, body = _get(server.port, "/debug/breaker")
+            assert json.loads(body)["generation"] == \
+                list(mgr.cache.generation_token())
+        finally:
+            server.stop()
+
+    def test_bare_server_keeps_live_reads(self, mgr):
+        # no query plane wired: the live path still serves (no stamp,
+        # no 503) — the conformance fallback
+        submit_n(mgr, 2)
+        mgr.schedule_until_settled()
+        server = VisibilityServer(VisibilityAPI(mgr.queues))
+        port = server.start()
+        try:
+            status, body = _get(port, self.PW)
+            assert status == 200
+            payload = json.loads(body)
+            assert "generation" not in payload and payload["items"]
+        finally:
+            server.stop()
+
+
+class TestVisibilityProbe:
+    def test_probe_smoke_stamped_reads_no_leaks(self, capsys):
+        """Tier-1 smoke for tools/visibility_probe.py (chaos_run CLI
+        contract): a tiny run must render the operator table, report a
+        parseable verdict, and find zero unstamped responses, bounded
+        token lag, and zero leaked snapshot handouts."""
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "visibility_probe",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "visibility_probe.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main(["3", "4"]) == 0
+        captured = capsys.readouterr()
+        assert "token_lag" in captured.err      # the operator table
+        verdict = json.loads(captured.out.strip().splitlines()[-1])
+        assert verdict["ok"] is True
+        assert verdict["errors"] == 0
+        assert verdict["unstamped"] == 0
+        assert verdict["max_token_lag"] <= 1
+        assert verdict["cycles_published"] > 0
+        assert verdict["live_handouts_after_shutdown"] == 0
+
+
 class TestDumper:
     def test_dump_contains_state(self, mgr):
         submit_n(mgr, 2)

@@ -404,7 +404,12 @@ class TestOperatorSurface:
 
         env = simple_env()
         ep = DebugEndpoints(env.scheduler)
-        assert ep.handle("/debug/warmup", {}) == {"attached": False}
+        unattached = ep.handle("/debug/warmup", {})
+        # the endpoint additionally stamps the generation token it
+        # rendered under (ISSUE 12 satellite)
+        assert unattached.pop("generation") == \
+            list(env.cache.generation_token())
+        assert unattached == {"attached": False}
 
         gov = CompileGovernor(StubWarmSolver(), env.cache)
         gov.run_sync()
@@ -413,6 +418,7 @@ class TestOperatorSurface:
         assert st["attached"] and st["state"] == GOV_WARM
         assert st["buckets"] and st["buckets"][0]["state"] == B_WARM
         assert st["cpu_warmup_cycles"] == 0
+        st.pop("generation")  # the endpoint's staleness stamp
         assert st == warmup_status(env.scheduler)  # one producer
 
         out = io.StringIO()

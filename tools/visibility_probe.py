@@ -1,0 +1,238 @@
+"""Visibility probe: query-plane read-path health table for operators.
+
+Drives the FULL control plane (KueueManager: sim store, controllers,
+scheduler, snapshot-backed query plane) with `serve_visibility()` bound
+to a real HTTP port, submits a few waves of traffic, and hammers the
+pending-workloads endpoints from reader threads WHILE admission cycles
+run — then prints one row per sample window:
+
+    window  reads  qps  p50_ms  p99_ms  snap_age_s  token_lag  warm  err
+
+plus a summary (total reads, latency percentiles, worst token lag vs
+the live cache, warming-503 count) read from the same producers
+/debug/queryplane serves, so the probe and the endpoint agree.
+
+Same CLI contract as tools/chaos_run.py / transport_probe.py: the
+human table (or --json report) goes to stderr, one parseable JSON
+verdict line to stdout, exit non-zero when the probe detects a
+read-plane violation — a response missing its generation stamp, worst
+token lag above one structural generation, read errors, or leaked
+snapshot handouts after shutdown.
+
+Usage: python tools/visibility_probe.py [waves] [cqs] [readers] [--json]
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kueue_tpu import config as cfgpkg  # noqa: E402
+from kueue_tpu.api import kueue as api  # noqa: E402
+from kueue_tpu.api.corev1 import (  # noqa: E402
+    Container, PodSpec, PodTemplateSpec)
+from kueue_tpu.api.meta import FakeClock, LabelSelector, ObjectMeta  # noqa: E402
+from kueue_tpu.manager import KueueManager  # noqa: E402
+
+DEFAULT_WAVES = 6
+DEFAULT_CQS = 8
+DEFAULT_READERS = 2
+
+
+def make_objects(num_cqs: int):
+    rf = api.ResourceFlavor(metadata=ObjectMeta(name="f0", uid="rf-f0"))
+    out = [rf]
+    for i in range(num_cqs):
+        cq = api.ClusterQueue(metadata=ObjectMeta(name=f"cq{i}",
+                                                  uid=f"cq-{i}"))
+        cq.spec.namespace_selector = LabelSelector()
+        cq.spec.cohort = f"cohort-{i % 2}"
+        cq.spec.resource_groups.append(api.ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[api.FlavorQuotas(name="f0", resources=[
+                api.ResourceQuota(name="cpu", nominal_quota=4000)])]))
+        lq = api.LocalQueue(metadata=ObjectMeta(
+            name=f"lq{i}", namespace="default", uid=f"lq-{i}"))
+        lq.spec.cluster_queue = f"cq{i}"
+        out += [cq, lq]
+    return out
+
+
+def make_workload(wave: int, i: int, n: int):
+    wl = api.Workload(metadata=ObjectMeta(
+        name=f"w{wave}-{i}", namespace="default", uid=f"wl-{wave}-{i}",
+        creation_timestamp=float(n)))
+    wl.spec.queue_name = f"lq{i}"
+    wl.spec.pod_sets.append(api.PodSet(
+        name="main", count=1, template=PodTemplateSpec(spec=PodSpec(
+            containers=[Container(name="c", requests={"cpu": 2000})]))))
+    return wl
+
+
+def probe(waves: int = DEFAULT_WAVES, num_cqs: int = DEFAULT_CQS,
+          readers: int = DEFAULT_READERS) -> dict:
+    cfg = cfgpkg.Configuration()
+    clock = FakeClock(1000.0)
+    mgr = KueueManager(cfg=cfg, clock=clock)
+    for obj in make_objects(num_cqs):
+        mgr.store.create(obj)
+    mgr.run_until_idle(max_iterations=1_000_000)
+    port = mgr.serve_visibility().port
+    base = f"http://127.0.0.1:{port}"
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    stats = {"reads": 0, "warming": 0, "errors": 0, "unstamped": 0,
+             "max_lag": 0, "lat": [], "windows": []}
+
+    def one_read(k: int):
+        cq = f"cq{k % num_cqs}"
+        url = (f"{base}/apis/visibility.kueue.x-k8s.io/v1alpha1/"
+               f"clusterqueues/{cq}/pendingworkloads?limit=20")
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                body = json.loads(resp.read())
+        except urllib.error.HTTPError as err:
+            with lock:
+                if err.code == 503:
+                    stats["warming"] += 1
+                else:
+                    stats["errors"] += 1
+            return
+        except Exception:
+            with lock:
+                stats["errors"] += 1
+            return
+        dt = time.perf_counter() - t0
+        token = body.get("generation")
+        lag = (mgr.cache.generation_lag(token)
+               if token is not None else None)
+        with lock:
+            stats["reads"] += 1
+            stats["lat"].append(dt)
+            if token is None:
+                stats["unstamped"] += 1
+            elif lag > stats["max_lag"]:
+                stats["max_lag"] = lag
+
+    def reader(idx: int):
+        k = idx
+        while not stop.is_set():
+            one_read(k)
+            k += readers
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=reader, args=(i,), daemon=True)
+               for i in range(readers)]
+    for t in threads:
+        t.start()
+
+    n = 0
+    try:
+        for wave in range(waves):
+            w0 = time.perf_counter()
+            r0 = stats["reads"]
+            for i in range(num_cqs):
+                mgr.store.create(make_workload(wave, i, n))
+                n += 1
+            mgr.run_until_idle(max_iterations=1_000_000)
+            mgr.scheduler.schedule(timeout=0)
+            mgr.run_until_idle(max_iterations=1_000_000)
+            clock.advance(1.0)
+            dt = time.perf_counter() - w0
+            with lock:
+                wreads = stats["reads"] - r0
+                lat = sorted(stats["lat"][-wreads:]) if wreads else []
+            qp = mgr.query_plane.status()
+            stats["windows"].append({
+                "window": wave, "reads": wreads,
+                "qps": round(wreads / max(dt, 1e-9), 1),
+                "p50_ms": round(lat[len(lat) // 2] * 1e3, 2)
+                if lat else None,
+                "p99_ms": round(
+                    lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 2)
+                if lat else None,
+                "snap_age_s": qp.get("age_s"),
+                "token_lag": qp.get("token_lag"),
+                "warming": stats["warming"], "errors": stats["errors"]})
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+    lat = sorted(stats["lat"])
+
+    def pct(q):
+        if not lat:
+            return None
+        return round(lat[min(len(lat) - 1, int(q * len(lat)))] * 1e3, 2)
+
+    plane_status = mgr.query_plane.status()
+    mgr.shutdown(checkpoint=False)
+    report = {
+        "waves": waves, "cqs": num_cqs, "readers": readers,
+        "reads": stats["reads"], "warming_503s": stats["warming"],
+        "errors": stats["errors"], "unstamped": stats["unstamped"],
+        "read_p50_ms": pct(0.5), "read_p99_ms": pct(0.99),
+        "max_token_lag": stats["max_lag"],
+        "cycles_published": plane_status["cycles_published"],
+        "tables_built": plane_status["tables_built"],
+        "live_handouts_after_shutdown": mgr.cache.live_handouts,
+        "windows": stats["windows"],
+    }
+    return report
+
+
+def render_table(report: dict) -> str:
+    head = (f"{'window':>6} {'reads':>6} {'qps':>8} {'p50_ms':>7} "
+            f"{'p99_ms':>7} {'snap_age_s':>10} {'token_lag':>9} "
+            f"{'warm':>5} {'err':>4}")
+    lines = [head, "-" * len(head)]
+    for w in report["windows"]:
+        lines.append(
+            f"{w['window']:>6} {w['reads']:>6} {w['qps']:>8} "
+            f"{w['p50_ms'] if w['p50_ms'] is not None else '-':>7} "
+            f"{w['p99_ms'] if w['p99_ms'] is not None else '-':>7} "
+            f"{w['snap_age_s'] if w['snap_age_s'] is not None else '-':>10} "
+            f"{w['token_lag'] if w['token_lag'] is not None else '-':>9} "
+            f"{w['warming']:>5} {w['errors']:>4}")
+    lines.append("-" * len(head))
+    lines.append(
+        f"reads: {report['reads']}  p50: {report['read_p50_ms']}ms  "
+        f"p99: {report['read_p99_ms']}ms  max token lag: "
+        f"{report['max_token_lag']}  warming 503s: "
+        f"{report['warming_503s']}  errors: {report['errors']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    waves = int(argv[0]) if len(argv) > 0 else DEFAULT_WAVES
+    num_cqs = int(argv[1]) if len(argv) > 1 else DEFAULT_CQS
+    readers = int(argv[2]) if len(argv) > 2 else DEFAULT_READERS
+    report = probe(waves, num_cqs, readers)
+    if as_json:
+        print(json.dumps(report), file=sys.stderr, flush=True)
+    else:
+        print(render_table(report), file=sys.stderr, flush=True)
+    verdict = {k: v for k, v in report.items() if k != "windows"}
+    verdict["ok"] = (report["errors"] == 0
+                     and report["unstamped"] == 0
+                     and report["max_token_lag"] <= 1
+                     and report["reads"] > 0
+                     and report["live_handouts_after_shutdown"] == 0)
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2171,6 +2171,134 @@ def bench_restart_recovery(num_cqs=16, num_cohorts=4, waves=4,
     return cold["restore_wall_s"], primed["restore_wall_s"]
 
 
+def bench_multihost():
+    """ISSUE 13 MULTICHIP multi-host row: the weak-scaling curve
+    (conflict domains per device held constant across 1/2/4/8 simulated
+    hosts, via a subprocess forcing the host-platform device count
+    before jax initializes) plus the cluster-column scoring cost at the
+    north-star single-chip shape with simulated remote clusters.
+
+    Target scenario: 1M pending workloads x 16k CQs x 32 flavors across
+    simulated remote clusters. On anything but a real multi-host device
+    deployment the sub-linear weak-scaling gate REFUSES judgement into
+    the device-witness-debt manifest (simulated hosts share one
+    machine's cores — total work grows with hosts while the hardware
+    does not, so sub-linearity is physically unwitnessable here); the
+    measured curve, layout balance and identity verdict are still
+    recorded."""
+    import subprocess
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kueue_tpu.perf.checker import record_refusal
+    from kueue_tpu.solver.kernel import max_rank_bound, solve_cycle_fused
+    from kueue_tpu.solver.synth import synth_solver_inputs
+
+    row = {
+        "bench": "multihost_scaling",
+        "target_scenario": {"pending": 1_000_000, "cqs": 16_384,
+                            "flavors": 32, "remote_clusters": 4,
+                            "hosts": [1, 2, 4, 8]},
+    }
+    probe_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools", "mesh_probe.py")
+    verdict = None
+    try:
+        out = subprocess.run(
+            [sys.executable, probe_path, "--hosts", "1,2,4,8",
+             "--devices", "8", "--cqs-per-host", "256",
+             "--wl-per-host", "512", "--cycles", "4",
+             "--check-identity", "--json"],
+            capture_output=True, text=True, timeout=560,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        verdict = json.loads(out.stdout.strip().splitlines()[-1])
+        row["weak_scaling"] = verdict.get("weak_scaling")
+        row["max_imbalance"] = verdict.get("max_imbalance")
+        row["identity_failures"] = verdict.get("identity_failures")
+        row["curve"] = [
+            {k: r.get(k) for k in ("hosts", "devices", "occupied_domains",
+                                   "planner_imbalance", "cycle_s_p50")}
+            for r in verdict.get("rows", [])]
+        row["probe_ok"] = bool(verdict.get("ok"))
+    except Exception as exc:  # noqa: BLE001 — probe ENV trouble: record
+        row["probe_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    if verdict is not None:
+        # The acceptance gates live OUTSIDE the env-trouble containment:
+        # a probe that RAN and found divergence or imbalance must fail
+        # the bench, not file a probe_error.
+        assert not verdict.get("identity_failures"), \
+            "multi-host decisions diverge from the single-chip oracle"
+        assert verdict.get("max_imbalance", 99.0) <= 1.5, \
+            f"planner imbalance {verdict.get('max_imbalance')} > 1.5x"
+
+    # Sub-linear weak scaling is a MULTI-HOST DEVICE property; judge it
+    # only there (SUFFIX: simulated hosts on one machine refuse).
+    ws = row.get("weak_scaling")
+    if BACKEND.get("cpu_fallback", True) or BACKEND.get("backend") != "tpu":
+        note = ("weak-scaling sub-linearity requires real multi-host "
+                "devices; simulated hosts share one machine's cores "
+                f"(backend={BACKEND.get('backend')})")
+        record_refusal("bench.multihost_scaling", "weak_scaling_sublinear",
+                       note, spec_backend="tpu-multihost")
+        row["weak_scaling_gate"] = {"refused": note}
+    elif ws is not None:
+        assert ws["sublinear"], \
+            f"cycle time grew {ws['cycle_time_growth']:.2f}x over " \
+            f"{ws['domain_growth']:.0f}x domains"
+        row["weak_scaling_gate"] = {"ok": True}
+
+    # Cluster-column scoring cost at the single-chip north-star shape:
+    # the fused solve with K=4 simulated remote-cluster columns vs
+    # without (the marginal cost of scoring cross-cluster placement
+    # inside the same execute).
+    topo, usage, cohort_usage, wl = synth_solver_inputs(
+        num_cqs=NUM_CQS, num_cohorts=NUM_COHORTS, num_flavors=NUM_FLAVORS,
+        num_resources=NUM_RESOURCES, num_workloads=HEADS, seed=42)
+    topo_dev = {k: jnp.asarray(v) for k, v in topo.items()}
+    args = (jnp.asarray(usage), jnp.asarray(cohort_usage),
+            jnp.asarray(wl["requests"]), jnp.asarray(wl["podset_active"]),
+            jnp.asarray(wl["wl_cq"]), jnp.asarray(wl["priority"]),
+            jnp.asarray(wl["timestamp"]), jnp.asarray(wl["eligible"]),
+            jnp.asarray(wl["solvable"]))
+    max_rank = max_rank_bound(wl["wl_cq"], topo["cq_cohort"],
+                              topo["cohort_root"])
+    Q, F, R = topo["nominal"].shape
+    K = 4
+    rng = np.random.default_rng(7)
+    cargs = (jnp.asarray(rng.integers(0, 10**7, size=(K, F, R))
+                         .astype(np.int64)),
+             jnp.asarray(np.ones((K, F, R), bool)),
+             jnp.asarray(np.ones(K, bool)),
+             jnp.asarray(np.ones(Q, bool)))
+
+    def run(with_cols):
+        out = solve_cycle_fused(topo_dev, *args, num_podsets=1,
+                                max_rank=max_rank,
+                                cluster_args=cargs if with_cols else None)
+        return int(np.asarray(out["admitted"]).sum())
+
+    times = {}
+    for with_cols in (False, True):
+        run(with_cols)  # compile
+        samples = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            run(with_cols)
+            samples.append(time.perf_counter() - t0)
+        times[with_cols] = p50(samples)
+    row["cluster_columns"] = {
+        "k": K,
+        "solve_p50_ms": round(times[False] * 1e3, 2),
+        "solve_with_columns_p50_ms": round(times[True] * 1e3, 2),
+        "scoring_overhead_x": round(times[True] / max(times[False], 1e-9),
+                                    3),
+    }
+    log(row)
+    return row
+
+
 def main():
     import jax
     from kueue_tpu.perf import checker as checkerpkg
@@ -2190,6 +2318,7 @@ def main():
     bench_visibility_storm()
     bench_cold_start()
     bench_restart_recovery()
+    bench_multihost()
     hit_rate = bench_speculative_pipeline()
     rows = {}
     admitted_per_sec, speedup = bench_e2e_progressive()

@@ -88,6 +88,15 @@ class Cache:
         self._journal: list = []
         self._journal_seq = 0
         self._journal_cap = 200_000
+        # MultiKueue remote-cluster capacity source (ISSUE 13): a
+        # callable returning (columns tuple, mk check-name frozenset) —
+        # the manager wires it to MultiKueueController.capacity_columns
+        # when remote clusters exist. Every snapshot handout (full AND
+        # light) is stamped with the current columns, so the solver can
+        # score cross-cluster placement inside the fused solve and a
+        # lost cluster's columns mask to zero on the next snapshot.
+        self.remote_capacity_source = None
+        self._remote_columns_cache = None  # last FULL snapshot's stamp
         self._journal_cursors: dict = {}  # consumer -> consumed-up-to seq
         self._journal_overflowed: set = set()  # consumers that lost entries
         self._journal_aux_stripped = 0  # aux dropped for seqs <= this
@@ -679,22 +688,55 @@ class Cache:
                         SNAPSHOT_CONSUMER, 0)
                     if backlog > self._journal_cap // 2:
                         self._maintainer.catch_up()
-                return self._build_snapshot(light=True)
-            t0 = _time.perf_counter()
-            if self._maintainer is not None:
-                snap, mode = self._maintainer.advance()
+                snap = self._build_snapshot(light=True)
             else:
-                snap, mode = self._build_snapshot(), "full"
-            self.snapshot_stats[mode] += 1
-            if len(self.snapshot_build_s) >= (1 << 20):
-                # Bound the sample buffer on very long runs; late samples
-                # (steady state) are the ones the percentiles should
-                # reflect anyway.
-                del self.snapshot_build_s[:1 << 19]
-            self.snapshot_build_s.append(_time.perf_counter() - t0)
-            self.handouts_taken += 1
-            snap._handout_live = True
+                t0 = _time.perf_counter()
+                if self._maintainer is not None:
+                    snap, mode = self._maintainer.advance()
+                else:
+                    snap, mode = self._build_snapshot(), "full"
+                self.snapshot_stats[mode] += 1
+                if len(self.snapshot_build_s) >= (1 << 20):
+                    # Bound the sample buffer on very long runs; late
+                    # samples (steady state) are the ones the
+                    # percentiles should reflect anyway.
+                    del self.snapshot_build_s[:1 << 19]
+                self.snapshot_build_s.append(_time.perf_counter() - t0)
+                self.handouts_taken += 1
+                snap._handout_live = True
+        # OUTSIDE the cache lock: the capacity source reads the local
+        # Store and the remote managers' caches — taking Store._lock
+        # while holding Cache._lock would invert the store-watch
+        # handlers' Store._lock -> Cache._lock order (AB-BA risk in
+        # threaded deployments).
+        return self._stamp_remote(snap, light=light)
+
+    def _stamp_remote(self, snap: Snapshot, light: bool = False) -> Snapshot:
+        """Attach the current remote-cluster capacity columns (read-only
+        per handout; the source rebuilds the tuple on change). Called
+        WITHOUT the cache lock held — see snapshot(). LIGHT snapshots
+        (the depth-2 pipelined all-fit hot path takes one per cycle)
+        reuse the last FULL snapshot's columns instead of re-walking
+        every remote cache + the plan table — capacity is an advisory
+        score, stale by at most one sync cycle there."""
+        src = self.remote_capacity_source
+        if src is None:
             return snap
+        cached = self._remote_columns_cache
+        if light and cached is not None:
+            snap.remote_clusters, snap.mk_check_names = cached
+            return snap
+        try:
+            cols, checks = src()
+        except Exception:  # noqa: BLE001 — capacity is advisory
+            # A torn read during remote churn degrades to "no columns
+            # this cycle" (placement falls back to the controller's
+            # mirror-to-all race), never a failed cycle.
+            cols, checks = (), frozenset()
+        self._remote_columns_cache = (cols, checks)
+        snap.remote_clusters = cols
+        snap.mk_check_names = checks
+        return snap
 
     def release_snapshot(self, snap: Snapshot) -> None:
         """Optional hint that the caller will never read or mutate

@@ -240,6 +240,16 @@ class Snapshot:
     topology_epoch: int = 0  # solver-topology version (Cache.topology_epoch)
     journal_seq: int = 0  # usage-journal position at snapshot time
     light: bool = False  # shared (not cloned) state; read-only consumers
+    # MultiKueue remote-cluster capacity columns (ISSUE 13): an ordered
+    # tuple of (cluster_name, {(flavor, resource): available}, active)
+    # stamped by Cache.snapshot() from the wired capacity source.
+    # Lost clusters stamp active=False — their columns mask to zero in
+    # the solve, so re-placement falls out of the next cycle's scoring.
+    # Immutable per handout (the source rebuilds the tuple on change).
+    remote_clusters: tuple = ()
+    # AdmissionCheck names controlled by the multikueue controller —
+    # lets the encoder mark which CQs route through the columns.
+    mk_check_names: frozenset = frozenset()
 
     def remove_workload(self, wl: wlpkg.Info) -> None:
         """Simulate removal (reference: snapshot.go:39)."""

@@ -24,6 +24,7 @@ from typing import Optional
 
 from kueue_tpu.api import autoscaling as asapi
 from kueue_tpu.api import kueue as api
+from kueue_tpu.api.corev1 import RESOURCE_PODS
 from kueue_tpu.api.meta import Condition, find_condition, is_condition_true, set_condition
 from kueue_tpu.core import workload as wlpkg
 from kueue_tpu.sim import DELETED, Store
@@ -90,6 +91,24 @@ class BatchJobAdapter(MultiKueueAdapter):
 ADAPTERS = {"Job": BatchJobAdapter()}
 
 
+def _remote_available(cache) -> dict:
+    """{(flavor, resource): available} across a worker cluster's CQs:
+    nominal minus usage, clamped at zero, summed per flavor-resource —
+    the capacity envelope one column of the batched placement solve
+    offers. Reads under the remote cache's lock (worker managers never
+    lock back into the local one, so the order is acyclic)."""
+    caps: dict = {}
+    with cache._lock:
+        for cqc in cache.hm.cluster_queues.values():
+            rn = cqc.resource_node
+            for fr, quota in rn.quotas.items():
+                avail = quota.nominal - rn.usage.get(fr, 0)
+                if avail > 0:
+                    key = (fr.flavor, fr.resource)
+                    caps[key] = caps.get(key, 0) + avail
+    return caps
+
+
 class MultiKueueController:
     def __init__(self, store: Store, recorder, clock,
                  remote_clusters: Optional[dict] = None,
@@ -117,6 +136,19 @@ class MultiKueueController:
         # mirror is deleted by the first-wins branch instead) — the
         # no-double-dispatch invariant under cluster loss/rejoin.
         self._reserving: dict = {}
+        # wl key -> cluster the BATCHED solve chose (ISSUE 13): the
+        # admission cycle scores remote clusters as capacity columns
+        # (kernel.score_cluster_columns_impl / the scheduler's host
+        # oracle) and forwards decisions here via note_placement. A
+        # planned workload mirrors ONLY to its chosen cluster — this
+        # controller becomes the executor of device-made decisions
+        # instead of racing mirrors across the fleet per workload.
+        # Un-planned workloads keep the reference's mirror-to-all race.
+        self.planned: dict = {}
+        self._planned_at: dict = {}    # wl key -> decision time (staleness)
+        self.placements_planned = 0    # decisions received
+        self.placements_executed = 0   # single-cluster mirrors performed
+        self.placements_expired = 0    # plans dropped to the mirror race
         self._ctrl = None  # workqueue handle, set by setup_*
 
     def _remote_store(self, cluster_name: str) -> Optional[Store]:
@@ -168,6 +200,83 @@ class MultiKueueController:
         for wl in self.store.list("Workload", copy_objects=False):
             self._ctrl.enqueue(wlpkg.key(wl))
 
+    # -- batched placement (capacity columns of the solve) ---------------
+
+    def note_placement(self, wl_key: str, cluster_name: str) -> None:
+        """Record a solve-made placement decision (scheduler hook). The
+        next reconcile of this workload mirrors only to the chosen
+        cluster. Idempotent; later decisions overwrite earlier ones
+        (a re-placed workload after cluster loss gets a fresh choice)."""
+        self.planned[wl_key] = cluster_name
+        self._planned_at[wl_key] = self.clock.now()
+        self.placements_planned += 1
+        if self._ctrl is not None:
+            self._ctrl.enqueue(wl_key)
+
+    def capacity_columns(self) -> tuple:
+        """(columns, mk_check_names) for Cache snapshot stamping:
+        columns is an ordered tuple of
+        (cluster_name, {(flavor, resource): available}, active) in
+        sorted-name order — the scoring order the batched solve, the
+        host oracle and the planned-mirror path all share. Lost or
+        unregistered clusters stamp active=False with NO capacity: the
+        column masks to zero on the next snapshot, so re-placement of
+        their workloads falls out of the next cycle's scoring.
+
+        In-flight debit: a plan the remote has not RESERVED yet is
+        capacity the remote usage read can't see (the mirror is still
+        queueing there), so its request is consumed from the columns
+        via the shared placement rule — without this, consecutive
+        cycles would pile every head onto the same already-chosen
+        cluster while its siblings sit idle."""
+        cols = []
+        for name in sorted(self.remote_clusters):
+            active = self.cluster_active(name)
+            caps: dict = {}
+            remote = self.remote_clusters.get(name)
+            cache = getattr(remote, "cache", None)
+            if active and cache is not None:
+                caps = _remote_available(cache)
+            cols.append((name, caps, active))
+        cols = tuple(cols)
+        reqs, pinned = [], []
+        covers_pods_memo: dict = {}
+        # list(): reconcile pops plans concurrently in threaded
+        # deployments — a mid-iteration mutation must not tear the
+        # whole stamp down to "no columns this cycle".
+        for key, cluster in list(self.planned.items()):
+            if self._reserving.get(key) is not None:
+                # Reserved ANYWHERE: the remote usage read covers it
+                # (and if it reserved off-plan, debiting the planned
+                # column would be wrong — reconcile drops such plans).
+                continue
+            namespace, wname = key.split("/", 1)
+            wl = self.store.try_get("Workload", namespace, wname)
+            if wl is None or not wlpkg.has_quota_reservation(wl):
+                continue
+            info = wlpkg.Info(wl)
+            # the debit must consume the SAME request vector the
+            # placement scored (wlpkg.mk_request_vector is the one
+            # shared fold): pods included when the local CQ covers it
+            covers = covers_pods_memo.get(info.cluster_queue)
+            if covers is None:
+                cq = self.store.try_get("ClusterQueue", "",
+                                        info.cluster_queue)
+                covers = cq is not None and any(
+                    RESOURCE_PODS in rg.covered_resources
+                    for rg in cq.spec.resource_groups)
+                covers_pods_memo[info.cluster_queue] = covers
+            reqs.append(wlpkg.mk_request_vector(info, covers))
+            pinned.append(cluster)
+        if reqs:
+            from kueue_tpu.solver.encode import consume_remote_dicts
+            cols = consume_remote_dicts(cols, reqs, pinned)
+        checks = frozenset(
+            ac.metadata.name
+            for ac in self.store.list("AdmissionCheck", copy_objects=False)
+            if ac.spec.controller_name == CONTROLLER_NAME)
+        return cols, checks
+
     # -- check/config resolution ----------------------------------------
 
     def _check_for(self, wl: api.Workload) -> Optional[str]:
@@ -212,10 +321,14 @@ class MultiKueueController:
         # Sticky placement: probe the recorded reserving cluster first,
         # so a rejoined cluster holding a stale reserved mirror cannot
         # out-rank the workload's current placement (no double
-        # dispatch; the stale mirror is GC'd below instead).
+        # dispatch; the stale mirror is GC'd below instead). The
+        # solve-planned cluster probes next — with a planned single
+        # mirror it is the only cluster that can be reserving anyway.
         recorded = self._reserving.get(wlpkg.key(wl))
-        ordered = ([recorded] + [c for c in clusters if c != recorded]
-                   if recorded in clusters else clusters)
+        planned = self.planned.get(wlpkg.key(wl))
+        head = [c for c in (recorded, planned) if c in clusters]
+        ordered = head + [c for c in clusters if c not in head] \
+            if head else clusters
         for cluster in ordered:
             remote = self._remote_store(cluster)
             if remote is None:
@@ -235,6 +348,10 @@ class MultiKueueController:
                 return float(remaining)
             self._lost_since.pop(wlpkg.key(wl), None)
             self._reserving.pop(wlpkg.key(wl), None)
+            # the plan died with the worker: the next admission cycle
+            # re-scores the workload against the masked columns
+            self.planned.pop(wlpkg.key(wl), None)
+            self._planned_at.pop(wlpkg.key(wl), None)
             wlpkg.set_admission_check_state(
                 wl.status.admission_checks,
                 api.AdmissionCheckState(
@@ -246,6 +363,14 @@ class MultiKueueController:
 
         if reserving is not None:
             self._reserving[wlpkg.key(wl)] = reserving
+            if self.planned.get(wlpkg.key(wl)) not in (None, reserving):
+                # Reality disagrees with the plan (the planned cluster
+                # was lost and the mirror race placed elsewhere): drop
+                # the stale plan, or capacity_columns would debit the
+                # planned cluster's column for this workload's whole
+                # lifetime.
+                self.planned.pop(wlpkg.key(wl), None)
+                self._planned_at.pop(wlpkg.key(wl), None)
             # first reservation wins: drop the other mirrors and their jobs
             adapter = self._adapter_for(wl)
             owner = next((o for o in wl.metadata.owner_references
@@ -278,8 +403,36 @@ class MultiKueueController:
                 self.store.update(wl)
             return None
 
-        # no remote reservation yet: mirror to every cluster
-        for cluster in clusters:
+        # No remote reservation yet: with a solve-planned placement,
+        # mirror ONLY to the chosen cluster — the per-workload
+        # mirror-everywhere race (and its K-1 mirror deletions on the
+        # win) leaves the admission hot path. A plan naming a cluster
+        # that is currently lost/inactive falls back to the reference's
+        # mirror-to-all race until the next cycle re-scores the
+        # workload against the masked columns. Starvation bound: a plan
+        # whose cluster never reserves within the worker-lost timeout
+        # (wedged remote, capacity the scoring over-estimated) EXPIRES
+        # back to the race — the planned path can delay cross-cluster
+        # placement, never strand it.
+        targets = clusters
+        single_mirror = False
+        requeue_after = None
+        if planned is not None and planned in clusters:
+            age = now - self._planned_at.get(wlpkg.key(wl), now)
+            if age > self.worker_lost_timeout:
+                self.planned.pop(wlpkg.key(wl), None)
+                self._planned_at.pop(wlpkg.key(wl), None)
+                self.placements_expired += 1
+            else:
+                targets = [planned]
+                single_mirror = True
+                # Schedule the expiry check: a planned cluster that
+                # never reserves produces NO watch events, so without a
+                # timed requeue the age gate above could never fire and
+                # the workload would strand on one pending mirror —
+                # the bounded-starvation contract needs the timer.
+                requeue_after = float(self.worker_lost_timeout - age) + 1.0
+        for cluster in targets:
             remote = self._remote_store(cluster)
             if remote is None:
                 continue  # lost: mirrored on rejoin via _requeue_all
@@ -288,12 +441,17 @@ class MultiKueueController:
                 clone = self._clone_for_remote(wl)
                 try:
                     remote.create(clone)
+                    if single_mirror:
+                        # counted per mirror actually CREATED on the
+                        # planned cluster — re-reconciles of an
+                        # existing mirror don't inflate the surface
+                        self.placements_executed += 1
                 except AlreadyExists:
                     pass
             adapter = self._adapter_for(wl)
             if adapter is not None:
                 adapter.sync_job(self.store, remote, wl, self.origin)
-        return None
+        return requeue_after
 
     def _adapter_for(self, wl: api.Workload) -> Optional[MultiKueueAdapter]:
         owner = next((o for o in wl.metadata.owner_references if o.controller), None)
@@ -333,6 +491,8 @@ class MultiKueueController:
         Lost clusters are skipped (unreachable); their stale mirrors
         are collected by the periodic gc_orphans pass after rejoin."""
         self._reserving.pop(f"{namespace}/{name}", None)
+        self.planned.pop(f"{namespace}/{name}", None)
+        self._planned_at.pop(f"{namespace}/{name}", None)
         for cluster in list(self.remote_clusters):
             self._delete_mirror(cluster, namespace, name)
 

@@ -203,6 +203,24 @@ def _total_requests_from_admission(wl: api.Workload) -> list:
     return out
 
 
+def mk_request_vector(info: "Info", covers_pods: bool) -> dict:
+    """Per-resource totals of an Info's pod sets, with the pods
+    resource folded in when the CQ covers it — the ONE request vector
+    the MultiKueue capacity-column machinery uses (ISSUE 13): the
+    placement scoring (scheduler's flush / the fused solve's encode)
+    and the controller's in-flight capacity debit MUST consume the
+    same vector, or consecutive cycles would score against capacity
+    the debit never consumed."""
+    from kueue_tpu.api.corev1 import RESOURCE_PODS
+    tot: dict = {}
+    for psr in info.total_requests:
+        for r, v in psr.requests.items():
+            tot[r] = tot.get(r, 0) + v
+        if covers_pods:
+            tot[RESOURCE_PODS] = tot.get(RESOURCE_PODS, 0) + psr.count
+    return tot
+
+
 # --- status transitions (reference: workload.go:346-623) ---
 
 def is_active(wl: api.Workload) -> bool:

@@ -194,6 +194,15 @@ class KueueManager:
             clock=clock, metrics=self.metrics, solver=solver,
             solver_min_heads=self.cfg.solver.min_heads,
             recorder=self.flight_recorder)
+        # MultiKueue batched-column placement wiring (ISSUE 13): the
+        # cache stamps every snapshot with the controller's remote
+        # capacity columns, the admission cycle scores them (fused
+        # solve on device routes, the identical sequential oracle on
+        # CPU routes), and the controller executes the decisions (one
+        # mirror per workload instead of the mirror-everywhere race).
+        if remote_clusters:
+            self.cache.remote_capacity_source = self.multikueue.capacity_columns
+            self.scheduler.on_placement = self.multikueue.note_placement
         self.visibility_server = None  # started by serve_visibility()
         # Snapshot-backed query plane (obs/queryplane.py + ISSUE 12):
         # every cycle seal publishes an immutable pending-position /

@@ -1,2 +1,8 @@
-"""Multi-chip execution: device meshes and the cohort-parallel sharded
-solve (jax.sharding + shard_map over ICI/DCN)."""
+"""Multi-chip / multi-host execution: device meshes, the first-class
+conflict-domain planner, and the cohort-parallel sharded solve
+(jax.sharding + shard_map over ICI/DCN). Design doc: MESH.md.
+
+``domains`` is import-light (numpy only) — the planner is usable from
+host-side tooling without initializing a jax backend; ``mesh`` pulls in
+jax on first import.
+"""

@@ -230,6 +230,13 @@ class SLOSpec:
     # recorded no staleness samples is itself a violation).
     min_reads: int = 0
     max_read_staleness_generations: Optional[int] = None
+    # MultiKueue batched-column re-placement (ISSUE 13): max VIRTUAL
+    # seconds from a worker-cluster loss to the LAST affected workload
+    # re-reserving on a surviving cluster (the cluster_rebalance
+    # scenario stamps result.replacement_latency_s). None = unchecked;
+    # with a bound set, a run whose survivors never re-placed is
+    # itself a violation.
+    max_replacement_latency_s: Optional[float] = None
 
 
 def check_slo(result, spec: SLOSpec) -> list:
@@ -303,6 +310,16 @@ def check_slo(result, spec: SLOSpec) -> list:
                 f"worst read staleness {worst_lag} structural "
                 f"generation(s) exceeds bound "
                 f"{spec.max_read_staleness_generations}")
+    if spec.max_replacement_latency_s is not None:
+        lat = getattr(result, "replacement_latency_s", None)
+        if lat is None:
+            violations.append(
+                "re-placement bound set but the run recorded no "
+                "re-placement (survivors never re-reserved)")
+        elif lat > spec.max_replacement_latency_s:
+            violations.append(
+                f"cluster-loss re-placement took {lat:.1f}s, bound "
+                f"{spec.max_replacement_latency_s:.1f}s")
     return violations
 
 

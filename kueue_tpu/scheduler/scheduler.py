@@ -296,6 +296,18 @@ class Scheduler:
         # Crash-restart recovery (resilience/recovery.py): restore()
         # stamps its report here for /debug/recovery and the dumper.
         self.last_recovery: Optional[dict] = None
+        # MultiKueue batched-column placement (ISSUE 13): when the
+        # manager wires on_placement (to MultiKueueController.
+        # note_placement), every admitted workload whose CQ routes
+        # through a multikueue check gets a cluster choice AT ADMISSION
+        # TIME — device-routed cycles take the fused solve's mk_cluster
+        # column, CPU-routed cycles run the identical sequential oracle
+        # (encode.place_remote_dicts) against the snapshot's capacity
+        # columns — and the controller mirrors only to that cluster,
+        # eliminating the per-workload mirror-everywhere race from the
+        # admission hot path.
+        self.on_placement: Optional[Callable[[str, str], None]] = None
+        self._mk_admits: list = []  # (Info, cq snapshot) this apply stage
         # HA: only the leader runs admission cycles (reference:
         # NeedLeaderElection, scheduler.go:144). None = standalone.
         self.leader_check: Optional[Callable[[], bool]] = None
@@ -680,6 +692,7 @@ class Scheduler:
                 self.admit(e, cq)
             except Exception as exc:  # noqa: BLE001 — cache/API races surface here
                 e.inadmissible_msg = f"Failed to admit workload: {exc}"
+        self._flush_mk_placements(snapshot)
         self._span("apply", t_ph)
 
     def _stage_requeue(self, nom: stages.NominatedCycle) -> stages.AppliedCycle:
@@ -1554,6 +1567,7 @@ class Scheduler:
                 e.inadmissible_msg = f"Failed to admit workload: {exc}"
                 self._solver_note_unapplied(w.key)
             entries.append(e)
+        self._flush_mk_placements(snapshot)
         self._span("apply", t_ph)
         if any_nonfit:
             self._pipeline_cooldown = 1
@@ -2262,6 +2276,12 @@ class Scheduler:
         self.cache.assume_workload(new_wl, info=wlpkg.Info.from_assignment(
             new_wl, e.info.cluster_queue, e.assignment))
         e.status = ASSUMED
+        if self.on_placement is not None:
+            # Batched-column MultiKueue placement: remember the admit
+            # ORDER (the oracle's intra-cycle capacity accounting is
+            # order-dependent); _flush_mk_placements filters to CQs
+            # that actually route through a multikueue check.
+            self._mk_admits.append((e.info, cq))
 
         def apply():
             # Crash window between the cache assumption above and the
@@ -2293,6 +2313,42 @@ class Scheduler:
                                   f"wait time since reservation was 0s")
 
         self.admission_routine(apply)
+
+    def _flush_mk_placements(self, snapshot: Snapshot) -> None:
+        """Resolve this apply stage's MultiKueue placements and forward
+        them to the controller (ISSUE 13 batched columns). Device-routed
+        cycles pin the fused solve's mk_cluster decisions; the remaining
+        (CPU-nominated) admissions run the identical sequential oracle
+        against the snapshot's capacity columns, CONTINUING from the
+        device's intra-cycle accounting — one consistent greedy per
+        cycle, zero per-workload controller probing on the hot path."""
+        admits, self._mk_admits = self._mk_admits, []
+        if self.on_placement is None or not admits:
+            return
+        cols = getattr(snapshot, "remote_clusters", ())
+        checks = getattr(snapshot, "mk_check_names", frozenset())
+        if not cols or not checks:
+            return
+        from kueue_tpu.api.corev1 import RESOURCE_PODS
+        from kueue_tpu.solver import encode as solver_encode
+        device = getattr(self.solver, "last_placements", None) or {}
+        mk, reqs, pinned = [], [], []
+        for info, cq in admits:
+            if checks.isdisjoint(cq.admission_checks):
+                continue
+            covers_pods = any(RESOURCE_PODS in rg.covered_resources
+                              for rg in cq.resource_groups)
+            mk.append(info)
+            # the one shared request-vector fold (the controller's
+            # in-flight debit consumes the identical vector)
+            reqs.append(wlpkg.mk_request_vector(info, covers_pods))
+            pinned.append(device.get(info.key))
+        if not mk:
+            return
+        placed = solver_encode.place_remote_dicts(cols, reqs, pinned=pinned)
+        for info, cluster in zip(mk, placed):
+            if cluster is not None:
+                self.on_placement(info.key, cluster)
 
     def _apply_preemption(self, wl: api.Workload, preempting_cq: str,
                           reason: str, message: str) -> None:

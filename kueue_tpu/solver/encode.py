@@ -467,6 +467,163 @@ def encode_workloads(entries: list, snapshot: Snapshot, topo: Topology,
 
 
 # ---------------------------------------------------------------------------
+# MultiKueue remote clusters as flavor-capacity columns (ISSUE 13)
+# ---------------------------------------------------------------------------
+#
+# Snapshot.remote_clusters carries each worker cluster's available
+# capacity as {(flavor, resource): quantity}; the encoder folds them
+# into [K,F,R] tensors in the LOCAL topology's flavor/resource index
+# space (capacity on flavors/resources unknown locally is unscorable
+# and ignored — workers in a MultiKueue fleet share the flavor
+# vocabulary, SURVEY.md §2.7). kernel.score_cluster_columns_impl scores
+# the columns inside the fused solve; place_remote_dicts is the
+# sequential host oracle with the IDENTICAL placement rule — the
+# scheduler uses it on CPU-routed cycles, and the differential tests
+# pin device == oracle bit-for-bit.
+
+
+@dataclass
+class ClusterColumns:
+    """Encoded remote-cluster capacity columns for one cycle."""
+
+    names: tuple = ()                 # K_real cluster names, column order
+    ccap: np.ndarray = None           # [K,F,R] int64 available capacity
+    coffer: np.ndarray = None         # [K,F,R] bool — (f,r) offered
+    cactive: np.ndarray = None        # [K] bool — reachable
+    mk_cq: np.ndarray = None          # [Q] bool — CQ has a mk check
+
+
+def encode_cluster_columns(snapshot: Snapshot,
+                           topo: Topology) -> Optional[ClusterColumns]:
+    """Snapshot remote-cluster capacities -> column tensors, or None
+    when the snapshot carries no remote clusters. K is bucketed
+    (factor 2, like the other topology dims); padding columns are
+    inactive and offer nothing."""
+    remotes = getattr(snapshot, "remote_clusters", ())
+    if not remotes:
+        return None
+    _, F, R = topo.nominal.shape
+    Q = topo.nominal.shape[0]
+    K = _bucket(len(remotes), 1, factor=2)
+    cols = ClusterColumns(names=tuple(name for name, _, _ in remotes))
+    cols.ccap = np.zeros((K, F, R), np.int64)
+    cols.coffer = np.zeros((K, F, R), bool)
+    cols.cactive = np.zeros(K, bool)
+    for ki, (_name, caps, active) in enumerate(remotes):
+        cols.cactive[ki] = bool(active)
+        for (fname, rname), avail in caps.items():
+            fi = topo.flavor_index.get(fname)
+            ri = topo.resource_index.get(rname)
+            if fi is None or ri is None:
+                continue
+            cols.coffer[ki, fi, ri] = True
+            cols.ccap[ki, fi, ri] = max(int(avail), 0)
+    mk_checks = getattr(snapshot, "mk_check_names", frozenset())
+    cols.mk_cq = np.zeros(Q, bool)
+    if mk_checks:
+        for qname, cq in snapshot.cluster_queues.items():
+            if not mk_checks.isdisjoint(cq.admission_checks):
+                cols.mk_cq[topo.cq_index[qname]] = True
+    if not cols.mk_cq.any():
+        return None  # no CQ routes through the columns this cycle
+    return cols
+
+
+def cluster_args_device(cols: ClusterColumns) -> tuple:
+    """The kernel-facing (ccap, coffer, cactive, mk_cq) tuple."""
+    return (cols.ccap, cols.coffer, cols.cactive, cols.mk_cq)
+
+
+def consume_remote_dicts(remote_clusters: tuple, requests: list,
+                         pinned: list) -> tuple:
+    """Debit already-decided (pinned) placements from the capacity
+    columns and return the REMAINING columns tuple — the controller
+    uses it to price in-flight planned-but-not-yet-reserved workloads
+    so consecutive cycles don't re-place onto capacity the remote
+    hasn't materialized yet (the remote usage read lags by however
+    long the worker takes to reserve)."""
+    remaining = [dict(caps) for _, caps, _ in remote_clusters]
+    by_name = {c[0]: i for i, c in enumerate(remote_clusters)}
+    for req, cluster in zip(requests, pinned):
+        ki = by_name.get(cluster)
+        if ki is None:
+            continue
+        caps = remaining[ki]
+        flavors: dict = {}
+        for (fname, rname), avail in caps.items():
+            flavors.setdefault(fname, {})[rname] = avail
+        req = {r: v for r, v in req.items() if v > 0}
+        for fname in sorted(flavors):
+            rem = flavors[fname]
+            if all(r in rem and rem[r] >= v for r, v in req.items()) \
+                    and any(r in rem for r in req):
+                for r, v in req.items():
+                    caps[(fname, r)] -= v
+                break
+    return tuple((name, remaining[i], active)
+                 for i, (name, _caps, active) in enumerate(remote_clusters))
+
+
+def place_remote_dicts(remote_clusters: tuple, requests: list,
+                       pinned: Optional[list] = None) -> list:
+    """The sequential placement oracle in name space: for each
+    per-workload request dict {resource: quantity} (in admission
+    order), pick the FIRST active cluster (column order) with ONE
+    flavor whose remaining capacity covers every requested resource;
+    consume it. ``pinned[i]`` (a cluster name) forces workload i's
+    choice — the scheduler pins device-decided rows so the host
+    continuation accounts from the same remaining capacity. Returns a
+    cluster name or None per workload. This is the one definition of
+    the placement rule; kernel.score_cluster_columns_impl is its
+    batched twin (differentially pinned in tests/test_clusters.py)."""
+    remaining = []
+    for name, caps, active in remote_clusters:
+        flavors: dict = {}
+        for (fname, rname), avail in caps.items():
+            flavors.setdefault(fname, {})[rname] = max(int(avail), 0)
+        remaining.append((name, flavors, bool(active)))
+    out: list = []
+
+    def fit_flavor(flavors: dict, req: dict) -> Optional[str]:
+        # sorted: the device twin scans flavors in topology index
+        # order, which encode_topology builds from sorted names — the
+        # oracle must consume the same flavor or later placements
+        # would diverge on remaining capacity.
+        for fname in sorted(flavors):
+            rem = flavors[fname]
+            if all(r in rem and rem[r] >= v for r, v in req.items() if v > 0):
+                if any(r in rem for r, v in req.items() if v > 0):
+                    return fname
+        return None
+
+    for i, req in enumerate(requests):
+        req = {r: v for r, v in req.items() if v > 0}
+        chosen = None
+        want = pinned[i] if pinned is not None else None
+        for name, flavors, active in remaining:
+            if not active or not req:
+                continue
+            if want is not None and name != want:
+                continue
+            f = fit_flavor(flavors, req)
+            if f is not None:
+                chosen = name
+                for r, v in req.items():
+                    flavors[f][r] -= v
+                break
+            if want is not None:
+                # Pinned but no longer fits host-side: honor the pin
+                # anyway (the device already consumed this capacity in
+                # its own accounting) without decrementing twice.
+                chosen = name
+                break
+        if chosen is None and want is not None:
+            chosen = want  # pinned to a cluster outside the column set
+        out.append(chosen)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Device-resident state: sparse correction encoding + the host mirror
 # ---------------------------------------------------------------------------
 

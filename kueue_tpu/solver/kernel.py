@@ -477,7 +477,7 @@ def solve_cycle_fused_impl(topo, usage, cohort_usage, requests, podset_active,
                            wl_cq, priority, timestamp, eligible, solvable,
                            num_podsets: int, max_rank: int,
                            fair_sharing: bool = False, start_rank=None,
-                           compact: bool = False):
+                           compact: bool = False, cluster_args=None):
     """The production single-chip path, fully fused: Phase A, the
     domain-rank order grid, and the cohort-parallel Phase B run as ONE
     device program — no host round-trip between phases.
@@ -529,6 +529,12 @@ def solve_cycle_fused_impl(topo, usage, cohort_usage, requests, podset_active,
     out = {"admitted": admitted, "chosen": chosen, "borrows": borrows,
            "chosen_borrow": chosen_borrow, "fit": fit, "usage": usage_out,
            "cohort_usage": cohort_out}
+    if cluster_args is not None:
+        # Remote-cluster capacity columns scored in the SAME program:
+        # nomination picks local vs remote in one argmax per ordered
+        # workload (see score_cluster_columns_impl).
+        out["mk_cluster"] = score_cluster_columns_impl(
+            *cluster_args, requests, podset_active, wl_cq, order, admitted)
     return pack_decisions_impl(out) if compact else out
 
 
@@ -545,7 +551,7 @@ def solve_cycle_with_preempt_impl(topo, usage, cohort_usage, requests,
                                   fair_sharing: bool = False,
                                   start_rank=None, fair_preempt_args=None,
                                   fs_strategies: tuple = (),
-                                  compact: bool = False):
+                                  compact: bool = False, cluster_args=None):
     """Mixed admission + preemption cycle as ONE device program: the fused
     fit solve plus the batched preemption target selection
     (preempt.solve_preempt_impl, and fairpreempt.solve_fair_impl for
@@ -559,7 +565,8 @@ def solve_cycle_with_preempt_impl(topo, usage, cohort_usage, requests,
     out = solve_cycle_fused_impl(
         topo, usage, cohort_usage, requests, podset_active, wl_cq, priority,
         timestamp, eligible, solvable, num_podsets=num_podsets,
-        max_rank=max_rank, fair_sharing=fair_sharing, start_rank=start_rank)
+        max_rank=max_rank, fair_sharing=fair_sharing, start_rank=start_rank,
+        cluster_args=cluster_args)
     if preempt_args is not None:
         targets, feasible, pstats = solve_preempt_impl(
             topo, usage, cohort_usage, *preempt_args)
@@ -582,6 +589,65 @@ solve_cycle_with_preempt = partial(
     jax.jit, static_argnames=("num_podsets", "max_rank", "fair_sharing",
                               "fs_strategies", "compact"))(
     solve_cycle_with_preempt_impl)
+
+
+# ---------------------------------------------------------------------------
+# MultiKueue remote clusters as capacity columns of the solve
+# ---------------------------------------------------------------------------
+#
+# The reference places a multikueue workload by mirroring it to EVERY
+# worker cluster and letting the first remote reservation win — a
+# sequential per-workload controller loop (multikueuecluster.go:67-307)
+# bolted onto the side of the admission cycle. Here remote clusters are
+# encoded as extra flavor-capacity columns ([K,F,R], solver/encode.py
+# encode_cluster_columns) and scored INSIDE the fused solve: one scan in
+# the cycle's admission order picks, per admitted multikueue workload,
+# the first cluster column (deterministic sorted-name order) with a
+# flavor that fits the workload's total request, with intra-cycle
+# accounting — the exact greedy the sequential controller converges to
+# on a quiet fleet. The multikueue controller becomes the EXECUTOR of
+# these device-made decisions (it mirrors only to the chosen cluster);
+# a lost cluster's columns mask to zero capacity on the next snapshot,
+# so re-placement falls out of the same scoring.
+
+
+def score_cluster_columns_impl(ccap, coffer, cactive, mk_cq, requests,
+                               podset_active, wl_cq, order, admitted):
+    """chosen cluster column per workload ([W] int32, -1 = none/local).
+
+    ccap [K,F,R] int64: remaining available remote capacity;
+    coffer [K,F,R] bool: (flavor, resource) offered by the cluster;
+    cactive [K] bool: reachable clusters (lost clusters mask False);
+    mk_cq [Q] bool: CQ carries a multikueue admission check.
+
+    Placement model: a cluster hosts the workload when ONE flavor
+    column covers every requested resource under the remaining
+    capacity (single-flavor fit — the remote's own flavor assignment
+    refines within that envelope). Chosen capacity is consumed for
+    later workloads in the same cycle (running scan state), matching
+    the sequential oracle bit-for-bit (encode.place_remote_dicts)."""
+    W = requests.shape[0]
+    treq = jnp.sum(jnp.where(podset_active[:, :, None], requests, 0),
+                   axis=1)                                   # [W,R]
+    mk = mk_cq[wl_cq] & admitted                             # [W]
+
+    def step(rem, w):
+        req = treq[w]                                        # [R]
+        has = req > 0
+        covers = (req[None, None, :] <= rem) & coffer        # [K,F,R]
+        fit_kf = jnp.all(covers | ~has[None, None, :], axis=2) & \
+            jnp.any(coffer & has[None, None, :], axis=2)     # [K,F]
+        fit_k = jnp.any(fit_kf, axis=1) & cactive            # [K]
+        any_fit = jnp.any(fit_k)
+        k = jnp.argmax(fit_k).astype(jnp.int32)              # first fitting
+        f = jnp.argmax(fit_kf[k]).astype(jnp.int32)          # first flavor
+        place = mk[w] & any_fit
+        chosen = jnp.where(place, k, jnp.int32(-1))
+        rem = rem.at[k, f].add(-jnp.where(place, req, 0))
+        return rem, chosen
+
+    _, chosen_ord = jax.lax.scan(step, ccap, order)
+    return jnp.full(W, -1, jnp.int32).at[order].set(chosen_ord)
 
 
 def max_rank_bound(wl_cq, cq_cohort, cohort_root) -> int:
@@ -746,7 +812,7 @@ def solve_cycle_resident_impl(topo, usage, cohort_usage, deltas, requests,
                               start_rank=None, preempt_args=None,
                               fair_preempt_args=None,
                               fs_strategies: tuple = (),
-                              compact: bool = False):
+                              compact: bool = False, cluster_args=None):
     """The device-resident production cycle: sparse correction prologue +
     the fused fit solve (+ the batched preemption programs when present),
     all ONE device program. usage/cohort_usage stay on device across
@@ -761,14 +827,14 @@ def solve_cycle_resident_impl(topo, usage, cohort_usage, deltas, requests,
             priority, timestamp, eligible, solvable,
             num_podsets=num_podsets, max_rank=max_rank,
             fair_sharing=fair_sharing, start_rank=start_rank,
-            compact=compact)
+            compact=compact, cluster_args=cluster_args)
     return solve_cycle_with_preempt_impl(
         topo, usage, cohort_usage, requests, podset_active, wl_cq,
         priority, timestamp, eligible, solvable, preempt_args,
         num_podsets=num_podsets, max_rank=max_rank,
         fair_sharing=fair_sharing, start_rank=start_rank,
         fair_preempt_args=fair_preempt_args, fs_strategies=fs_strategies,
-        compact=compact)
+        compact=compact, cluster_args=cluster_args)
 
 
 solve_cycle_resident = partial(
@@ -847,7 +913,8 @@ def solve_cycle_resident_arena_impl(topo, usage, cohort_usage, deltas,
                                     start_rank=None, preempt_args=None,
                                     fair_preempt_args=None,
                                     fs_strategies: tuple = (),
-                                    compact: bool = False):
+                                    compact: bool = False,
+                                    cluster_args=None):
     """The arena-resident production cycle: gather the head slots from
     the device arena twin into the batch tensors, then run the resident
     solve — one device program, with no per-cycle batch upload (changed
@@ -858,7 +925,8 @@ def solve_cycle_resident_arena_impl(topo, usage, cohort_usage, deltas,
         num_podsets=num_podsets, max_rank=max_rank,
         fair_sharing=fair_sharing, start_rank=start_rank,
         preempt_args=preempt_args, fair_preempt_args=fair_preempt_args,
-        fs_strategies=fs_strategies, compact=compact)
+        fs_strategies=fs_strategies, compact=compact,
+        cluster_args=cluster_args)
 
 
 solve_cycle_resident_arena = partial(

@@ -153,7 +153,7 @@ class WarmContext:
     buckets (solver/COMPILE.md)."""
 
     __slots__ = ("topo", "topo_dev", "usage", "cohort_usage",
-                 "arena_dev", "arena_cap")
+                 "arena_dev", "arena_cap", "cluster")
 
 
 def _scramble_fetched(fetched: dict) -> dict:
@@ -231,6 +231,12 @@ class Plan:
         # rows were gathered from, so a delta landing between encode
         # and stamp is seen as the staleness it is (stages.py).
         self.slot_gens = None
+        # MultiKueue remote-cluster capacity columns (ISSUE 13): encoded
+        # from Snapshot.remote_clusters when the snapshot carries any
+        # and a CQ routes through a multikueue check; scored inside the
+        # fused solve (kernel.score_cluster_columns_impl) and decoded
+        # into BatchSolver.last_placements.
+        self.cluster = None   # encode.ClusterColumns or None
 
 
 class InFlight:
@@ -320,6 +326,10 @@ class BatchSolver:
         # Per-cycle host<->device payload accounting (bench visibility).
         self.last_upload_bytes = 0
         self.last_fetch_bytes = 0
+        # Device-made MultiKueue placements from the last decode:
+        # workload key -> cluster name (ISSUE 13 batched columns). The
+        # scheduler forwards them through its on_placement hook.
+        self.last_placements: dict = {}
         # Decision-only fetch (kernel.pack_decisions_impl): None = auto
         # (compact whenever the topology's flavor count fits the wire
         # format), False = force the staged dense fetch (the
@@ -532,6 +542,13 @@ class BatchSolver:
         ctx.cohort_usage = jnp.zeros((max(C, 1), F, R), jnp.int64)
         ctx.arena_dev = None
         ctx.arena_cap = 0
+        # MultiKueue deployments (snapshot carries capacity columns):
+        # live dispatches key on kdim = the bucketed column shape, so
+        # every variant is warmed BOTH ways — without columns and at
+        # the deployment's column shape (warm_bucket/_cluster_variants;
+        # a K-bucket change from adding clusters later self-heals with
+        # one counted compile).
+        ctx.cluster = encode.encode_cluster_columns(snapshot, topo)
         if expected_pending is not None:
             # Pre-size the arena so the run never pays mid-run growth,
             # and warm the arena-resident kernel at that shape.
@@ -621,42 +638,58 @@ class BatchSolver:
         warmed = 0
         for max_rank in max_ranks:
             for sr in (None, start_rank):
-                out = solve_cycle_fused(
-                    topo_dev, usage, cohort_usage, *args,
-                    num_podsets=P, max_rank=max_rank,
-                    fair_sharing=fair_sharing, start_rank=sr,
-                    compact=compact)
-                out[ready_key].block_until_ready()
-                note_program(("fused", dims, W, P, max_rank,
-                              fair_sharing, sr is not None, (), (), (),
-                              compact))
-                warmed += 1
-                for dlt in (None,) + tuple(deltas_buckets):
-                    deltas = _warm_deltas(L, dlt)
-                    if ctx.arena_dev is None:
-                        out = solve_cycle_resident(
-                            topo_dev, usage, cohort_usage, deltas,
-                            *args, num_podsets=P, max_rank=max_rank,
-                            fair_sharing=fair_sharing, start_rank=sr,
-                            compact=compact)
-                        key = ("resident", dims, W, P, max_rank,
-                               fair_sharing, sr is not None, dlt,
-                               (), (), (), compact)
-                    else:
-                        slots_w = np.full(W, -1, np.int32)
-                        out = solve_cycle_resident_arena(
-                            topo_dev, usage, cohort_usage, deltas,
-                            ctx.arena_dev, slots_w,
-                            num_podsets=P, max_rank=max_rank,
-                            fair_sharing=fair_sharing, start_rank=sr,
-                            compact=compact)
-                        key = ("arena", dims, ctx.arena_cap, W, P,
-                               max_rank, fair_sharing, sr is not None,
-                               dlt, (), (), (), compact)
+                for cargs_w, kdim_w in self._cluster_variants(ctx):
+                    out = solve_cycle_fused(
+                        topo_dev, usage, cohort_usage, *args,
+                        num_podsets=P, max_rank=max_rank,
+                        fair_sharing=fair_sharing, start_rank=sr,
+                        compact=compact, cluster_args=cargs_w)
                     out[ready_key].block_until_ready()
-                    note_program(key)
+                    note_program(("fused", dims, W, P, max_rank,
+                                  fair_sharing, sr is not None, (), (), (),
+                                  compact, kdim_w))
                     warmed += 1
+                    for dlt in (None,) + tuple(deltas_buckets):
+                        deltas = _warm_deltas(L, dlt)
+                        if ctx.arena_dev is None:
+                            out = solve_cycle_resident(
+                                topo_dev, usage, cohort_usage, deltas,
+                                *args, num_podsets=P, max_rank=max_rank,
+                                fair_sharing=fair_sharing, start_rank=sr,
+                                compact=compact, cluster_args=cargs_w)
+                            key = ("resident", dims, W, P, max_rank,
+                                   fair_sharing, sr is not None, dlt,
+                                   (), (), (), compact, kdim_w)
+                        else:
+                            slots_w = np.full(W, -1, np.int32)
+                            out = solve_cycle_resident_arena(
+                                topo_dev, usage, cohort_usage, deltas,
+                                ctx.arena_dev, slots_w,
+                                num_podsets=P, max_rank=max_rank,
+                                fair_sharing=fair_sharing, start_rank=sr,
+                                compact=compact, cluster_args=cargs_w)
+                            key = ("arena", dims, ctx.arena_cap, W, P,
+                                   max_rank, fair_sharing, sr is not None,
+                                   dlt, (), (), (), compact, kdim_w)
+                        out[ready_key].block_until_ready()
+                        note_program(key)
+                        warmed += 1
         return warmed
+
+    @staticmethod
+    def _cluster_variants(ctx: WarmContext) -> list:
+        """(cluster_args, kdim) pairs every solve variant warms: the
+        column-less program always, plus the deployment's bucketed
+        cluster-column shape when the warm snapshot carried capacity
+        columns (ISSUE 13) — live dispatch keys on exactly these kdims,
+        so a MultiKueue deployment's cluster-carrying cycles hit warm
+        programs instead of compiling on the admission thread."""
+        variants = [(None, None)]
+        cluster = getattr(ctx, "cluster", None)
+        if cluster is not None:
+            variants.append((encode.cluster_args_device(cluster),
+                             cluster.ccap.shape))
+        return variants
 
     def warm_scatter(self, ctx: WarmContext) -> int:
         """Warm the changed-row arena scatter programs: one compile per
@@ -806,45 +839,48 @@ class BatchSolver:
         warmed = 0
         for max_rank in dict.fromkeys(max_ranks):
             for pargs, psh, fargs, fsh, fflags in variants:
-                out = solve_cycle_with_preempt(
-                    ctx.topo_dev, ctx.usage, ctx.cohort_usage, *args,
-                    pargs, num_podsets=P, max_rank=max_rank,
-                    fair_sharing=fair_sharing, start_rank=sr,
-                    fair_preempt_args=fargs, fs_strategies=fflags,
-                    compact=compact)
-                out[ready_key].block_until_ready()
-                note_program(("preempt", dims, W, P, max_rank,
-                              fair_sharing, sr_flag, psh, fsh, fflags,
-                              compact))
-                warmed += 1
-                for dlt in (None,) + tuple(deltas_buckets):
-                    deltas = _warm_deltas(L, dlt)
-                    if ctx.arena_dev is None:
-                        out = solve_cycle_resident(
-                            topo_dev, ctx.usage, ctx.cohort_usage,
-                            deltas, *args, num_podsets=P,
-                            max_rank=max_rank,
-                            fair_sharing=fair_sharing, start_rank=sr,
-                            preempt_args=pargs, fair_preempt_args=fargs,
-                            fs_strategies=fflags, compact=compact)
-                        key = ("resident", dims, W, P, max_rank,
-                               fair_sharing, sr_flag, dlt, psh, fsh,
-                               fflags, compact)
-                    else:
-                        slots_w = np.full(W, -1, np.int32)
-                        out = solve_cycle_resident_arena(
-                            topo_dev, ctx.usage, ctx.cohort_usage,
-                            deltas, ctx.arena_dev, slots_w,
-                            num_podsets=P, max_rank=max_rank,
-                            fair_sharing=fair_sharing, start_rank=sr,
-                            preempt_args=pargs, fair_preempt_args=fargs,
-                            fs_strategies=fflags, compact=compact)
-                        key = ("arena", dims, ctx.arena_cap, W, P,
-                               max_rank, fair_sharing, sr_flag, dlt,
-                               psh, fsh, fflags, compact)
+                for cargs_w, kdim_w in self._cluster_variants(ctx):
+                    out = solve_cycle_with_preempt(
+                        ctx.topo_dev, ctx.usage, ctx.cohort_usage, *args,
+                        pargs, num_podsets=P, max_rank=max_rank,
+                        fair_sharing=fair_sharing, start_rank=sr,
+                        fair_preempt_args=fargs, fs_strategies=fflags,
+                        compact=compact, cluster_args=cargs_w)
                     out[ready_key].block_until_ready()
-                    note_program(key)
+                    note_program(("preempt", dims, W, P, max_rank,
+                                  fair_sharing, sr_flag, psh, fsh, fflags,
+                                  compact, kdim_w))
                     warmed += 1
+                    for dlt in (None,) + tuple(deltas_buckets):
+                        deltas = _warm_deltas(L, dlt)
+                        if ctx.arena_dev is None:
+                            out = solve_cycle_resident(
+                                topo_dev, ctx.usage, ctx.cohort_usage,
+                                deltas, *args, num_podsets=P,
+                                max_rank=max_rank,
+                                fair_sharing=fair_sharing, start_rank=sr,
+                                preempt_args=pargs, fair_preempt_args=fargs,
+                                fs_strategies=fflags, compact=compact,
+                                cluster_args=cargs_w)
+                            key = ("resident", dims, W, P, max_rank,
+                                   fair_sharing, sr_flag, dlt, psh, fsh,
+                                   fflags, compact, kdim_w)
+                        else:
+                            slots_w = np.full(W, -1, np.int32)
+                            out = solve_cycle_resident_arena(
+                                topo_dev, ctx.usage, ctx.cohort_usage,
+                                deltas, ctx.arena_dev, slots_w,
+                                num_podsets=P, max_rank=max_rank,
+                                fair_sharing=fair_sharing, start_rank=sr,
+                                preempt_args=pargs, fair_preempt_args=fargs,
+                                fs_strategies=fflags, compact=compact,
+                                cluster_args=cargs_w)
+                            key = ("arena", dims, ctx.arena_cap, W, P,
+                                   max_rank, fair_sharing, sr_flag, dlt,
+                                   psh, fsh, fflags, compact, kdim_w)
+                        out[ready_key].block_until_ready()
+                        note_program(key)
+                        warmed += 1
         return warmed
 
     def warm(self, snapshot: Snapshot, widths=(2048,),
@@ -968,6 +1004,7 @@ class BatchSolver:
             if own_snap is not None:
                 self._cache.release_snapshot(own_snap)
         plan = Plan(topo, topo_dev, state, batch, start_rank, fit_pred)
+        plan.cluster = encode.encode_cluster_columns(cycle_snapshot, topo)
         plan.slots = slots
         if slots is not None:
             plan.slot_gens = slot_gens
@@ -1216,19 +1253,25 @@ class BatchSolver:
             from kueue_tpu.solver import preempt as devpreempt
             pargs = (devpreempt.preempt_args(preempt_batch)
                      if preempt_batch is not None else None)
-            # Preemption is FUSED into the sharded execute (the preempt
-            # program replicates across the mesh while Phase A shards over
+            cargs = (encode.cluster_args_device(plan.cluster)
+                     if plan.cluster is not None else None)
+            # Preemption is FUSED into the sharded execute (sharded over
+            # the planner-assigned problem axis while Phase A shards over
             # workloads): one dispatch, one sync (VERDICT r3 weak #6).
             # Fair-sharing preemption stays on the CPU path under a mesh
-            # (the scheduler routes it there).
+            # (the scheduler routes it there). Remote-cluster capacity
+            # columns score replicated inside the same program.
             result = solve_cycle_sharded(self.mesh, topo_dev, state, batch,
                                          self.max_podsets,
                                          fair_sharing=fair_sharing,
                                          start_rank=start_rank,
-                                         preempt_args=pargs)
+                                         preempt_args=pargs, topo_np=topo,
+                                         cluster_args=cargs)
             keys = ["admitted", "fit", "chosen", "borrows", "chosen_borrow"]
             if preempt_batch is not None:
                 keys += ["preempt_targets", "preempt_feasible"]
+            if cargs is not None:
+                keys.append("mk_cluster")
             fetched = jax.device_get({k: result[k] for k in keys
                                       if k in result})
             aux = None
@@ -1236,7 +1279,10 @@ class BatchSolver:
                 aux = {"preempt": (np.asarray(fetched["preempt_targets"]),
                                    np.asarray(fetched["preempt_feasible"]))}
             return (self._decode_batch(entries, snapshot, topo, batch,
-                                       fetched), aux)
+                                       fetched,
+                                       cluster_names=(plan.cluster.names
+                                                      if plan.cluster
+                                                      else None)), aux)
 
         inflight = self.dispatch(plan, preempt_batch=preempt_batch,
                                  fair_sharing=fair_sharing,
@@ -1343,6 +1389,12 @@ class BatchSolver:
         # packed-output program variants; the fetch then ships the
         # compact decisions buffer instead of the dense [W,...] arrays.
         compact = self._compact_flag(topo)
+        # MultiKueue capacity columns ride the SAME execute (scored by
+        # kernel.score_cluster_columns_impl); their bucketed [K,F,R]
+        # shape keys the program variant like the other batch dims.
+        cargs = (encode.cluster_args_device(plan.cluster)
+                 if plan.cluster is not None else None)
+        kdim = plan.cluster.ccap.shape if plan.cluster is not None else None
 
         # Identity check: the plan must have been built on the CURRENT
         # ResidentState — after an invalidate + re-establish, a stale
@@ -1415,7 +1467,7 @@ class BatchSolver:
                 if note_program(("arena", dims, self._arena.cap, W,
                                  self.max_podsets, max_rank, fair_sharing,
                                  sr_flag, D, pshapes, fshapes,
-                                 tuple(fs_flags), compact)):
+                                 tuple(fs_flags), compact, kdim)):
                     self._note_mid_traffic_compile("arena", W)
                 result = solve_cycle_resident_arena(
                     topo_dev, usage_in, cohort_in, plan.deltas,
@@ -1423,12 +1475,13 @@ class BatchSolver:
                     num_podsets=self.max_podsets, max_rank=max_rank,
                     fair_sharing=fair_sharing, start_rank=start_rank,
                     preempt_args=pargs, fair_preempt_args=fargs,
-                    fs_strategies=fs_flags, compact=compact)
+                    fs_strategies=fs_flags, compact=compact,
+                    cluster_args=cargs)
             else:
                 if note_program(("resident", dims, W, self.max_podsets,
                                  max_rank, fair_sharing, sr_flag, D,
                                  pshapes, fshapes, tuple(fs_flags),
-                                 compact)):
+                                 compact, kdim)):
                     self._note_mid_traffic_compile("resident", W)
                 result = solve_cycle_resident(
                     topo_dev, usage_in, cohort_in, plan.deltas,
@@ -1438,7 +1491,7 @@ class BatchSolver:
                     max_rank=max_rank, fair_sharing=fair_sharing,
                     start_rank=start_rank, preempt_args=pargs,
                     fair_preempt_args=fargs, fs_strategies=fs_flags,
-                    compact=compact)
+                    compact=compact, cluster_args=cargs)
             rs.usage_dev = result["usage"]
             rs.cohort_dev = result["cohort_usage"]
             if plan.deltas is not None and plan.backlog_gen == rs.backlog_gen:
@@ -1449,7 +1502,7 @@ class BatchSolver:
             if pargs is None and fargs is None:
                 if note_program(("fused", dims, W, self.max_podsets,
                                  max_rank, fair_sharing, sr_flag,
-                                 (), (), (), compact)):
+                                 (), (), (), compact, kdim)):
                     self._note_mid_traffic_compile("fused", W)
                 result = solve_cycle_fused(
                     topo_dev, state.usage, state.cohort_usage,
@@ -1457,12 +1510,13 @@ class BatchSolver:
                     batch.priority, batch.timestamp, batch.eligible,
                     batch.solvable, num_podsets=self.max_podsets,
                     max_rank=max_rank, fair_sharing=fair_sharing,
-                    start_rank=start_rank, compact=compact)
+                    start_rank=start_rank, compact=compact,
+                    cluster_args=cargs)
             else:
                 if note_program(("preempt", dims, W, self.max_podsets,
                                  max_rank, fair_sharing, sr_flag,
                                  pshapes, fshapes, tuple(fs_flags),
-                                 compact)):
+                                 compact, kdim)):
                     self._note_mid_traffic_compile("preempt", W)
                 result = solve_cycle_with_preempt(
                     topo_dev, state.usage, state.cohort_usage,
@@ -1472,7 +1526,7 @@ class BatchSolver:
                     num_podsets=self.max_podsets, max_rank=max_rank,
                     fair_sharing=fair_sharing, start_rank=start_rank,
                     fair_preempt_args=fargs, fs_strategies=fs_flags,
-                    compact=compact)
+                    compact=compact, cluster_args=cargs)
 
         # An orphan whose wedged solve call finally returned must not
         # run the bookkeeping below: counters would double-count, and
@@ -1485,6 +1539,8 @@ class BatchSolver:
         # the residency chain (usage/cohort_usage) stays on device.
         keys = (list(DECISION_KEYS) if compact
                 else list(DENSE_DECISION_KEYS))
+        if plan.cluster is not None:
+            keys.append("mk_cluster")
         if preempt_batch is not None:
             keys += ["preempt_targets", "preempt_feasible", "preempt_stats"]
         if fair_batch is not None:
@@ -1666,7 +1722,10 @@ class BatchSolver:
         resident_ok = plan.resident and plan.rs is self._resident
         decisions = self._decode_batch(plan.batch.infos, snapshot, plan.topo,
                                        plan.batch, fetched,
-                                       resident=resident_ok)
+                                       resident=resident_ok,
+                                       cluster_names=(plan.cluster.names
+                                                      if plan.cluster
+                                                      else None))
         self._phase("decode", t_fetch, time.perf_counter())
         return decisions, aux
 
@@ -1775,7 +1834,8 @@ class BatchSolver:
 
     def _decode_batch(self, entries: list, snapshot: Snapshot,
                       topo: encode.Topology, batch, fetched: dict,
-                      resident: bool = False) -> dict:
+                      resident: bool = False,
+                      cluster_names: Optional[tuple] = None) -> dict:
         """Decode device output into the scheduler's Assignment form,
         including the LastTriedFlavorIdx resume state exactly as the CPU
         assigner stores it (reference: flavorassigner.go:289-324): the
@@ -1788,10 +1848,17 @@ class BatchSolver:
         loop only assembles the Assignment objects from Python lists."""
         from kueue_tpu.api.corev1 import RESOURCE_PODS
         n = batch.n
+        # MultiKueue placements decoded this cycle (ISSUE 13): reset
+        # unconditionally so a column-less cycle never serves a stale
+        # map to the scheduler's placement flush.
+        self.last_placements = {}
         fit = np.asarray(fetched["fit"])[:n]
         idx = np.flatnonzero(fit)
         if idx.size == 0:
             return {}
+        mkc = fetched.get("mk_cluster")
+        mk_l = (np.asarray(mkc)[:n][idx].tolist()
+                if mkc is not None and cluster_names else None)
         admitted = np.asarray(fetched["admitted"])[:n][idx]     # [M]
         chosen = np.asarray(fetched["chosen"])[:n][idx]          # [M,P,R]
         borrows = np.asarray(fetched["borrows"])[:n][idx]        # [M]
@@ -1880,6 +1947,12 @@ class BatchSolver:
                     count=psr.count))
                 assignment.last_state.last_tried_flavor_idx.append(flavor_idx)
             was_admitted = bool(admitted_l[row])
+            if mk_l is not None and was_admitted:
+                ki = mk_l[row]
+                if 0 <= ki < len(cluster_names):
+                    # device-made placement: the multikueue controller
+                    # executes it (scheduler forwards via on_placement)
+                    self.last_placements[info.key] = cluster_names[ki]
             if rs is not None and was_admitted:
                 # Device Phase B applied this usage; track it until the
                 # assume write confirms it through the journal, and bring

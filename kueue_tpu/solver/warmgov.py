@@ -173,19 +173,27 @@ def snapshot_cohort_members(snapshot) -> dict:
     return members
 
 
-def topology_fingerprint(topo, max_podsets: int) -> str:
+def topology_fingerprint(topo, max_podsets: int, mesh=None) -> str:
     """Stable cache-layout stamp: everything the compiled executables'
     shapes derive from (topology tensor dims + podset width) plus the
-    toolchain identity (jax version, backend platform). The
-    process-local ``topo.token`` is deliberately NOT included — it
-    changes on every rebuild, and the whole point of the stamp is
-    cross-process reuse that still refuses stale shapes."""
+    toolchain identity (jax version, backend platform) and — for mesh
+    solvers — the mesh LAYOUT (axis names + shape): a sharded program
+    over a different host count is a different executable population,
+    so its warm ladder and persistent-cache directory must not collide
+    with another mesh shape's (ISSUE 13). Device IDs are deliberately
+    NOT included (they renumber across restarts on some runtimes — the
+    layout, not the numbering, shapes the program). The process-local
+    ``topo.token`` is deliberately NOT included — it changes on every
+    rebuild, and the whole point of the stamp is cross-process reuse
+    that still refuses stale shapes."""
     import hashlib
 
     import jax
+    mesh_dims = (tuple(mesh.axis_names), tuple(mesh.devices.shape)) \
+        if mesh is not None else None
     dims = (topo.nominal.shape, topo.cohort_subtree.shape,
             topo.cq_chain.shape, max_podsets,
-            jax.__version__, jax.default_backend())
+            jax.__version__, jax.default_backend(), mesh_dims)
     return hashlib.blake2b(repr(dims).encode(), digest_size=8).hexdigest()
 
 
@@ -718,7 +726,8 @@ class CompileGovernor:
         if not self.cache_dir:
             return
         from kueue_tpu.utils.runtime import enable_compilation_cache
-        fp = topology_fingerprint(topo, self.solver.max_podsets)
+        fp = topology_fingerprint(topo, self.solver.max_podsets,
+                                  mesh=getattr(self.solver, "mesh", None))
         self.cache_subdir = os.path.join(self.cache_dir, f"topo-{fp}")
         enable_compilation_cache(self.cache_subdir,
                                  min_compile_time_secs=0.0)

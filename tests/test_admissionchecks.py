@@ -195,10 +195,17 @@ class TestMultiKueue:
         manager.store.create(
             WorkloadWrapper("w").queue("lq").request("cpu", "2").obj())
         manager.schedule_until_settled()
-        # mirrored to both workers
-        assert worker1.store.try_get("Workload", "default", "w") is not None
-        assert worker2.store.try_get("Workload", "default", "w") is not None
-        mirrored = worker1.store.get("Workload", "default", "w")
+        # Batched-column placement (ISSUE 13): admission scored the
+        # remote capacity columns and the controller executed the
+        # decision — exactly ONE mirror (the planned cluster), not the
+        # reference's mirror-everywhere race.
+        mirrors = [w for w in (worker1, worker2)
+                   if w.store.try_get("Workload", "default", "w") is not None]
+        assert len(mirrors) == 1
+        assert manager.multikueue.planned.get("default/w") in (
+            "worker1", "worker2")
+        assert manager.multikueue.placements_executed >= 1
+        mirrored = mirrors[0].store.get("Workload", "default", "w")
         assert mirrored.metadata.labels[ORIGIN_LABEL] == "multikueue"
         # workers schedule; one reserves; the other mirror is deleted
         self.run_all(manager, worker1, worker2)

@@ -1,0 +1,296 @@
+"""MultiKueue capacity-column tests (ISSUE 13): the batched placement
+scored inside the fused solve must be bit-equivalent to the sequential
+oracle (encode.place_remote_dicts), match the sequential multikueue
+controller's outcome on single-cluster traffic, mask lost clusters to
+zero capacity, and drive single-mirror execution end-to-end through a
+real manager (host-oracle CPU route AND device-decode solver route)."""
+
+import numpy as np
+import pytest
+
+from kueue_tpu import config as cfgpkg
+from kueue_tpu.api import autoscaling as asapi
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.meta import FakeClock, ObjectMeta
+from kueue_tpu.controller.admissionchecks.multikueue import (
+    CONTROLLER_NAME as MK_CONTROLLER,
+)
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.manager import KueueManager
+from kueue_tpu.solver import encode
+
+from tests.wrappers import (
+    ClusterQueueWrapper,
+    WorkloadWrapper,
+    flavor_quotas,
+    make_flavor,
+    make_local_queue,
+)
+
+
+@pytest.fixture
+def clock():
+    return FakeClock(1000.0)
+
+
+FLAVORS = ["fa", "fb", "fc"]      # sorted — topology index order
+RESOURCES = ["cpu", "mem"]        # sorted
+
+
+def _random_columns(rng, K):
+    """Random (columns tuple, ccap, coffer, cactive) with the dict and
+    tensor forms built from the SAME draw."""
+    F, R = len(FLAVORS), len(RESOURCES)
+    ccap = np.zeros((K, F, R), np.int64)
+    coffer = np.zeros((K, F, R), bool)
+    cactive = rng.random(K) < 0.8
+    cols = []
+    for k in range(K):
+        caps = {}
+        for fi, fname in enumerate(FLAVORS):
+            for ri, rname in enumerate(RESOURCES):
+                if rng.random() < 0.7:
+                    avail = int(rng.integers(0, 50))
+                    caps[(fname, rname)] = avail
+                    coffer[k, fi, ri] = True
+                    ccap[k, fi, ri] = avail
+        cols.append((f"w{k}", caps, bool(cactive[k])))
+    return tuple(cols), ccap, coffer, cactive
+
+
+class TestKernelVsOracle:
+    def test_batched_placement_matches_sequential_oracle(self):
+        import jax.numpy as jnp
+
+        from kueue_tpu.solver.kernel import score_cluster_columns_impl
+        F, R = len(FLAVORS), len(RESOURCES)
+        for seed in range(12):
+            rng = np.random.default_rng(seed)
+            K = int(rng.integers(1, 5))
+            W, P, Q = 24, 2, 6
+            cols, ccap, coffer, cactive = _random_columns(rng, K)
+            requests = rng.integers(0, 30, size=(W, P, R)).astype(np.int64)
+            podset_active = rng.random((W, P)) < 0.8
+            requests[~podset_active] = 0
+            wl_cq = rng.integers(0, Q, size=W).astype(np.int32)
+            mk_cq = rng.random(Q) < 0.7
+            admitted = rng.random(W) < 0.7
+            order = rng.permutation(W).astype(np.int64)
+
+            got = np.asarray(score_cluster_columns_impl(
+                jnp.asarray(ccap), jnp.asarray(coffer),
+                jnp.asarray(cactive), jnp.asarray(mk_cq),
+                jnp.asarray(requests), jnp.asarray(podset_active),
+                jnp.asarray(wl_cq), jnp.asarray(order),
+                jnp.asarray(admitted)))
+
+            # oracle: the mk-admitted rows in admission order
+            treq = np.where(podset_active[:, :, None], requests, 0).sum(1)
+            seq = [w for w in order.tolist()
+                   if mk_cq[wl_cq[w]] and admitted[w]]
+            reqs = [{RESOURCES[ri]: int(treq[w, ri]) for ri in range(R)}
+                    for w in seq]
+            placed = encode.place_remote_dicts(cols, reqs)
+            name_to_idx = {c[0]: i for i, c in enumerate(cols)}
+            want = np.full(W, -1, np.int32)
+            for w, name in zip(seq, placed):
+                if name is not None:
+                    want[w] = name_to_idx[name]
+            assert (got == want).all(), (seed, got.tolist(), want.tolist())
+            # non-mk / non-admitted rows never place
+            non = ~(mk_cq[wl_cq] & admitted)
+            assert (got[non] == -1).all()
+
+    def test_lost_cluster_columns_mask_to_zero(self):
+        # an inactive cluster can hold capacity but never receives a
+        # placement — its column is masked (the snapshot stamps
+        # active=False the moment the activity probe flips)
+        cols = ((u"w0", {("fa", "cpu"): 100}, False),
+                ("w1", {("fa", "cpu"): 100}, True))
+        placed = encode.place_remote_dicts(cols, [{"cpu": 10}, {"cpu": 10}])
+        assert placed == ["w1", "w1"]
+
+    def test_intra_cycle_accounting_consumes_capacity(self):
+        cols = (("w0", {("fa", "cpu"): 15}, True),
+                ("w1", {("fa", "cpu"): 100}, True))
+        placed = encode.place_remote_dicts(
+            cols, [{"cpu": 10}, {"cpu": 10}, {"cpu": 5}])
+        # the second workload no longer fits w0's remaining 5
+        assert placed == ["w0", "w1", "w0"]
+
+    def test_mk_cluster_survives_compact_pack(self):
+        import jax.numpy as jnp
+
+        from kueue_tpu.solver.kernel import pack_decisions_impl
+        W, P, R = 8, 1, 2
+        out = {"admitted": jnp.zeros(W, bool), "fit": jnp.zeros(W, bool),
+               "borrows": jnp.zeros(W, bool),
+               "chosen": jnp.zeros((W, P, R), jnp.int32),
+               "chosen_borrow": jnp.zeros((W, P, R), bool),
+               "usage": jnp.zeros((2, 2, 2), jnp.int64),
+               "cohort_usage": jnp.zeros((1, 2, 2), jnp.int64),
+               "mk_cluster": jnp.full(W, -1, jnp.int32)}
+        packed = pack_decisions_impl(out)
+        assert "mk_cluster" in packed and "admitted" not in packed
+
+
+def _mk_manager(clock, workers, quota_cpu=8, solver=None, worker_cpu=None):
+    worker_mgrs = {}
+    for name in workers:
+        w = KueueManager(clock=clock)
+        w.store.create(make_flavor("default"))
+        w.store.create(ClusterQueueWrapper("cq").resource_group(
+            flavor_quotas("default", cpu=worker_cpu or quota_cpu)).obj())
+        w.store.create(make_local_queue("lq", "default", "cq"))
+        w.run_until_idle()
+        worker_mgrs[name] = w
+    cfg = None
+    if solver is not None:
+        cfg = cfgpkg.Configuration()
+        cfg.solver.enable = True
+        cfg.solver.min_heads = 0
+    mgr = KueueManager(cfg=cfg, clock=clock, solver=solver,
+                       remote_clusters=worker_mgrs)
+    for name in workers:
+        mgr.store.create(asapi.MultiKueueCluster(
+            metadata=ObjectMeta(name=name)))
+    mgr.store.create(asapi.MultiKueueConfig(
+        metadata=ObjectMeta(name="mk-config"),
+        spec=asapi.MultiKueueConfigSpec(clusters=list(workers))))
+    ac = api.AdmissionCheck(metadata=ObjectMeta(name="mk-check"))
+    ac.spec.controller_name = MK_CONTROLLER
+    ac.spec.parameters = api.AdmissionCheckParametersReference(
+        kind="MultiKueueConfig", name="mk-config")
+    mgr.store.create(ac)
+    mgr.store.create(make_flavor("default"))
+    mgr.store.create(ClusterQueueWrapper("cq").resource_group(
+        flavor_quotas("default", cpu=quota_cpu))
+        .admission_checks("mk-check").obj())
+    mgr.store.create(make_local_queue("lq", "default", "cq"))
+    mgr.run_until_idle()
+    return mgr, worker_mgrs
+
+
+class TestControllerExecutesPlacements:
+    def test_single_cluster_matches_sequential_controller(self, clock):
+        # Acceptance gate: on single-cluster traffic the batched-column
+        # choice must equal the sequential controller's outcome (the
+        # only cluster that CAN reserve) for every workload — and the
+        # planned path must actually have executed (no mirror race).
+        mgr, workers = _mk_manager(clock, ["w1"])
+        for i in range(3):
+            mgr.store.create(WorkloadWrapper(f"wl{i}").queue("lq")
+                             .request("cpu", "2").obj())
+        mgr.schedule_until_settled()
+        workers["w1"].schedule_until_settled()
+        mgr.run_until_idle()
+        mk = mgr.multikueue
+        assert mk.placements_executed >= 3
+        for i in range(3):
+            key = f"default/wl{i}"
+            assert mk.planned.get(key) == "w1"
+            # the sequential outcome: the reserving cluster recorded by
+            # the first-reserve probe equals the batched choice
+            assert mk._reserving.get(key) == "w1"
+
+    def test_capacity_columns_mask_lost_cluster(self, clock):
+        mgr, workers = _mk_manager(clock, ["w1", "w2"])
+        cols, checks = mgr.multikueue.capacity_columns()
+        assert [c[0] for c in cols] == ["w1", "w2"]
+        assert checks == {"mk-check"}
+        assert all(active for _, _, active in cols)
+        assert all(caps for _, caps, _ in cols)
+        mgr.multikueue.mark_cluster_lost("w1")
+        cols, _ = mgr.multikueue.capacity_columns()
+        byname = {c[0]: c for c in cols}
+        assert byname["w1"][2] is False and not byname["w1"][1]
+        assert byname["w2"][2] is True
+        # snapshots pick the masked columns up immediately
+        snap = mgr.cache.snapshot()
+        assert {c[0]: c[2] for c in snap.remote_clusters} == {
+            "w1": False, "w2": True}
+        mgr.cache.release_snapshot(snap)
+        # placement avoids the lost cluster
+        mgr.store.create(WorkloadWrapper("late").queue("lq")
+                         .request("cpu", "2").obj())
+        mgr.schedule_until_settled()
+        assert mgr.multikueue.planned.get("default/late") == "w2"
+
+    def test_capacity_spills_to_second_cluster(self, clock):
+        # w1's capacity exhausts mid-cycle; the batched greedy places
+        # the overflow on w2 — one mirror each, no race. Local quota
+        # admits all four in one cycle; each WORKER only holds two.
+        mgr, workers = _mk_manager(clock, ["w1", "w2"], quota_cpu=8,
+                                   worker_cpu=4)
+        for i in range(4):  # 2 cpu each; w1 fits two, w2 takes the rest
+            mgr.store.create(WorkloadWrapper(f"wl{i}").queue("lq")
+                             .request("cpu", "2").obj())
+        mgr.schedule_until_settled()
+        placed = [mgr.multikueue.planned.get(f"default/wl{i}")
+                  for i in range(4)]
+        assert placed.count("w1") == 2 and placed.count("w2") == 2, placed
+        for i in range(4):
+            mirrors = [n for n, w in workers.items()
+                       if w.store.try_get("Workload", "default", f"wl{i}")
+                       is not None]
+            assert mirrors == [mgr.multikueue.planned[f"default/wl{i}"]]
+
+    def test_warm_ladder_covers_cluster_variants(self, clock):
+        # The warm helpers must register the EXACT keys a
+        # cluster-carrying dispatch computes (kdim = bucketed column
+        # shape), or every MultiKueue deployment would compile each
+        # variant mid-traffic on the admission thread.
+        from kueue_tpu.solver import BatchSolver
+        from kueue_tpu.solver.service import note_program
+        solver = BatchSolver()
+        mgr, _workers = _mk_manager(clock, ["w1", "w2"], solver=solver)
+        snap = mgr.cache.snapshot()
+        try:
+            assert snap.remote_clusters and snap.mk_check_names
+            ctx = solver.warm_setup(snap)
+            assert ctx.cluster is not None
+            kdim = ctx.cluster.ccap.shape
+            variants = solver._cluster_variants(ctx)
+            assert [v[1] for v in variants] == [None, kdim]
+            solver.warm_bucket(ctx, 8, max_ranks=(8,))
+            dims = solver._topo_dims(ctx.topo)
+            compact = solver._compact_flag(ctx.topo)
+            # the dispatch-site key for a cluster-carrying fused cycle
+            # at this bucket must already be registered (False = no
+            # mid-traffic compile would be counted)
+            key = ("fused", dims, 8, solver.max_podsets, 8, False,
+                   False, (), (), (), compact, kdim)
+            assert note_program(key) is False, key
+            # and the column-less twin too
+            key_none = key[:-1] + (None,)
+            assert note_program(key_none) is False
+        finally:
+            mgr.cache.release_snapshot(snap)
+        mgr.scheduler.stop()
+
+    def test_device_route_decodes_placements(self, clock):
+        # End-to-end through the SOLVER route: the fused solve's
+        # mk_cluster column drives the decode -> on_placement ->
+        # controller execution chain.
+        from kueue_tpu.solver import BatchSolver
+        solver = BatchSolver()
+        mgr, workers = _mk_manager(clock, ["w1"], solver=solver)
+        for i in range(4):
+            mgr.store.create(WorkloadWrapper(f"wl{i}").queue("lq")
+                             .request("cpu", "2").obj())
+        mgr.run_until_idle()
+        for _ in range(10):  # the speculative pipeline collects lazily
+            mgr.scheduler.schedule(timeout=0)
+            mgr.run_until_idle()
+            if len(mgr.multikueue.planned) == 4:
+                break
+        routes = set(mgr.scheduler.cycle_counts)
+        assert any(r.startswith("device") for r in routes), routes
+        # every admission carried a device-decoded placement
+        for i in range(4):
+            assert mgr.multikueue.planned.get(f"default/wl{i}") == "w1"
+            mirrors = [n for n, w in workers.items()
+                       if w.store.try_get("Workload", "default", f"wl{i}")
+                       is not None]
+            assert mirrors == ["w1"]
+        mgr.scheduler.stop()

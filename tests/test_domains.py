@@ -1,0 +1,230 @@
+"""Domain planner (parallel/domains.py) + multi-host mesh layout tests
+(ISSUE 13): randomized planner-vs-naive balance properties, plan
+determinism across process restarts (warm-ladder key stability), the
+mesh executable-cache key fix, and the ≥2-simulated-hosts bit-identity
+gate (subprocess via tools/mesh_probe.py — the host-platform device
+count must be forced before jax initializes)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kueue_tpu.parallel import domains
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestBalancedPartition:
+    def test_lpt_bound_randomized(self):
+        # LPT guarantee: max load <= (4/3 - 1/(3m)) * OPT, and
+        # OPT >= max(total/m, heaviest item).
+        for seed in range(20):
+            rng = np.random.default_rng(seed)
+            n = int(rng.integers(1, 200))
+            m = int(rng.integers(1, 9))
+            w = rng.integers(1, 1000, size=n)
+            bins, loads = domains.balanced_partition(w, m)
+            assert loads.sum() == w.sum()
+            # every item assigned to a valid bin
+            assert ((bins >= 0) & (bins < m)).all()
+            opt_lb = max(w.sum() / m, w.max())
+            assert loads.max() <= opt_lb * (4 / 3) + 1e-9
+
+    def test_beats_round_robin_on_residue_skew(self):
+        # The pre-planner layout (domain d -> device d mod n) collapses
+        # when heavy domains share a residue class — the exact shape a
+        # big tenant's cohorts land in with stable domain ids. LPT
+        # spreads them; round-robin stacks every heavy domain on one
+        # device.
+        n = 4
+        w = np.ones(32, np.int64)
+        w[::n] = 1000  # heavies all ≡ 0 (mod n)
+        _, lpt_loads = domains.balanced_partition(w, n)
+        _, rr_loads = domains.round_robin_partition(w, n)
+        assert lpt_loads.max() < rr_loads.max()
+        assert domains.imbalance_ratio(lpt_loads) < 1.5
+        assert domains.imbalance_ratio(rr_loads) > 3.0
+
+    def test_deterministic(self):
+        rng = np.random.default_rng(7)
+        w = rng.integers(1, 100, size=64)
+        a1, l1 = domains.balanced_partition(w, 5)
+        a2, l2 = domains.balanced_partition(w.copy(), 5)
+        assert (a1 == a2).all() and (l1 == l2).all()
+
+
+class TestDomainPlan:
+    def _inputs(self, seed=0, Q=16, C=4, W=48, F=3, R=2):
+        rng = np.random.default_rng(seed)
+        cq_cohort = np.where(rng.random(Q) < 0.5,
+                             rng.integers(0, C, size=Q), -1).astype(np.int32)
+        cohort_root = np.arange(C, dtype=np.int32)
+        offered = rng.random((Q, F, R)) < 0.7
+        wl_cq = rng.integers(0, Q, size=W).astype(np.int32)
+        return wl_cq, cq_cohort, cohort_root, offered
+
+    def test_every_occupied_domain_exactly_once(self):
+        wl_cq, cq_cohort, cohort_root, offered = self._inputs()
+        plan = domains.plan_domains(wl_cq, cq_cohort, cohort_root,
+                                    offered, 4)
+        dom = domains.workload_domains(wl_cq, cq_cohort, cohort_root)
+        assigned = plan.columns[plan.columns >= 0]
+        assert sorted(assigned.tolist()) == sorted(set(dom.tolist()))
+        assert plan.occupied == len(set(dom.tolist()))
+        assert plan.imbalance >= 1.0
+        assert plan.columns.shape == (4, plan.d_cols)
+
+    def test_weights_are_count_times_flavor_width(self):
+        # one CQ with wide flavors, one with a single flavor, equal
+        # workload counts: the wide CQ's domain must carry more weight.
+        Q, C, F, R = 2, 0, 4, 1
+        cq_cohort = np.full(Q, -1, np.int32)
+        cohort_root = np.zeros(0, np.int32)
+        offered = np.zeros((Q, F, R), bool)
+        offered[0, :, 0] = True      # flavor width 4
+        offered[1, 0, 0] = True      # flavor width 1
+        wl_cq = np.array([0] * 4 + [1] * 4, np.int32)
+        plan = domains.plan_domains(wl_cq, cq_cohort, cohort_root,
+                                    offered, 2)
+        # each synthetic domain lands on its own device; the wide one
+        # carries 4x the load
+        loads = sorted(plan.loads.tolist())
+        assert loads == [4, 16]
+
+    def test_fingerprint_stable_across_processes(self):
+        # Warm-ladder key stability: the fingerprint must be a pure
+        # function of the layout (blake2b over bytes — no hash()/id()),
+        # so a restarted process re-plans to the identical key.
+        wl_cq, cq_cohort, cohort_root, offered = self._inputs(seed=3)
+        p1 = domains.plan_domains(wl_cq, cq_cohort, cohort_root,
+                                  offered, 4)
+        code = (
+            "import numpy as np, json, sys;"
+            "from kueue_tpu.parallel import domains;"
+            "a=[np.asarray(x) for x in json.load(sys.stdin)];"
+            "p=domains.plan_domains(a[0],a[1],a[2],np.asarray(a[3],bool),4);"
+            "print(p.fingerprint)")
+        payload = json.dumps([wl_cq.tolist(), cq_cohort.tolist(),
+                              cohort_root.tolist(), offered.tolist()])
+        out = subprocess.run(
+            [sys.executable, "-c", code], input=payload, cwd=REPO,
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == p1.fingerprint
+        # and it is layout-sensitive
+        p2 = domains.plan_domains(wl_cq, cq_cohort, cohort_root,
+                                  offered, 2)
+        assert p2.fingerprint != p1.fingerprint
+
+    def test_plan_problems_roundtrip(self):
+        rng = np.random.default_rng(5)
+        for n_dev in (1, 3, 4):
+            weights = rng.integers(1, 50, size=11)
+            perm, inv, b_local = domains.plan_problems(weights, n_dev)
+            assert len(perm) == n_dev * b_local
+            # pad lanes point at the sentinel row (== N)
+            real = perm[perm < len(weights)]
+            assert sorted(real.tolist()) == list(range(len(weights)))
+            # inv restores original order through the permuted layout
+            gathered = perm.copy()  # "output" in perm order
+            assert (gathered[inv] == np.arange(len(weights))).all()
+
+
+class TestWarmLadderMeshFingerprint:
+    def test_mesh_shape_keys_fingerprint(self):
+        from kueue_tpu.solver.warmgov import topology_fingerprint
+
+        class T:
+            nominal = np.zeros((4, 2, 2))
+            cohort_subtree = np.zeros((2, 2, 2))
+            cq_chain = np.zeros((4, 1))
+
+        class MeshLike:
+            def __init__(self, names, shape):
+                self.axis_names = names
+
+                class D:
+                    pass
+                self.devices = np.empty(shape, object)
+
+        base = topology_fingerprint(T, 4)
+        assert base == topology_fingerprint(T, 4)  # deterministic
+        one = topology_fingerprint(T, 4, mesh=MeshLike(("cohorts",), (8,)))
+        two = topology_fingerprint(T, 4,
+                                   mesh=MeshLike(("hosts", "cohorts"),
+                                                 (2, 4)))
+        four = topology_fingerprint(T, 4,
+                                    mesh=MeshLike(("hosts", "cohorts"),
+                                                  (4, 2)))
+        assert len({base, one, two, four}) == 4  # every layout distinct
+        assert two == topology_fingerprint(
+            T, 4, mesh=MeshLike(("hosts", "cohorts"), (2, 4)))
+
+
+class TestShardedExecutableCache:
+    def test_cache_keys_on_layout_not_identity(self):
+        # ISSUE 13 satellite: the pre-v4 cache keyed on id(mesh) — a
+        # recycled allocation (or a re-built mesh over a different host
+        # count) could be served a stale executable. The key is now the
+        # full (axis names, shape, device set) fingerprint: two Mesh
+        # OBJECTS over the same layout share one entry; a different
+        # axis layout over the same device gets its own.
+        import jax
+
+        from kueue_tpu.parallel import mesh as meshmod
+        from kueue_tpu.solver.encode import State
+        from kueue_tpu.solver.synth import synth_solver_inputs
+        import jax.numpy as jnp
+
+        topo, usage, cohort_usage, wl = synth_solver_inputs(
+            num_cqs=4, num_cohorts=1, num_flavors=2, num_resources=2,
+            num_workloads=8)
+        topo_dev = {k: jnp.asarray(v) for k, v in topo.items()}
+
+        class B:
+            requests = wl["requests"]
+            podset_active = wl["podset_active"]
+            wl_cq = wl["wl_cq"]
+            priority = wl["priority"]
+            timestamp = wl["timestamp"]
+            eligible = wl["eligible"]
+            solvable = wl["solvable"]
+
+        state = State(usage=usage, cohort_usage=cohort_usage)
+        dev = jax.devices()[:1]
+        meshmod._SHARDED_CACHE.clear()
+        m1 = meshmod.make_mesh(dev)
+        m2 = meshmod.make_mesh(dev)  # re-built mesh, same layout
+        assert meshmod.mesh_fingerprint(m1) == meshmod.mesh_fingerprint(m2)
+        meshmod.solve_cycle_sharded(m1, topo_dev, state, B, 1)
+        n1 = len(meshmod._SHARDED_CACHE)
+        meshmod.solve_cycle_sharded(m2, topo_dev, state, B, 1)
+        assert len(meshmod._SHARDED_CACHE) == n1  # layout hit, no rebuild
+        m3 = meshmod.make_host_mesh(dev, hosts=1)  # two-axis layout
+        assert meshmod.mesh_fingerprint(m3) != meshmod.mesh_fingerprint(m1)
+        r3 = meshmod.solve_cycle_sharded(m3, topo_dev, state, B, 1)
+        assert len(meshmod._SHARDED_CACHE) == n1 + 1  # distinct entry
+        # and the two-axis single-device program is still bit-identical
+        r1 = meshmod.solve_cycle_sharded(m1, topo_dev, state, B, 1)
+        assert bool(jnp.array_equal(r1["admitted"], r3["admitted"]))
+
+
+@pytest.mark.slow
+class TestMultiHostIdentitySweep:
+    def test_probe_identity_wide(self):
+        # The wide randomized sweep (tier-1 runs the smoke via
+        # tests/test_tools.py::TestMeshProbe).
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "mesh_probe.py"),
+             "--hosts", "1,2,4,8", "--devices", "8", "--check-identity",
+             "--seed", "11", "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=580,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr[-2000:]
+        verdict = json.loads(out.stdout.strip().splitlines()[-1])
+        assert verdict["ok"] and not verdict["identity_failures"]

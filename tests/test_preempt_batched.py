@@ -501,7 +501,7 @@ class TestWarmPreemptLadder:
                                fair_sharing=True, fs_flags=flags)
         sync = [k for k in keys if k[0] == "preempt"]
         # key layout: ("preempt", dims, W, P, max_rank, fair_sharing,
-        #              sr, pshapes, fshapes, flags, compact)
+        #              sr, pshapes, fshapes, flags, compact, kdim)
         minimal_only = [k for k in sync if k[7] and not k[8]]
         fair_only = [k for k in sync if not k[7] and k[8]]
         mixed = [k for k in sync if k[7] and k[8]]
@@ -516,11 +516,13 @@ class TestWarmPreemptLadder:
             # with a cohort-wide fair batch (QL bucket > 1)
             assert k[7][0][1] == 1 and k[8][0][1] > 1
         # resident/arena variants mirror the same families (key tail:
-        # ..., pshapes, fshapes, flags, compact)
+        # ..., pshapes, fshapes, flags, compact, kdim — kdim is the
+        # ISSUE-13 cluster-column dims, None on every warmed variant)
         res = [k for k in keys if k[0] in ("resident", "arena")]
-        assert any(k[-4] and not k[-3] for k in res)
-        assert any(not k[-4] and k[-3] for k in res)
-        assert any(k[-4] and k[-3] for k in res)
+        assert all(k[-1] is None for k in res)
+        assert any(k[-5] and not k[-4] for k in res)
+        assert any(not k[-5] and k[-4] for k in res)
+        assert any(k[-5] and k[-4] for k in res)
 
 
 class TestTenantStormRouteCoverage:

@@ -199,11 +199,12 @@ class TestEventRecorderRing:
 # ----------------------------------------------------------------------
 
 class TestScenarioSmoke:
-    def test_catalog_lists_all_eight(self):
-        assert list_scenarios() == ["cluster_loss", "diurnal",
-                                    "flavor_churn", "mixed_jobs",
-                                    "requeue_flood", "restart_storm",
-                                    "tenant_storm", "visibility_storm"]
+    def test_catalog_lists_all_nine(self):
+        assert list_scenarios() == ["cluster_loss", "cluster_rebalance",
+                                    "diurnal", "flavor_churn",
+                                    "mixed_jobs", "requeue_flood",
+                                    "restart_storm", "tenant_storm",
+                                    "visibility_storm"]
 
     def test_unknown_scenario_and_scale_rejected(self):
         with pytest.raises(KeyError):
@@ -277,6 +278,23 @@ class TestScenarioSmoke:
         assert res.counters["double_dispatched"] == 0
         assert res.counters["unplaced_admitted"] == 0
         assert res.counters["orphan_collected"] is True
+        assert not res.starved
+
+    def test_cluster_rebalance_batched_columns_bounded_replacement(self):
+        # scenario (i), ISSUE 13: loss/rejoin mid-storm on the
+        # batched-column placement path — zero double-dispatch, bounded
+        # re-placement latency, and the planned single-mirror execution
+        # actually engaged (no mirror-everywhere race, no expiries).
+        res = run_scenario("cluster_rebalance", seed=0, scale="smoke")
+        assert res.ok, res.violations
+        assert res.counters["survivors_at_loss"] > 0
+        assert res.replacement_latency_s is not None
+        assert res.replacement_latency_s <= 90.0
+        assert res.counters["double_dispatched"] == 0
+        assert res.counters["unplaced_admitted"] == 0
+        assert res.counters["placements_planned"] > 0
+        assert res.counters["placements_executed"] > 0
+        assert res.counters["placements_expired"] == 0
         assert not res.starved
 
     def test_mixed_jobs_admission_and_eviction_parity(self):
@@ -381,10 +399,10 @@ class TestScenarioRunCLI:
 
 @pytest.mark.slow
 class TestFullSweep:
-    @pytest.mark.parametrize("name", ["cluster_loss", "diurnal",
-                                      "flavor_churn", "mixed_jobs",
-                                      "requeue_flood", "restart_storm",
-                                      "tenant_storm"])
+    @pytest.mark.parametrize("name", ["cluster_loss", "cluster_rebalance",
+                                      "diurnal", "flavor_churn",
+                                      "mixed_jobs", "requeue_flood",
+                                      "restart_storm", "tenant_storm"])
     def test_full_scale_green(self, name):
         res = run_scenario(name, seed=0, scale="full")
         assert res.ok, (name, res.violations)
@@ -392,6 +410,7 @@ class TestFullSweep:
 
     @pytest.mark.parametrize("seed", [1, 2])
     def test_failure_scenarios_hold_across_seeds(self, seed):
-        for name in ("requeue_flood", "cluster_loss", "restart_storm"):
+        for name in ("requeue_flood", "cluster_loss", "cluster_rebalance",
+                     "restart_storm"):
             res = run_scenario(name, seed=seed, scale="full")
             assert res.ok, (name, seed, res.violations)

@@ -1,0 +1,296 @@
+"""Mesh probe: multi-host domain layout, balance and DCN traffic table.
+
+Operator tooling for the multi-host DCN scale-out (ISSUE 13): forces a
+host-platform device count (simulated hosts), builds the two-axis
+``("hosts", "cohorts")`` mesh for each requested host count, runs the
+sharded admission cycle on synthetic north-star-shaped traffic, and
+reports —
+
+- per-host conflict-domain assignment (the planner's cost-balanced
+  layout vs the naive round-robin baseline),
+- the imbalance ratio (max/mean device load; FAILS the probe > 1.5x),
+- DCN-collective bytes per cycle (Phase A all_gather vs the Phase B
+  reduction tensors — the layout contract that only the small
+  per-domain reductions cross hosts in Phase B),
+- the weak-scaling curve: per-cycle wall time with conflict domains
+  per device held constant across host counts (sub-linear growth in
+  total domains is the scale-out win),
+- decision bit-identity of every mesh shape against the single-chip
+  fused oracle (--check-identity: randomized seeds, exit non-zero on
+  any divergence).
+
+Same CLI contract as tools/chaos_run.py: human table (or --json) to
+stderr, one parseable JSON verdict line to stdout, non-zero exit on a
+violated gate (imbalance > 1.5x, or identity divergence under
+--check-identity). The weak-scaling curve is REPORTED but never gated
+here: sub-linearity is only judgeable on real multi-host devices
+(simulated hosts share one machine's cores), so the judging — or the
+refusal into the device-witness-debt manifest — lives in
+bench.bench_multihost.
+
+Usage: python tools/mesh_probe.py [--hosts 1,2,4,8] [--devices 8]
+           [--cqs-per-host 64] [--wl-per-host 128] [--cycles 4]
+           [--check-identity] [--seed 0] [--json]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _force_devices(n: int) -> None:
+    """Must run before jax import: the host-platform device count is
+    latched at backend init (the simulate-multi-host knob the ISSUE
+    names: XLA_FLAGS=--xla_force_host_platform_device_count)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            f"{flags} --xla_force_host_platform_device_count={n}".strip()
+
+
+IMBALANCE_GATE = 1.5
+
+
+def _build_inputs(num_cqs: int, num_cohorts: int, num_workloads: int,
+                  seed: int = 0):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kueue_tpu.solver.encode import State
+    from kueue_tpu.solver.synth import synth_solver_inputs
+    topo, usage, cohort_usage, wl = synth_solver_inputs(
+        num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=4,
+        num_resources=2, num_workloads=num_workloads, seed=seed)
+    topo_dev = {k: jnp.asarray(v) for k, v in topo.items()}
+
+    class Batch:
+        requests = wl["requests"]
+        podset_active = wl["podset_active"]
+        wl_cq = wl["wl_cq"]
+        priority = wl["priority"]
+        timestamp = wl["timestamp"]
+        eligible = wl["eligible"]
+        solvable = wl["solvable"]
+
+    state = State(usage=usage, cohort_usage=cohort_usage)
+    return topo, topo_dev, state, Batch, wl, np
+
+
+def _dcn_bytes(mesh, W, P, R, F, Q, C) -> dict:
+    """Cross-host collective bytes per cycle for a (hosts, per_host)
+    mesh: each host ships (H-1)/H of a gathered/reduced tensor across
+    DCN. Phase A gathers the per-workload assignment outputs; Phase B
+    reduces only the usage deltas + admitted mask (the per-domain
+    reduction tensors the layout confines DCN traffic to)."""
+    hosts = dict(mesh.shape).get("hosts", 1)
+    if hosts <= 1:
+        return {"phase_a_gather": 0, "phase_b_reduce": 0}
+    frac = (hosts - 1) / hosts
+    phase_a = (W * 2            # fit + borrows (bool)
+               + W * P * R * 4  # chosen (int32)
+               + W * P * R      # chosen_borrow (bool)
+               + W * F * R * 8)  # asg_usage (int64)
+    phase_b = Q * F * R * 8 + C * F * R * 8 + W * 4
+    return {"phase_a_gather": int(phase_a * frac),
+            "phase_b_reduce": int(phase_b * frac)}
+
+
+def probe(hosts_list, cqs_per_host: int, wl_per_host: int,
+          cycles: int, seed: int) -> dict:
+    import jax
+
+    from kueue_tpu.parallel import domains
+    from kueue_tpu.parallel.mesh import (make_host_mesh, plan_cycle,
+                                         solve_cycle_sharded)
+    devices = jax.devices()
+    rows = []
+    for h in hosts_list:
+        if h > len(devices):
+            rows.append({"hosts": h, "skipped":
+                         f"only {len(devices)} devices"})
+            continue
+        mesh = make_host_mesh(devices[:h], hosts=h)
+        # weak scaling: domains scale with hosts, domains/DEVICE constant
+        topo, topo_dev, state, batch, wl, np = _build_inputs(
+            num_cqs=cqs_per_host * h, num_cohorts=max(cqs_per_host // 4, 1) * h,
+            num_workloads=wl_per_host * h, seed=seed)
+        plan = plan_cycle(mesh, topo_dev, batch, topo_np=None)
+        # round-robin baseline (the pre-planner `d mod n` layout) under
+        # the SAME cost model — count x flavor width over the same
+        # occupied-domain set — so the imbal columns are comparable
+        n_dev = int(mesh.devices.size)
+        dom = domains.workload_domains(batch.wl_cq, topo["cq_cohort"],
+                                       topo["cohort_root"])
+        D = len(topo["cohort_root"]) + len(topo["cq_cohort"])
+        fw = domains.flavor_width(topo["offered"])
+        weights = np.bincount(
+            dom, weights=fw[np.asarray(batch.wl_cq)].astype(np.float64),
+            minlength=D).astype(np.int64)
+        occupied = np.flatnonzero(np.bincount(dom, minlength=D))
+        naive_loads = np.zeros(n_dev, np.int64)
+        np.add.at(naive_loads, occupied % n_dev, weights[occupied])
+        times = []
+        for c in range(max(cycles, 2)):
+            t0 = time.perf_counter()
+            out = solve_cycle_sharded(mesh, topo_dev, state, batch, 1,
+                                      plan=plan)
+            jax.block_until_ready(out["admitted"])
+            times.append(time.perf_counter() - t0)
+        warm = sorted(times[1:])  # drop the compile cycle
+        W, P, R = batch.requests.shape
+        Q, F, _ = topo["nominal"].shape
+        C = topo["cohort_subtree"].shape[0]
+        rows.append({
+            "hosts": h,
+            "devices": int(mesh.devices.size),
+            "mesh_shape": dict(mesh.shape),
+            "occupied_domains": plan.occupied,
+            "domains_per_device": plan.occupied / mesh.devices.size,
+            "columns_per_device": plan.d_cols,
+            "planner_loads": plan.loads.tolist(),
+            "planner_imbalance": plan.imbalance,
+            "round_robin_imbalance": domains.imbalance_ratio(naive_loads),
+            "plan_fingerprint": plan.fingerprint,
+            "cycle_s_p50": warm[len(warm) // 2],
+            "dcn_bytes_per_cycle": _dcn_bytes(mesh, W, P, R, F, Q, C),
+            "admitted": int(np.asarray(out["admitted"]).sum()),
+        })
+    report = {"hosts": hosts_list, "rows": rows,
+              "backend": jax.default_backend(),
+              "total_devices": len(devices)}
+    ran = [r for r in rows if "skipped" not in r]
+    if ran:
+        report["max_imbalance"] = max(r["planner_imbalance"] for r in ran)
+        first, last = ran[0], ran[-1]
+        if last["hosts"] > first["hosts"]:
+            # weak scaling: per-cycle time growth vs total-domain growth
+            growth = last["cycle_s_p50"] / max(first["cycle_s_p50"], 1e-9)
+            domain_growth = last["hosts"] / first["hosts"]
+            report["weak_scaling"] = {
+                "cycle_time_growth": growth,
+                "domain_growth": domain_growth,
+                "sublinear": growth < domain_growth,
+            }
+    return report
+
+
+def check_identity(hosts_list, seed: int, cases: int = 3) -> dict:
+    """Randomized bit-identity: every mesh shape's admitted set, usage
+    and cohort usage must equal the single-chip fused oracle's."""
+    import jax
+    import jax.numpy as jnp
+
+    from kueue_tpu.parallel.mesh import make_host_mesh, solve_cycle_sharded
+    from kueue_tpu.solver.kernel import max_rank_bound, solve_cycle_fused_impl
+    devices = jax.devices()
+    failures = []
+    checked = 0
+    for case in range(cases):
+        topo, topo_dev, state, batch, wl, np = _build_inputs(
+            num_cqs=24 + 8 * case, num_cohorts=6 + 2 * case,
+            num_workloads=48 + 16 * case, seed=seed + case)
+        mr = max_rank_bound(wl["wl_cq"], topo["cq_cohort"],
+                            topo["cohort_root"])
+        ref = solve_cycle_fused_impl(
+            topo_dev, jnp.asarray(state.usage),
+            jnp.asarray(state.cohort_usage), jnp.asarray(batch.requests),
+            jnp.asarray(batch.podset_active), jnp.asarray(batch.wl_cq),
+            jnp.asarray(batch.priority), jnp.asarray(batch.timestamp),
+            jnp.asarray(batch.eligible), jnp.asarray(batch.solvable),
+            num_podsets=1, max_rank=mr)
+        for h in hosts_list:
+            if h > len(devices):
+                continue
+            mesh = make_host_mesh(devices[:h], hosts=h)
+            out = solve_cycle_sharded(mesh, topo_dev, state, batch, 1)
+            checked += 1
+            for key in ("admitted", "usage", "cohort_usage"):
+                if not bool(jnp.array_equal(out[key], ref[key])):
+                    failures.append({"case": case, "hosts": h, "key": key})
+    return {"cases": cases, "shapes_checked": checked,
+            "failures": failures}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    def opt(name, default):
+        if name in argv:
+            i = argv.index(name)
+            val = argv[i + 1]
+            del argv[i:i + 2]
+            return val
+        return default
+
+    as_json = "--json" in argv
+    identity = "--check-identity" in argv
+    argv = [a for a in argv if a not in ("--json", "--check-identity")]
+    hosts_list = [int(h) for h in opt("--hosts", "1,2,4,8").split(",")]
+    n_devices = int(opt("--devices", str(max(hosts_list))))
+    cqs_per_host = int(opt("--cqs-per-host", "64"))
+    wl_per_host = int(opt("--wl-per-host", "128"))
+    cycles = int(opt("--cycles", "4"))
+    seed = int(opt("--seed", "0"))
+
+    _force_devices(n_devices)  # before the first jax import
+
+    report = probe(hosts_list, cqs_per_host, wl_per_host, cycles, seed)
+    if identity:
+        report["identity"] = check_identity(hosts_list, seed)
+
+    if as_json:
+        print(json.dumps(report), file=sys.stderr, flush=True)
+    else:
+        head = (f"{'hosts':>5} {'dev':>4} {'domains':>8} {'cols/dev':>8} "
+                f"{'imbal':>6} {'rr-imbal':>8} {'cycle_p50':>10} "
+                f"{'dcn_B(A/B)':>18}")
+        lines = [head, "-" * len(head)]
+        for r in report["rows"]:
+            if "skipped" in r:
+                lines.append(f"{r['hosts']:>5} skipped: {r['skipped']}")
+                continue
+            d = r["dcn_bytes_per_cycle"]
+            lines.append(
+                f"{r['hosts']:>5} {r['devices']:>4} "
+                f"{r['occupied_domains']:>8} {r['columns_per_device']:>8} "
+                f"{r['planner_imbalance']:>6.2f} "
+                f"{r['round_robin_imbalance']:>8.2f} "
+                f"{r['cycle_s_p50']:>10.4f} "
+                f"{d['phase_a_gather']:>8}/{d['phase_b_reduce']}")
+        if "weak_scaling" in report:
+            ws = report["weak_scaling"]
+            lines.append(f"weak scaling: cycle-time x{ws['cycle_time_growth']:.2f} "
+                         f"over domains x{ws['domain_growth']:.0f} "
+                         f"({'SUB' if ws['sublinear'] else 'SUPER'}-linear)")
+        print("\n".join(lines), file=sys.stderr, flush=True)
+
+    verdict = {
+        "hosts": report["hosts"],
+        "total_devices": report["total_devices"],
+        "max_imbalance": report.get("max_imbalance"),
+        "weak_scaling": report.get("weak_scaling"),
+        "identity_failures": (report.get("identity", {}) or {}).get(
+            "failures", []) if identity else None,
+        "rows": [{k: r.get(k) for k in ("hosts", "devices",
+                                        "occupied_domains",
+                                        "planner_imbalance", "cycle_s_p50",
+                                        "skipped")}
+                 for r in report["rows"]],
+    }
+    ok = True
+    if report.get("max_imbalance") is not None \
+            and report["max_imbalance"] > IMBALANCE_GATE:
+        ok = False
+    if identity and verdict["identity_failures"]:
+        ok = False
+    verdict["ok"] = ok
+    print(json.dumps(verdict))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

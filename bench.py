@@ -707,6 +707,106 @@ def bench_trace_overhead(num_cqs=256, num_cohorts=32, spans_per_cycle=16):
     return off_pct
 
 
+def bench_journey_overhead(num_cqs=256, num_cohorts=32):
+    """Workload journey ledger (kueue_tpu/obs/journey.py): pin the cost
+    contract, mirroring trace_overhead. Disabled, every scheduler hook
+    is one attribute load + is-None compare (scheduler.journeys is
+    None) — asserted <=1% of a fault-free cycle at one hook per entry;
+    enabled, a hook is a span append under the ledger lock — also
+    asserted <=1% at one hook per head. Then runs ledgered cycles
+    end-to-end and checks the journeys are well-formed (sealed on
+    admission, LRU bounded, zero retained after close)."""
+    import timeit
+
+    from kueue_tpu.metrics import Registry
+    from kueue_tpu.obs.journey import JourneyLedger
+    from kueue_tpu.solver import BatchSolver
+
+    flavors = ["f0"]
+    sched, cache, queues, client, clock = build_env(
+        num_cqs, num_cohorts, flavors, nominal_units=400,
+        solver=BatchSolver())
+    n = 0
+
+    def submit_wave():
+        nonlocal n
+        for i in range(num_cqs):
+            wl = make_workload(f"w{n}", f"lq{i}", cpu_units=2,
+                               creation=float(n))
+            queues.add_or_update_workload(wl)
+            n += 1
+
+    def cycle():
+        sched.schedule(timeout=0)
+        clock.advance(1.0)
+
+    for _ in range(2):  # warm: compile the shape buckets
+        submit_wave()
+        cycle()
+    times = []
+    for _ in range(4):
+        submit_wave()
+        t0 = time.perf_counter()
+        cycle()
+        times.append(time.perf_counter() - t0)
+    clean_p50 = p50(times)
+
+    # Disabled per-hook cost: the exact expression every hook site
+    # evaluates when no ledger is wired.
+    per_off_s = timeit.timeit(
+        lambda: sched.journeys is None, number=200_000) / 200_000
+    # One hook per entry per cycle (requeue_and_update / admit).
+    off_pct = 100.0 * (num_cqs * per_off_s) / max(clean_p50, 1e-9)
+    assert off_pct <= 1.0, (off_pct, clean_p50)
+
+    # Enabled per-hook cost: a requeued-span append on a live ledger.
+    led = JourneyLedger(capacity=4096, metrics=Registry(), clock=clock,
+                        generation_source=cache.generation_token)
+    led.begin_cycle(1, cache.generation_token())
+    from kueue_tpu.core import workload as wlpkg
+    from kueue_tpu.queue import RequeueReason
+    sample_info = wlpkg.Info(make_workload("bench-probe", "lq0",
+                                           cpu_units=2))
+    sample_info.cluster_queue = "cq0"
+    per_on_s = timeit.timeit(
+        lambda: led.requeued(sample_info, "nominated",
+                             RequeueReason.GENERIC),
+        number=50_000) / 50_000
+    on_pct = 100.0 * (num_cqs * per_on_s) / max(clean_p50, 1e-9)
+    assert on_pct <= 1.0, (on_pct, clean_p50)
+
+    # Ledgered cycles end-to-end: journeys seal on admission and the
+    # ledger stays bounded + leak-free.
+    led2 = JourneyLedger(capacity=128, metrics=Registry(), clock=clock,
+                         generation_source=cache.generation_token)
+    queues.add_journey_listener(led2.note_queue_delta)
+    sched.journeys = led2
+    for _ in range(6):
+        submit_wave()
+        cycle()
+    st = led2.status()
+    assert st["completed"] > 0, st
+    assert st["active"] <= 128, st
+    # /metrics and the ledger share one producer: histogram count ==
+    # sealed journeys (the reconcile-by-construction satellite).
+    hist_count = sum(s[2] for s in
+                     led2.metrics.admission_wait_time.series.values())
+    assert hist_count == st["completed"], (hist_count, st["completed"])
+    led2.close()
+    assert led2.retained == 0
+    sched.journeys = None
+
+    log({"bench": "journey_overhead", "cqs": num_cqs,
+         "clean_cycle_p50_ms": round(clean_p50 * 1e3, 2),
+         "disabled_hook_ns": round(per_off_s * 1e9, 1),
+         "enabled_hook_ns": round(per_on_s * 1e9, 1),
+         "disabled_overhead_pct": round(off_pct, 4),
+         "enabled_overhead_pct": round(on_pct, 4),
+         "journeys_completed": st["completed"],
+         "lru_evictions": st["lru_evictions"]})
+    return off_pct
+
+
 def bench_overload_shed(num_cqs=256, num_cohorts=32, backlog_waves=10,
                         storm_cycles=24, shed_heads=32, survival_heads=8):
     """Bounded-cycle admission (kueue_tpu/resilience/degrade.py): a
@@ -2313,6 +2413,7 @@ def main():
     arena_speedup = bench_workload_arena()
     bench_device_fault_recovery()
     bench_trace_overhead()
+    bench_journey_overhead()
     bench_overload_shed()
     bench_scenario_slo()
     bench_visibility_storm()

@@ -204,6 +204,10 @@ class StoreConfig:
 # Cycle flight recorder defaults (kueue_tpu/obs/OBSERVABILITY.md).
 DEFAULT_FLIGHT_RECORDER_CAPACITY = 256
 
+# Workload journey ledger defaults (kueue_tpu/obs/journey.py).
+DEFAULT_JOURNEY_LEDGER_CAPACITY = 8192
+DEFAULT_JOURNEY_EXEMPLARS = 8
+
 
 @dataclass
 class ObservabilityConfig:
@@ -217,10 +221,23 @@ class ObservabilityConfig:
     seal publishes an immutable pending-position view served by the
     visibility server instead of walking live queue state per request;
     disabling reverts reads to the live (per-request) visibility API
-    and restores the maintainer's snapshot shell recycling."""
+    and restores the maintainer's snapshot shell recycling.
+
+    ``journey_enable`` wires the workload journey ledger
+    (obs/journey.py): every workload accumulates a causally-stamped
+    span timeline (queued -> requeued(cycle)... -> admitted) in a
+    bounded LRU of ``journey_ledger_capacity`` active journeys, with
+    the ``journey_exemplars`` slowest completed journeys retained in
+    full for /debug/journeys. Disabling drops every hook to one
+    is-None compare (the journey_overhead bench row pins both modes
+    at <=1% of a cycle) and reverts the wait-time histograms to their
+    direct call sites."""
     flight_recorder_enable: bool = True
     flight_recorder_capacity: int = DEFAULT_FLIGHT_RECORDER_CAPACITY
     query_plane_enable: bool = True
+    journey_enable: bool = True
+    journey_ledger_capacity: int = DEFAULT_JOURNEY_LEDGER_CAPACITY
+    journey_exemplars: int = DEFAULT_JOURNEY_EXEMPLARS
 
 # Device-fault containment defaults (kueue_tpu/resilience) — single
 # source for both the dataclass defaults and load()'s fallbacks.
@@ -407,6 +424,10 @@ def validate(cfg: Configuration) -> list[str]:
         errs.append("solver.warmupDeadline must be positive")
     if cfg.observability.flight_recorder_capacity < 1:
         errs.append("observability.flightRecorderCapacity must be >= 1")
+    if cfg.observability.journey_ledger_capacity < 1:
+        errs.append("observability.journeyLedgerCapacity must be >= 1")
+    if cfg.observability.journey_exemplars < 1:
+        errs.append("observability.journeyExemplars must be >= 1")
     sc = cfg.scheduler
     if sc.cycle_budget_s < 0:
         errs.append("scheduler.cycleBudget must be >= 0 (0 disables "
@@ -566,6 +587,11 @@ def load(raw: dict) -> Configuration:
             flight_recorder_capacity=o.get(
                 "flightRecorderCapacity", DEFAULT_FLIGHT_RECORDER_CAPACITY),
             query_plane_enable=o.get("queryPlaneEnable", True),
+            journey_enable=o.get("journeyEnable", True),
+            journey_ledger_capacity=o.get(
+                "journeyLedgerCapacity", DEFAULT_JOURNEY_LEDGER_CAPACITY),
+            journey_exemplars=o.get(
+                "journeyExemplars", DEFAULT_JOURNEY_EXEMPLARS),
         )
     cfg.feature_gates = dict(raw.get("featureGates", {}))
     cfg = set_defaults(cfg)

@@ -149,6 +149,12 @@ class MultiKueueController:
         self.placements_planned = 0    # decisions received
         self.placements_executed = 0   # single-cluster mirrors performed
         self.placements_expired = 0    # plans dropped to the mirror race
+        # Optional obs JourneyLedger (manager wiring): the planned-
+        # mirror lifecycle stamps mk-planned/executed/expired spans —
+        # with the cluster name — onto the workload's journey, so
+        # cross-cluster placement stays causal in the timeline
+        # (ISSUE 14; post-PR-13 mesh context).
+        self.journeys = None
         self._ctrl = None  # workqueue handle, set by setup_*
 
     def _remote_store(self, cluster_name: str) -> Optional[Store]:
@@ -210,6 +216,8 @@ class MultiKueueController:
         self.planned[wl_key] = cluster_name
         self._planned_at[wl_key] = self.clock.now()
         self.placements_planned += 1
+        if self.journeys is not None:
+            self.journeys.mk_event(wl_key, "planned", cluster_name)
         if self._ctrl is not None:
             self._ctrl.enqueue(wl_key)
 
@@ -423,6 +431,9 @@ class MultiKueueController:
                 self.planned.pop(wlpkg.key(wl), None)
                 self._planned_at.pop(wlpkg.key(wl), None)
                 self.placements_expired += 1
+                if self.journeys is not None:
+                    self.journeys.mk_event(wlpkg.key(wl), "expired",
+                                           planned)
             else:
                 targets = [planned]
                 single_mirror = True
@@ -446,6 +457,9 @@ class MultiKueueController:
                         # planned cluster — re-reconciles of an
                         # existing mirror don't inflate the surface
                         self.placements_executed += 1
+                        if self.journeys is not None:
+                            self.journeys.mk_event(wlpkg.key(wl),
+                                                   "executed", cluster)
                 except AlreadyExists:
                     pass
             adapter = self._adapter_for(wl)

@@ -36,10 +36,11 @@ class CoreControllers:
 def setup_core_controllers(runtime: Runtime, store: Store, queues, cache,
                            recorder: EventRecorder, cfg=None, metrics=None,
                            registered_check_controllers: Optional[set] = None,
-                           obs_recorder=None) -> CoreControllers:
+                           obs_recorder=None, journeys=None) -> CoreControllers:
     clock = runtime.clock
     wl_r = WorkloadReconciler(store, queues, cache, recorder, clock, cfg,
-                              metrics, obs_recorder=obs_recorder)
+                              metrics, obs_recorder=obs_recorder,
+                              journeys=journeys)
     cq_r = ClusterQueueReconciler(store, queues, cache, recorder, clock, metrics)
     lq_r = LocalQueueReconciler(store, queues, cache, recorder, clock, metrics)
     ac_r = AdmissionCheckReconciler(store, queues, cache, recorder, clock,

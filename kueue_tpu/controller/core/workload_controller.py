@@ -36,7 +36,8 @@ class WorkloadReconciler:
     def __init__(self, store: Store, queues, cache, recorder: EventRecorder,
                  clock, cfg: Optional[cfgpkg.Configuration] = None, metrics=None,
                  watchers: Optional[list] = None,
-                 rng: Optional[random.Random] = None, obs_recorder=None):
+                 rng: Optional[random.Random] = None, obs_recorder=None,
+                 journeys=None):
         self.store = store
         self.queues = queues
         self.cache = cache
@@ -49,6 +50,11 @@ class WorkloadReconciler:
         # trace is open (no-op otherwise — same disabled contract as
         # every recorder hook).
         self.obs_recorder = obs_recorder
+        # Optional obs JourneyLedger: check-gated admissions and
+        # evictions stamp the workload's journey, and the admission
+        # wait-time histograms are fed FROM the ledger's seal (one
+        # emission site — ISSUE 14). None = direct metrics calls.
+        self.journeys = journeys
         # seeded for reproducible backoff jitter in the deterministic sim
         self.rng = rng or random.Random(0)
         # MultiKueue et al. observe workload transitions (reference:
@@ -155,7 +161,15 @@ class WorkloadReconciler:
                     wl, "Normal", "Admitted",
                     f"Admitted by ClusterQueue {wl.status.admission.cluster_queue}, "
                     f"wait time since reservation was {checks_wait:.0f}s")
-                if self.metrics and cq_name:
+                if self.journeys is not None:
+                    # THE emission site for check-gated admission SLIs
+                    # (ISSUE 14 reconcile-by-construction): the ledger
+                    # observes admission_wait_time +
+                    # admission_checks_wait_time and seals the journey.
+                    self.journeys.admitted_after_checks(
+                        wl, cq_name or "",
+                        wlpkg.queued_wait_time(wl, now), checks_wait)
+                elif self.metrics and cq_name:
                     self.metrics.admitted_workload(cq_name, wlpkg.queued_wait_time(wl, now))
                     self.metrics.admission_checks_wait_time.observe(
                         checks_wait, cluster_queue=cq_name)
@@ -397,6 +411,10 @@ class WorkloadReconciler:
         self.recorder.event(wl, "Normal", "EvictedDueTo" + reason, message)
         if self.metrics and cq_name:
             self.metrics.report_evicted_workload(cq_name, reason)
+        if self.journeys is not None:
+            # Eviction re-opens the journey: the requeue/re-admission
+            # loop it starts is part of the workload's admission story.
+            self.journeys.evicted(wlpkg.key(wl), cq_name, reason)
 
     # ------------------------------------------------------------------
     # watch handlers feeding queues + cache (reference: :554-757)

@@ -139,11 +139,33 @@ class KueueManager:
             capacity=o.flight_recorder_capacity,
             enabled=o.flight_recorder_enable)
 
+        # Workload journey ledger (obs/journey.py + ISSUE 14): every
+        # workload accumulates a causally-stamped span timeline fed
+        # from the queue manager's delta feed (arrivals), the
+        # scheduler's admit/requeue/shed sites, the workload
+        # controller's eviction paths and the MultiKueue planned-mirror
+        # lifecycle. The ledger is also THE emission site for the
+        # admission wait-time histograms, so /debug/journeys and
+        # /metrics reconcile by construction. Created before the
+        # controllers (the workload reconciler seals check-gated
+        # admissions through it).
+        self.journey_ledger = None
+        if o.journey_enable:
+            from kueue_tpu.obs import JourneyLedger
+            self.journey_ledger = JourneyLedger(
+                capacity=o.journey_ledger_capacity,
+                exemplars=o.journey_exemplars,
+                metrics=self.metrics, clock=clock,
+                generation_source=self.cache.generation_token)
+            self.queues.add_journey_listener(
+                self.journey_ledger.note_queue_delta)
+
         self.controllers = setup_core_controllers(
             self.runtime, self.store, self.queues, self.cache, self.recorder,
             cfg=self.cfg, metrics=self.metrics,
             registered_check_controllers=check_controllers,
-            obs_recorder=self.flight_recorder)
+            obs_recorder=self.flight_recorder,
+            journeys=self.journey_ledger)
 
         self.provisioning = provpkg.setup_provisioning_controller(
             self.runtime, self.store, self.recorder)
@@ -203,6 +225,64 @@ class KueueManager:
         if remote_clusters:
             self.cache.remote_capacity_source = self.multikueue.capacity_columns
             self.scheduler.on_placement = self.multikueue.note_placement
+            self.multikueue.journeys = self.journey_ledger
+        self.scheduler.journeys = self.journey_ledger
+        # Aging watch (obs/trend.py + ROADMAP item 5): EWMA-slope trend
+        # monitors over the monotone resources long-horizon soak gates
+        # on, sampled once per cycle seal and served on /debug/aging.
+        # Always wired — the per-cycle cost is a handful of float ops.
+        from kueue_tpu.obs import AgingWatch
+        from kueue_tpu.obs.trend import rss_kb
+        self.aging_watch = AgingWatch()
+        self.aging_watch.add(
+            # Snapshot handouts not yet released between cycles: the
+            # steady state is flat (the query plane legitimately holds
+            # one); sustained growth is an abandoned-cycle leak.
+            "live_handouts", lambda: self.cache.live_handouts,
+            slope_threshold=0.05)
+        if self.durable is not None and self.durable.checkpoint_every > 0:
+            # WAL records since the last checkpoint: bounded by the
+            # compaction interval when healthy; a level past 2x the
+            # interval means compaction stalled (slope is useless on a
+            # sawtooth, the bound is not).
+            self.aging_watch.add(
+                "wal_records_since_checkpoint",
+                lambda: self.durable.records_since_checkpoint,
+                slope_threshold=None,
+                bound=2.0 * self.durable.checkpoint_every)
+        if self.journey_ledger is not None:
+            self.aging_watch.add(
+                # ROADMAP item 5's requeue-amplification invariant: the
+                # ratio stabilizes on a healthy system; a sustained
+                # upward trend is a requeue-backoff pile-up.
+                "requeue_amplification",
+                lambda: self.journey_ledger.requeues_per_admission,
+                slope_threshold=0.02, window=32)
+        if solver is not None:
+            self.aging_watch.add(
+                # Arena slot occupancy grows while a backlog fills and
+                # plateaus after; growth sustained past a long window
+                # is slot leakage (rows never released at admission).
+                "arena_occupied",
+                lambda: ((solver._arena.size - len(solver._arena.free))
+                         if getattr(solver, "_arena", None) is not None
+                         else 0.0),
+                slope_threshold=1.0, window=64)
+            self.aging_watch.add(
+                # Zero mid-traffic compiles after warmup (the PR-7
+                # north-star bound, now a live trend): ANY sustained
+                # growth flags.
+                "mid_traffic_compiles",
+                lambda: getattr(solver, "counters", {}).get(
+                    "mid_traffic_compiles", 0),
+                slope_threshold=0.01, window=16)
+        self.aging_watch.add(
+            # Peak RSS plateaus after warmup; a sustained climb of
+            # >1MB/cycle over a long window is the flat-RSS-trend
+            # invariant failing.
+            "rss_kb", rss_kb, slope_threshold=1024.0, window=64,
+            warmup=16)
+        self.scheduler.aging = self.aging_watch
         self.visibility_server = None  # started by serve_visibility()
         # Snapshot-backed query plane (obs/queryplane.py + ISSUE 12):
         # every cycle seal publishes an immutable pending-position /
@@ -390,6 +470,11 @@ class KueueManager:
             # a shutdown — the same leak contract abandoned speculative
             # cycles honor.
             self.query_plane.close()
+        if self.journey_ledger is not None:
+            # Drop every retained journey (active LRU + exemplars):
+            # the ledger's leak contract is zero retained journeys
+            # after shutdown, mirroring live_handouts.
+            self.journey_ledger.close()
         if checkpoint and self.durable is not None:
             self.store.checkpoint_now()
 

@@ -361,6 +361,34 @@ class Registry:
         self.visibility_inflight_reads = Gauge(
             "kueue_visibility_inflight_reads",
             "Query-plane HTTP reads currently being served")
+        # Workload journey ledger (obs/journey.py + ISSUE 14): per-class
+        # time-to-admission SLIs folded from sealed journeys (the SAME
+        # seal that feeds admission_wait_time — one emission site, so
+        # /debug/journeys and /metrics reconcile by construction), the
+        # requeue-amplification soak invariant (ROADMAP item 5), the
+        # burn-rate evaluator's output, and the ledger's LRU pressure.
+        self.journey_tta_seconds = Histogram(
+            "kueue_journey_tta_seconds",
+            "Time-to-admission of sealed workload journeys by SLI class",
+            ["cls"], buckets=wt)
+        self.journeys_completed_total = Counter(
+            "kueue_journeys_completed_total",
+            "Workload journeys sealed by full admission, by SLI class",
+            ["cls"])
+        self.requeues_per_admission = Gauge(
+            "kueue_requeues_per_admission",
+            "Requeue-class journey events (cycle re-heaps: requeued or "
+            "shed) per sealed admission — the requeue-amplification "
+            "soak invariant (ROADMAP item 5); refreshed at each cycle "
+            "seal")
+        self.slo_burn_rate = Gauge(
+            "kueue_slo_burn_rate",
+            "Per-class SLO burn rate: EWMA of the TTA-objective "
+            "violation indicator divided by the error budget fraction "
+            "(1.0 = burning exactly at budget; >1 = too fast)", ["cls"])
+        self.journey_ledger_evictions_total = Counter(
+            "kueue_journey_ledger_evictions_total",
+            "Active journeys dropped by the ledger's LRU capacity bound")
         # Coarse reconciler latency (ROADMAP PR-4 follow-up: the
         # wall_s - cycle_time_total gap had no signal); fed by the sim
         # Runtime around every reconcile call.
@@ -465,6 +493,19 @@ class Registry:
 
     def set_visibility_snapshot_age(self, seconds: float) -> None:
         self.visibility_snapshot_age_seconds.set(seconds)
+
+    def journey_completed(self, cls: str, tta_s: float) -> None:
+        self.journeys_completed_total.inc(cls=cls)
+        self.journey_tta_seconds.observe(tta_s, cls=cls)
+
+    def set_requeue_amplification(self, value: float) -> None:
+        self.requeues_per_admission.set(value)
+
+    def set_slo_burn(self, cls: str, rate: float) -> None:
+        self.slo_burn_rate.set(rate, cls=cls)
+
+    def journey_lru_evicted(self) -> None:
+        self.journey_ledger_evictions_total.inc()
 
     def speculation_hit(self) -> None:
         self.speculation_hits_total.inc()

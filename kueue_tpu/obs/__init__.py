@@ -6,29 +6,39 @@ from kueue_tpu.obs.recorder import (
     CycleTrace,
     FlightRecorder,
 )
+from kueue_tpu.obs.journey import JourneyLedger, WorkloadJourney
 from kueue_tpu.obs.queryplane import QueryPlane, SealedView
 from kueue_tpu.obs.status import (
     DebugEndpoints,
+    aging_status,
     arena_status,
     breaker_status,
     degrade_status,
+    journey_status,
     pipeline_status,
     queryplane_status,
     recovery_status,
     router_status,
     warmup_status,
 )
+from kueue_tpu.obs.trend import AgingWatch, TrendMonitor
 
 __all__ = [
     "DEFAULT_CAPACITY",
+    "AgingWatch",
     "CycleTrace",
     "FlightRecorder",
+    "JourneyLedger",
     "QueryPlane",
     "SealedView",
+    "TrendMonitor",
+    "WorkloadJourney",
     "DebugEndpoints",
+    "aging_status",
     "arena_status",
     "breaker_status",
     "degrade_status",
+    "journey_status",
     "pipeline_status",
     "queryplane_status",
     "recovery_status",

@@ -149,6 +149,34 @@ def queryplane_status(scheduler) -> dict:
     return st
 
 
+def journey_status(scheduler) -> dict:
+    """Workload journey ledger state (/debug/journeys, without
+    exemplars — the endpoint adds those): retention counters, the
+    requeue-amplification ratio and per-class burn rates, from the
+    SAME producer tools/journey_probe.py and tests read. ``attached``
+    False = no ledger wired (observability.journeyEnable off)."""
+    led = getattr(scheduler, "journeys", None)
+    if led is None:
+        return {"attached": False}
+    st = led.status()
+    st["attached"] = True
+    return st
+
+
+def aging_status(scheduler) -> dict:
+    """Aging-watch verdicts (/debug/aging): per-monitor value, slope
+    EWMA and verdict over the monotone resources ROADMAP item 5 gates
+    on (live handouts, WAL compaction, arena occupancy, requeue
+    amplification, mid-traffic compiles, RSS). ``attached`` False = no
+    watch wired (bare scheduler)."""
+    watch = getattr(scheduler, "aging", None)
+    if watch is None:
+        return {"attached": False}
+    st = watch.status()
+    st["attached"] = True
+    return st
+
+
 def arena_status(solver) -> dict:
     """Encode-arena slot occupancy and churn counters."""
     arena = getattr(solver, "_arena", None)
@@ -214,11 +242,45 @@ class DebugEndpoints:
             return recovery_status(self.scheduler)
         if path == "/debug/queryplane":
             return queryplane_status(self.scheduler)
+        if path == "/debug/journeys":
+            return self._journeys(params)
+        if path == "/debug/aging":
+            return aging_status(self.scheduler)
         if path == "/debug/arena":
             if self.scheduler.solver is None:
                 return {"bound": False}
             return arena_status(self.scheduler.solver)
         return None
+
+    def _journeys(self, params: dict):
+        """/debug/journeys: the ledger's status + slowest-exemplar and
+        violation timelines (``?n=K`` limits exemplars), or one full
+        journey with ``?wl=<ns/name|name>``. Bad ``n`` -> ValueError
+        (400); unknown workload (or no ledger) -> None (404) — the
+        same DebugEndpoints contract every other route honors."""
+        led = getattr(self.scheduler, "journeys", None)
+        wl = params.get("wl")
+        if wl is not None:
+            if led is None:
+                return None
+            # journey_dict serializes under the ledger lock: an ACTIVE
+            # journey mutates on the scheduler thread mid-flood.
+            j = led.journey_dict(wl)
+            if j is None:
+                return None  # 404: unknown workload
+            return {"journey": j}
+        payload = journey_status(self.scheduler)
+        if led is None:
+            return payload
+        n = int(params.get("n", led.exemplars))   # ValueError -> 400
+        if n < 0:
+            raise ValueError("n must be >= 0")
+        # n=0 means zero exemplars, not "all" (slicing with [:0]/[-0:]
+        # would invert the limit).
+        payload["slowest"] = [j.to_dict() for j in led.slowest()[:n]]
+        viol = led.violations()[-n:] if n > 0 else []
+        payload["violations"] = [j.to_dict() for j in viol]
+        return payload
 
     def _cycles(self, params: dict) -> dict:
         rec = self.scheduler.recorder

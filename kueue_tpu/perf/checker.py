@@ -323,6 +323,16 @@ def check_slo(result, spec: SLOSpec) -> list:
     return violations
 
 
+def journey_objectives(spec: SLOSpec) -> dict:
+    """SLOSpec-derived objectives for the journey ledger's burn-rate
+    evaluator (obs/journey.py + ISSUE 14): the per-class p99 TTA bounds
+    a scenario gates on ARE the targets the live SLI stream is priced
+    against — one source of truth, so a scenario's post-hoc SLO verdict
+    and the live ``slo_burn_rate{class}`` gauge can never diverge on
+    what "too slow" means. Returns {class: target_tta_seconds}."""
+    return dict(spec.class_max_p99_tta_s)
+
+
 def check(result: RunResult, spec: RangeSpec) -> list:
     violations = []
     if spec.max_wall_s and result.wall_s > spec.max_wall_s:

@@ -46,6 +46,11 @@ class Manager:
         # the registered listeners, so derived per-workload state is
         # maintained by deltas instead of rescanned per cycle.
         self._workload_listeners: list = []
+        # Info-carrying variant of the same feed (journey ledger,
+        # obs/journey.py): cb(kind, key, info) — the arrival hook needs
+        # the Info (creation timestamp, CQ, class labels), which the
+        # key-only arena feed deliberately omits.
+        self._journey_listeners: list = []
 
     def add_workload_listener(self, cb: Callable[[str, str], None]) -> None:
         """Register cb(kind, key): 'upsert' = the workload was added or
@@ -57,9 +62,20 @@ class Manager:
         with self._lock:
             self._workload_listeners.append(cb)
 
-    def _notify(self, kind: str, key: str) -> None:
+    def add_journey_listener(self, cb: Callable[[str, str, object], None]
+                             ) -> None:
+        """Like add_workload_listener, but cb(kind, key, info) carries
+        the Info (None when the mutator no longer holds it). Same
+        contract: fired under the manager lock, listeners must only
+        record, never call back."""
+        with self._lock:
+            self._journey_listeners.append(cb)
+
+    def _notify(self, kind: str, key: str, info=None) -> None:
         for cb in self._workload_listeners:
             cb(kind, key)
+        for cb in self._journey_listeners:
+            cb(kind, key, info)
 
     def _new_info(self, wl: api.Workload) -> wlpkg.Info:
         return wlpkg.Info(wl, excluded_resource_prefixes=self.excluded_resource_prefixes)
@@ -119,8 +135,10 @@ class Manager:
             for wl in workloads or []:
                 if wl.spec.queue_name != lq.metadata.name or wlpkg.has_quota_reservation(wl):
                     continue
-                items.items[wlpkg.key(wl)] = self._new_info(wl)
-                self._notify("upsert", wlpkg.key(wl))
+                info = self._new_info(wl)
+                info.cluster_queue = items.cluster_queue
+                items.items[wlpkg.key(wl)] = info
+                self._notify("upsert", wlpkg.key(wl), info)
             cqh = self.cluster_queues.get(items.cluster_queue)
             if cqh is not None:
                 added = False
@@ -147,7 +165,7 @@ class Manager:
             # the old CQ's row.
             for info in items.items.values():
                 info._solver_enc = None
-                self._notify("upsert", info.key)
+                self._notify("upsert", info.key, info)
             new_cq = self.cluster_queues.get(items.cluster_queue)
             if new_cq is not None:
                 added = False
@@ -166,7 +184,7 @@ class Manager:
             for info in items.items.values():
                 if cqh is not None:
                     cqh.delete(info.obj)
-                self._notify("del", info.key)
+                self._notify("del", info.key, info)
 
     # --- workload flow ---
 
@@ -181,7 +199,7 @@ class Manager:
         info = self._new_info(wl)
         info.cluster_queue = items.cluster_queue
         items.items[info.key] = info
-        self._notify("upsert", info.key)
+        self._notify("upsert", info.key, info)
         cqh = self.cluster_queues.get(items.cluster_queue)
         if cqh is None:
             return False
@@ -202,8 +220,9 @@ class Manager:
     def _delete_workload_locked(self, wl: api.Workload) -> None:
         items = self.local_queues.get(wlpkg.queue_key(wl))
         if items is not None:
-            if items.items.pop(wlpkg.key(wl), None) is not None:
-                self._notify("del", wlpkg.key(wl))
+            info = items.items.pop(wlpkg.key(wl), None)
+            if info is not None:
+                self._notify("del", wlpkg.key(wl), info)
             cqh = self.cluster_queues.get(items.cluster_queue)
             if cqh is not None:
                 cqh.delete(wl)

@@ -267,6 +267,18 @@ class Scheduler:
         self.query_plane = None
         self._cycle_order: Optional[list] = None  # admission-sorted keys
         self._seal_snapshot = None  # handout pending transfer at seal
+        # Workload journey ledger (obs/journey.py + ISSUE 14): when
+        # attached (manager wiring), every admit/requeue/shed/defer
+        # site stamps a causally-tagged journey span, and the ledger
+        # becomes THE emission site for the reservation/admission
+        # wait-time histograms (reconcile-by-construction). None =
+        # every hook is one is-None compare (the journey_overhead
+        # bench contract) and the histograms keep their direct calls.
+        self.journeys = None
+        # Aging watch (obs/trend.py): sampled once per cycle seal when
+        # attached — the ROADMAP item 5 monotone-resource trend
+        # monitors (/debug/aging).
+        self.aging = None
         # Below this head count the accelerator dispatch overhead exceeds
         # the win; narrow cycles go through the CPU path even with a
         # solver configured (SolverConfig.min_heads; 0 = always solve).
@@ -373,6 +385,7 @@ class Scheduler:
                 # heads=0 is honest — the drained batch's heads were
                 # counted by the cycle that dispatched them.
                 trace = self.recorder.begin_cycle(self.attempt_count)
+                self._journey_begin_cycle("drain")
                 self._cycle_evictions = 0
                 self._cycle_faults = 0
                 self._cycle_io0 = self._io_counters()
@@ -391,6 +404,7 @@ class Scheduler:
         start = self.clock.now()
         wall0 = _time.perf_counter()
         trace = self.recorder.begin_cycle(self.attempt_count)
+        self._journey_begin_cycle()
         self._drain_cost = 0.0
         self._cycle_evictions = 0
         self._cycle_faults = 0
@@ -461,6 +475,12 @@ class Scheduler:
             # cycle another gate then routes off-device would leave the
             # breaker wedged in HALF_OPEN with no outcome ever recorded.
             route = "cpu-breaker"
+        if self.journeys is not None:
+            # Spans emitted from here on carry the decided route (the
+            # pipelined path may refine it to device-pipelined/-nofit
+            # on its trace; the journey stamp keeps the decision the
+            # entries were actually routed under).
+            self.journeys.set_route(route)
         # Cooldown elapses per schedule() call, not per device-routed
         # call — a CPU-routed stretch must not freeze it.
         cooling = self._pipeline_cooldown > 0
@@ -790,6 +810,17 @@ class Scheduler:
         return (c.get("upload_bytes", 0), c.get("fetch_bytes", 0),
                 c.get("dispatches", 0), c.get("collects", 0))
 
+    def _journey_begin_cycle(self, route: str = "") -> None:
+        """Stamp the journey ledger's cycle context (attempt id +
+        structural generation token) so every span this cycle emits is
+        causally tagged. One is-None compare when no ledger is wired."""
+        led = self.journeys
+        if led is None:
+            return
+        led.begin_cycle(self.attempt_count, self.cache.generation_token())
+        if route:
+            led.set_route(route)
+
     def _finish_trace(self, trace, route: str, heads: int,
                       admitted: Optional[int]) -> None:
         """Seal this cycle's trace and feed the observability metrics.
@@ -823,6 +854,14 @@ class Scheduler:
         # recorder being enabled): the read plane refreshes atomically
         # at every cycle seal.
         self._publish_query_plane(route)
+        # Journey ledger + aging watch ride the seal too: the ledger
+        # refreshes its per-cycle gauges (requeues_per_admission), the
+        # watch samples its monotone-resource monitors exactly once per
+        # cycle — both one is-None compare when not wired.
+        if self.journeys is not None:
+            self.journeys.seal_cycle()
+        if self.aging is not None:
+            self.aging.sample()
 
     def _flush_seal_snapshot(self) -> None:
         """Release a snapshot parked for seal but never published — an
@@ -875,6 +914,8 @@ class Scheduler:
             self.ordering.queue_order_timestamp(w.obj)))
         keep, extra = heads[:cap], heads[cap:]
         for w in extra:
+            if self.journeys is not None:
+                self.journeys.shed(w)
             self.queues.requeue_workload(
                 w, RequeueReason.FAILED_AFTER_NOMINATION)
         self.shed_heads_requeued += len(extra)
@@ -1370,6 +1411,10 @@ class Scheduler:
         for i, w in enumerate(prev.inflight.plan.batch.infos):
             if i in prev.nofit_idx:
                 continue  # already requeued at dispatch time
+            if self.journeys is not None:
+                self.journeys.requeued(
+                    w, NOMINATED, RequeueReason.FAILED_AFTER_NOMINATION,
+                    "in-flight speculative cycle abandoned")
             self.queues.requeue_workload(
                 w, RequeueReason.FAILED_AFTER_NOMINATION)
 
@@ -2303,7 +2348,17 @@ class Scheduler:
             self.client.event(new_wl, "Normal", "QuotaReserved",
                               f"Quota reserved in ClusterQueue {admission.cluster_queue}, "
                               f"wait time since queued was {wait_time:.0f}s")
-            if self.metrics is not None:
+            if self.journeys is not None:
+                # THE emission site for the reservation-time wait
+                # histograms (ISSUE 14 reconcile-by-construction): the
+                # ledger observes quota_reserved_wait_time (+
+                # admission_wait_time when the write also admits) AND
+                # stamps the journey span, so /debug/journeys and
+                # /metrics share one producer.
+                self.journeys.quota_reserved(
+                    new_wl, admission.cluster_queue, wait_time,
+                    wlpkg.is_admitted(new_wl))
+            elif self.metrics is not None:
                 self.metrics.quota_reserved(admission.cluster_queue, wait_time)
                 if wlpkg.is_admitted(new_wl):
                     self.metrics.admitted(admission.cluster_queue, wait_time)
@@ -2360,12 +2415,23 @@ class Scheduler:
         self.client.event(target, "Normal", "Preempted", message)
         if self.metrics is not None:
             self.metrics.preempted(preempting_cq, reason)
+        if self.journeys is not None:
+            # Victim's journey re-opens: it will requeue and re-admit,
+            # and the preemption is part of WHY its admission was slow.
+            self.journeys.preempted(wlpkg.key(wl), preempting_cq, reason)
 
     # --- requeue (reference: scheduler.go:674-692) ---
 
     def requeue_and_update(self, e: Entry) -> None:
         if e.status != NOT_NOMINATED and e.requeue_reason == RequeueReason.GENERIC:
             e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
+        if self.journeys is not None:
+            # Every non-admitted entry on every route passes through
+            # here: the journey's per-cycle evidence of WHERE a slow
+            # admission's cycles went (status + reason + message, all
+            # stamped with this cycle's id/generation/route).
+            self.journeys.requeued(e.info, e.status, e.requeue_reason,
+                                   e.inadmissible_msg)
         self.queues.requeue_workload(e.info, e.requeue_reason)
         if e.status in (NOT_NOMINATED, SKIPPED):
             # Clone only when the Pending condition would actually change:

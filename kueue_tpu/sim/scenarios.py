@@ -306,6 +306,16 @@ class ScenarioHarness:
         flight-recorder windowing handle for SLO evaluation)."""
         self.mgr.flight_recorder.set_tag(tag)
 
+    def set_objectives(self, slo) -> None:
+        """Price the journey ledger's live SLI stream against this
+        scenario's SLOSpec (perf.checker.journey_objectives): sealed
+        journeys exceeding their class p99 bound burn the error budget
+        and are retained as violation exemplars."""
+        led = getattr(self.mgr, "journey_ledger", None)
+        if led is not None:
+            from kueue_tpu.perf.checker import journey_objectives
+            led.set_objectives(journey_objectives(slo))
+
     def mark_storm_end(self) -> None:
         self._storm_end_cycle = self.cycles
         self.set_phase("recovery")
@@ -664,8 +674,64 @@ class ScenarioHarness:
         else:
             res.ladder_recovery_cycles = 0
 
+        # Journey-backed evidence (obs/journey.py + ISSUE 14): every
+        # scenario reports the ledger's retention/amplification stats
+        # and the live burn rates alongside its SLO verdict, so the
+        # post-hoc gates and the live SLI surface stay comparable.
+        led = getattr(self.mgr, "journey_ledger", None)
+        if led is not None:
+            st = led.status()
+            res.counters["journeys"] = {
+                k: st[k] for k in ("active", "completed", "requeues",
+                                   "requeues_per_admission",
+                                   "lru_evictions", "burn_rates")}
+
         res.violations = check_slo(res, slo)
         return res
+
+    def journey_gate(self, res: ScenarioResult) -> None:
+        """The ISSUE 14 acceptance gate: from /debug/journeys ALONE,
+        the slowest admitted workload's journey must answer "why did it
+        take N cycles" with a complete causally-stamped span timeline —
+        first span ``queued``, last an admission, every span carrying a
+        cycle id + generation token, time and cycle ids monotone.
+        Violations land on the scenario result like any SLO breach."""
+        from kueue_tpu.obs import DebugEndpoints, WorkloadJourney
+        from kueue_tpu.obs.journey import JourneySpan
+        led = getattr(self.mgr, "journey_ledger", None)
+        if led is None:
+            res.violations.append("journey gate: no ledger wired")
+            return
+        endpoints = DebugEndpoints(self.mgr.scheduler, self.mgr.metrics)
+        payload = endpoints.handle("/debug/journeys", {"n": "1"})
+        slowest = (payload or {}).get("slowest") or []
+        if not slowest:
+            res.violations.append(
+                "journey gate: /debug/journeys retained no slowest "
+                "exemplar after an admitting run")
+            return
+        timeline = slowest[0]
+        res.counters["journey_slowest"] = {
+            "workload": timeline["workload"],
+            "tta_s": timeline["tta_s"],
+            "spans": len(timeline["spans"]),
+            "requeues": timeline["requeues"],
+        }
+        # Rebuild the journey from the WIRE payload (the "from
+        # /debug/journeys alone" clause) and run the completeness check
+        # on that, not on ledger internals.
+        j = WorkloadJourney(timeline["workload"],
+                            timeline["cluster_queue"], timeline["class"],
+                            timeline["created_t"])
+        for s in timeline["spans"]:
+            j.spans.append(JourneySpan(
+                s["kind"], s["t"], s["cycle"], tuple(s["generation"]),
+                s.get("route", "")))
+        ok, why = j.timeline_complete()
+        if not ok:
+            res.violations.append(
+                f"journey gate: slowest exemplar "
+                f"{timeline['workload']} timeline incomplete: {why}")
 
 
 def _p99(values: list) -> float:
@@ -866,6 +932,14 @@ def run_tenant_storm(seed: int = 0, scale: str = "full",
     arrivals = storm_trace(seed, duration_s=p["duration"],
                            tenants=p["tenants"], storm_tenant=0,
                            storm_at_s=60.0, storm_count=p["storm"])
+    slo = SLOSpec(
+        min_admitted=len(arrivals),
+        class_max_p99_tta_s={"prod": 120.0, "standard": 300.0,
+                             "batch": 600.0},
+        max_requeue_amplification=2.0)
+    # The journey ledger prices its live SLI stream against the SAME
+    # objectives this scenario gates on (ISSUE 14 burn-rate evaluator).
+    h.set_objectives(slo)
     h.set_phase("trickle")
     h.run(arrivals, p["duration"],
           hooks=[(60.0, lambda: h.set_phase("storm")),
@@ -877,15 +951,14 @@ def run_tenant_storm(seed: int = 0, scale: str = "full",
         return h.tenant_of_wl.get(name) != 0
     storm_ttas = [t for n, t in h.first_admit.items()
                   if h.tenant_of_wl.get(n) == 0]
-    slo = SLOSpec(
-        min_admitted=len(arrivals),
-        class_max_p99_tta_s={"prod": 120.0, "standard": 300.0,
-                             "batch": 600.0},
-        max_requeue_amplification=2.0)
     res = h.result(scale, slo, tta_filter=non_storm,
                    tta_scope="non-storm tenants (t1..)")
     res.counters["storm_tenant_p99_tta_s"] = \
         round(_p99(storm_ttas), 3) if storm_ttas else None
+    # ISSUE 14 acceptance: the slowest workload's journey, read from
+    # /debug/journeys alone, must explain its N admission cycles with
+    # a complete causally-stamped span timeline.
+    h.journey_gate(res)
     # Route/regime coverage (trace tags stamped by set_phase): how the
     # router handled the storm's preemption-heavy cycles.
     mix: dict = {}

@@ -6,6 +6,13 @@ reconciliation between per-trace span sums and the cycle_phase_seconds
 histograms (acceptance criterion), solver-phase spans on the device
 route, fault annotations, and the status producers the /debug/*
 endpoints and Dumper share.
+
+ISSUE 14 additions: the workload journey ledger (causally-stamped span
+timelines, LRU bounds under a 50k-workload storm, exemplar retention,
+reconcile-by-construction with the wait-time histograms, the
+requeue-amplification metric, burn rates) and the aging watch
+(EWMA-slope trend monitors flagging injected slow leaks while staying
+silent on clean runs).
 """
 
 import io
@@ -17,8 +24,12 @@ from kueue_tpu import config as cfgpkg
 from kueue_tpu.api.meta import FakeClock
 from kueue_tpu.manager import KueueManager
 from kueue_tpu.obs import (
+    AgingWatch,
     CycleTrace,
+    DebugEndpoints,
     FlightRecorder,
+    JourneyLedger,
+    TrendMonitor,
     arena_status,
     breaker_status,
     router_status,
@@ -234,6 +245,413 @@ class TestSolverTraces:
                  if a["kind"] == "fault"]
         assert notes and notes[0]["site"] in ("solve", "dispatch")
         assert "breaker" in notes[0]
+
+
+def _mk_info(i: int, cq: str = "cq"):
+    """A minimal real Info for direct ledger drives."""
+    from kueue_tpu.core import workload as wlpkg
+    wl = (WorkloadWrapper(f"storm{i}").queue("lq").creation(100 + i)
+          .request("cpu", "1").obj())
+    info = wlpkg.Info(wl)
+    info.cluster_queue = cq
+    return info
+
+
+class TestJourneyLedger:
+    """Direct ledger drives: LRU bounds, repeat collapse, exemplars,
+    burn rates, close() leak contract."""
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            JourneyLedger(capacity=0)
+        with pytest.raises(ValueError):
+            JourneyLedger(exemplars=0)
+        with pytest.raises(ValueError):
+            cfgpkg.load({"observability": {"journeyLedgerCapacity": 0}})
+        with pytest.raises(ValueError):
+            cfgpkg.load({"observability": {"journeyExemplars": 0}})
+
+    def test_lru_eviction_under_50k_storm(self):
+        """Acceptance: LRU eviction exercised under a 50k-workload
+        storm — the active set never exceeds capacity and the overflow
+        is counted, not leaked."""
+        from kueue_tpu.metrics import Registry
+        led = JourneyLedger(capacity=1000, metrics=Registry())
+        led.begin_cycle(1, (1, 0, 0))
+        n = 50_000
+        for i in range(n):
+            led.note_queue_delta("upsert", f"default/storm{i}",
+                                 _mk_info(i))
+        st = led.status()
+        assert st["active"] == 1000
+        assert st["started"] == n
+        assert st["lru_evictions"] == n - 1000
+        assert led.metrics.journey_ledger_evictions_total.value() \
+            == n - 1000
+        led.close()
+        assert led.retained == 0
+
+    def test_repeat_collapse_bounds_flood_timelines(self):
+        """A 40-cycle requeue loop reads as ONE span covering cycles
+        [n, n+39], not 40 allocations — and requeues_total still counts
+        every event."""
+        from kueue_tpu.queue import RequeueReason
+        led = JourneyLedger()
+        info = _mk_info(0)
+        led.begin_cycle(5, (1, 0, 0))
+        led.note_queue_delta("upsert", info.key, info)
+        for c in range(40):
+            led.begin_cycle(5 + c, (1, 0, 0))
+            led.requeued(info, "nominated",
+                         RequeueReason.FAILED_AFTER_NOMINATION,
+                         "Workload no longer fits")
+        j = led.journey(info.key)
+        kinds = [s.kind for s in j.spans]
+        assert kinds == ["queued", "requeued"]
+        f = j.spans[-1].fields
+        assert f["repeats"] == 40
+        assert j.spans[-1].cycle == 5 and f["last_cycle"] == 44
+        assert j.requeues == 40 and led.requeues_total == 40
+        # a DIFFERENT reason breaks the collapse
+        led.requeued(info, "nominated", RequeueReason.GENERIC, "other")
+        assert [s.kind for s in led.journey(info.key).spans] \
+            == ["queued", "requeued", "requeued"]
+
+    def test_mid_cycle_arrival_stays_monotone(self):
+        """A workload created AFTER the cycle's begin stamp reuses the
+        cycle-start timestamp for its requeue spans: the append-order
+        clamp keeps the timeline monotone (no false 'out of time
+        order' gate failures under a real clock)."""
+        from kueue_tpu.api.meta import FakeClock
+        from kueue_tpu.queue import RequeueReason
+        clk = FakeClock(100.0)
+        led = JourneyLedger(clock=clk)
+        led.begin_cycle(1, (1, 0, 0))       # _cycle_t = 100.0
+        wl = (WorkloadWrapper("late").queue("lq").creation(100.5)
+              .request("cpu", "1").obj())
+        from kueue_tpu.core import workload as wlpkg
+        info = wlpkg.Info(wl)
+        info.cluster_queue = "cq"
+        led.note_queue_delta("upsert", info.key, info)   # queued@100.5
+        led.requeued(info, "nominated", RequeueReason.GENERIC)
+        j = led.journey(info.key)
+        assert [s.t for s in j.spans] == [100.5, 100.5]  # clamped
+        clk.advance(10.0)
+        led.quota_reserved(wl, "cq", 10.0, admitted=True)
+        ok, why = led.slowest(1)[0].timeline_complete()
+        assert ok, why
+
+    def test_span_cap_keeps_arrival_anchor(self):
+        from kueue_tpu.obs.journey import MAX_SPANS_PER_JOURNEY
+        from kueue_tpu.queue import RequeueReason
+        led = JourneyLedger()
+        info = _mk_info(0)
+        led.begin_cycle(1, (1, 0, 0))
+        led.note_queue_delta("upsert", info.key, info)
+        for c in range(MAX_SPANS_PER_JOURNEY + 50):
+            led.begin_cycle(1 + c, (1, 0, 0))
+            # distinct messages defeat the collapse on purpose
+            led.requeued(info, "nominated", RequeueReason.GENERIC,
+                         f"msg{c}")
+        j = led.journey(info.key)
+        assert len(j.spans) == MAX_SPANS_PER_JOURNEY
+        assert j.spans[0].kind == "queued"    # the anchor survives
+        assert j.dropped_spans == 51
+
+    def test_lru_evicted_journey_resumes_with_class_and_anchor(self):
+        """Review-pass contract: past the capacity bound, a journey
+        re-created mid-life recovers its SLI class from the seal hook
+        (the TTA folds into the RIGHT histogram) and its first span is
+        marked ``resumed`` so timeline_complete stays honest instead
+        of minting a false violation."""
+        from kueue_tpu.metrics import Registry
+        from kueue_tpu.obs.journey import CLASS_LABEL
+        from kueue_tpu.queue import RequeueReason
+        reg = Registry()
+        led = JourneyLedger(capacity=1, metrics=reg)
+        led.begin_cycle(1, (1, 0, 0))
+        prod = _mk_info(0)
+        prod.obj.metadata.labels = {CLASS_LABEL: "prod"}
+        led.note_queue_delta("upsert", prod.key, prod)
+        # a second arrival LRU-evicts prod's journey
+        led.note_queue_delta("upsert", "default/other", _mk_info(1))
+        assert led.lru_evictions == 1
+        # prod resumes mid-life through the requeue hook...
+        led.requeued(prod, "nominated", RequeueReason.GENERIC)
+        # ...and seals with its real class recovered from the workload
+        led.quota_reserved(prod.obj, "cq", 12.0, admitted=True)
+        assert reg.journey_tta_seconds.count(cls="prod") == 1
+        assert reg.journey_tta_seconds.count(cls="standard") == 0
+        j = led.slowest(1)[0]
+        assert j.spans[0].fields.get("resumed") is True
+        ok, why = j.timeline_complete()
+        assert ok, why
+
+    def test_exemplars_keep_k_slowest_and_violations(self):
+        led = JourneyLedger(exemplars=2)
+        led.set_objectives({"standard": 25.0})
+        led.begin_cycle(1, (1, 0, 0))
+        ttas = [10.0, 50.0, 5.0, 30.0, 20.0]
+        for i, tta in enumerate(ttas):
+            info = _mk_info(i)
+            led.note_queue_delta("upsert", info.key, info)
+            led.quota_reserved(info.obj, "cq", tta, admitted=True)
+        slow = led.slowest()
+        assert [j.tta_s for j in slow] == [50.0, 30.0]
+        assert {j.tta_s for j in led.violations()} == {50.0, 30.0}
+        assert led.journeys_completed == 5
+        assert led.status()["active"] == 0   # sealed journeys fold out
+        # burn rate moved: 2 violations of 5 with alpha 0.1
+        assert led.burn_rates()["standard"] > 0
+
+    def test_burn_rate_gauge_prices_objectives(self):
+        from kueue_tpu.metrics import Registry
+        from kueue_tpu.perf.checker import SLOSpec, journey_objectives
+        reg = Registry()
+        led = JourneyLedger(metrics=reg, error_budget=0.05,
+                            burn_alpha=1.0)
+        led.set_objectives(journey_objectives(
+            SLOSpec(class_max_p99_tta_s={"standard": 10.0})))
+        led.begin_cycle(1, (1, 0, 0))
+        info = _mk_info(0)
+        led.note_queue_delta("upsert", info.key, info)
+        led.quota_reserved(info.obj, "cq", 99.0, admitted=True)  # violates
+        # alpha=1: ewma == 1.0 -> burn = 1.0 / 0.05 = 20
+        assert reg.slo_burn_rate.value(cls="standard") \
+            == pytest.approx(20.0)
+        info2 = _mk_info(1)
+        led.note_queue_delta("upsert", info2.key, info2)
+        led.quota_reserved(info2.obj, "cq", 1.0, admitted=True)  # ok
+        assert reg.slo_burn_rate.value(cls="standard") \
+            == pytest.approx(0.0)
+
+
+class TestJourneyManager:
+    """Full-manager journeys: the end-to-end acceptance contract."""
+
+    def test_slowest_journey_answers_why_from_debug_journeys(self, clock):
+        """Acceptance: from /debug/journeys alone, the slowest
+        workload's timeline explains its admission — first span
+        ``queued``, last an admission, every span stamped with cycle id
+        + generation token, monotone — no gaps."""
+        mgr = make_mgr(clock)
+        submit_n(mgr, 6)
+        for _ in range(8):
+            mgr.schedule_once()
+            clock.advance(5.0)
+            # release quota so the backlog admits over several cycles
+            from kueue_tpu.api import kueue as api
+            from kueue_tpu.api.meta import Condition, set_condition
+            from kueue_tpu.core import workload as wlpkg
+            for wl in mgr.store.list("Workload"):
+                if wlpkg.is_admitted(wl) and not wlpkg.is_finished(wl):
+                    set_condition(wl.status.conditions, Condition(
+                        type=api.WORKLOAD_FINISHED, status="True",
+                        reason="Succeeded", message="done"), clock.now())
+                    mgr.store.update(wl)
+            mgr.run_until_idle()
+        endpoints = DebugEndpoints(mgr.scheduler, mgr.metrics)
+        payload = endpoints.handle("/debug/journeys", {"n": "1"})
+        assert payload["completed"] == 6
+        assert payload["unstamped_spans"] == 0
+        slowest = payload["slowest"][0]
+        assert slowest["tta_s"] > 0
+        spans = slowest["spans"]
+        assert spans[0]["kind"] == "queued"
+        assert spans[-1]["kind"] in ("quota-reserved", "admitted")
+        prev_c = None
+        for s in spans:
+            assert isinstance(s["cycle"], int)
+            assert s["generation"], s
+            if prev_c is not None:
+                assert s["cycle"] >= prev_c
+            prev_c = s["cycle"]
+        # the ledger's own completeness predicate agrees
+        j = mgr.journey_ledger.journey(slowest["workload"])
+        ok, why = j.timeline_complete()
+        assert ok, why
+
+    def test_histograms_fed_from_sealed_journeys(self, clock):
+        """Satellite regression: histogram totals == completed-journey
+        count — one emission site, /metrics and /debug/journeys can
+        never disagree."""
+        mgr = make_mgr(clock)
+        submit_n(mgr, 6)
+        mgr.schedule_until_settled()
+        led = mgr.journey_ledger
+        adm_count = sum(
+            s[2] for s in mgr.metrics.admission_wait_time.series.values())
+        qr_count = sum(
+            s[2] for s in
+            mgr.metrics.quota_reserved_wait_time.series.values())
+        tta_count = sum(
+            s[2] for s in mgr.metrics.journey_tta_seconds.series.values())
+        assert adm_count == led.journeys_completed == tta_count
+        assert qr_count == led.quota_reservations
+        assert adm_count == 4   # 4-cpu quota admits 4 of 6
+
+    def test_requeue_amplification_flood(self, clock):
+        """Satellite: a requeue flood drives requeues_per_admission —
+        the gauge matches the ledger ratio and exceeds the clean
+        baseline."""
+        mgr = make_mgr(clock)
+        submit_n(mgr, 6)
+        # never finish anything: quota stays full after 4 admits, every
+        # later popped head requeues
+        for _ in range(6):
+            mgr.schedule_once()
+            clock.advance(5.0)
+            # cohort flush so parked-inadmissible entries re-pop and
+            # requeue again (the flood shape)
+            mgr.queues.queue_inadmissible_workloads({"cq"})
+        led = mgr.journey_ledger
+        assert led.requeues_total > 0
+        want = led.requeues_total / max(led.journeys_completed, 1)
+        assert mgr.metrics.requeues_per_admission.value() \
+            == pytest.approx(want)
+        assert want > 0
+
+    def test_eviction_reopens_journey(self, clock):
+        """A sealed journey folds out of the active set; the eviction
+        starts a successor anchored at ``evicted``, the re-queue
+        appends its own ``queued`` span, and the re-admission seals a
+        COMPLETE timeline (the review-pass contract for preemption-
+        heavy storms)."""
+        from kueue_tpu.api import kueue as api
+        from kueue_tpu.api.meta import find_condition
+        mgr = make_mgr(clock)
+        submit_n(mgr, 1)
+        mgr.schedule_until_settled()
+        led = mgr.journey_ledger
+        assert led.journeys_completed == 1
+        # deactivate -> the eviction path stamps a successor journey
+        wl = mgr.store.get("Workload", "default", "w0")
+        wl.spec.active = False
+        mgr.store.update(wl)
+        mgr.run_until_idle()
+        j = led.journey("default/w0")
+        assert j is not None
+        assert j.spans[0].kind == "evicted"   # post-admission anchor
+        assert j.sealed_t is None             # re-opened
+        # reactivate: the harness-side eviction completion + requeue
+        clock.advance(5.0)
+        wl = mgr.store.get("Workload", "default", "w0")
+        from kueue_tpu.core import workload as wlpkg
+        ev = find_condition(wl.status.conditions, api.WORKLOAD_EVICTED)
+        wlpkg.unset_quota_reservation_with_condition(
+            wl, "Pending", "evicted", clock.now())
+        wlpkg.set_requeued_condition(wl, ev.reason, ev.message, False,
+                                     clock.now())
+        wl.spec.active = True
+        mgr.store.update(wl)
+        mgr.run_until_idle()
+        mgr.schedule_until_settled()
+        assert led.journeys_completed == 2    # the re-admission sealed
+        j2 = led.journey("default/w0")
+        kinds = [s.kind for s in j2.spans]
+        assert kinds[0] == "evicted" and "queued" in kinds
+        ok, why = j2.timeline_complete()
+        assert ok, (why, kinds)
+
+    def test_journeys_disabled_by_config(self, clock):
+        cfg = cfgpkg.Configuration()
+        cfg.observability.journey_enable = False
+        mgr = make_mgr(clock, cfg=cfg)
+        submit_n(mgr, 3)
+        mgr.schedule_until_settled()
+        assert mgr.journey_ledger is None
+        assert mgr.scheduler.journeys is None
+        # the wait-time histograms keep their direct call sites
+        adm = sum(s[2] for s in
+                  mgr.metrics.admission_wait_time.series.values())
+        assert adm == 3
+        # /debug/journeys reports detached; ?wl= is a 404 (None)
+        endpoints = DebugEndpoints(mgr.scheduler, mgr.metrics)
+        assert endpoints.handle("/debug/journeys", {})["attached"] is False
+        assert endpoints.handle("/debug/journeys", {"wl": "w0"}) is None
+
+    def test_zero_retained_after_shutdown(self, clock):
+        mgr = make_mgr(clock)
+        submit_n(mgr, 6)
+        mgr.schedule_until_settled()
+        led = mgr.journey_ledger
+        assert led.retained > 0
+        mgr.shutdown(checkpoint=False)
+        assert led.retained == 0
+
+
+class TestAgingWatch:
+    def test_monitor_flags_injected_leak_within_window(self):
+        """Acceptance: a +1/sample leak flips the verdict to leaking
+        within warmup + window samples; the clean source never does."""
+        mon = TrendMonitor("leak", slope_threshold=0.05, window=12,
+                           warmup=8)
+        for _ in range(40):
+            mon.sample(3.0)          # clean: flat
+        assert mon.verdict() == "ok"
+        for i in range(8 + 12):      # leak: +1 per sample
+            mon.sample(3.0 + i)
+        assert mon.verdict() == "leaking"
+
+    def test_clean_sawtooth_stays_ok(self):
+        """A compacting WAL shape (grow then drop) must not flag on
+        slope — the EWMA absorbs the sawtooth."""
+        mon = TrendMonitor("wal", slope_threshold=None, bound=200.0)
+        v = 0.0
+        for i in range(100):
+            v = 0.0 if i % 10 == 0 else v + 10.0
+            mon.sample(v)
+        assert mon.verdict() == "ok"
+        mon.sample(500.0)            # compaction stall: bound trips
+        assert mon.verdict() == "over-bound"
+
+    def test_watch_guards_dead_sources(self):
+        watch = AgingWatch()
+
+        def boom():
+            raise RuntimeError("dead source")
+        watch.add("bad", boom, slope_threshold=0.1)
+        watch.sample()
+        assert watch.monitors["bad"].sample_errors == 1
+        assert watch.failing == []
+
+    def test_manager_handout_leak_flagged_clean_run_silent(self, clock):
+        """Acceptance: the aging watch flags a scripted handout leak
+        within its EWMA window while staying silent on the clean run.
+        Cycles sample the watch at each seal; the leak takes one
+        un-released snapshot per cycle."""
+        mgr = make_mgr(clock)
+        mon = mgr.aging_watch.monitors["live_handouts"]
+
+        def cycles(n, leak=False):
+            for i in range(n):
+                submit_n(mgr, 1, prefix=f"c{mgr.scheduler.attempt_count}-")
+                mgr.schedule_once()
+                clock.advance(1.0)
+                if leak:
+                    mgr.cache.snapshot()   # taken, never released
+        cycles(mon.warmup + mon.window + 4)
+        assert mon.verdict() == "ok", mon.status()
+        assert mgr.aging_watch.failing == []
+        leak_start = mon.samples
+        cycles(mon.warmup + mon.window + 8, leak=True)
+        assert mon.verdict() == "leaking", mon.status()
+        assert "live_handouts" in mgr.aging_watch.failing
+        # flagged within the EWMA window (bounded detection latency)
+        assert mon.samples - leak_start <= mon.warmup + mon.window + 8
+
+    def test_aging_endpoint_payload(self, clock):
+        mgr = make_mgr(clock)
+        submit_n(mgr, 2)
+        mgr.schedule_until_settled()
+        endpoints = DebugEndpoints(mgr.scheduler, mgr.metrics)
+        payload = endpoints.handle("/debug/aging", {})
+        assert payload["attached"] is True
+        assert payload["samples_taken"] > 0
+        assert "live_handouts" in payload["monitors"]
+        assert "rss_kb" in payload["monitors"]
+        assert "requeue_amplification" in payload["monitors"]
+        assert "generation" in payload
 
 
 class TestStatusSurface:

@@ -247,6 +247,16 @@ class TestScenarioSmoke:
         # the storm tenant queues behind its own flood; everyone else's
         # gated p99 stays bounded (it is the SLO population)
         assert res.counters["storm_tenant_p99_tta_s"] is not None
+        # ISSUE 14 journey gate ran and held: the slowest workload's
+        # /debug/journeys timeline explained its admission (a gate
+        # failure would be in res.violations and fail the ok assert),
+        # and the ledger's evidence landed on the result.
+        assert res.counters["journey_slowest"]["spans"] >= 2
+        assert res.counters["journey_slowest"]["tta_s"] is not None
+        assert res.counters["journeys"]["completed"] > 0
+        # burn rates were priced against THIS scenario's SLOSpec
+        # objectives (set_objectives wiring)
+        assert res.counters["journeys"]["burn_rates"]
 
     def test_flavor_churn_takes_partial_rebuild_path(self):
         res = run_scenario("flavor_churn", seed=0, scale="smoke")

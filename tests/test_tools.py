@@ -235,6 +235,64 @@ class TestVisibilityHTTP:
         finally:
             server.stop()
 
+    def test_debug_journeys_edges(self, server, mgr):
+        """ISSUE 14 satellite: /debug/journeys honors the
+        DebugEndpoints contract — 400 on bad ?n=, 404 on an unknown
+        workload, generation stamp on every payload."""
+        status, body = _get(server.port, "/debug/journeys")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["attached"] is True
+        assert "generation" in payload           # staleness stamp
+        assert payload["completed"] >= 1         # w0 admitted
+        assert payload["slowest"], payload
+        # every exemplar span is causally stamped
+        for j in payload["slowest"]:
+            for s in j["spans"]:
+                assert isinstance(s["cycle"], int)
+                assert s["generation"]
+        # bad params -> 400
+        assert _get(server.port, "/debug/journeys?n=abc")[0] == 400
+        assert _get(server.port, "/debug/journeys?n=-1")[0] == 400
+        # n=0 means ZERO exemplars, not all
+        status, body = _get(server.port, "/debug/journeys?n=0")
+        assert status == 200
+        zero = json.loads(body)
+        assert zero["slowest"] == [] and zero["violations"] == []
+        # unknown workload -> 404
+        assert _get(server.port, "/debug/journeys?wl=nope")[0] == 404
+        # point query (full key AND bare name) -> the span timeline
+        for ref in ("default/w1", "w1"):
+            status, body = _get(server.port, f"/debug/journeys?wl={ref}")
+            assert status == 200, ref
+            j = json.loads(body)["journey"]
+            assert j["workload"] == "default/w1"
+            assert j["spans"][0]["kind"] == "queued"
+
+    def test_debug_aging(self, server):
+        status, body = _get(server.port, "/debug/aging")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["attached"] is True
+        assert "generation" in payload
+        assert "live_handouts" in payload["monitors"]
+        assert payload["samples_taken"] > 0
+        assert payload["failing"] == []
+
+    def test_trace_dump_journey(self, server, capsys):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "trace_dump", os.path.join(os.path.dirname(__file__),
+                                       "..", "tools", "trace_dump.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        url = f"http://127.0.0.1:{server.port}"
+        assert mod.main([url, "--journey", "default/w0"]) == 0
+        out = capsys.readouterr().out
+        assert "journey default/w0" in out
+        assert "queued" in out and "cycle=" in out and "gen=" in out
+
     def test_trace_dump_tool(self, server, tmp_path, capsys):
         import importlib.util
         import os
@@ -472,6 +530,34 @@ class TestVisibilityProbe:
         assert verdict["max_token_lag"] <= 1
         assert verdict["cycles_published"] > 0
         assert verdict["live_handouts_after_shutdown"] == 0
+
+
+class TestJourneyProbe:
+    def test_probe_smoke_complete_timelines_no_leaks(self, capsys):
+        """Tier-1 smoke for tools/journey_probe.py (chaos_run CLI
+        contract): a tiny run must render the per-class TTA table +
+        slowest-exemplar timeline + aging verdicts, report a parseable
+        verdict, and find zero ledger leaks, zero unstamped spans, and
+        a complete slowest timeline."""
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "journey_probe",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "journey_probe.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main(["2", "3"]) == 0
+        captured = capsys.readouterr()
+        assert "time-to-admission" in captured.err   # the operator table
+        assert "aging verdicts" in captured.err
+        verdict = json.loads(captured.out.strip().splitlines()[-1])
+        assert verdict["ok"] is True
+        assert verdict["retained_after_shutdown"] == 0
+        assert verdict["unstamped_spans"] == 0
+        assert verdict["timeline_ok"] is True
+        assert verdict["journeys"]["completed"] > 0
+        assert verdict["aging_failing"] == []
 
 
 class TestDumper:

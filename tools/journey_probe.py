@@ -1,0 +1,221 @@
+"""Journey probe: end-to-end admission SLI + aging health for operators.
+
+Drives the FULL control plane (KueueManager: sim store, controllers,
+scheduler, journey ledger, aging watch) through a few traffic waves —
+including an over-quota wave that forces requeue loops — then prints:
+
+- a per-class time-to-admission table (count, p50, p99) folded from
+  the SAME sealed journeys /metrics serves,
+- the slowest retained exemplar's span timeline (the "why did it take
+  N cycles" answer, read from the /debug/journeys producer),
+- the aging watch's per-monitor verdicts.
+
+Same CLI contract as tools/chaos_run.py / visibility_probe.py: the
+human tables go to stderr (or --json for the full report), one
+parseable JSON verdict line to stdout, exit non-zero when the probe
+detects a violation — a ledger leak (retained journeys after
+shutdown), an unstamped span, an incomplete slowest-exemplar timeline,
+or an aging monitor in a leaking/over-bound verdict.
+
+Usage: python tools/journey_probe.py [waves] [cqs] [--json]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kueue_tpu import config as cfgpkg  # noqa: E402
+from kueue_tpu.api import kueue as api  # noqa: E402
+from kueue_tpu.api.corev1 import (  # noqa: E402
+    Container, PodSpec, PodTemplateSpec)
+from kueue_tpu.api.meta import FakeClock, LabelSelector, ObjectMeta  # noqa: E402
+from kueue_tpu.manager import KueueManager  # noqa: E402
+from kueue_tpu.obs import DebugEndpoints  # noqa: E402
+from kueue_tpu.obs.journey import CLASS_LABEL  # noqa: E402
+
+DEFAULT_WAVES = 6
+DEFAULT_CQS = 4
+
+CLASSES = ("prod", "standard", "batch")
+
+
+def make_objects(num_cqs: int):
+    rf = api.ResourceFlavor(metadata=ObjectMeta(name="f0", uid="rf-f0"))
+    out = [rf]
+    for i in range(num_cqs):
+        cq = api.ClusterQueue(metadata=ObjectMeta(name=f"cq{i}",
+                                                  uid=f"cq-{i}"))
+        cq.spec.namespace_selector = LabelSelector()
+        cq.spec.cohort = "cohort-0"
+        cq.spec.resource_groups.append(api.ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[api.FlavorQuotas(name="f0", resources=[
+                api.ResourceQuota(name="cpu", nominal_quota=4000)])]))
+        lq = api.LocalQueue(metadata=ObjectMeta(
+            name=f"lq{i}", namespace="default", uid=f"lq-{i}"))
+        lq.spec.cluster_queue = f"cq{i}"
+        out += [cq, lq]
+    return out
+
+
+def make_workload(wave: int, i: int, n: int, now: float):
+    wl = api.Workload(metadata=ObjectMeta(
+        name=f"w{n}", namespace="default", uid=f"wl-{n}",
+        creation_timestamp=now,
+        labels={CLASS_LABEL: CLASSES[n % len(CLASSES)]}))
+    wl.spec.queue_name = f"lq{i}"
+    wl.spec.pod_sets.append(api.PodSet(
+        name="main", count=1, template=PodTemplateSpec(spec=PodSpec(
+            containers=[Container(name="c", requests={"cpu": 2000})]))))
+    return wl
+
+
+def probe(waves: int = DEFAULT_WAVES, num_cqs: int = DEFAULT_CQS) -> dict:
+    from kueue_tpu.api.meta import Condition, set_condition
+    from kueue_tpu.core import workload as wlpkg
+
+    cfg = cfgpkg.Configuration()
+    clock = FakeClock(1000.0)
+    mgr = KueueManager(cfg=cfg, clock=clock)
+    # Burn-rate objectives so the evaluator runs (the probe's targets
+    # are generous — the verdict gates on surface health, not speed).
+    mgr.journey_ledger.set_objectives({c: 3600.0 for c in CLASSES})
+    for obj in make_objects(num_cqs):
+        mgr.store.create(obj)
+    mgr.run_until_idle(max_iterations=1_000_000)
+
+    n = 0
+    for wave in range(waves):
+        # Each CQ gets 3 arrivals per wave at 2 cpu against 4-cpu
+        # quota: one workload per wave requeues until earlier ones
+        # finish — real requeue loops for the timelines.
+        for i in range(num_cqs):
+            for _ in range(3):
+                mgr.store.create(make_workload(wave, i, n, clock.now()))
+                n += 1
+        for _ in range(3):
+            mgr.run_until_idle(max_iterations=1_000_000)
+            mgr.scheduler.schedule(timeout=0)
+            mgr.run_until_idle(max_iterations=1_000_000)
+            clock.advance(5.0)
+        # Finish admitted workloads so the next wave's backlog drains.
+        for wl in mgr.store.list("Workload"):
+            if wlpkg.is_admitted(wl) and not wlpkg.is_finished(wl):
+                set_condition(wl.status.conditions, Condition(
+                    type=api.WORKLOAD_FINISHED, status="True",
+                    reason="Succeeded", message="done"), clock.now())
+                mgr.store.update(wl)
+        mgr.run_until_idle(max_iterations=1_000_000)
+    # Drain: cycle until the backlog admits.
+    for _ in range(40):
+        mgr.run_until_idle(max_iterations=1_000_000)
+        mgr.scheduler.schedule(timeout=0)
+        mgr.run_until_idle(max_iterations=1_000_000)
+        clock.advance(5.0)
+        for wl in mgr.store.list("Workload"):
+            if wlpkg.is_admitted(wl) and not wlpkg.is_finished(wl):
+                set_condition(wl.status.conditions, Condition(
+                    type=api.WORKLOAD_FINISHED, status="True",
+                    reason="Succeeded", message="done"), clock.now())
+                mgr.store.update(wl)
+        mgr.run_until_idle(max_iterations=1_000_000)
+
+    led = mgr.journey_ledger
+    metrics = mgr.metrics
+    endpoints = DebugEndpoints(mgr.scheduler, metrics)
+    status = led.status()
+    payload = endpoints.handle("/debug/journeys", {"n": "1"})
+    aging = endpoints.handle("/debug/aging", {})
+
+    # Per-class TTA table from the SAME histogram the seal feeds.
+    h = metrics.journey_tta_seconds
+    classes = {}
+    for cls in sorted({k[0] for k in h.series}):
+        classes[cls] = {
+            "count": h.count(cls=cls),
+            "p50_s": round(h.percentile(0.5, cls=cls), 2),
+            "p99_s": round(h.percentile(0.99, cls=cls), 2),
+        }
+
+    slowest = (payload.get("slowest") or [{}])[0]
+    unstamped = status["unstamped_spans"]
+    timeline_ok, timeline_why = False, "no slowest exemplar retained"
+    if slowest:
+        j = led.journey(slowest["workload"])
+        if j is not None:
+            timeline_ok, timeline_why = j.timeline_complete()
+
+    report = {
+        "waves": waves, "cqs": num_cqs, "submitted": n,
+        "classes": classes,
+        "journeys": {k: status[k] for k in
+                     ("started", "completed", "requeues",
+                      "requeues_per_admission", "lru_evictions",
+                      "burn_rates")},
+        "slowest": {k: slowest.get(k) for k in
+                    ("workload", "tta_s", "requeues")} if slowest else None,
+        "slowest_spans": slowest.get("spans", []),
+        "timeline_ok": timeline_ok,
+        "timeline_why": timeline_why,
+        "unstamped_spans": unstamped,
+        "aging_failing": aging["failing"],
+        "aging": {name: mon["verdict"]
+                  for name, mon in aging["monitors"].items()},
+    }
+    mgr.shutdown(checkpoint=False)
+    report["retained_after_shutdown"] = led.retained
+    return report
+
+
+def render_table(report: dict) -> str:
+    lines = ["per-class time-to-admission (sealed journeys)",
+             f"{'class':>10} {'count':>6} {'p50_s':>8} {'p99_s':>8}"]
+    for cls, row in report["classes"].items():
+        lines.append(f"{cls:>10} {row['count']:>6} {row['p50_s']:>8} "
+                     f"{row['p99_s']:>8}")
+    j = report["journeys"]
+    lines.append(f"journeys: {j['completed']}/{report['submitted']} sealed  "
+                 f"requeues/admission: {j['requeues_per_admission']}  "
+                 f"lru evictions: {j['lru_evictions']}")
+    if report["slowest"]:
+        s = report["slowest"]
+        lines.append(f"slowest exemplar: {s['workload']} "
+                     f"tta={s['tta_s']}s requeues={s['requeues']}")
+        for sp in report["slowest_spans"]:
+            extra = {k: v for k, v in sp.items()
+                     if k not in ("kind", "t", "cycle", "generation",
+                                  "route")}
+            lines.append(f"  t={sp['t']:>10.1f} cycle={sp['cycle']:>4} "
+                         f"gen={sp['generation']} {sp['kind']:<16} "
+                         f"{extra if extra else ''}")
+    lines.append("aging verdicts: " + ", ".join(
+        f"{name}={v}" for name, v in report["aging"].items()))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    waves = int(argv[0]) if len(argv) > 0 else DEFAULT_WAVES
+    num_cqs = int(argv[1]) if len(argv) > 1 else DEFAULT_CQS
+    report = probe(waves, num_cqs)
+    if as_json:
+        print(json.dumps(report), file=sys.stderr, flush=True)
+    else:
+        print(render_table(report), file=sys.stderr, flush=True)
+    verdict = {k: v for k, v in report.items() if k != "slowest_spans"}
+    verdict["ok"] = (report["retained_after_shutdown"] == 0
+                     and report["unstamped_spans"] == 0
+                     and report["timeline_ok"]
+                     and report["journeys"]["completed"] > 0
+                     and not report["aging_failing"])
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

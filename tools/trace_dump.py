@@ -1,4 +1,4 @@
-"""Fetch and pretty-print flight-recorder cycle traces.
+"""Fetch and pretty-print flight-recorder cycle traces and journeys.
 
 Pulls ``/debug/cycles`` from a running VisibilityServer (see
 ``KueueManager.serve_visibility`` / kueue_tpu/obs/OBSERVABILITY.md) and
@@ -7,9 +7,15 @@ renders each cycle as a phase timeline: one header line per cycle
 duration) followed by its spans as proportional bars, nested sub-spans
 (dotted names like ``dispatch.scatter``) indented under their parent.
 
+With ``--journey <workload>`` it instead pulls ``/debug/journeys?wl=``
+and renders the workload's end-to-end admission timeline — one line
+per journey span (offset since arrival, cycle id, generation token,
+route, kind, detail): the "why did this take N cycles" view.
+
 Usage:
     python tools/trace_dump.py http://127.0.0.1:8082 [--slowest K | --n K]
-    python tools/trace_dump.py traces.json      # a saved /debug/cycles body
+    python tools/trace_dump.py http://127.0.0.1:8082 --journey ns/name
+    python tools/trace_dump.py traces.json      # a saved /debug/* body
     some-cmd | python tools/trace_dump.py -     # JSON on stdin
 """
 
@@ -22,22 +28,30 @@ import sys
 BAR_WIDTH = 40
 
 
-def fetch(source: str, slowest: int = 0, n: int = 0) -> dict:
-    """Load a /debug/cycles payload from a base URL, a file, or stdin."""
+def fetch(source: str, slowest: int = 0, n: int = 0,
+          journey: str = "") -> dict:
+    """Load a /debug/cycles (or /debug/journeys?wl=) payload from a
+    base URL, a file, or stdin."""
     if source == "-":
         return json.load(sys.stdin)
     if source.startswith("http://") or source.startswith("https://"):
+        import urllib.parse
         import urllib.request
         url = source.rstrip("/")
-        if not url.endswith("/debug/cycles"):
-            url += "/debug/cycles"
-        qs = []
-        if slowest:
-            qs.append(f"slowest={slowest}")
-        elif n:
-            qs.append(f"n={n}")
-        if qs:
-            url += "?" + "&".join(qs)
+        if journey:
+            if not url.endswith("/debug/journeys"):
+                url += "/debug/journeys"
+            url += "?wl=" + urllib.parse.quote(journey, safe="")
+        else:
+            if not url.endswith("/debug/cycles"):
+                url += "/debug/cycles"
+            qs = []
+            if slowest:
+                qs.append(f"slowest={slowest}")
+            elif n:
+                qs.append(f"n={n}")
+            if qs:
+                url += "?" + "&".join(qs)
         with urllib.request.urlopen(url, timeout=10) as resp:
             return json.load(resp)
     with open(source) as f:
@@ -81,6 +95,25 @@ def render(payload: dict, out=None) -> None:
                   + (f"  {extra}" if extra else ""), file=out)
 
 
+def render_journey(payload: dict, out=None) -> None:
+    """One line per journey span: offset since arrival, cycle id,
+    generation token, route, kind, detail fields."""
+    out = out or sys.stdout
+    j = payload.get("journey", payload)
+    t0 = j.get("created_t", 0.0)
+    print(f"journey {j['workload']}  cq={j['cluster_queue']} "
+          f"class={j['class']} sealed={j['sealed']} "
+          f"tta={j['tta_s']}s requeues={j['requeues']} "
+          f"admissions={j['admissions']}", file=out)
+    for s in j.get("spans", []):
+        extra = {k: v for k, v in s.items()
+                 if k not in ("kind", "t", "cycle", "generation", "route")}
+        print(f"  +{s['t'] - t0:>10.2f}s cycle={s['cycle']:>5} "
+              f"gen={s['generation']} "
+              f"{(s.get('route') or '-'):<16} {s['kind']:<16} "
+              f"{extra if extra else ''}", file=out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("source",
@@ -90,13 +123,20 @@ def main(argv=None) -> int:
                     help="show the K slowest retained cycles")
     ap.add_argument("--n", type=int, default=0,
                     help="show only the last K cycles")
+    ap.add_argument("--journey", default="",
+                    help="render one workload's journey timeline "
+                         "(ns/name or bare name) from /debug/journeys")
     args = ap.parse_args(argv)
     try:
-        payload = fetch(args.source, slowest=args.slowest, n=args.n)
+        payload = fetch(args.source, slowest=args.slowest, n=args.n,
+                        journey=args.journey)
     except Exception as exc:  # noqa: BLE001 — CLI surface
         print(f"error: {exc}", file=sys.stderr)
         return 1
-    render(payload)
+    if args.journey or "journey" in payload:
+        render_journey(payload)
+    else:
+        render(payload)
     return 0
 
 

@@ -2271,6 +2271,225 @@ def bench_restart_recovery(num_cqs=16, num_cohorts=4, waves=4,
     return cold["restore_wall_s"], primed["restore_wall_s"]
 
 
+FAILOVER_MAX_CYCLES_TO_ADMIT = 3
+
+
+def bench_failover_recovery(num_cqs=16, num_cohorts=4, waves=4,
+                            budget_s=240.0):
+    """Hot-standby failover A/B (resilience/replica.py +
+    RESILIENCE.md §7): one leader life over a durable log with a
+    StandbyReplica tailing the WAL every cycle, both running the
+    production config (solver + compile governor) against ONE shared
+    persistent compilation cache dir. The leader is killed by an
+    injected crash at a store write; the log is cloned at that instant
+    and recovery runs BOTH ways:
+
+    - **warm**: the follower promotes (fence + tail drain — its
+      manager, caches and solver warm investment already live);
+    - **cold**: a PR-10 restore from the clone into a "new process"
+      (jit caches cleared, warmed registry reset, fresh BatchSolver),
+      timed through the follower's incremental replay path AND the
+      legacy collapsed replay on a second clone — the ISSUE 15
+      carried-thread delta.
+
+    Asserts (backend-agnostic): replication lag drains to zero at
+    every poll during the storm (bounded throughout); both arms admit
+    within FAILOVER_MAX_CYCLES_TO_ADMIT cycles; the warm promotion's
+    recovery wall is strictly under the cold restore's (same host,
+    back-to-back — the structural claim the subsystem exists for);
+    zero mid-traffic compiles after promotion; and nothing durably
+    admitted before the kill is lost by either arm."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from kueue_tpu import config as cfgpkg
+    from kueue_tpu.api.meta import FakeClock
+    from kueue_tpu.core import workload as wlpkg
+    from kueue_tpu.manager import KueueManager
+    from kueue_tpu.resilience import faultinject, recovery
+    from kueue_tpu.resilience.faultinject import (
+        CRASH, FaultInjector, InjectedCrash)
+    from kueue_tpu.resilience.replica import StandbyReplica, lead
+    from kueue_tpu.solver import BatchSolver
+    from kueue_tpu.solver import service as svc
+    from kueue_tpu.utils.runtime import enable_compilation_cache
+
+    cache_dir = tempfile.mkdtemp(prefix="kueue-failover-")
+
+    def make_cfg():
+        cfg = cfgpkg.Configuration()
+        cfg.solver.enable = True
+        cfg.solver.min_heads = 0
+        cfg.solver.routing = "always"
+        cfg.solver.compile_cache_dir = cache_dir
+        cfg.solver.warmup_at_startup = True
+        cfg.store.durable = True
+        cfg.store.checkpoint_every = 256
+        return cfg
+
+    def drive_cycle(mgr, clock, label, n):
+        for i in range(num_cqs):
+            mgr.store.create(make_workload(f"{label}-w{n}", f"lq{i}",
+                                           cpu_units=1,
+                                           creation=float(n)))
+            n += 1
+        mgr.run_until_idle(max_iterations=1_000_000)
+        mgr.scheduler.schedule(timeout=0)
+        mgr.run_until_idle(max_iterations=1_000_000)
+        clock.advance(1.0)
+        return n
+
+    def admitted_keys(mgr):
+        return sorted(wlpkg.key(wl) for wl in mgr.store.list("Workload")
+                      if wlpkg.has_quota_reservation(wl))
+
+    def cycles_to_admit(mgr, clock, label, t0):
+        before = mgr.recorder.reason_counts.get("QuotaReserved", 0)
+        n = 100_000
+        for cycle in range(10):
+            if time.perf_counter() - t0 > budget_s:
+                break
+            n = drive_cycle(mgr, clock, label, n)
+            if mgr.recorder.reason_counts.get("QuotaReserved",
+                                              0) > before:
+                return cycle + 1
+        return None
+
+    jax.clear_caches()
+    svc.reset_seen_programs()
+    clock = FakeClock(1000.0)
+    leader = KueueManager(cfg=make_cfg(), clock=clock,
+                          solver=BatchSolver())
+    for obj in ([make_flavor("f0")]
+                + [make_cq(f"cq{i}", f"cohort-{i % num_cohorts}",
+                           ["f0"], nominal_units=100_000)
+                   for i in range(num_cqs)]
+                + [make_lq(f"lq{i}", f"cq{i}")
+                   for i in range(num_cqs)]):
+        leader.store.create(obj)
+    leader.run_until_idle(max_iterations=1_000_000)
+    durable = leader.durable
+    lead(leader, durable, identity="leader-0")
+    standby = StandbyReplica(durable, cfg=make_cfg(), clock=clock,
+                             solver=BatchSolver(), identity="standby-0")
+
+    try:
+        # -- the storm: follower polls every cycle, lag must drain ----
+        n = 0
+        undrained_polls = 0
+        for _wave in range(waves):
+            n = drive_cycle(leader, clock, "life", n)
+            standby.poll()
+            if standby.lag_records != 0:
+                undrained_polls += 1
+        max_lag = standby.max_lag_records
+
+        # -- the kill -------------------------------------------------
+        faultinject.install(FaultInjector(
+            {faultinject.SITE_STORE: {5: CRASH}}))
+        crashed = False
+        try:
+            drive_cycle(leader, clock, "life", n)
+        except InjectedCrash:
+            crashed = True
+        finally:
+            faultinject.uninstall()
+        assert crashed, "kill point never fired"
+        leader.warm_governor.stop()  # in-process hygiene (bench_restart)
+        pre_admitted = set(
+            wlpkg.key(wl)
+            for wl in durable.load().objects.get("Workload", {}).values()
+            if wlpkg.has_quota_reservation(wl))
+        # The cold arm must see EXACTLY the durable state the warm arm
+        # promotes from — promotion checkpoints and journals onward, so
+        # clone the log at the kill instant (twice: one per replay mode).
+        clone_inc = durable.clone()
+        clone_col = durable.clone()
+
+        # -- warm arm: promote the follower ---------------------------
+        t0 = time.perf_counter()
+        promoted = standby.promote(force=True)
+        warm_wall_s = standby.last_promotion.duration_s
+        warm_cycles = cycles_to_admit(promoted, clock, "warm", t0)
+        warm_mid = promoted.scheduler.solver.counters[
+            "mid_traffic_compiles"]
+        warm = {"recovery_wall_s": round(warm_wall_s, 4),
+                "cycles_to_first_admission": warm_cycles,
+                "mid_traffic_compiles": warm_mid,
+                "drained_records":
+                    standby.last_promotion.drained_records,
+                "epoch": standby.last_promotion.epoch}
+        warm_lost = sorted(pre_admitted - set(admitted_keys(promoted)))
+        promoted.shutdown(checkpoint=False)
+
+        # -- cold arm: restore from the clone into a "new process" ----
+        jax.clear_caches()
+        svc.reset_seen_programs()
+        clock2 = FakeClock(clock.now())
+        t0 = time.perf_counter()
+        cold_mgr = recovery.restore(clone_inc, cfg=make_cfg(),
+                                    clock=clock2, solver=BatchSolver())
+        cold_wall_s = cold_mgr.last_recovery.duration_s
+        cold_cycles = cycles_to_admit(cold_mgr, clock2, "cold", t0)
+        cold_mid = cold_mgr.scheduler.solver.counters[
+            "mid_traffic_compiles"]
+        cold = {"recovery_wall_s": round(cold_wall_s, 4),
+                "cycles_to_first_admission": cold_cycles,
+                "mid_traffic_compiles": cold_mid,
+                "replay_mode": cold_mgr.last_recovery.replay_mode,
+                "wal_records_replayed":
+                    cold_mgr.last_recovery.wal_records_replayed}
+        cold_lost = sorted(pre_admitted - set(admitted_keys(cold_mgr)))
+        cold_mgr.warm_governor.stop()
+        cold_mgr.shutdown(checkpoint=False)
+
+        # -- the carried-thread delta: incremental vs collapsed replay
+        # (checkpoint_after left at its default on BOTH arms so the
+        # delta compares replay modes, not checkpoint policy)
+        clock3 = FakeClock(clock.now())
+        col_mgr = recovery.restore(clone_col, cfg=make_cfg(),
+                                   clock=clock3, solver=BatchSolver(),
+                                   incremental=False)
+        collapsed_wall_s = col_mgr.last_recovery.duration_s
+        col_mgr.warm_governor.stop()
+        col_mgr.shutdown(checkpoint=False)
+    finally:
+        faultinject.uninstall()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        enable_compilation_cache()  # restore the shared bench cache dir
+
+    # Backend-agnostic gates.
+    assert undrained_polls == 0, (
+        f"{undrained_polls} poll(s) left replication lag undrained "
+        f"during the storm")
+    for name, rec in (("warm", warm), ("cold", cold)):
+        assert rec["cycles_to_first_admission"] is not None \
+            and rec["cycles_to_first_admission"] \
+            <= FAILOVER_MAX_CYCLES_TO_ADMIT, (name, rec)
+    assert warm["mid_traffic_compiles"] == 0, warm
+    assert not warm_lost and not cold_lost, (warm_lost, cold_lost)
+    # The structural A/B: the warm promotion beats the cold restore on
+    # the same host, back-to-back — the follower's whole point.
+    assert warm["recovery_wall_s"] < cold["recovery_wall_s"], \
+        (warm, cold)
+
+    row = {"bench": "failover_recovery", "cqs": num_cqs, "waves": waves,
+           "warm_promotion": warm, "cold_restore": cold,
+           "speedup": round(cold["recovery_wall_s"]
+                            / max(warm["recovery_wall_s"], 1e-9), 1),
+           "max_lag_records_during_storm": max_lag,
+           "undrained_polls": undrained_polls,
+           "incremental_restore_wall_s": round(cold_wall_s, 4),
+           "collapsed_restore_wall_s": round(collapsed_wall_s, 4),
+           "incremental_vs_collapsed_delta_s":
+               round(collapsed_wall_s - cold_wall_s, 4),
+           "max_cycles_to_admit": FAILOVER_MAX_CYCLES_TO_ADMIT}
+    log(row)
+    return warm["recovery_wall_s"], cold["recovery_wall_s"]
+
+
 def bench_multihost():
     """ISSUE 13 MULTICHIP multi-host row: the weak-scaling curve
     (conflict domains per device held constant across 1/2/4/8 simulated
@@ -2419,6 +2638,7 @@ def main():
     bench_visibility_storm()
     bench_cold_start()
     bench_restart_recovery()
+    bench_failover_recovery()
     bench_multihost()
     hit_rate = bench_speculative_pipeline()
     rows = {}

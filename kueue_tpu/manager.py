@@ -476,7 +476,32 @@ class KueueManager:
             # after shutdown, mirroring live_handouts.
             self.journey_ledger.close()
         if checkpoint and self.durable is not None:
-            self.store.checkpoint_now()
+            from kueue_tpu.sim.durable import Fenced
+            try:
+                self.store.checkpoint_now()
+            except Fenced:
+                # A DEPOSED leader shutting down gracefully: its stale
+                # image must not replace the checkpoint (that would
+                # rotate away the new leader's live WAL tail). Skip —
+                # the durable truth belongs to the current epoch.
+                pass
+        if getattr(self.store, "fencing", None) is not None:
+            # A leading manager hands the lease off instead of making
+            # the standby wait out the full duration (the successor's
+            # acquire bumps the fencing epoch as usual).
+            self.store.fencing.release()
+
+    @classmethod
+    def standby(cls, durable, cfg=None, clock: Clock = REAL_CLOCK,
+                solver=None, **kwargs):
+        """Build a hot-standby follower of ``durable`` — a warm
+        manager continuously advanced by WAL tail replay, promotable
+        to leadership in sub-cycle time (RESILIENCE.md §7). Returns a
+        ``resilience.replica.StandbyReplica``; drive ``poll()`` at
+        your cycle cadence and call ``promote()`` on leader loss."""
+        from kueue_tpu.resilience.replica import StandbyReplica
+        return StandbyReplica(durable, cfg=cfg, clock=clock,
+                              solver=solver, **kwargs)
 
     @classmethod
     def restore(cls, durable, cfg=None, clock: Clock = REAL_CLOCK,

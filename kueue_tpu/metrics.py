@@ -340,6 +340,33 @@ class Registry:
             "Wall seconds from restore() entry to a settled control "
             "plane (checkpoint load + WAL replay + reconcile drain)",
             buckets=exponential_buckets(0.005, 2.0, 16))
+        # Hot-standby replication (resilience/replica.py +
+        # RESILIENCE.md §7): how far the follower's WAL tail replay
+        # lags the leader's append head, the fencing epoch in effect,
+        # and standby-to-leader promotions.
+        self.replication_lag_records = Gauge(
+            "kueue_replication_lag_records",
+            "WAL records appended by the leader that this standby "
+            "replica has not yet applied (refreshed at every poll; 0 "
+            "after a drain)")
+        self.replication_lag_seconds = Gauge(
+            "kueue_replication_lag_seconds",
+            "Virtual seconds between the newest WAL record and the "
+            "newest this replica applied")
+        self.fencing_epoch_gauge = Gauge(
+            "kueue_fencing_epoch",
+            "The durable log's current leader-lease fencing epoch as "
+            "this replica last observed it (a deposed leader's writes "
+            "are rejected the moment this advances past its token)")
+        self.promotions_total = Counter(
+            "kueue_replica_promotions_total",
+            "Standby replicas promoted to leadership (sub-cycle "
+            "failover, RESILIENCE.md §7)")
+        self.promotion_seconds = Histogram(
+            "kueue_replica_promotion_seconds",
+            "Wall seconds for a standby promotion (fence + tail drain "
+            "+ settle + checkpoint)",
+            buckets=exponential_buckets(0.001, 2.0, 16))
         # Snapshot-backed query plane (obs/queryplane.py): read-side
         # saturation — per-route request counts by HTTP code, request
         # latency, the sealed view's age, and reads in flight. Fed by
@@ -479,6 +506,18 @@ class Registry:
     def restart_recovered(self, seconds: float) -> None:
         self.restarts_total.inc()
         self.recovery_seconds.observe(seconds)
+
+    def replication_lag(self, records: float, seconds: float) -> None:
+        self.replication_lag_records.set(records)
+        self.replication_lag_seconds.set(seconds)
+
+    def set_fencing_epoch(self, epoch: int) -> None:
+        self.fencing_epoch_gauge.set(epoch)
+
+    def replica_promoted(self, epoch: int, seconds: float) -> None:
+        self.promotions_total.inc()
+        self.promotion_seconds.observe(seconds)
+        self.fencing_epoch_gauge.set(epoch)
 
     def visibility_request(self, route: str, code: int,
                            seconds: float) -> None:

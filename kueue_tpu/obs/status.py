@@ -131,6 +131,17 @@ def recovery_status(scheduler) -> dict:
     out = {"restored": rep is not None}
     if rep is not None:
         out.update(rep)
+    # Hot-standby surface (resilience/replica.py + RESILIENCE.md §7):
+    # a StandbyReplica wires its status producer onto the scheduler —
+    # on the follower it reports role/lag/cursor, and it carries
+    # through promotion (role flips to "leader"); promote() stamps its
+    # own report alongside. Absent = no replication regime.
+    std = getattr(scheduler, "standby_status", None)
+    if std is not None:
+        out["standby"] = std()
+    prom = getattr(scheduler, "last_promotion", None)
+    if prom is not None:
+        out["promotion"] = prom
     return out
 
 

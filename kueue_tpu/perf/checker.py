@@ -230,6 +230,13 @@ class SLOSpec:
     # recorded no staleness samples is itself a violation).
     min_reads: int = 0
     max_read_staleness_generations: Optional[int] = None
+    # Hot-standby failover (RESILIENCE.md §7): max VIRTUAL seconds
+    # from a standby promotion back to the next admission grant — the
+    # promotion-to-first-admission SLO, gated WELL UNDER the PR-10
+    # cold-restore budget (the entire point of the warm follower).
+    # None = unchecked; with a bound set, a scenario that promoted but
+    # never admitted again is itself a violation.
+    max_promotion_to_first_admission_s: Optional[float] = None
     # MultiKueue batched-column re-placement (ISSUE 13): max VIRTUAL
     # seconds from a worker-cluster loss to the LAST affected workload
     # re-reserving on a surviving cluster (the cluster_rebalance
@@ -293,6 +300,18 @@ def check_slo(result, spec: SLOSpec) -> list:
             violations.append(
                 f"recovery-to-first-admission {worst:.1f}s exceeds "
                 f"{spec.max_recovery_to_first_admission_s:.1f}s")
+    if spec.max_promotion_to_first_admission_s is not None:
+        promotions = getattr(result, "promotions", 0)
+        ttas = getattr(result, "promotion_to_first_admission_s", [])
+        if promotions and len(ttas) < promotions:
+            violations.append(
+                f"{promotions - len(ttas)} of {promotions} "
+                "promotion(s) never re-admitted a workload")
+        worst = max(ttas) if ttas else 0.0
+        if worst > spec.max_promotion_to_first_admission_s:
+            violations.append(
+                f"promotion-to-first-admission {worst:.1f}s exceeds "
+                f"{spec.max_promotion_to_first_admission_s:.1f}s")
     if spec.min_reads:
         reads = getattr(result, "reads", 0)
         if reads < spec.min_reads:

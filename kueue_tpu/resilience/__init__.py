@@ -46,6 +46,12 @@ from kueue_tpu.resilience.faultinject import (  # noqa: F401
     SITE_STORE,
     SITES,
 )
+from kueue_tpu.resilience.replica import (  # noqa: F401
+    FencingToken,
+    PromotionReport,
+    StandbyReplica,
+    lead,
+)
 from kueue_tpu.resilience.supervisor import (  # noqa: F401
     SupervisedTimeout,
     SupervisedWorker,

@@ -73,6 +73,12 @@ class RecoveryReport:
     admitted_restored: int = 0    # workloads restored holding quota
     pending_restored: int = 0     # workloads restored without quota
     settle_reconciles: int = 0    # reconciles to drain the rebuild
+    # Which tail replay ran: "incremental" applies the WAL records as
+    # their ORIGINAL watch events through Store.apply_replicated — the
+    # hot-standby follower's live path (RESILIENCE.md §7) — while
+    # "collapsed" folds the tail into final objects first (the PR-10
+    # shape, kept for the bench A/B).
+    replay_mode: str = "incremental"
 
     def to_dict(self) -> dict:
         return {
@@ -86,13 +92,15 @@ class RecoveryReport:
             "admitted_restored": self.admitted_restored,
             "pending_restored": self.pending_restored,
             "settle_reconciles": self.settle_reconciles,
+            "replay_mode": self.replay_mode,
         }
 
 
 def restore(durable, cfg=None, clock: Clock = REAL_CLOCK, solver=None,
             registered_check_controllers: Optional[set] = None,
             remote_clusters: Optional[dict] = None,
-            identity: str = "", checkpoint_after: bool = True):
+            identity: str = "", checkpoint_after: bool = True,
+            incremental: bool = True):
     """Build a fresh ``KueueManager`` from a durable log's newest
     recoverable state. Returns the manager; its ``last_recovery``
     carries the ``RecoveryReport``.
@@ -102,22 +110,28 @@ def restore(durable, cfg=None, clock: Clock = REAL_CLOCK, solver=None,
     while its compile investment (jit caches + the persistent
     compilation cache) carries over. ``checkpoint_after`` compacts the
     log once the rebuild settles, so a crash-during-recovery restarts
-    from the restored image instead of re-replaying the tail."""
+    from the restored image instead of re-replaying the tail.
+
+    ``incremental`` (default) replays the WAL tail as its ORIGINAL
+    watch events through ``Store.apply_replicated`` — the same path
+    the hot-standby follower streams live (resilience/replica.py), so
+    cold restore and warm failover exercise one replay. False keeps
+    the PR-10 collapsed replay (fold the tail into final objects,
+    replay everything as ADDED) for the bench A/B delta."""
     from kueue_tpu.core import workload as wlpkg
     from kueue_tpu.manager import KueueManager
     from kueue_tpu.sim import Store
 
     t0 = _time.perf_counter()
     report = RecoveryReport()
+    report.replay_mode = "incremental" if incremental else "collapsed"
 
-    loaded = durable.load()
+    parts = durable.load_parts()
     t_load = _time.perf_counter()
-    report.checkpoint_loaded = loaded.checkpoint_loaded
-    report.wal_records_replayed = loaded.records_replayed
-    report.torn_records = loaded.torn_records
-    report.warnings = list(loaded.warnings)
-    report.rv = loaded.rv
-    report.objects = {k: len(v) for k, v in loaded.objects.items() if v}
+    report.checkpoint_loaded = parts.checkpoint_loaded
+    report.torn_records = parts.torn_records
+    report.warnings = list(parts.warnings)
+    report.rv = parts.rv
 
     if solver is not None and hasattr(solver, "detach"):
         # Drop every binding to the dead control plane BEFORE the new
@@ -142,22 +156,36 @@ def restore(durable, cfg=None, clock: Clock = REAL_CLOCK, solver=None,
              t_load - t0)
 
     t_replay = _time.perf_counter()
-    kinds = sorted(loaded.objects,
+    if incremental:
+        base, tail = parts.objects, parts.records
+    else:
+        collapsed = parts.collapse()
+        base, tail = collapsed.objects, ()
+        report.wal_records_replayed = collapsed.records_replayed
+    kinds = sorted(base,
                    key=lambda k: (_KIND_ORDER.get(k, _KIND_DEFAULT), k))
     for kind in kinds:
-        for obj in loaded.objects[kind].values():
+        for obj in base[kind].values():
             store.load_object(obj)
-            if kind == "Workload":
-                if wlpkg.has_quota_reservation(obj):
-                    report.admitted_restored += 1
-                else:
-                    report.pending_restored += 1
+    # The tail replays as the original event stream — creates, status
+    # flips and finalizer deletes fire in exactly the order the dead
+    # leader's controllers observed them, through the follower's
+    # apply path (event fidelity preserved; not re-logged).
+    for event, _kind, _key, obj, _t in tail:
+        store.apply_replicated(event, obj)
+        report.wal_records_replayed += 1
+    for wl in store.list("Workload", copy_objects=False):
+        if wlpkg.has_quota_reservation(wl):
+            report.admitted_restored += 1
+        else:
+            report.pending_restored += 1
+    report.objects = {k: len(v) for k, v in store._objects.items() if v}
     rec.span("recovery.replay", t_replay, _time.perf_counter() - t_replay)
 
     # The resourceVersion high-water mark may exceed any SURVIVING
     # object's rv (a deleted object can have held it): seed it from the
     # log so post-restore writes never re-mint a used rv.
-    store._rv = max(store._rv, loaded.rv)
+    store._rv = max(store._rv, parts.rv)
 
     t_settle = _time.perf_counter()
     report.settle_reconciles = mgr.run_until_idle(

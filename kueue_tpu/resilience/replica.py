@@ -1,0 +1,448 @@
+"""Hot-standby replicated control plane (RESILIENCE.md §7).
+
+PR 10 made crash-restart convergent from the durable checkpoint/WAL
+log, but a cold restore still pays load + full replay + settle at the
+worst possible moment — right after losing the leader at traffic. The
+reference leans on k8s leader election plus a full cache rebuild
+("etcd is the checkpoint", SURVEY.md §4/§5); we can beat it because
+our WAL *is* the watch stream: a follower that continuously replays it
+holds a **warm manager** the whole time, so failover costs roughly one
+admission cycle, not a restore.
+
+Three cooperating pieces:
+
+- ``StandbyReplica`` — a follower process around its own Store +
+  ``KueueManager``. It bootstraps from ``DurableLog.load_with_cursor``
+  and then **tails** the leader's WAL (``read_tail`` — the
+  rotation-aware cursor from sim/durable.py), applying each record as
+  its original watch event through ``Store.apply_replicated``. Queue
+  heaps, cache trees and snapshot masters advance through the ordinary
+  reconciler paths — the same journal replay ``cache/incremental.py``
+  already runs per cycle — and a solver handed to the standby
+  pre-warms through the PR-7 persistent compile cache + governor. The
+  replica tracks replication lag in records and (virtual) seconds,
+  feeds the ``replication_lag_*`` gauges and an AgingWatch trend
+  monitor, and falls back to a full re-bootstrap when its cursor drops
+  behind the segment retention window (counted, never fatal).
+
+- ``FencingToken`` / ``lead()`` — the leader lease with **fencing
+  epochs**, arbitrated by the durable log (the one medium that
+  outlives every process). ``lead()`` wires the token into the leader:
+  ``Store._persist`` validates it before every WAL append (and the
+  append re-checks under the log lock), and the scheduler's
+  ``_validate_speculation`` consults it at the speculative commit
+  point — so a deposed leader's in-flight cycle aborts un-decoded,
+  and even its synchronous admission write raises ``Fenced`` before
+  reaching the log. The store's admission records stay the
+  exactly-once arbiter; fencing just guarantees a deposed writer can
+  never author one.
+
+- ``StandbyReplica.promote()`` — **sub-cycle promotion**: acquire the
+  lease (bumping the fencing epoch FIRST, so the drain below reads a
+  quiescent tail — a still-twitching old leader is fenced off before
+  we stop listening to it), drain the replay tail, settle, attach the
+  durable log (the promoted store now journals), take a compaction
+  checkpoint (which also truncates any torn crash tail), and adopt
+  the restore() posture: first cycle pinned synchronous, breaker and
+  ladder at their fresh CLOSED/NORMAL rungs. The whole sequence is
+  traced (route ``"promotion"``), counted (``promotions_total``) and
+  reported (``/debug/recovery`` standby/promotion sections).
+"""
+
+from __future__ import annotations
+
+import time as _time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.meta import REAL_CLOCK, Clock
+from kueue_tpu.resilience.recovery import _KIND_DEFAULT, _KIND_ORDER
+from kueue_tpu.sim.durable import DurableLog, Fenced, TailCursor
+
+DEFAULT_LEASE_DURATION_S = 15.0
+
+# AgingWatch monitor defaults for the follower-lag trend source
+# (obs/trend.py): sustained growth of >1 unapplied record per poll over
+# the window means the follower is falling behind its leader's append
+# rate — the replication analogue of ROADMAP item 5's monotone leaks.
+LAG_SLOPE_THRESHOLD = 1.0
+LAG_WINDOW = 16
+LAG_WARMUP = 4
+
+
+@dataclass
+class FencingToken:
+    """One replica's claim to the leader lease at a specific epoch.
+    ``valid()`` is the cheap gate the scheduler polls; ``check()`` is
+    the raising form the store's commit path uses. The token never
+    refreshes its epoch — a deposed replica must construct a new one
+    by re-acquiring the lease (and will get a HIGHER epoch)."""
+
+    log: DurableLog
+    identity: str
+    epoch: int
+
+    def valid(self) -> bool:
+        try:
+            self.check()
+            return True
+        except Fenced:
+            return False
+
+    def check(self) -> None:
+        self.log.check_epoch(self.identity, self.epoch)
+
+    def renew(self, now: float) -> bool:
+        return self.log.renew_lease(self.identity, now)
+
+    def release(self) -> None:
+        self.log.release_lease(self.identity)
+
+
+def lead(mgr, durable: DurableLog, identity: str = "",
+         now: Optional[float] = None,
+         duration: float = DEFAULT_LEASE_DURATION_S,
+         force: bool = False) -> FencingToken:
+    """Make ``mgr`` the fenced leader over ``durable``: acquire the
+    lease (raising if another holder's lease is live and ``force`` is
+    False), then wire the token through every commit gate — the
+    store's persist path, the scheduler's speculative-commit
+    validation, and the leader gate itself. Returns the token (the
+    caller renews it via ``token.renew`` at its cycle cadence)."""
+    identity = identity or f"kueue-leader-{uuid.uuid4().hex[:8]}"
+    if now is None:
+        now = mgr.clock.now()
+    epoch = durable.acquire_lease(identity, now=now, duration=duration,
+                                  force=force)
+    if epoch is None:
+        holder = durable.lease_status()["holder"]
+        raise RuntimeError(
+            f"cannot lead: lease held by {holder!r} and not expired")
+    token = FencingToken(durable, identity, epoch)
+    mgr.store.fencing = token
+    mgr.scheduler.fencing_check = token.valid
+    mgr.scheduler.leader_check = token.valid
+    if mgr.metrics is not None:
+        mgr.metrics.set_fencing_epoch(epoch)
+    return token
+
+
+@dataclass
+class PromotionReport:
+    """What one ``promote()`` did, for /debug/recovery and the chaos
+    harness asserts. Times are wall seconds (the promotion itself is
+    host work); the SLO gate on promotion-to-first-admission lives in
+    virtual time on the scenario side (SCENARIOS.md failover)."""
+
+    duration_s: float = 0.0
+    epoch: int = 0
+    drained_records: int = 0      # tail records applied during the drain
+    torn_records: int = 0         # incomplete crash-tail records dropped
+    resyncs: int = 0              # bootstrap fallbacks over this replica's life
+    settle_reconciles: int = 0
+    lag_records_at_entry: int = 0  # how far behind the follower was
+    warnings: list = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "duration_s": round(self.duration_s, 6),
+            "epoch": self.epoch,
+            "drained_records": self.drained_records,
+            "torn_records": self.torn_records,
+            "resyncs": self.resyncs,
+            "settle_reconciles": self.settle_reconciles,
+            "lag_records_at_entry": self.lag_records_at_entry,
+            "warnings": list(self.warnings),
+        }
+
+
+class StandbyReplica:
+    """A warm follower of a durable log: its own Store + KueueManager,
+    continuously advanced by WAL tail replay, promotable to leadership
+    in sub-cycle time. See the module docstring for the contract.
+
+    The replica's manager never runs admission cycles while standby
+    (``scheduler.leader_check`` is wired to the promotion state), but
+    every watch-driven structure — queue heaps, cache trees, snapshot
+    masters, the encode arena's delta feed, a warming solver — stays
+    live, which is the entire point."""
+
+    def __init__(self, durable: DurableLog, cfg=None,
+                 clock: Clock = REAL_CLOCK, solver=None,
+                 identity: str = "",
+                 registered_check_controllers: Optional[set] = None,
+                 remote_clusters: Optional[dict] = None,
+                 lease_duration: float = DEFAULT_LEASE_DURATION_S):
+        self.durable = durable
+        self.cfg = cfg
+        self.clock = clock
+        self.identity = identity or f"kueue-standby-{uuid.uuid4().hex[:8]}"
+        self.lease_duration = lease_duration
+        self._solver = solver
+        self._check_controllers = registered_check_controllers
+        self._remote_clusters = remote_clusters
+        self.promoted = False
+        self.polls = 0
+        self.applied_records = 0
+        self.resyncs = 0
+        self.max_lag_records = 0      # worst pre-poll lag observed
+        self._last_applied_t = 0.0
+        self._token: Optional[FencingToken] = None
+        self.last_promotion: Optional[PromotionReport] = None
+        self.mgr = None
+        self._cursor = TailCursor()
+        self._bootstrap()
+
+    # -- construction / resync -----------------------------------------
+
+    def _bootstrap(self) -> None:
+        """(Re)build the warm manager from the log's newest recoverable
+        state — the follower's cold half, shared in shape with
+        recovery.restore(): checkpoint objects in dependency order,
+        then the tail as its original event stream, then settle. Runs
+        at construction and again whenever the cursor falls behind the
+        segment retention window (resync)."""
+        from kueue_tpu.manager import KueueManager
+        from kueue_tpu.sim import Store
+
+        if self._solver is not None and hasattr(self._solver, "detach"):
+            # On a resync rebuild the solver was bound to the discarded
+            # manager; fresh construction makes this a no-op.
+            self._solver.detach()
+        parts, cursor = self.durable.load_with_cursor()
+        store = Store(self.clock)
+        mgr = KueueManager(
+            cfg=self.cfg, clock=self.clock, solver=self._solver,
+            registered_check_controllers=self._check_controllers,
+            remote_clusters=self._remote_clusters, store=store,
+            identity=self.identity)
+        kinds = sorted(parts.objects,
+                       key=lambda k: (_KIND_ORDER.get(k, _KIND_DEFAULT), k))
+        for kind in kinds:
+            for obj in parts.objects[kind].values():
+                store.load_object(obj)
+        for event, _kind, _key, obj, t in parts.records:
+            store.apply_replicated(event, obj)
+            self._last_applied_t = max(self._last_applied_t, t)
+        store._rv = max(store._rv, parts.rv)
+        mgr.run_until_idle(max_iterations=1_000_000)
+        # The follower must never admit: the leader gate opens only at
+        # promotion (and stays honest afterwards via the fencing token).
+        mgr.scheduler.leader_check = self._leader_gate
+        mgr.scheduler.standby_status = self.status
+        # Follower-lag trend source (obs/trend.py): sustained growth of
+        # the unapplied-record count is the replication leak signature;
+        # the watch is sampled at every poll (the follower's "cycle").
+        mgr.aging_watch.add(
+            "replication_lag_records",
+            lambda: float(self.lag_records or 0),
+            slope_threshold=LAG_SLOPE_THRESHOLD,
+            window=LAG_WINDOW, warmup=LAG_WARMUP)
+        self._cursor = cursor
+        self.applied_records += len(parts.records)
+        self.mgr = mgr
+        self._publish_lag()
+
+    def _leader_gate(self) -> bool:
+        if not self.promoted:
+            return False
+        return self._token is None or self._token.valid()
+
+    # -- the follow loop -----------------------------------------------
+
+    @property
+    def lag_records(self) -> Optional[int]:
+        """Records appended to the log this replica has not yet
+        applied. None while a resync is pending (lag unknowable
+        incrementally)."""
+        return self.durable.records_ahead(self._cursor)
+
+    @property
+    def lag_seconds(self) -> float:
+        """Virtual seconds between the newest record on the log and
+        the newest this replica applied — 0 when fully caught up."""
+        return max(0.0, self.durable.last_append_t - self._last_applied_t)
+
+    def poll(self, max_records: int = 0) -> int:
+        """Apply every record appended since the last poll (bounded by
+        ``max_records`` if nonzero) and settle the reconcilers.
+        Returns the number of records applied. A cursor past the
+        retention window triggers a counted full re-bootstrap.
+
+        Cost contract: ONE tail read per poll, O(new records) — the
+        pre-poll lag observation comes from the batch itself instead
+        of a separate scan, and a scan only happens when a bounded
+        batch filled up (the leftover is otherwise zero by
+        construction)."""
+        if self.promoted:
+            return 0
+        batch = self.durable.read_tail(self._cursor, max_records)
+        if batch.resync:
+            self.resyncs += 1
+            self._bootstrap()
+            self.polls += 1
+            return 0
+        for event, _kind, _key, obj, t in batch.records:
+            self.mgr.store.apply_replicated(event, obj)
+            self._last_applied_t = max(self._last_applied_t, t)
+        self._cursor = batch.cursor
+        if batch.records:
+            self.mgr.run_until_idle(max_iterations=1_000_000)
+            self.applied_records += len(batch.records)
+        leftover = 0
+        if max_records and len(batch.records) >= max_records:
+            leftover = self.durable.records_ahead(self._cursor) or 0
+        behind = len(batch.records) + leftover
+        if behind > self.max_lag_records:
+            self.max_lag_records = behind
+        self.polls += 1
+        self._publish_lag(leftover)
+        self.mgr.aging_watch.sample()
+        return len(batch.records)
+
+    def _publish_lag(self, lag: int = 0) -> None:
+        if self.mgr is None or self.mgr.metrics is None:
+            return
+        self.mgr.metrics.replication_lag(lag, self.lag_seconds)
+        self.mgr.metrics.set_fencing_epoch(self.durable.fencing_epoch)
+
+    # -- promotion -----------------------------------------------------
+
+    def promote(self, now: Optional[float] = None, force: bool = False,
+                checkpoint_after: bool = True):
+        """Become the leader in sub-cycle time. Sequence (order is the
+        correctness argument): (1) acquire the lease, bumping the
+        fencing epoch — from this instant the old leader's appends
+        raise ``Fenced``, so (2) draining the replay tail reads a
+        quiescent stream; (3) settle the reconcilers; (4) attach the
+        durable log (this store now journals) and checkpoint, which
+        also truncates any torn crash tail the drain parked before;
+        (5) adopt the restore() posture — first cycle pinned
+        synchronous, breaker/ladder already at their fresh rungs —
+        and open the leader gate. Returns the (now leading) manager.
+
+        ``force`` skips lease-expiry arbitration — the harness's "the
+        leader is known dead" path; without it, promotion of a live
+        leader's lease raises."""
+        if self.promoted:
+            return self.mgr
+        t0 = _time.perf_counter()
+        if now is None:
+            now = self.clock.now()
+        report = PromotionReport(
+            lag_records_at_entry=self.lag_records or 0)
+        epoch = self.durable.acquire_lease(
+            self.identity, now=now, duration=self.lease_duration,
+            force=force)
+        if epoch is None:
+            holder = self.durable.lease_status()["holder"]
+            raise RuntimeError(
+                f"cannot promote: lease held by {holder!r} and not "
+                f"expired (pass force=True only when the leader is "
+                f"known dead)")
+        report.epoch = epoch
+
+        t_drain = _time.perf_counter()
+        while True:
+            batch = self.durable.read_tail(self._cursor)
+            if batch.resync:
+                self.resyncs += 1
+                self._bootstrap()
+                continue
+            for event, _kind, _key, obj, t in batch.records:
+                self.mgr.store.apply_replicated(event, obj)
+                self._last_applied_t = max(self._last_applied_t, t)
+            self._cursor = batch.cursor
+            self.applied_records += len(batch.records)
+            report.drained_records += len(batch.records)
+            if not batch.records:
+                break
+        drain_s = _time.perf_counter() - t_drain
+        # The drain parks before an incomplete trailing record — a torn
+        # crash tail from the dead leader's final append. Count it; the
+        # checkpoint below truncates it (same fallback load() applies).
+        if (self._cursor.generation == self.durable.generation
+                and self.durable.wal_size() > self._cursor.offset):
+            report.torn_records += 1
+            report.warnings.append(
+                "torn WAL tail record dropped at promotion (leader "
+                "crashed mid-append); recovered to the last intact "
+                "record")
+        # The trace opens AFTER the drain: a resync mid-drain rebuilds
+        # self.mgr, and the promotion spans must land on the recorder
+        # the promoted manager actually serves.
+        mgr = self.mgr
+        rec = mgr.flight_recorder
+        trace = rec.begin_cycle(mgr.scheduler.attempt_count)
+        rec.span("promotion.drain", t_drain, drain_s)
+
+        t_settle = _time.perf_counter()
+        report.settle_reconciles = mgr.run_until_idle(
+            max_iterations=1_000_000)
+        rec.span("promotion.settle", t_settle,
+                 _time.perf_counter() - t_settle)
+
+        token = FencingToken(self.durable, self.identity, epoch)
+        self._token = token
+        mgr.store.fencing = token
+        mgr.scheduler.fencing_check = token.valid
+        mgr.store.attach_durable(self.durable)
+        mgr.durable = self.durable
+        if checkpoint_after:
+            mgr.store.checkpoint_now()
+        # Conservative takeover posture, exactly like restore(): the
+        # first cycle runs synchronously — never a speculative dispatch
+        # against a cache that finished catching up milliseconds ago.
+        mgr.scheduler._pipeline_cooldown = max(
+            mgr.scheduler._pipeline_cooldown, 1)
+        self.promoted = True
+        report.resyncs = self.resyncs
+        report.duration_s = _time.perf_counter() - t0
+        self.last_promotion = report
+        mgr.last_promotion = report
+        mgr.scheduler.last_promotion = report.to_dict()
+        if mgr.metrics is not None:
+            mgr.metrics.replica_promoted(epoch, report.duration_s)
+            mgr.metrics.replication_lag(0, 0.0)
+        if trace is not None:
+            trace.route = "promotion"
+            trace.heads = 0
+            rec.annotate(
+                "promotion",
+                f"standby {self.identity} promoted at fencing epoch "
+                f"{epoch}: {report.drained_records} tail record(s) "
+                f"drained, torn={report.torn_records}",
+                **{k: v for k, v in report.to_dict().items()
+                   if k != "warnings"})
+            rec.finish(trace)
+        mgr.recorder.system_event(
+            "Warning" if report.torn_records else "Normal", "Promoted",
+            f"standby promoted to leader in "
+            f"{report.duration_s * 1e3:.1f}ms (epoch {epoch}, "
+            f"{report.drained_records} record(s) drained)")
+        return mgr
+
+    # -- operator surface ----------------------------------------------
+
+    def status(self) -> dict:
+        """The single producer /debug/recovery's standby section,
+        tools/failover_probe.py and the tests share."""
+        lag = self.lag_records
+        return {
+            "identity": self.identity,
+            "role": "leader" if self.promoted else "standby",
+            "promoted": self.promoted,
+            "polls": self.polls,
+            "applied_records": self.applied_records,
+            "resyncs": self.resyncs,
+            "lag_records": lag,
+            "lag_seconds": round(self.lag_seconds, 6),
+            "max_lag_records": self.max_lag_records,
+            "cursor": {"generation": self._cursor.generation,
+                       "offset": self._cursor.offset},
+            "fencing_epoch": self.durable.fencing_epoch,
+            "lease": self.durable.lease_status(),
+            "last_promotion": (self.last_promotion.to_dict()
+                               if self.last_promotion else None),
+        }

@@ -323,6 +323,19 @@ class Scheduler:
         # HA: only the leader runs admission cycles (reference:
         # NeedLeaderElection, scheduler.go:144). None = standalone.
         self.leader_check: Optional[Callable[[], bool]] = None
+        # Fencing (resilience/replica.py + RESILIENCE.md §7): when a
+        # leader lease with fencing epochs is in effect, the
+        # speculative commit point consults this alongside the
+        # generation token — a deposed leader's in-flight cycle aborts
+        # un-decoded (reason "fenced") before the store's own Fenced
+        # backstop can even be reached. None = no lease regime.
+        self.fencing_check: Optional[Callable[[], bool]] = None
+        # Hot-standby operator surface: a StandbyReplica wires its
+        # status producer here (on the follower AND carried through
+        # promotion), and promote() stamps its report — both served by
+        # /debug/recovery (obs/status.recovery_status).
+        self.standby_status: Optional[Callable[[], dict]] = None
+        self.last_promotion: Optional[dict] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -1657,6 +1670,11 @@ class Scheduler:
         Routed through the ``speculation_validate`` injection site so
         chaos suites can force a mis-speculation; a token-less inflight
         (custom solvers) validates trivially."""
+        if self.fencing_check is not None and not self.fencing_check():
+            # Deposed mid-flight: another replica holds the lease at a
+            # higher fencing epoch, so this result must never commit —
+            # the new leader may already be admitting these heads.
+            return False, "fenced"
         try:
             faultinject.site(faultinject.SITE_SPECULATION)
             if prev.token is not None:

@@ -21,13 +21,14 @@ from kueue_tpu.sim.store import (
     kind_of,
     obj_key,
 )
-from kueue_tpu.sim.durable import DurableLog, LoadResult
+from kueue_tpu.sim.durable import (DurableLog, Fenced, LoadParts,
+                                   LoadResult, TailCursor)
 from kueue_tpu.sim.runtime import Controller, EventRecorder, Runtime
 
 __all__ = [
     "ADDED", "MODIFIED", "DELETED",
     "Store", "NotFound", "AlreadyExists", "Conflict", "Invalid",
     "kind_of", "obj_key",
-    "DurableLog", "LoadResult",
+    "DurableLog", "LoadResult", "LoadParts", "TailCursor", "Fenced",
     "Controller", "Runtime", "EventRecorder",
 ]

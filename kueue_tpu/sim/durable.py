@@ -9,11 +9,11 @@ Store that durable surface:
 
 - an **append-only event log** (WAL) of committed mutations — one
   record per watch event the store fires (ADDED/MODIFIED/DELETED with
-  the post-mutation object), so replay IS the event stream the live
-  controllers consumed, and
+  the post-mutation object and its virtual commit time), so replay IS
+  the event stream the live controllers consumed, and
 - a **periodic checkpoint** — a full pickled image of the store taken
-  every ``checkpoint_every`` records (and on demand), after which the
-  WAL restarts empty.
+  every ``checkpoint_every`` records, after which the WAL rotates to
+  a fresh **generation-stamped segment**.
 
 Two backings behind one knob: the default is an **fsync-free
 in-memory byte buffer** (tests, the crash-restart chaos suites — the
@@ -26,8 +26,33 @@ the checkpoint plus the WAL tail and treats a short or checksum-failed
 final record as a **torn write**: replay stops at the last intact
 record with a counted warning (``LoadResult.torn_records``) instead of
 raising — exactly the crash-mid-append case the WAL exists for.
+
+Tail streaming (RESILIENCE.md §7): a hot-standby follower subscribes
+to the log with a **rotation-aware cursor** — ``load_with_cursor()``
+bootstraps a consistent (state, position) pair and ``read_tail()``
+returns every record appended since, crossing segment rotations
+transparently. Each checkpoint used to reopen the WAL ``"wb"`` (a
+naive byte-offset tailer would read that as silent truncation); now
+rotation **retires** the old segment under its generation stamp and
+keeps the last ``retain_segments`` of them around, so a follower
+lagging across a compaction still streams — only a follower further
+behind than the retention window is told to ``resync`` (re-bootstrap
+from the checkpoint), mirroring the snapshot journal's overflow
+fallback in ``cache/incremental.py``.
+
+Leader lease + fencing (RESILIENCE.md §7): the log — the one durable
+medium that outlives every process — also arbitrates which process may
+COMMIT to it. ``acquire_lease`` hands out monotonically increasing
+**fencing epochs**; a deposed leader holding a stale epoch gets
+``Fenced`` from ``append`` (and from ``Store._persist`` before it), so
+its in-flight cycle can never reach the log the new leader replays.
+The shared-Store HA mode (``utils/leaderelection.py``) keeps its
+Lease-object election; this lease is the replicated-store mode's,
+where each replica owns a store and the log is the only shared truth.
+
 Recovery semantics on top of this layer live in
-``kueue_tpu/resilience/recovery.py`` (RESILIENCE.md §6).
+``kueue_tpu/resilience/recovery.py`` (cold restore, RESILIENCE.md §6)
+and ``kueue_tpu/resilience/replica.py`` (hot standby, §7).
 """
 
 from __future__ import annotations
@@ -46,6 +71,25 @@ _HEADER = struct.Struct("<II")  # (body length, crc32(body))
 
 CHECKPOINT_FILE = "checkpoint.bin"
 WAL_FILE = "wal.log"
+# Retired segments are kept as wal.<generation>.log (file mode) or
+# in-memory bytes until pruned past the retention window.
+RETIRED_PREFIX = "wal."
+RETIRED_SUFFIX = ".log"
+
+# How many retired segments a rotation keeps for lagging tailers. A
+# follower polling once per admission cycle stays within one segment of
+# the head (checkpoint_every records >> records per cycle); the window
+# exists for stalls, and past it the follower resyncs from the
+# checkpoint — always safe, just not incremental.
+DEFAULT_RETAIN_SEGMENTS = 4
+
+
+class Fenced(RuntimeError):
+    """A commit carrying a stale fencing epoch was rejected: another
+    replica acquired the leader lease since this writer's. The deposed
+    leader's write never reaches the WAL (and so can never be replayed
+    by the new leader) — the hot-standby exactly-once guarantee's hard
+    backstop (RESILIENCE.md §7)."""
 
 
 def _frame(body: bytes) -> bytes:
@@ -70,6 +114,39 @@ def _iter_records(buf: bytes):
         off += _HEADER.size + length
 
 
+def _unpack_record(body: bytes) -> tuple:
+    """(event, kind, key, obj, t) — tolerating the pre-timestamp
+    4-tuple shape for logs written before the tail-streaming surface."""
+    rec = pickle.loads(body)
+    if len(rec) == 4:
+        event, kind, key, obj = rec
+        return event, kind, key, obj, 0.0
+    return rec
+
+
+@dataclass(frozen=True)
+class TailCursor:
+    """A follower's position in the stream: which segment generation
+    and the byte offset within it. Opaque to callers — only
+    ``read_tail`` advances it."""
+    generation: int = 0
+    offset: int = 0
+
+
+@dataclass
+class TailBatch:
+    """One ``read_tail`` result. ``records`` are (event, kind, key,
+    obj, t) tuples in append order; ``cursor`` is the advanced
+    position. ``resync`` True means the cursor fell behind the segment
+    retention window (or a foreign log) — the caller must re-bootstrap
+    via ``load_with_cursor`` and treat its local state as stale.
+    ``segments_crossed`` counts rotations the read streamed across."""
+    records: list = field(default_factory=list)
+    cursor: TailCursor = field(default_factory=TailCursor)
+    resync: bool = False
+    segments_crossed: int = 0
+
+
 @dataclass
 class LoadResult:
     """What ``DurableLog.load()`` reconstructed: the object map in the
@@ -85,28 +162,86 @@ class LoadResult:
     warnings: list = field(default_factory=list)
 
 
+@dataclass
+class LoadParts:
+    """The un-collapsed view of the newest recoverable state: the
+    checkpoint image and the WAL tail as the ORIGINAL event records.
+    ``resilience/recovery.py`` and the hot-standby bootstrap replay the
+    records incrementally through ``Store.apply_replicated`` (the same
+    path the follower's live tailing uses); ``collapse()`` folds them
+    into the final object map for consumers that only want state."""
+
+    objects: dict = field(default_factory=dict)   # checkpoint image
+    rv: int = 0
+    checkpoint_loaded: bool = False
+    records: list = field(default_factory=list)   # (event,kind,key,obj,t)
+    torn_records: int = 0
+    warnings: list = field(default_factory=list)
+
+    def collapse(self) -> LoadResult:
+        res = LoadResult(
+            objects={k: dict(v) for k, v in self.objects.items()},
+            rv=self.rv, checkpoint_loaded=self.checkpoint_loaded,
+            torn_records=self.torn_records,
+            warnings=list(self.warnings))
+        for event, kind, key, obj, _t in self.records:
+            bucket = res.objects.setdefault(kind, {})
+            if event == "DELETED":
+                bucket.pop(key, None)
+            else:
+                bucket[key] = obj
+            if obj is not None:
+                rv = getattr(obj.metadata, "resource_version", 0) or 0
+                res.rv = max(res.rv, rv)
+            res.records_replayed += 1
+        return res
+
+
 class DurableLog:
     """The Store's durability sink. Thread-safe; the Store appends
     while holding its own lock, so record order always matches the
     watch-event order the live process observed."""
 
     def __init__(self, dir: Optional[str] = None,
-                 checkpoint_every: int = 0):
+                 checkpoint_every: int = 0,
+                 retain_segments: int = DEFAULT_RETAIN_SEGMENTS):
         self.dir = dir
         self.checkpoint_every = checkpoint_every
+        self.retain_segments = max(0, retain_segments)
         self._lock = threading.Lock()
         self.appends = 0
         self.checkpoints = 0
         self.records_since_checkpoint = 0
+        # Virtual commit time of the newest appended record — the
+        # follower's replication-lag-seconds reference point.
+        self.last_append_t = 0.0
+        # Segment generation: bumped at every checkpoint rotation and
+        # stamped into tail cursors so a follower can tell compaction
+        # from truncation.
+        self.generation = 0
+        # Leader lease (fencing): holder identity, the monotone fencing
+        # epoch, and the renew clock. All times are caller-supplied
+        # (the log has no clock of its own — virtual-time harnesses
+        # pass their FakeClock readings).
+        self._lease_holder = ""
+        self._lease_epoch = 0
+        self._lease_renew_t = 0.0
+        self._lease_duration = 0.0
         self.log = vlog.logger("durable")
         if dir is None:
             self._wal = bytearray()
             self._ckpt: Optional[bytes] = None
             self._wal_file = None
+            self._retired: dict[int, bytes] = {}
         else:
             os.makedirs(dir, exist_ok=True)
             self._wal = None
             self._ckpt = None
+            self._retired = None
+            # A re-opened dir resumes after the newest retired segment
+            # (cursors from a previous process resync past a reset).
+            gens = self._retired_generations_on_disk()
+            self.generation = (max(gens) + 1) if gens else 0
             # Buffered append handle, flushed per record but never
             # fsynced — the fsync-free contract; a torn tail is the
             # accepted (and handled) failure shape.
@@ -115,15 +250,26 @@ class DurableLog:
 
     # -- append path ---------------------------------------------------
 
-    def append(self, event: str, kind: str, key: str, obj) -> None:
+    def append(self, event: str, kind: str, key: str, obj,
+               t: float = 0.0, fence: Optional[tuple] = None) -> None:
         """One committed store mutation: ``event`` is the watch event
         type (ADDED/MODIFIED/DELETED), ``obj`` the post-mutation stored
         object (the DELETED record carries the final image so replay
-        can drop finalized deletes by key)."""
-        body = pickle.dumps((event, kind, key, obj),
+        can drop finalized deletes by key), ``t`` the committing
+        store's clock reading (the follower's lag-seconds basis).
+
+        ``fence=(identity, epoch)``: the append is rejected with
+        ``Fenced`` — under the log lock, atomically with the write —
+        when a lease exists and the writer's epoch is stale. This is
+        the medium-level backstop: a deposed leader cannot append even
+        if it races the promotion between a validity check and the
+        write."""
+        body = pickle.dumps((event, kind, key, obj, t),
                             protocol=pickle.HIGHEST_PROTOCOL)
         rec = _frame(body)
         with self._lock:
+            if fence is not None:
+                self._check_epoch_locked(*fence)
             if self._wal_file is not None:
                 self._wal_file.write(rec)
                 self._wal_file.flush()
@@ -131,44 +277,315 @@ class DurableLog:
                 self._wal += rec
             self.appends += 1
             self.records_since_checkpoint += 1
+            self.last_append_t = t
 
     def should_checkpoint(self) -> bool:
         return (self.checkpoint_every > 0
                 and self.records_since_checkpoint >= self.checkpoint_every)
 
-    def checkpoint(self, objects: dict, rv: int) -> None:
-        """Full image ({kind: {key: obj}}, rv); the WAL restarts empty.
-        The caller (Store.checkpoint_now) holds the store lock, so the
-        image is a consistent cut of the committed state."""
+    def checkpoint(self, objects: dict, rv: int,
+                   fence: Optional[tuple] = None) -> None:
+        """Full image ({kind: {key: obj}}, rv); the WAL **rotates**: the
+        written-out segment retires under the current generation (kept
+        for ``retain_segments`` rotations so lagging tailers stream
+        across the compaction instead of resyncing) and a fresh segment
+        opens under generation+1. The caller (Store.checkpoint_now)
+        holds the store lock, so the image is a consistent cut of the
+        committed state.
+
+        ``fence=(identity, epoch)`` rejects a STALE writer's checkpoint
+        with ``Fenced`` — without it a deposed leader's graceful
+        shutdown would replace the checkpoint with its stale image and
+        rotate away the new leader's live WAL tail: silent loss of
+        every admission committed since the takeover."""
         body = pickle.dumps((objects, rv),
                             protocol=pickle.HIGHEST_PROTOCOL)
         with self._lock:
+            if fence is not None:
+                self._check_epoch_locked(*fence)
             if self.dir is not None:
                 tmp = os.path.join(self.dir, CHECKPOINT_FILE + ".tmp")
                 with open(tmp, "wb") as f:
                     f.write(_frame(body))
                 os.replace(tmp, os.path.join(self.dir, CHECKPOINT_FILE))
                 self._wal_file.close()
-                self._wal_file = open(
-                    os.path.join(self.dir, WAL_FILE), "wb")
+                # Retire (rename, atomically) instead of truncating in
+                # place: a tailer's stale handle-by-path re-opens per
+                # read, and its cursor's generation tells it which
+                # segment its offset belongs to.
+                wal_path = os.path.join(self.dir, WAL_FILE)
+                if self.retain_segments > 0:
+                    os.replace(wal_path, self._retired_path(self.generation))
+                else:
+                    os.unlink(wal_path)
+                self._wal_file = open(wal_path, "wb")
             else:
                 self._ckpt = _frame(body)
+                if self.retain_segments > 0:
+                    self._retired[self.generation] = bytes(self._wal)
                 self._wal = bytearray()
+            self.generation += 1
+            self._prune_retired_locked()
             self.checkpoints += 1
             self.records_since_checkpoint = 0
+
+    # -- leader lease + fencing (RESILIENCE.md §7) ---------------------
+
+    def acquire_lease(self, identity: str, now: float,
+                      duration: float = 15.0,
+                      force: bool = False) -> Optional[int]:
+        """Take (or retake) the leader lease. Returns the fencing epoch
+        on success, None when another holder's lease is still live and
+        ``force`` is False. Every change of holder — including a
+        returning holder re-acquiring after expiry — bumps the epoch,
+        so a write stamped with the previous epoch is fenced the
+        instant the new holder wins. A current holder calling this is
+        a renewal (same epoch). ``force`` is the operator/harness
+        "I know the leader is dead" path (a crash leaves the lease
+        formally unexpired until ``duration`` passes)."""
+        with self._lock:
+            if self._lease_holder == identity and self._lease_epoch > 0:
+                self._lease_renew_t = now
+                self._lease_duration = duration
+                return self._lease_epoch
+            held = (self._lease_holder
+                    and now < self._lease_renew_t + self._lease_duration)
+            if held and not force:
+                return None
+            self._lease_holder = identity
+            self._lease_epoch += 1
+            self._lease_renew_t = now
+            self._lease_duration = duration
+            self.log.v(1, "durable.lease.acquired", holder=identity,
+                       epoch=self._lease_epoch, forced=bool(held))
+            return self._lease_epoch
+
+    def renew_lease(self, identity: str, now: float) -> bool:
+        """Extend the current holder's lease; False if this identity no
+        longer holds it (it was deposed — stop committing)."""
+        with self._lock:
+            if self._lease_holder != identity:
+                return False
+            self._lease_renew_t = now
+            return True
+
+    def release_lease(self, identity: str) -> None:
+        """Voluntary hand-off (graceful shutdown): the next replica
+        acquires immediately instead of waiting out the duration. The
+        epoch is NOT bumped here — the successor's acquire bumps it."""
+        with self._lock:
+            if self._lease_holder == identity:
+                self._lease_holder = ""
+                self._lease_renew_t = 0.0
+
+    def lease_status(self, now: Optional[float] = None) -> dict:
+        with self._lock:
+            st = {"holder": self._lease_holder,
+                  "epoch": self._lease_epoch,
+                  "renew_t": self._lease_renew_t,
+                  "duration_s": self._lease_duration}
+            if now is not None:
+                st["expired"] = (not self._lease_holder
+                                 or now >= self._lease_renew_t
+                                 + self._lease_duration)
+            return st
+
+    @property
+    def fencing_epoch(self) -> int:
+        return self._lease_epoch
+
+    def check_epoch(self, identity: str, epoch: int) -> None:
+        """Raise ``Fenced`` unless ``identity`` still holds the lease
+        at ``epoch`` (the Store's commit-path validity check)."""
+        with self._lock:
+            self._check_epoch_locked(identity, epoch)
+
+    def _check_epoch_locked(self, identity: str, epoch: int) -> None:
+        if self._lease_epoch == 0:
+            return  # no lease regime in effect (standalone durability)
+        if self._lease_holder != identity or self._lease_epoch != epoch:
+            raise Fenced(
+                f"writer {identity!r} (epoch {epoch}) fenced: lease "
+                f"held by {self._lease_holder!r} at epoch "
+                f"{self._lease_epoch}")
+
+    # -- tail streaming (RESILIENCE.md §7) -----------------------------
+
+    def cursor(self) -> TailCursor:
+        """The CURRENT end-of-stream position (records appended after
+        this call are what ``read_tail`` will return)."""
+        with self._lock:
+            return TailCursor(self.generation, self._segment_size_locked())
+
+    def load_with_cursor(self) -> tuple:
+        """(LoadParts, TailCursor) captured atomically: the parts
+        describe exactly the records before the cursor, so a follower
+        bootstrapping from them and then tailing from the cursor sees
+        every record exactly once."""
+        with self._lock:
+            parts = self._load_parts_locked()
+            cur = TailCursor(self.generation, self._segment_size_locked())
+        return parts, cur
+
+    def read_tail(self, cursor: TailCursor,
+                  max_records: int = 0) -> TailBatch:
+        """Every complete record appended since ``cursor``, streaming
+        across retained segment rotations. An INCOMPLETE trailing
+        record (a write in flight, or a torn crash tail) is left in
+        place — the cursor parks before it and the next poll retries;
+        promotion's post-drain checkpoint is what finally truncates a
+        genuinely torn tail (resilience/replica.py). ``max_records``
+        bounds one batch (0 = unbounded)."""
+        out = TailBatch(cursor=cursor)
+        with self._lock:
+            gen, off = cursor.generation, cursor.offset
+            while True:
+                size = self._segment_size_of_locked(gen)
+                if size is None or off > size:
+                    # Not current and not retained (the cursor fell
+                    # behind the retention window / predates a process
+                    # restart), or offset past the segment end (a
+                    # foreign or reset log): incremental catch-up is
+                    # impossible — re-bootstrap from the checkpoint.
+                    out.resync = True
+                    out.cursor = cursor
+                    out.records.clear()
+                    return out
+                # O(delta): only the bytes past the cursor are read
+                # (seek on files, slice in memory) — a poll never
+                # re-parses the records it already applied.
+                chunk = self._segment_bytes_locked(gen, off)
+                for body, torn in _iter_records(chunk):
+                    if torn:
+                        break  # incomplete so far — park, retry later
+                    out.records.append(_unpack_record(body))
+                    off += _HEADER.size + len(body)
+                    if max_records and len(out.records) >= max_records:
+                        out.cursor = TailCursor(gen, off)
+                        return out
+                if gen >= self.generation:
+                    out.cursor = TailCursor(gen, off)
+                    return out
+                # This segment was retired complete; cross into the
+                # next one. (A torn mid-segment record in a RETIRED
+                # segment means bytes were lost mid-stream — that
+                # cursor can never make progress past it, so resync.)
+                if off < size:
+                    out.resync = True
+                    out.cursor = cursor
+                    out.records.clear()
+                    return out
+                gen += 1
+                off = 0
+                out.segments_crossed += 1
+
+    def records_ahead(self, cursor: TailCursor) -> Optional[int]:
+        """How many complete records a tailer at ``cursor`` has not yet
+        read — the replication-lag-records gauge. None when the cursor
+        needs a resync (lag unknowable incrementally)."""
+        with self._lock:
+            gen, off, n = cursor.generation, cursor.offset, 0
+            while True:
+                size = self._segment_size_of_locked(gen)
+                if size is None or off > size:
+                    return None
+                for body, torn in _iter_records(
+                        self._segment_bytes_locked(gen, off)):
+                    if torn:
+                        break
+                    n += 1
+                if gen >= self.generation:
+                    return n
+                gen += 1
+                off = 0
+
+    def _segment_size_of_locked(self, gen: int) -> Optional[int]:
+        if gen == self.generation:
+            return self._segment_size_locked()
+        if self.dir is None:
+            seg = self._retired.get(gen)
+            return None if seg is None else len(seg)
+        path = self._retired_path(gen)
+        if not os.path.exists(path):
+            return None
+        return os.path.getsize(path)
+
+    def _segment_bytes_locked(self, gen: int,
+                              off: int = 0) -> Optional[bytes]:
+        """Segment ``gen``'s bytes from ``off`` to its end (None when
+        the segment is gone)."""
+        if gen == self.generation:
+            if self.dir is None:
+                return bytes(self._wal[off:])
+            self._wal_file.flush()
+            with open(os.path.join(self.dir, WAL_FILE), "rb") as f:
+                if off:
+                    f.seek(off)
+                return f.read()
+        if self.dir is None:
+            seg = self._retired.get(gen)
+            return None if seg is None else bytes(seg[off:])
+        path = self._retired_path(gen)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            if off:
+                f.seek(off)
+            return f.read()
+
+    def _segment_size_locked(self) -> int:
+        if self.dir is None:
+            return len(self._wal)
+        self._wal_file.flush()
+        return os.path.getsize(os.path.join(self.dir, WAL_FILE))
+
+    def _retired_path(self, gen: int) -> str:
+        return os.path.join(self.dir, f"{RETIRED_PREFIX}{gen}{RETIRED_SUFFIX}")
+
+    def _retired_generations_on_disk(self) -> list:
+        gens = []
+        for name in os.listdir(self.dir):
+            if (name.startswith(RETIRED_PREFIX)
+                    and name.endswith(RETIRED_SUFFIX)):
+                mid = name[len(RETIRED_PREFIX):-len(RETIRED_SUFFIX)]
+                if mid.isdigit():
+                    gens.append(int(mid))
+        return gens
+
+    def _prune_retired_locked(self) -> None:
+        floor = self.generation - self.retain_segments
+        if self.dir is None:
+            for gen in [g for g in self._retired if g < floor]:
+                del self._retired[gen]
+            return
+        for gen in self._retired_generations_on_disk():
+            if gen < floor:
+                try:
+                    os.unlink(self._retired_path(gen))
+                except OSError:
+                    pass
 
     # -- load path -----------------------------------------------------
 
     def load(self) -> LoadResult:
         """Reconstruct the newest recoverable state: checkpoint (when
-        one exists) + every intact WAL record after it. A torn final
-        record falls back to the state up to the last intact one, with
-        a counted warning — never an exception; losing the in-flight
-        tail write is the crash the log is FOR."""
-        res = LoadResult()
+        one exists) + every intact WAL record after it, collapsed into
+        the final object map. A torn final record falls back to the
+        state up to the last intact one, with a counted warning —
+        never an exception; losing the in-flight tail write is the
+        crash the log is FOR."""
+        return self.load_parts().collapse()
+
+    def load_parts(self) -> LoadParts:
+        """The un-collapsed load: checkpoint image + the tail's
+        original event records (see LoadParts)."""
         with self._lock:
-            ckpt = self._read_checkpoint()
-            wal = self._read_wal()
+            return self._load_parts_locked()
+
+    def _load_parts_locked(self) -> LoadParts:
+        res = LoadParts()
+        ckpt = self._read_checkpoint()
+        wal = self._segment_bytes_locked(self.generation)
         if ckpt is not None:
             body, torn = next(_iter_records(ckpt), (None, False))
             if body is not None:
@@ -190,18 +607,14 @@ class DurableLog:
                     "torn WAL tail record dropped (crash mid-append); "
                     "recovered to the last intact record")
                 self.log.v(1, "durable.tornTail",
-                           records=res.records_replayed)
+                           records=len(res.records))
                 break
-            event, kind, key, obj = pickle.loads(body)
-            bucket = res.objects.setdefault(kind, {})
-            if event == "DELETED":
-                bucket.pop(key, None)
-            else:
-                bucket[key] = obj
+            rec = _unpack_record(body)
+            res.records.append(rec)
+            obj = rec[3]
             if obj is not None:
                 rv = getattr(obj.metadata, "resource_version", 0) or 0
                 res.rv = max(res.rv, rv)
-            res.records_replayed += 1
         return res
 
     def _read_checkpoint(self) -> Optional[bytes]:
@@ -229,6 +642,27 @@ class DurableLog:
         return n
 
     # -- test helpers ----------------------------------------------------
+
+    def clone(self) -> "DurableLog":
+        """A deep, independent copy of a MEMORY-backed log's durable
+        state (checkpoint + retired segments + current WAL + counters;
+        lease state excluded — the clone is an alternate timeline a
+        bench A/B restores from, not a lease participant). File-backed
+        logs are cross-process artifacts; copy the directory instead."""
+        if self.dir is not None:
+            raise ValueError("clone() supports memory-backed logs only")
+        with self._lock:
+            other = DurableLog(checkpoint_every=self.checkpoint_every,
+                               retain_segments=self.retain_segments)
+            other._wal = bytearray(self._wal)
+            other._ckpt = self._ckpt
+            other._retired = dict(self._retired)
+            other.generation = self.generation
+            other.appends = self.appends
+            other.checkpoints = self.checkpoints
+            other.records_since_checkpoint = self.records_since_checkpoint
+            other.last_append_t = self.last_append_t
+            return other
 
     def truncate_tail(self, nbytes: int) -> None:
         """Simulate a torn write: chop ``nbytes`` off the WAL tail (the
@@ -258,4 +692,7 @@ class DurableLog:
             "records_since_checkpoint": self.records_since_checkpoint,
             "checkpoint_every": self.checkpoint_every,
             "wal_bytes": self.wal_size(),
+            "generation": self.generation,
+            "retain_segments": self.retain_segments,
+            "lease": self.lease_status(),
         }

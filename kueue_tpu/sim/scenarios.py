@@ -40,6 +40,14 @@ The catalog (sim/SCENARIOS.md documents each in detail):
                         re-placement latency
                         (SLOSpec.max_replacement_latency_s) and the
                         planned single-mirror execution engaging
+- ``failover``      (j) the leader is killed mid-storm and the HOT
+                        STANDBY promotes (resilience/replica.py +
+                        RESILIENCE.md §7) — no cold restore; gated on
+                        promotion-to-first-admission
+                        (SLOSpec.max_promotion_to_first_admission_s,
+                        well under the restart_storm cold budget),
+                        zero double admission (store-vs-cache usage
+                        cross-check) and zero starvation
 
 Run one via ``run_scenario(name, seed=..., scale="smoke"|"full")`` or
 end-to-end with artifacts via ``tools/scenario_run.py``.
@@ -101,6 +109,12 @@ class ScenarioResult:
     # to the next admission grant (the recovery-to-first-admission SLO).
     restarts: int = 0
     recovery_to_first_admission_s: list = field(default_factory=list)
+    # Hot-standby failover scenario (j / RESILIENCE.md §7): standby
+    # promotions and the virtual seconds from each promotion back to
+    # the next admission grant (the promotion-to-first-admission SLO —
+    # the warm analogue of the restart fields above).
+    promotions: int = 0
+    promotion_to_first_admission_s: list = field(default_factory=list)
     # Query-plane read storm (scenario h / ISSUE 12): reads served and
     # the worst structural-generation lag any stamped response showed
     # vs the live cache at read time (None = no samples recorded).
@@ -134,6 +148,9 @@ class ScenarioResult:
             "restarts": self.restarts,
             "recovery_to_first_admission_s": [
                 round(v, 3) for v in self.recovery_to_first_admission_s],
+            "promotions": self.promotions,
+            "promotion_to_first_admission_s": [
+                round(v, 3) for v in self.promotion_to_first_admission_s],
             "reads": self.reads,
             "read_staleness_generations": self.read_staleness_generations,
             "replacement_latency_s": (
@@ -178,7 +195,8 @@ class ScenarioHarness:
                  reclaim_within_cohort: str = api.PREEMPTION_ANY,
                  remote_clusters: Optional[list] = None,
                  mk_check: bool = False, solver=None,
-                 durable: bool = False):
+                 durable: bool = False, standby: bool = False,
+                 standby_poll_every: int = 1):
         from kueue_tpu.manager import KueueManager
         self.name = name
         self.seed = seed
@@ -215,6 +233,30 @@ class ScenarioHarness:
         self.recovery_ttas: list = []      # virtual s, restore -> admit
         self._recovery_pending: Optional[float] = None
         self._adm_at_restore = 0
+        # Hot-standby failover (scenario j / RESILIENCE.md §7): with
+        # standby=True a StandbyReplica tails the durable log (polled
+        # every ``standby_poll_every`` cycles — the lag-state knob the
+        # promotion-timing sweeps vary) and a crash PROMOTES it instead
+        # of cold-restoring; the initial leader is fenced via lead().
+        if standby and not durable:
+            raise ValueError("standby=True requires durable=True")
+        if standby and solver is not None:
+            # The cold-restore path reuses the harness solver AFTER the
+            # leader dies; a standby would have to detach() it out from
+            # under the LIVE leader at construction. Loud, not silent:
+            # give the replica its own solver via StandbyReplica
+            # directly if a scenario needs the device path warm.
+            raise ValueError(
+                "standby=True cannot share the harness solver with "
+                "the live leader; construct the StandbyReplica with "
+                "its own solver instead")
+        self.standby = None
+        self.standby_poll_every = max(1, standby_poll_every)
+        self.promotions = 0
+        self.promotion_ttas: list = []     # virtual s, promote -> admit
+        self._promotion_pending: Optional[float] = None
+        self._adm_at_promote = 0
+        self._want_standby = standby
         # Lifetime event counts observed from managers that have since
         # crashed: the EventRecorder dies with its process, but the
         # harness (the outside observer) saw the events live — SLO
@@ -247,6 +289,14 @@ class ScenarioHarness:
         self._create_capacity(self.mgr, tenants, quota_units, cohorts,
                               reclaim_within_cohort, check_names)
         self.mgr.run_until_idle()
+        if self._want_standby:
+            # Capacity is journaled by now, so the follower bootstraps
+            # warm; the leader takes the fenced lease (epoch 1) — a
+            # promotion bumps it and fences whatever is left of the
+            # old process.
+            from kueue_tpu.resilience.replica import lead
+            lead(self.mgr, self.durable, identity="leader-0")
+            self.standby = self._make_standby()
 
         self._seq = 0
         self.cycles = 0
@@ -408,6 +458,13 @@ class ScenarioHarness:
         self._step_counted = False
         self._step_advanced = False
         try:
+            if (self.standby is not None
+                    and self.cycles % self.standby_poll_every == 0):
+                # The follower's heartbeat: tail replay at (a fraction
+                # of) cycle cadence. Runs BEFORE the leader's cycle so
+                # the lag at a kill point reflects the poll interval,
+                # not the step's own appends.
+                self.standby.poll()
             self._step_body()
         except InjectedCrash:
             # Simulated process death mid-step (scenario g): store
@@ -445,6 +502,13 @@ class ScenarioHarness:
             self.recovery_ttas.append(
                 self.clock.now() - self._recovery_pending)
             self._recovery_pending = None
+        if self._promotion_pending is not None \
+                and self.admissions > self._adm_at_promote:
+            # First admission grant since a standby promotion: the
+            # promotion-to-first-admission SLO sample (virtual s).
+            self.promotion_ttas.append(
+                self.clock.now() - self._promotion_pending)
+            self._promotion_pending = None
         self.cycles += 1
         self._step_counted = True
         self._track_ladder()
@@ -470,18 +534,39 @@ class ScenarioHarness:
         # stay exact across restarts.
         self._evictions_carry += self.mgr.recorder.count_by_reason_prefix(
             "EvictedDueTo")
-        self.mgr = recovery.restore(
-            self.durable, cfg=self._cfg, clock=self.clock,
-            solver=self._solver,
-            remote_clusters=self.workers or None)
+        if self.standby is not None:
+            # Hot failover (scenario j): no cold restore — the warm
+            # follower fences the dead leader's epoch, drains the tail
+            # and takes over; a FRESH follower then starts tailing the
+            # promoted leader for the next kill.
+            self.mgr = self.standby.promote(force=True)
+            self.promotions += 1
+            self._promotion_pending = self.clock.now()
+            self._adm_at_promote = self.admissions
+            self.standby = self._make_standby()
+        else:
+            self.mgr = recovery.restore(
+                self.durable, cfg=self._cfg, clock=self.clock,
+                solver=self._solver,
+                remote_clusters=self.workers or None)
+            self.restarts += 1
+            self._recovery_pending = self.clock.now()
+            self._adm_at_restore = self.admissions
         self.mgr.flight_recorder.set_tag("recovery")
         # The fresh scheduler's cycle ids restart at 0/1 and would
         # collide with the dead manager's in _seen_trace_ids, silently
         # ending the (tag, route, regime) stream after the first crash.
         self._seen_trace_ids = set()
-        self.restarts += 1
-        self._recovery_pending = self.clock.now()
-        self._adm_at_restore = self.admissions
+
+    def _make_standby(self):
+        from kueue_tpu.resilience.replica import StandbyReplica
+        # Remote clusters carry through (same external workers the
+        # leader mirrors to); the solver deliberately does NOT — see
+        # the constructor's standby+solver rejection.
+        return StandbyReplica(self.durable, cfg=self._cfg,
+                              clock=self.clock,
+                              remote_clusters=self.workers or None,
+                              identity=f"standby-{self.promotions}")
 
     # -- observation: the job-framework role for plain workloads -------
 
@@ -666,6 +751,16 @@ class ScenarioHarness:
         res.recovery_to_first_admission_s = list(self.recovery_ttas)
         if self.restarts:
             res.counters["restarts"] = self.restarts
+        res.promotions = self.promotions
+        res.promotion_to_first_admission_s = list(self.promotion_ttas)
+        if self.promotions:
+            res.counters["promotions"] = self.promotions
+        if self.standby is not None:
+            st = self.standby.status()
+            res.counters["standby"] = {
+                k: st[k] for k in ("polls", "applied_records", "resyncs",
+                                   "lag_records", "max_lag_records",
+                                   "fencing_epoch")}
         if res.admitted:
             res.requeue_amplification = \
                 (res.admissions + res.evictions) / res.admitted
@@ -1547,6 +1642,123 @@ def run_restart_storm(seed: int = 0, scale: str = "full") -> ScenarioResult:
 
 
 # ----------------------------------------------------------------------
+# scenario (j): hot-standby failover mid-storm
+# (resilience/replica.py + RESILIENCE.md §7)
+# ----------------------------------------------------------------------
+
+def _usage_consistent(mgr) -> tuple:
+    """Per-CQ reservation usage in the cache must equal the sum of the
+    STORE's admitted workloads — the double-admission detector (a
+    workload admitted by both the deposed leader and its successor
+    would double-count its usage). Same cross-check tools/crash_run.py
+    runs, inlined so scenarios stay self-contained."""
+    expected: dict = {}
+    for wl in mgr.store.list("Workload", copy_objects=False):
+        if not wlpkg.has_quota_reservation(wl):
+            continue
+        if wlpkg.is_finished(wl) or not wlpkg.is_active(wl):
+            # A finished run keeps its QuotaReserved condition in the
+            # store but holds no capacity — the cache rightly dropped
+            # it (crash_run's variant of this check skips the filter
+            # only because its traffic never finishes).
+            continue
+        info = wlpkg.Info(wl)
+        cq = wl.status.admission.cluster_queue
+        bucket = expected.setdefault(cq, {})
+        for fr, v in info.flavor_resource_usage().items():
+            bucket[fr] = bucket.get(fr, 0) + v
+    for cq in mgr.cache.hm.cluster_queues:
+        reserved, _admitted = mgr.cache.usage_for_cluster_queue(cq)
+        want = {fr: v for fr, v in expected.get(cq, {}).items() if v}
+        got = {fr: v for fr, v in reserved.items() if v}
+        if want != got:
+            return False, f"{cq}: store says {want}, cache says {got}"
+    return True, ""
+
+
+def run_failover(seed: int = 0, scale: str = "full") -> ScenarioResult:
+    """The leader is killed at seeded store-write commit points while
+    steady per-tenant traffic flows — and instead of the cold restore
+    scenario (g) pays, a HOT STANDBY that has been tailing the WAL the
+    whole time promotes: fence the dead leader's epoch, drain the
+    replay tail, first cycle pinned synchronous (RESILIENCE.md §7). A
+    fresh follower then shadows each promoted leader, so every kill in
+    the storm fails over warm.
+
+    Gates: promotion-to-first-admission in virtual seconds per
+    promotion (SLOSpec.max_promotion_to_first_admission_s — a THIRD of
+    restart_storm's cold-restore budget, the point of the warm
+    follower), zero starvation after the drain, amplification ~1 and
+    zero evictions (a promotion must not re-admit or re-evict anything
+    the store already settled), the store-vs-cache usage cross-check
+    (zero double admission across the leadership chain), and the
+    fencing epoch having advanced once per promotion."""
+    import random as _random
+
+    from kueue_tpu.resilience import faultinject
+    from kueue_tpu.resilience.faultinject import FaultInjector
+
+    p = {"smoke": dict(duration=160.0, tenants=3, quota=10,
+                       interval=20.0, kills=2, poll_every=1),
+         "full": dict(duration=800.0, tenants=6, quota=12,
+                      interval=12.0, kills=4, poll_every=2),
+         }[scale]
+    h = ScenarioHarness("failover", seed, tenants=p["tenants"],
+                        quota_units=p["quota"], durable=True,
+                        standby=True,
+                        standby_poll_every=p["poll_every"])
+    arrivals = steady_trace(seed, duration_s=p["duration"],
+                            tenants=p["tenants"],
+                            interval_s=p["interval"])
+    rng = _random.Random(seed ^ 0xFA110)
+
+    def arm_kill():
+        # Seeded store-write kill counted from NOW — deep enough to
+        # land mid-admission-wave, shallow enough to fire before the
+        # next arm point replaces the schedule.
+        hit = rng.randint(2, 30)
+        faultinject.install(FaultInjector(
+            {faultinject.SITE_STORE: {hit: faultinject.CRASH}}))
+
+    span = p["duration"] / (p["kills"] + 1)
+    hooks = [(span * (k + 1), arm_kill) for k in range(p["kills"])]
+    h.set_phase("storm")
+    try:
+        h.run(arrivals, p["duration"], hooks=hooks)
+        h.set_phase("drain")
+        h.drain()
+    finally:
+        faultinject.uninstall()
+    slo = SLOSpec(
+        min_admitted=len(arrivals),
+        class_max_p99_tta_s={"prod": 240.0, "standard": 480.0,
+                             "batch": 900.0},
+        max_requeue_amplification=1.1,
+        max_evictions=0,
+        # restart_storm's cold budget is 6 cycles; the warm follower
+        # must beat it decisively.
+        max_promotion_to_first_admission_s=2 * h.cycle_s)
+    res = h.result(scale, slo)
+    if h.promotions < min(1, p["kills"]):
+        res.violations.append(
+            f"failover storm never promoted (promotions="
+            f"{h.promotions}; kill schedule mis-armed?)")
+    ok, msg = _usage_consistent(h.mgr)
+    if not ok:
+        res.violations.append(f"double-admission detector: {msg}")
+    # One epoch per leadership change: the initial lead() takes 1 and
+    # each promotion bumps once — anything else means a fencing hole.
+    want_epoch = 1 + h.promotions
+    if h.durable.fencing_epoch != want_epoch:
+        res.violations.append(
+            f"fencing epoch {h.durable.fencing_epoch} != "
+            f"{want_epoch} (1 initial lease + {h.promotions} "
+            f"promotion(s))")
+    res.counters["fencing_epoch"] = h.durable.fencing_epoch
+    return res
+
+
+# ----------------------------------------------------------------------
 # scenario (h): query-plane read storm under admission traffic
 # (obs/queryplane.py + ISSUE 12)
 # ----------------------------------------------------------------------
@@ -1759,6 +1971,7 @@ SCENARIOS = {
     "cluster_rebalance": run_cluster_rebalance,
     "mixed_jobs": run_mixed_jobs,
     "restart_storm": run_restart_storm,
+    "failover": run_failover,
     "visibility_storm": run_visibility_storm,
 }
 

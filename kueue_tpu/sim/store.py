@@ -24,6 +24,7 @@ from kueue_tpu.api.kueue import (clone_cluster_queue, clone_local_queue,
                                  clone_workload)
 from kueue_tpu.api.meta import Clock, REAL_CLOCK, new_uid
 from kueue_tpu.resilience import faultinject
+from kueue_tpu.sim.durable import Fenced  # noqa: F401 — re-exported
 
 # Hand-rolled per-kind deep clones for the hottest objects: semantically
 # identical to copy.deepcopy, ~10x faster (reconciler reads + status
@@ -88,6 +89,13 @@ class Store:
         # controllers consumed — replaying it rebuilds this store
         # bit-for-bit (resilience/recovery.py).
         self._durable = durable
+        # Leader fencing (resilience/replica.py + RESILIENCE.md §7):
+        # when a FencingToken is attached, every commit validates the
+        # token against the durable log's lease BEFORE the WAL append
+        # (and the append itself re-checks under the log lock), so a
+        # deposed leader's write raises Fenced instead of reaching the
+        # log the new leader replays. None = standalone store.
+        self.fencing = None
 
     # -- durability (sim/durable.py + resilience/recovery.py) ---------------
 
@@ -97,26 +105,58 @@ class Store:
         attach before seeding capacity)."""
         self._durable = durable
 
+    def _check_fence(self) -> None:
+        """Raise Fenced when this store's leadership epoch is stale.
+        Called at the TOP of every mutator — BEFORE the local bucket
+        mutates — so a deposed-but-alive leader that survives the
+        exception is not left holding phantom objects its own log never
+        saw (a retried create must raise Fenced again, not
+        AlreadyExists). The checks at _persist and inside
+        DurableLog.append remain as backstops."""
+        f = self.fencing
+        if f is not None:
+            f.check()
+
     def checkpoint_now(self) -> None:
         """Take a full durable checkpoint of the committed state (the
-        WAL restarts empty). No-op without an attached log."""
+        WAL rotates). No-op without an attached log; a deposed
+        leader's checkpoint raises Fenced — it would otherwise replace
+        the checkpoint with a stale image and rotate away the new
+        leader's live tail."""
         with self._lock:
             if self._durable is not None:
-                self._durable.checkpoint(self._objects, self._rv)
+                f = self.fencing
+                self._durable.checkpoint(
+                    self._objects, self._rv,
+                    fence=(f.identity, f.epoch) if f is not None
+                    else None)
 
     def _persist(self, event: str, kind: str, key: str, stored) -> None:
         """The commit point every mutation passes through, just before
-        its watch event fires: append the WAL record, then cross the
-        ``store_write`` crash window (RESILIENCE.md §6 — a crash AFTER
-        the append is durable-but-unobserved: the write survives
-        restart even though no watcher ever saw it), then maybe
-        compact. Caller holds the store lock."""
+        its watch event fires: validate the fencing token (a deposed
+        leader raises Fenced here — its write must never reach the log
+        the new leader replays, RESILIENCE.md §7), append the WAL
+        record, then cross the ``store_write`` crash window
+        (RESILIENCE.md §6 — a crash AFTER the append is
+        durable-but-unobserved: the write survives restart even though
+        no watcher ever saw it), then maybe compact. The crash window
+        only exists where a WAL exists, so the injection site is gated
+        on an attached log (a fenced standby's own reconcile writes
+        must not consume kill points armed for the leader). Caller
+        holds the store lock."""
         d = self._durable
-        if d is not None:
-            d.append(event, kind, key, stored)
+        fence = self.fencing
+        if fence is not None:
+            fence.check()
+        if d is None:
+            return
+        ftup = ((fence.identity, fence.epoch)
+                if fence is not None else None)
+        d.append(event, kind, key, stored, t=self._clock.now(),
+                 fence=ftup)
         faultinject.site(faultinject.SITE_STORE)
-        if d is not None and d.should_checkpoint():
-            d.checkpoint(self._objects, self._rv)
+        if d.should_checkpoint():
+            d.checkpoint(self._objects, self._rv, fence=ftup)
 
     def load_object(self, obj) -> object:
         """Recovery-path insert (resilience/recovery.py): place an
@@ -139,6 +179,40 @@ class Store:
                            obj.metadata.resource_version or 0)
             self._notify(kind, ADDED, obj, None)
             return obj
+
+    def apply_replicated(self, event: str, obj) -> None:
+        """Replica-side application of ONE replicated watch record
+        (resilience/replica.py: the hot-standby's tail replay, and
+        recovery.py's incremental cold restore). Like ``load_object``,
+        the object is placed VERBATIM (uid/resourceVersion/timestamps
+        preserved, admission webhooks skipped — they ran on the leader
+        before the record was persisted) and the ORIGINAL event fires
+        so the derived caches advance through the normal watch path —
+        the same journal replay the snapshot maintainer already runs.
+        Not persisted and not fault-sited: applying a record is
+        consumption, not a commit. Event fidelity is defended against
+        replay edge cases: an ADDED for a key we already hold becomes
+        MODIFIED, a MODIFIED for an unknown key becomes ADDED, and a
+        DELETED for an unknown key is a no-op — reconcilers see a
+        self-consistent stream even across a bootstrap boundary."""
+        kind = kind_of(obj)
+        with self._lock:
+            key = obj_key(obj)
+            bucket = self._objects.setdefault(kind, {})
+            old = bucket.get(key)
+            self._rv = max(self._rv,
+                           obj.metadata.resource_version or 0)
+            if event == DELETED:
+                if old is None:
+                    return
+                del bucket[key]
+                self._notify(kind, DELETED, obj, old)
+                return
+            bucket[key] = obj
+            if old is None:
+                self._notify(kind, ADDED, obj, None)
+            else:
+                self._notify(kind, MODIFIED, obj, old)
 
     # -- admission webhooks -------------------------------------------------
 
@@ -176,6 +250,7 @@ class Store:
     def create(self, obj) -> object:
         kind = kind_of(obj)
         with self._lock:
+            self._check_fence()
             key = obj_key(obj)
             bucket = self._objects.setdefault(kind, {})
             if key in bucket:
@@ -222,6 +297,7 @@ class Store:
         persisted state."""
         kind = kind_of(obj)
         with self._lock:
+            self._check_fence()
             key = obj_key(obj)
             bucket = self._objects.setdefault(kind, {})
             if key not in bucket:
@@ -274,6 +350,7 @@ class Store:
         2k-CQ scale."""
         kind = kind_of(obj)
         with self._lock:
+            self._check_fence()
             key = obj_key(obj)
             bucket = self._objects.setdefault(kind, {})
             if key not in bucket:
@@ -305,6 +382,7 @@ class Store:
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
+            self._check_fence()
             key = f"{namespace}/{name}" if namespace else name
             bucket = self._objects.get(kind, {})
             if key not in bucket:

@@ -199,12 +199,12 @@ class TestEventRecorderRing:
 # ----------------------------------------------------------------------
 
 class TestScenarioSmoke:
-    def test_catalog_lists_all_nine(self):
+    def test_catalog_lists_all_ten(self):
         assert list_scenarios() == ["cluster_loss", "cluster_rebalance",
-                                    "diurnal", "flavor_churn",
-                                    "mixed_jobs", "requeue_flood",
-                                    "restart_storm", "tenant_storm",
-                                    "visibility_storm"]
+                                    "diurnal", "failover",
+                                    "flavor_churn", "mixed_jobs",
+                                    "requeue_flood", "restart_storm",
+                                    "tenant_storm", "visibility_storm"]
 
     def test_unknown_scenario_and_scale_rejected(self):
         with pytest.raises(KeyError):
@@ -238,6 +238,31 @@ class TestScenarioSmoke:
     def test_restart_storm_deterministic_per_seed(self):
         a = run_scenario("restart_storm", seed=5, scale="smoke").to_dict()
         b = run_scenario("restart_storm", seed=5, scale="smoke").to_dict()
+        assert a == b
+
+    def test_failover_promotes_warm_standby(self):
+        """Scenario (j): leader killed mid-storm, hot standby promotes
+        — no cold restore, promotion-to-first-admission gated at a
+        THIRD of restart_storm's cold budget, zero double admission
+        (store-vs-cache cross-check), fencing epoch advanced once per
+        leadership change."""
+        res = run_scenario("failover", seed=3, scale="smoke")
+        assert res.ok, res.violations
+        assert res.promotions >= 1
+        assert res.restarts == 0  # warm failover never cold-restores
+        assert len(res.promotion_to_first_admission_s) == res.promotions
+        bound = res.slo.max_promotion_to_first_admission_s
+        assert max(res.promotion_to_first_admission_s) <= bound
+        # decisively under the cold-restore scenario's 6-cycle budget
+        assert bound < 6 * 5.0
+        assert res.admitted == res.submitted and not res.starved
+        assert res.requeue_amplification == 1.0
+        assert res.counters["fencing_epoch"] == 1 + res.promotions
+        assert res.counters["standby"]["resyncs"] == 0
+
+    def test_failover_deterministic_per_seed(self):
+        a = run_scenario("failover", seed=5, scale="smoke").to_dict()
+        b = run_scenario("failover", seed=5, scale="smoke").to_dict()
         assert a == b
 
     def test_tenant_storm_no_cross_tenant_starvation(self):
@@ -410,9 +435,10 @@ class TestScenarioRunCLI:
 @pytest.mark.slow
 class TestFullSweep:
     @pytest.mark.parametrize("name", ["cluster_loss", "cluster_rebalance",
-                                      "diurnal", "flavor_churn",
-                                      "mixed_jobs", "requeue_flood",
-                                      "restart_storm", "tenant_storm"])
+                                      "diurnal", "failover",
+                                      "flavor_churn", "mixed_jobs",
+                                      "requeue_flood", "restart_storm",
+                                      "tenant_storm"])
     def test_full_scale_green(self, name):
         res = run_scenario(name, seed=0, scale="full")
         assert res.ok, (name, res.violations)
@@ -421,6 +447,6 @@ class TestFullSweep:
     @pytest.mark.parametrize("seed", [1, 2])
     def test_failure_scenarios_hold_across_seeds(self, seed):
         for name in ("requeue_flood", "cluster_loss", "cluster_rebalance",
-                     "restart_storm"):
+                     "restart_storm", "failover"):
             res = run_scenario(name, seed=seed, scale="full")
             assert res.ok, (name, seed, res.violations)

@@ -532,6 +532,37 @@ class TestVisibilityProbe:
         assert verdict["live_handouts_after_shutdown"] == 0
 
 
+class TestFailoverProbe:
+    def test_probe_smoke_bounded_lag_fencing_holds(self, capsys):
+        """Tier-1 smoke for tools/failover_probe.py (chaos_run CLI
+        contract): a tiny run must render the replication table,
+        report a parseable verdict, and find zero unbounded-lag polls,
+        every deposed-leader write fenced, zero deposed admissions,
+        and a promoted replica admitting within the cycle bound."""
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "failover_probe",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "failover_probe.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main(["3", "4"]) == 0
+        captured = capsys.readouterr()
+        assert "lag_pre" in captured.err        # the operator table
+        assert "promotion:" in captured.err
+        verdict = json.loads(captured.out.strip().splitlines()[-1])
+        assert verdict["ok"] is True
+        assert verdict["unbounded_lag_polls"] == 0
+        assert verdict["leaked_writes"] == 0
+        assert verdict["fenced_writes"] == 2
+        assert verdict["deposed_admissions"] == 0
+        assert verdict["fencing_epoch"] == 2
+        assert verdict["cycles_to_first_admission"] <= 3
+        assert verdict["usage_consistent"] is True
+        assert verdict["live_handouts_after_shutdown"] == 0
+
+
 class TestJourneyProbe:
     def test_probe_smoke_complete_timelines_no_leaks(self, capsys):
         """Tier-1 smoke for tools/journey_probe.py (chaos_run CLI

@@ -1,18 +1,24 @@
-"""Crash-restart chaos driver: seeded kill points end-to-end (ISSUE 10).
+"""Crash-restart chaos driver: seeded kill points end-to-end (ISSUE 10;
+hot-standby promotion timing added by ISSUE 15).
 
 Runs the FULL control plane (KueueManager over a durable store:
 checkpoint/WAL sim apiserver, controllers, webhooks, scheduler +
-pipelined solver) over a fixed arrival schedule three ways:
+pipelined solver) over a fixed arrival schedule several ways:
 
 - an **oracle** run that never crashes,
 - a **crash** run killed by an ``InjectedCrash`` at a seeded
-  ``(site, hit)`` — any resilience injection site, including the new
+  ``(site, hit)`` — any resilience injection site, including the
   ``store_write`` (durable-but-unobserved window) and ``apply_commit``
   (assumed-but-unwritten window) — then restored from the durable
   store (``resilience/recovery.py``) with the SAME solver object
-  (exercising ``detach()``) and driven over the remaining schedule.
+  (exercising ``detach()``) and driven over the remaining schedule,
+- a **failover** run killed the same way while a HOT STANDBY
+  (``resilience/replica.py``) tails the WAL at one of three lag
+  states (``hot``: poll every cycle, ``lagged``: every 3rd, ``cold``:
+  never polled until the kill) — the standby PROMOTES (fence + tail
+  drain, no cold restore) and drives the remainder.
 
-Verifies the recovery contract (RESILIENCE.md §6):
+Verifies the recovery contract (RESILIENCE.md §6/§7) either way:
 
 - **convergence**: the post-recovery admitted set is exactly the
   uncrashed oracle's,
@@ -24,8 +30,13 @@ Verifies the recovery contract (RESILIENCE.md §6):
   holds no in-flight cycle and no live snapshot handouts.
 
 Usage:
-  python tools/crash_run.py [seed] [site] [hit]     one seeded kill
-  python tools/crash_run.py --sweep [seeds]         every site x seeds
+  python tools/crash_run.py [seed] [site] [hit]        one seeded kill
+  python tools/crash_run.py --failover [seed] [site] [hit] [lag]
+  python tools/crash_run.py --sweep [seeds]   every site x seeds, the
+                                              cold-restore sweep PLUS
+                                              the promotion-timing
+                                              sweep (lag state varied
+                                              per seed)
 
 Prints one JSON line per run to stderr plus a final verdict line to
 stdout; exits non-zero on any divergence. Deterministic for a given
@@ -65,6 +76,11 @@ CRASH_SITES = (faultinject.SITE_STORE, faultinject.SITE_APPLY,
                faultinject.SITE_DISPATCH, faultinject.SITE_COLLECT,
                faultinject.SITE_SCATTER, faultinject.SITE_REPLAY,
                faultinject.SITE_SPECULATION)
+
+# Follower lag states for the promotion-timing sweep: cycles between
+# standby polls (0 = never polled until the promotion itself, so the
+# entire tail drains inside promote()).
+LAG_MODES = {"hot": 1, "lagged": 3, "cold": 0}
 
 
 def make_objects():
@@ -148,11 +164,16 @@ def deliver_wave(mgr, wave):
             mgr.store.create(make_workload(wave, i, n + i))
 
 
-def drive(mgr, clock, next_wave, waves, max_cycles=MAX_CYCLES):
+def drive(mgr, clock, next_wave, waves, max_cycles=MAX_CYCLES,
+          on_cycle=None):
     """Run cycles, trickling remaining arrival waves; returns (next
-    undelivered wave, settled?). Raises InjectedCrash through."""
+    undelivered wave, settled?). Raises InjectedCrash through.
+    ``on_cycle`` fires before each cycle (the failover runs poll the
+    standby there — its cadence is the swept lag state)."""
     settled = 0
     for cycle in range(max_cycles):
+        if on_cycle is not None:
+            on_cycle(cycle)
         if next_wave < waves:
             deliver_wave(mgr, next_wave)
             next_wave += 1
@@ -245,6 +266,80 @@ def run_crash(seed: int, site: str, hit: int) -> dict:
     return out
 
 
+def run_failover(seed: int, site: str, hit: int,
+                 lag_mode: str = "hot") -> dict:
+    """The promotion-timing arm (ISSUE 15): the leader is killed at
+    the seeded (site, hit) while a hot standby tails its WAL at the
+    given lag state; the standby PROMOTES — fencing epoch bump + tail
+    drain, never a cold restore — and drives the remaining schedule.
+    The verdict contract is identical to run_crash's."""
+    from kueue_tpu.resilience.replica import StandbyReplica, lead
+
+    poll_every = LAG_MODES[lag_mode]
+    clock = FakeClock(1000.0)
+    mgr = KueueManager(cfg=make_config(), clock=clock,
+                       solver=BatchSolver())
+    for obj in make_objects():
+        mgr.store.create(obj)
+    mgr.run_until_idle(max_iterations=1_000_000)
+    durable = mgr.durable
+    lead(mgr, durable, identity="leader-0")
+    standby = StandbyReplica(durable, cfg=make_config(), clock=clock,
+                             solver=BatchSolver(), identity="standby-0")
+
+    def on_cycle(cycle):
+        if poll_every and cycle % poll_every == 0:
+            standby.poll()
+
+    faultinject.install(FaultInjector({site: {hit: CRASH}}))
+    crashed = False
+    next_wave = 0
+    try:
+        next_wave, settled = drive(mgr, clock, 0, WAVES,
+                                   on_cycle=on_cycle)
+    except InjectedCrash:
+        crashed = True
+    finally:
+        faultinject.uninstall()
+
+    pre_admitted = []
+    lag_at_kill = None
+    if crashed:
+        loaded = durable.load()
+        pre_admitted = sorted(
+            wlpkg.key(wl)
+            for wl in loaded.objects.get("Workload", {}).values()
+            if wlpkg.has_quota_reservation(wl))
+        lag_at_kill = standby.lag_records
+        mgr = standby.promote(force=True)
+        created = {wl.metadata.name
+                   for wl in mgr.store.list("Workload",
+                                            copy_objects=False)}
+        next_wave = 0
+        while next_wave < WAVES and all(
+                f"w{next_wave}-{i}" in created
+                for i in range(NUM_CQS)):
+            next_wave += 1
+    _, settled = drive(mgr, clock, next_wave, WAVES)
+
+    ok_usage, usage_msg = usage_consistent(mgr)
+    out = {
+        "mode": "failover", "seed": seed, "site": site, "hit": hit,
+        "lag_mode": lag_mode, "crashed": crashed, "settled": settled,
+        "admitted": admitted_keys(mgr),
+        "pre_crash_admitted": pre_admitted,
+        "usage_consistent": ok_usage, "usage_msg": usage_msg,
+        "lag_at_kill": lag_at_kill,
+        "promotion": (standby.last_promotion.to_dict()
+                      if standby.last_promotion is not None else None),
+        "fencing_epoch": durable.fencing_epoch,
+    }
+    mgr.shutdown()
+    out["inflight_after_shutdown"] = mgr.scheduler._inflight is not None
+    out["live_handouts"] = mgr.cache.live_handouts
+    return out
+
+
 def verdict(oracle: dict, crash: dict) -> dict:
     lost = sorted(set(crash["pre_crash_admitted"])
                   - set(crash["admitted"]))
@@ -259,29 +354,40 @@ def verdict(oracle: dict, crash: dict) -> dict:
     }
 
 
-def one_run(seed: int, site: str, hit: int) -> int:
+def one_run(seed: int, site: str, hit: int,
+            lag_mode: str = "") -> int:
     oracle = run_oracle(seed)
-    crash = run_crash(seed, site, hit)
+    crash = (run_failover(seed, site, hit, lag_mode) if lag_mode
+             else run_crash(seed, site, hit))
     for r in (oracle, crash):
         print(json.dumps({**r, "admitted": len(r["admitted"])}),
               file=sys.stderr)
     v = verdict(oracle, crash)
     ok = (v["converged"] and not v["lost_admissions"]
           and not v["double_admission"] and not v["stranded"])
-    print(json.dumps({"tool": "crash_run", "seed": seed, "site": site,
-                      "hit": hit, "ok": ok, **v,
-                      "admitted": len(crash["admitted"])}))
+    line = {"tool": "crash_run", "mode": crash["mode"], "seed": seed,
+            "site": site, "hit": hit, "ok": ok, **v,
+            "admitted": len(crash["admitted"])}
+    if lag_mode:
+        line["lag_mode"] = lag_mode
+        line["promotion"] = crash["promotion"]
+    print(json.dumps(line))
     return 0 if ok else 1
 
 
 def sweep(seeds: int) -> int:
-    """Every crash site x ``seeds`` seeded kill points. A seeded hit
-    that is never reached (the site didn't fire before settle) still
-    must converge — it degenerates to a clean run — but each site must
-    fire at least once across its seeds or the sweep is vacuous."""
+    """Every crash site x ``seeds`` seeded kill points, run through
+    BOTH recovery paths: the ISSUE-10 cold-restore arm and the
+    ISSUE-15 promotion-timing arm (hot standby promoted at a lag state
+    varied per seed across hot/lagged/cold). A seeded hit that is
+    never reached (the site didn't fire before settle) still must
+    converge — it degenerates to a clean run — but each site must fire
+    at least once per arm across its seeds or the sweep is vacuous."""
     failures = []
-    fired_by_site = {s: 0 for s in CRASH_SITES}
+    fired = {(m, s): 0 for m in ("restore", "promote")
+             for s in CRASH_SITES}
     oracle_by_seed: dict = {}
+    lag_names = sorted(LAG_MODES)
     import zlib
     for site in CRASH_SITES:
         for seed in range(seeds):
@@ -297,33 +403,49 @@ def sweep(seeds: int) -> int:
                    else rng.randint(0, 8))
             if seed not in oracle_by_seed:
                 oracle_by_seed[seed] = run_oracle(seed)
-            crash = run_crash(seed, site, hit)
-            v = verdict(oracle_by_seed[seed], crash)
-            fired_by_site[site] += 1 if crash["crashed"] else 0
-            ok = (v["converged"] and not v["lost_admissions"]
-                  and not v["double_admission"] and not v["stranded"])
-            line = {"site": site, "seed": seed, "hit": hit, "ok": ok,
-                    **{k: v[k] for k in ("converged", "crashed")}}
-            print(json.dumps(line), file=sys.stderr)
-            if not ok:
-                failures.append(line)
-    vacuous = [s for s, n in fired_by_site.items() if n == 0]
+            lag_mode = lag_names[seed % len(lag_names)]
+            for mode, run in (("restore",
+                               lambda: run_crash(seed, site, hit)),
+                              ("promote",
+                               lambda: run_failover(seed, site, hit,
+                                                    lag_mode))):
+                crash = run()
+                v = verdict(oracle_by_seed[seed], crash)
+                fired[(mode, site)] += 1 if crash["crashed"] else 0
+                ok = (v["converged"] and not v["lost_admissions"]
+                      and not v["double_admission"]
+                      and not v["stranded"])
+                line = {"arm": mode, "site": site, "seed": seed,
+                        "hit": hit, "ok": ok,
+                        **{k: v[k] for k in ("converged", "crashed")}}
+                if mode == "promote":
+                    line["lag_mode"] = lag_mode
+                print(json.dumps(line), file=sys.stderr)
+                if not ok:
+                    failures.append(line)
+    vacuous = [f"{m}:{s}" for (m, s), n in fired.items() if n == 0]
     ok = not failures and not vacuous
     print(json.dumps({"tool": "crash_run", "mode": "sweep",
                       "seeds": seeds, "sites": len(CRASH_SITES),
+                      "arms": ["restore", "promote"],
                       "ok": ok, "failures": failures,
-                      "fired_by_site": fired_by_site,
+                      "fired": {f"{m}:{s}": n
+                                for (m, s), n in fired.items()},
                       "vacuous_sites": vacuous}))
     return 0 if ok else 1
 
 
 def main():
-    args = [a for a in sys.argv[1:] if a != "--sweep"]
-    if "--sweep" in sys.argv[1:]:
+    argv = sys.argv[1:]
+    args = [a for a in argv if a not in ("--sweep", "--failover")]
+    if "--sweep" in argv:
         return sweep(int(args[0]) if args else 20)
     seed = int(args[0]) if args else 1234
     site = args[1] if len(args) > 1 else faultinject.SITE_STORE
     hit = int(args[2]) if len(args) > 2 else 40
+    if "--failover" in argv:
+        lag = args[3] if len(args) > 3 else "hot"
+        return one_run(seed, site, hit, lag_mode=lag)
     return one_run(seed, site, hit)
 
 

@@ -1,0 +1,268 @@
+"""Failover probe: hot-standby replication + fencing health table.
+
+Drives a fenced leader (KueueManager over a durable checkpoint/WAL
+log, ``resilience/replica.lead``) through waves of traffic while a
+``StandbyReplica`` tails the WAL, printing one row per wave:
+
+    wave  appends  lag_pre  lag_post  applied  lag_s  epoch
+
+Then simulates the failure the subsystem exists for — as a PARTITION,
+not a crash, because that is the sharper case: the old leader is still
+ALIVE when the standby force-promotes. The probe verifies the fencing
+contract end-to-end (RESILIENCE.md §7):
+
+- the deposed leader's store writes raise ``Fenced`` (counted; ONE
+  write slipping through is a violation),
+- the deposed leader's admission cycles admit nothing (its leader
+  gate reads the bumped epoch),
+- the promoted replica admits within a bounded number of cycles and
+  its per-CQ cache usage matches the store's admitted sum (the
+  double-admission cross-check),
+- replication lag drains to zero at every poll (unbounded lag fails).
+
+Same CLI contract as tools/chaos_run.py / visibility_probe.py: the
+human table (or --json report) goes to stderr, one parseable JSON
+verdict line to stdout, exit non-zero on unbounded lag or a fencing
+violation.
+
+Usage: python tools/failover_probe.py [waves] [cqs] [--json]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kueue_tpu import config as cfgpkg  # noqa: E402
+from kueue_tpu.api import kueue as api  # noqa: E402
+from kueue_tpu.api.corev1 import (  # noqa: E402
+    Container, PodSpec, PodTemplateSpec)
+from kueue_tpu.api.meta import FakeClock, LabelSelector, ObjectMeta  # noqa: E402
+from kueue_tpu.core import workload as wlpkg  # noqa: E402
+from kueue_tpu.manager import KueueManager  # noqa: E402
+from kueue_tpu.resilience.replica import StandbyReplica, lead  # noqa: E402
+from kueue_tpu.sim.durable import Fenced  # noqa: E402
+
+DEFAULT_WAVES = 6
+DEFAULT_CQS = 6
+MAX_CYCLES_TO_ADMIT = 3
+
+
+def make_objects(num_cqs: int):
+    rf = api.ResourceFlavor(metadata=ObjectMeta(name="f0", uid="rf-f0"))
+    out = [rf]
+    for i in range(num_cqs):
+        cq = api.ClusterQueue(metadata=ObjectMeta(name=f"cq{i}",
+                                                  uid=f"cq-{i}"))
+        cq.spec.namespace_selector = LabelSelector()
+        cq.spec.cohort = f"cohort-{i % 2}"
+        cq.spec.resource_groups.append(api.ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[api.FlavorQuotas(name="f0", resources=[
+                api.ResourceQuota(name="cpu", nominal_quota=100_000)])]))
+        lq = api.LocalQueue(metadata=ObjectMeta(
+            name=f"lq{i}", namespace="default", uid=f"lq-{i}"))
+        lq.spec.cluster_queue = f"cq{i}"
+        out += [cq, lq]
+    return out
+
+
+def make_workload(wave: int, i: int, n: int):
+    wl = api.Workload(metadata=ObjectMeta(
+        name=f"w{wave}-{i}", namespace="default", uid=f"wl-{wave}-{i}",
+        creation_timestamp=float(n)))
+    wl.spec.queue_name = f"lq{i}"
+    wl.spec.pod_sets.append(api.PodSet(
+        name="main", count=1, template=PodTemplateSpec(spec=PodSpec(
+            containers=[Container(name="c", requests={"cpu": 2000})]))))
+    return wl
+
+
+def usage_consistent(mgr) -> tuple:
+    expected: dict = {}
+    for wl in mgr.store.list("Workload", copy_objects=False):
+        if not wlpkg.has_quota_reservation(wl):
+            continue
+        info = wlpkg.Info(wl)
+        cq = wl.status.admission.cluster_queue
+        bucket = expected.setdefault(cq, {})
+        for fr, v in info.flavor_resource_usage().items():
+            bucket[fr] = bucket.get(fr, 0) + v
+    for cq in mgr.cache.hm.cluster_queues:
+        reserved, _ = mgr.cache.usage_for_cluster_queue(cq)
+        want = {fr: v for fr, v in expected.get(cq, {}).items() if v}
+        got = {fr: v for fr, v in reserved.items() if v}
+        if want != got:
+            return False, f"{cq}: store says {want}, cache says {got}"
+    return True, ""
+
+
+def admitted_count(mgr) -> int:
+    return sum(1 for wl in mgr.store.list("Workload", copy_objects=False)
+               if wlpkg.has_quota_reservation(wl))
+
+
+def probe(waves: int = DEFAULT_WAVES, num_cqs: int = DEFAULT_CQS) -> dict:
+    cfg = cfgpkg.Configuration()
+    cfg.store.durable = True
+    cfg.store.checkpoint_every = 64
+    clock = FakeClock(1000.0)
+    leader = KueueManager(cfg=cfg, clock=clock)
+    for obj in make_objects(num_cqs):
+        leader.store.create(obj)
+    leader.run_until_idle(max_iterations=1_000_000)
+    durable = leader.durable
+    token = lead(leader, durable, identity="leader-0")
+    standby = StandbyReplica(durable, clock=clock, identity="standby-0")
+
+    windows = []
+    n = 0
+    unbounded_lag = 0
+    for wave in range(waves):
+        appends0 = durable.appends
+        for i in range(num_cqs):
+            leader.store.create(make_workload(wave, i, n))
+            n += 1
+        leader.run_until_idle(max_iterations=1_000_000)
+        leader.scheduler.schedule(timeout=0)
+        leader.run_until_idle(max_iterations=1_000_000)
+        clock.advance(1.0)
+        token.renew(clock.now())
+        lag_pre = standby.lag_records
+        standby.poll()
+        lag_post = standby.lag_records
+        if lag_post is None or lag_post != 0:
+            # The tail must DRAIN at every poll — anything else means
+            # the follower cannot keep up with one cycle's appends
+            # (unbounded lag, the probe's failure condition).
+            unbounded_lag += 1
+        windows.append({
+            "wave": wave, "appends": durable.appends - appends0,
+            "lag_pre": lag_pre, "lag_post": lag_post,
+            "applied": standby.applied_records,
+            "lag_s": round(standby.lag_seconds, 3),
+            "epoch": durable.fencing_epoch})
+
+    pre_admitted = admitted_count(leader)
+
+    # --- the partition: promote OVER a live leader --------------------
+    promoted = standby.promote(force=True)
+
+    # Deposed-leader commit attempts: every one must raise Fenced.
+    fenced_writes = 0
+    leaked_writes = 0
+    try:
+        leader.store.create(make_workload(999, 0, 10_000))
+        leaked_writes += 1
+    except Fenced:
+        fenced_writes += 1
+    try:
+        wl = leader.store.list("Workload", copy_objects=False)[0]
+        patch = wlpkg.clone_for_status_update(wl)
+        patch.status.conditions = list(patch.status.conditions)
+        from kueue_tpu.api.meta import Condition, set_condition
+        set_condition(patch.status.conditions, Condition(
+            type="DeposedProbe", status="True", reason="Probe",
+            message="deposed status write"), clock.now())
+        leader.store.update_status(patch, owned_status=True)
+        leaked_writes += 1
+    except Fenced:
+        fenced_writes += 1
+    # Deposed admission cycles: the leader gate reads the bumped epoch.
+    deposed_before = admitted_count(leader)
+    leader.scheduler.schedule(timeout=0)
+    deposed_admissions = admitted_count(leader) - deposed_before
+
+    # The promoted replica keeps admitting the live traffic.
+    cycles_to_admit = None
+    before = admitted_count(promoted)
+    for cycle in range(MAX_CYCLES_TO_ADMIT + 2):
+        for i in range(num_cqs):
+            promoted.store.create(make_workload(100 + cycle, i, n))
+            n += 1
+        promoted.run_until_idle(max_iterations=1_000_000)
+        promoted.scheduler.schedule(timeout=0)
+        promoted.run_until_idle(max_iterations=1_000_000)
+        clock.advance(1.0)
+        if admitted_count(promoted) > before:
+            cycles_to_admit = cycle + 1
+            break
+    ok_usage, usage_msg = usage_consistent(promoted)
+
+    report = {
+        "waves": waves, "cqs": num_cqs,
+        "windows": windows,
+        "unbounded_lag_polls": unbounded_lag,
+        "max_lag_records": standby.max_lag_records,
+        "resyncs": standby.resyncs,
+        "pre_partition_admitted": pre_admitted,
+        "promotion": (standby.last_promotion.to_dict()
+                      if standby.last_promotion else None),
+        "fencing_epoch": durable.fencing_epoch,
+        "fenced_writes": fenced_writes,
+        "leaked_writes": leaked_writes,
+        "deposed_admissions": deposed_admissions,
+        "cycles_to_first_admission": cycles_to_admit,
+        "usage_consistent": ok_usage, "usage_msg": usage_msg,
+        "standby_status": standby.status(),
+    }
+    promoted.shutdown(checkpoint=False)
+    report["live_handouts_after_shutdown"] = promoted.cache.live_handouts
+    return report
+
+
+def render_table(report: dict) -> str:
+    head = (f"{'wave':>5} {'appends':>8} {'lag_pre':>8} {'lag_post':>9} "
+            f"{'applied':>8} {'lag_s':>6} {'epoch':>6}")
+    lines = [head, "-" * len(head)]
+    for w in report["windows"]:
+        lines.append(
+            f"{w['wave']:>5} {w['appends']:>8} "
+            f"{w['lag_pre'] if w['lag_pre'] is not None else '-':>8} "
+            f"{w['lag_post'] if w['lag_post'] is not None else '-':>9} "
+            f"{w['applied']:>8} {w['lag_s']:>6} {w['epoch']:>6}")
+    lines.append("-" * len(head))
+    prom = report["promotion"] or {}
+    lines.append(
+        f"promotion: {prom.get('duration_s', 0) * 1e3:.1f}ms at epoch "
+        f"{prom.get('epoch')}  drained: {prom.get('drained_records')}  "
+        f"fenced writes: {report['fenced_writes']}  leaked: "
+        f"{report['leaked_writes']}  deposed admissions: "
+        f"{report['deposed_admissions']}")
+    lines.append(
+        f"max lag: {report['max_lag_records']} records  unbounded-lag "
+        f"polls: {report['unbounded_lag_polls']}  cycles to first "
+        f"admission: {report['cycles_to_first_admission']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    waves = int(argv[0]) if len(argv) > 0 else DEFAULT_WAVES
+    num_cqs = int(argv[1]) if len(argv) > 1 else DEFAULT_CQS
+    report = probe(waves, num_cqs)
+    if as_json:
+        print(json.dumps(report), file=sys.stderr, flush=True)
+    else:
+        print(render_table(report), file=sys.stderr, flush=True)
+    verdict = {k: v for k, v in report.items()
+               if k not in ("windows", "standby_status")}
+    verdict["ok"] = (
+        report["unbounded_lag_polls"] == 0
+        and report["leaked_writes"] == 0
+        and report["deposed_admissions"] == 0
+        and report["fenced_writes"] == 2
+        and report["cycles_to_first_admission"] is not None
+        and report["cycles_to_first_admission"] <= MAX_CYCLES_TO_ADMIT
+        and report["usage_consistent"]
+        and report["live_handouts_after_shutdown"] == 0)
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

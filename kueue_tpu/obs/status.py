@@ -178,8 +178,11 @@ def aging_status(scheduler) -> dict:
     """Aging-watch verdicts (/debug/aging): per-monitor value, slope
     EWMA and verdict over the monotone resources ROADMAP item 5 gates
     on (live handouts, WAL compaction, arena occupancy, requeue
-    amplification, mid-traffic compiles, RSS). ``attached`` False = no
-    watch wired (bare scheduler)."""
+    amplification, mid-traffic compiles, RSS), plus the machine-
+    readable ``gate`` dict ({ok, failing, verdicts}) the soak harness
+    and scenario results consume — one green/red contract, whether
+    read over HTTP or in-process. ``attached`` False = no watch wired
+    (bare scheduler)."""
     watch = getattr(scheduler, "aging", None)
     if watch is None:
         return {"attached": False}

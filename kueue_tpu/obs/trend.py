@@ -168,12 +168,26 @@ class AgingWatch:
         return sorted(name for name, mon in self.monitors.items()
                       if mon.verdict() in BAD_VERDICTS)
 
+    def gate(self) -> dict:
+        """The machine-readable aging verdict every gate consumes —
+        soak harness, scenario results and /debug/aging share THIS
+        contract instead of re-deriving pass/fail from status():
+        ``ok`` is True iff no monitor's verdict is in BAD_VERDICTS,
+        ``failing`` lists the violators, ``verdicts`` maps every
+        monitor to its current verdict (warming/ok/growing count as
+        green — a fresh process is not a leaking one)."""
+        failing = self.failing
+        return {"ok": not failing, "failing": failing,
+                "verdicts": self.verdicts()}
+
     def status(self) -> dict:
         """The single producer /debug/aging, the probe and tests
-        share."""
+        share. Carries the gate() dict verbatim so a status consumer
+        and a gate consumer can never disagree."""
         return {
             "samples_taken": self.samples_taken,
             "failing": self.failing,
+            "gate": self.gate(),
             "monitors": {name: mon.status()
                          for name, mon in self.monitors.items()},
         }

@@ -244,6 +244,32 @@ class SLOSpec:
     # with a bound set, a run whose survivors never re-placed is
     # itself a violation.
     max_replacement_latency_s: Optional[float] = None
+    # --- soak gates (sim/soak.py + ISSUE 18) ---------------------------
+    # These judge the counters a composed multi-day run stamps on its
+    # result, so one check_slo call renders the whole soak verdict.
+    # The AgingWatch must end green: counters["aging"] (the gate() dict
+    # the harness stamps) must exist with ok=True — no monitor leaking
+    # or over-bound at run end. A run that never stamped the gate is
+    # itself a violation (the watch was not sampled, not "green").
+    require_aging_green: bool = False
+    # Max per-class journey SLO burn rate at run end
+    # (counters["journeys"]["burn_rates"], obs/journey.py: violation-
+    # fraction EWMA / error budget — 1.0 burns exactly at budget).
+    # None = unchecked; requires objectives set (harness
+    # set_objectives). A run that stamps no burn rates while this
+    # bound is set is a violation, not a vacuous pass — empty
+    # evidence means the ledger went dark, not that nothing burned.
+    max_journey_burn_rate: Optional[float] = None
+    # Max program variants first executed inside a measured cycle AFTER
+    # the soak's warm horizon (virtual day 1): the steady-state
+    # compile-storm contract over a long composed run
+    # (counters["mid_traffic_compiles_after_warm"]; 0 = the north-star
+    # bound, None = unchecked). Solver-less runs stamp 0 honestly.
+    max_mid_traffic_compiles_after_warm: Optional[int] = None
+    # Teardown handout leak gate: counters["live_handouts_at_teardown"]
+    # (stamped after manager shutdown) must be 0 — a long-lived run
+    # may not strand snapshot borrows.
+    require_zero_live_handouts: bool = False
 
 
 def check_slo(result, spec: SLOSpec) -> list:
@@ -339,6 +365,51 @@ def check_slo(result, spec: SLOSpec) -> list:
             violations.append(
                 f"cluster-loss re-placement took {lat:.1f}s, bound "
                 f"{spec.max_replacement_latency_s:.1f}s")
+    counters = getattr(result, "counters", {}) or {}
+    if spec.require_aging_green:
+        gate = counters.get("aging")
+        if gate is None:
+            violations.append(
+                "aging gate required but the run stamped no "
+                "counters['aging'] (AgingWatch never sampled)")
+        elif not gate.get("ok"):
+            bad = {name: gate["verdicts"].get(name, "?")
+                   for name in gate.get("failing", [])}
+            violations.append(f"aging gate red at run end: {bad}")
+    if spec.max_journey_burn_rate is not None:
+        rates = (counters.get("journeys") or {}).get("burn_rates") or {}
+        if not rates:
+            violations.append(
+                "journey burn-rate bound set but the run stamped no "
+                "counters['journeys']['burn_rates'] (ledger unpriced "
+                "or lost across a restart)")
+        for cls in sorted(rates):
+            if rates[cls] > spec.max_journey_burn_rate:
+                violations.append(
+                    f"class {cls!r} journey SLO burn rate "
+                    f"{rates[cls]:.2f} exceeds "
+                    f"{spec.max_journey_burn_rate:.2f}")
+    if spec.max_mid_traffic_compiles_after_warm is not None:
+        compiles = counters.get("mid_traffic_compiles_after_warm")
+        if compiles is None:
+            violations.append(
+                "post-warm compile bound set but the run stamped no "
+                "counters['mid_traffic_compiles_after_warm']")
+        elif compiles > spec.max_mid_traffic_compiles_after_warm:
+            violations.append(
+                f"{compiles} program variant(s) first executed inside "
+                f"a cycle after the warm horizon (bound "
+                f"{spec.max_mid_traffic_compiles_after_warm})")
+    if spec.require_zero_live_handouts:
+        handouts = counters.get("live_handouts_at_teardown")
+        if handouts is None:
+            violations.append(
+                "teardown handout gate set but the run stamped no "
+                "counters['live_handouts_at_teardown']")
+        elif handouts:
+            violations.append(
+                f"{handouts} snapshot handout(s) still live at "
+                "teardown (live_handouts != 0 after shutdown)")
     return violations
 
 

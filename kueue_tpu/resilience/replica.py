@@ -234,9 +234,13 @@ class StandbyReplica:
         # Follower-lag trend source (obs/trend.py): sustained growth of
         # the unapplied-record count is the replication leak signature;
         # the watch is sampled at every poll (the follower's "cycle").
+        # The source must go silent at promotion: the cursor freezes
+        # there, so a promoted leader's own appends would otherwise
+        # read as unapplied "lag" and trip the soak gate as a leak.
         mgr.aging_watch.add(
             "replication_lag_records",
-            lambda: float(self.lag_records or 0),
+            lambda: 0.0 if self.promoted
+            else float(self.lag_records or 0),
             slope_threshold=LAG_SLOPE_THRESHOLD,
             window=LAG_WINDOW, warmup=LAG_WARMUP)
         self._cursor = cursor

@@ -1,0 +1,345 @@
+"""Adversarial traffic search over the soak parameter surface: find
+the failure modes no scripted storm triggers (ISSUE 18 tentpole b).
+
+The scripted catalog and the composed soak (sim/soak.py) replay storm
+shapes a human thought of. The weaknesses that survive those are the
+ones only an unanticipated SHAPE exposes — a readiness outage two
+beats longer than the backoff ramp, a burst harmonic that lands on the
+churn cadence, a kill window that catches the WAL mid-checkpoint. This
+module hunts for them mechanically:
+
+- ``DIMENSIONS`` is the mutable traffic surface — every SoakParams
+  knob that describes TRAFFIC (arrival mix, burst harmonics, churn
+  cadence, outage geometry, readiness-storm shape, kill-site windows),
+  with its legal range. Config under test (backoff bounds, readiness
+  timeout, cluster shape, horizon) is deliberately NOT mutable: the
+  search varies the weather, never the system.
+- ``search()`` draws ``budget`` seeded mutants of a base schedule,
+  runs each through the full soak gate, and keeps the probes whose
+  violations are INTERESTING (SLO/invariant breaches, not harness
+  artifacts of sparse mutated traffic).
+- ``shrink()`` minimizes the first failing probe the way crash_run's
+  --sweep narrows a kill site, generalized to traffic shapes: revert
+  every mutated dimension back to the base schedule while the verdict
+  stays red (ddmin over dimensions), then halve the survivors toward
+  base (numeric bisection) — the result is the MINIMAL perturbation
+  that still breaks the gate, which is the bug report.
+- ``to_spec()/register_repro()`` serialize the minimum as a named
+  scenario spec ``{"scenario", "seed", "params"}`` and install it in
+  the sim/scenarios.py catalog, so ``scenario_run <name>`` replays the
+  red trace forever (the repro corpus workflow, RESILIENCE.md §8).
+
+Everything is deterministic per (base, seed): mutation draws come from
+one seeded RNG, every probe replays the SAME run seed (variation comes
+from the params, so a found trace is (params, seed)-replayable), and
+the shrink re-runs the same runner.
+
+``weak_backoff_fixture()`` is the planted weakness the acceptance test
+hunts: a requeue backoff whose cap truncates the exponential ramp at
+~2 s, so a long-enough readiness outage makes every storm victim lap
+eviction -> requeue -> re-admission at line rate (amplification grows
+linearly with the outage) where the healthy default's doubling ramp
+keeps the lap count logarithmic.
+
+``preempt_shape_report()`` is the warm-ladder feed (satellite 2):
+adversarially-synthesized preempt-storm geometries emit their
+``(B, rank)`` bucket keys — B = the bucketed problem count, rank = the
+bucketed candidate-axis size, the two dims warmgov.preempt_shape_ladder
+rungs on — and the report lists the keys the current ladder would NOT
+precompile, i.e. the storm shapes that would cost a counted
+mid-traffic compile today. ``tools/soak_run.py --shapes`` serves it.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import replace
+
+from kueue_tpu.sim.soak import SoakParams, run_soak
+
+# field -> (lo, hi, kind). The TRAFFIC surface only — see module doc.
+DIMENSIONS = {
+    "base_rate":           (0.01, 0.25, "float"),
+    "amplitude":           (0.0, 1.0, "float"),
+    "burst_extra":         (0.0, 0.6, "float"),
+    "burst_width_frac":    (0.01, 0.25, "float"),
+    "trickle_interval_s":  (10.0, 120.0, "float"),
+    "churn_interval_frac": (0.02, 0.3, "float"),
+    "outage_start_frac":   (0.05, 0.5, "float"),
+    "outage_end_frac":     (0.5, 0.95, "float"),
+    "storm_per_tenant":    (0, 24, "int"),
+    "storm_width_s":       (1.0, 30.0, "float"),
+    "storm_runtime_s":     (20.0, 240.0, "float"),
+    "pods_ready_outage_s": (0.0, 180.0, "float"),
+    "kill_hit_lo":         (1, 8, "int"),
+    "kill_hit_hi":         (8, 60, "int"),
+}
+
+DEFAULT_MUTATION_RATE = 0.35
+
+# Harness artifacts of sparse mutated traffic, not weaknesses: a
+# mutant whose storm is too thin to reach the armed kill hit count
+# simply never crashes — that's the schedule failing to fire, not the
+# control plane failing to survive.
+_STRUCTURAL_MARKERS = ("mis-armed",)
+
+
+def interesting(violations: list) -> list:
+    """The violations a probe counts for: everything except the
+    harness's own structural checks (see _STRUCTURAL_MARKERS)."""
+    return [v for v in violations
+            if not any(m in v for m in _STRUCTURAL_MARKERS)]
+
+
+def _draw(rng: random.Random, lo, hi, kind):
+    """One dimension draw, boundary-biased the way fuzzers weight
+    interesting values: range extremes expose dose-response failures
+    (the longest outage, the widest storm) that a uniform draw rarely
+    lands on, while the uniform bulk still explores the interior."""
+    r = rng.random()
+    if r < 0.25:
+        return hi
+    if r < 0.35:
+        return lo
+    return rng.randint(lo, hi) if kind == "int" else rng.uniform(lo, hi)
+
+
+def mutate(base: SoakParams, rng: random.Random,
+           rate: float = DEFAULT_MUTATION_RATE) -> SoakParams:
+    """One seeded mutant: each traffic dimension independently redrawn
+    (boundary-biased) with probability ``rate`` (at least one always
+    moves), then clamped to the cross-dimension constraints the
+    schedule needs (kill window ordered, outage start < end)."""
+    changes = {}
+    names = list(DIMENSIONS)
+    while not changes:
+        for name in names:
+            if rng.random() >= rate:
+                continue
+            changes[name] = _draw(rng, *DIMENSIONS[name])
+    cand = replace(base, **changes)
+    if cand.kill_hit_hi < cand.kill_hit_lo:
+        cand = replace(cand, kill_hit_hi=cand.kill_hit_lo)
+    if cand.outage_end_frac <= cand.outage_start_frac:
+        cand = replace(cand,
+                       outage_end_frac=min(0.95,
+                                           cand.outage_start_frac + 0.2))
+    # Fair-play feasibility clamp: the storm's offered work per tenant
+    # (count x runtime, in quota-unit-seconds) must be drainable well
+    # inside the p99 bounds, or every big-enough storm trivially reds
+    # the TTA gates by capacity arithmetic alone and buries the
+    # control-plane weaknesses the search exists to find. Half a day
+    # of the tenant's full quota is the envelope.
+    cap = 0.5 * cand.day_s * cand.quota_units
+    if cand.storm_per_tenant * cand.storm_runtime_s > cap:
+        cand = replace(
+            cand, storm_runtime_s=cap / cand.storm_per_tenant)
+    return cand
+
+
+def weak_backoff_fixture(base: SoakParams = None) -> SoakParams:
+    """The planted weakness (acceptance fixture): an aggressive
+    readiness timeout paired with a backoff cap that truncates the
+    exponential ramp at ~2 s. Under a readiness outage every victim
+    laps at ~(timeout + cap) seconds — amplification linear in the
+    outage length — where the healthy default's doubling ramp keeps
+    the lap count logarithmic and the soak's amplification bound
+    holds."""
+    base = base or SoakParams()
+    return replace(base, pods_ready_timeout_s=5.0,
+                   backoff_base_s=1.0, backoff_max_s=2.0)
+
+
+def to_spec(name: str, params: SoakParams, seed: int) -> dict:
+    """The serializable repro: everything a red trace needs to replay
+    — the schedule params (which carry the config under test too) and
+    the run seed."""
+    return {"scenario": name, "seed": seed, "params": params.to_dict()}
+
+
+def from_spec(spec: dict):
+    """(name, seed, SoakParams) from a ``to_spec`` dict; rejects
+    malformed specs loudly (unknown params keys raise)."""
+    return (spec["scenario"], int(spec["seed"]),
+            SoakParams.from_dict(spec["params"]))
+
+
+def register_repro(spec: dict) -> str:
+    """Install a repro spec as a named catalog scenario so
+    ``scenario_run <name>`` (and the soak corpus workflow) replays it.
+    The closure pins the recorded params; seed/scale follow the
+    catalog's call convention but default to the recorded seed."""
+    from kueue_tpu.sim import scenarios
+    name, rec_seed, params = from_spec(spec)
+
+    def _replay(seed: int = rec_seed, scale: str = "repro",
+                _p: SoakParams = params):
+        return run_soak(_p, seed=seed, scale=scale)
+
+    scenarios.SCENARIOS[name] = _replay
+    return name
+
+
+def search(base: SoakParams, seed: int = 0, budget: int = 12,
+           runner=run_soak, scale: str = "hunt",
+           shrink_budget: int = 48) -> dict:
+    """The hunt: probe 0 replays the base schedule (a red base means
+    the config is broken without adversarial help — reported as such),
+    then ``budget`` seeded mutants run the full soak gate at the SAME
+    run seed. The first interesting failure is shrunk to its minimal
+    perturbation and serialized as a repro spec. ``runner`` is
+    injectable (tests stub it; --shapes never runs one).
+
+    Returns ``{"seed", "budget", "evals", "probes": [...],
+    "findings": [...], "repro": spec|None, "shrink": {...}|None}``."""
+    rng = random.Random(seed ^ 0xAD5A)
+    probes, findings = [], []
+    evals = 0
+    for i in range(budget + 1):
+        cand = base if i == 0 else mutate(base, rng)
+        res = runner(cand, seed=seed, scale=scale)
+        evals += 1
+        bad = interesting(list(res.violations))
+        delta = {k: v for k, v in cand.to_dict().items()
+                 if v != getattr(base, k)
+                 and not isinstance(getattr(base, k), tuple)}
+        probes.append({"probe": i, "base": i == 0, "delta": delta,
+                       "violations": bad})
+        if bad:
+            findings.append({"probe": i, "params": cand.to_dict(),
+                             "violations": bad})
+    report = {"seed": seed, "budget": budget, "evals": evals,
+              "probes": probes, "findings": findings,
+              "repro": None, "shrink": None}
+    # Shrink the first ADVERSARIAL finding (a red base needs no
+    # minimizing — the base schedule is already the repro).
+    first = next((f for f in findings if f["probe"] > 0), None)
+    if first is not None:
+        cand = SoakParams.from_dict(first["params"])
+        mini, viols, used = shrink(cand, base, seed=seed, runner=runner,
+                                   scale=scale, budget=shrink_budget)
+        evals += used
+        report["evals"] = evals
+        report["shrink"] = {
+            "from_probe": first["probe"], "evals": used,
+            "violations": viols,
+            "delta": {k: v for k, v in mini.to_dict().items()
+                      if v != getattr(base, k)
+                      and not isinstance(getattr(base, k), tuple)}}
+        report["repro"] = to_spec(f"soak_repro_s{seed}", mini, seed)
+    return report
+
+
+def shrink(cand: SoakParams, base: SoakParams, seed: int = 0,
+           runner=run_soak, scale: str = "shrink", budget: int = 48):
+    """Minimize a failing schedule: (1) ddmin over dimensions — revert
+    each mutated dimension to its base value, keep the revert whenever
+    the gate stays red, repeat until a full pass makes no progress;
+    (2) bisect the survivors — halve each remaining dimension's
+    distance to base while still red. Returns ``(params, violations,
+    evals)`` where ``violations`` is the minimum's interesting set.
+    Budget caps total runner calls; on exhaustion the best-so-far
+    minimum is returned (still failing by construction)."""
+    evals = 0
+    viols = None
+
+    def still_red(p: SoakParams):
+        nonlocal evals, viols
+        if evals >= budget:
+            return False
+        res = runner(p, seed=seed, scale=scale)
+        evals += 1
+        bad = interesting(list(res.violations))
+        if bad:
+            viols = bad
+        return bool(bad)
+
+    # the entry candidate is known red; re-establish its violation set
+    # under THIS runner so the returned violations are the minimum's
+    if not still_red(cand):
+        return cand, [], evals
+
+    # pass 1: dimension-wise revert-to-base until a fixpoint
+    progress = True
+    while progress and evals < budget:
+        progress = False
+        for name in DIMENSIONS:
+            if getattr(cand, name) == getattr(base, name):
+                continue
+            trial = replace(cand, **{name: getattr(base, name)})
+            if still_red(trial):
+                cand = trial
+                progress = True
+
+    # pass 2: bisect the surviving dimensions toward base. A true
+    # interval bisection — the base value is the known-green side,
+    # the candidate value the known-red side; a green midpoint moves
+    # the green bound up rather than ending the search, so the
+    # survivor converges to just past the failure threshold instead
+    # of stalling at the first green halving.
+    for name in DIMENSIONS:
+        _, _, kind = DIMENSIONS[name]
+        red, green = getattr(cand, name), getattr(base, name)
+        if red == green:
+            continue
+        for _ in range(6):
+            if evals >= budget:
+                break
+            mid = (red + green) / 2.0
+            if kind == "int":
+                mid = int(round(mid))
+                if mid in (red, green):
+                    break
+            elif abs(red - mid) < 1e-3 * max(1.0, abs(red)):
+                break
+            if still_red(replace(cand, **{name: mid})):
+                red = mid
+            else:
+                green = mid
+        cand = replace(cand, **{name: red})
+    return cand, list(viols or []), evals
+
+
+# -- warm-ladder feed (satellite 2) ------------------------------------
+
+def preempt_shape_report(base: SoakParams = None, seed: int = 0,
+                         samples: int = 32) -> dict:
+    """Synthesize adversarial preempt-storm geometries (no soak runs —
+    pure shape arithmetic) and bucket each the way the solver would:
+    ``B`` = encode._bucket(problem count, 1) (a synchronized storm
+    makes ~one preemption problem per head), ``rank`` =
+    encode._bucket(max(8, 4 * cohort members)) (the candidate axis K).
+    Compare against the (B, K) pairs warmgov.preempt_shape_ladder
+    precompiles for the harness topology at each sampled backlog: keys
+    OFF the ladder are the storm shapes that would cost a counted
+    mid-traffic compile today — the rung-tuning feed."""
+    from kueue_tpu.solver.encode import _bucket
+    from kueue_tpu.solver.warmgov import preempt_shape_ladder
+
+    base = base or SoakParams()
+    rng = random.Random(seed ^ 0x5AFE)
+    # harness topology: cohorts=1, so one cohort holds every tenant CQ
+    members = {"cohort-0": base.tenants}
+    keys: dict = {}
+    ladder_keys: set = set()
+    for _ in range(max(1, samples)):
+        p = mutate(base, rng)
+        per = max(0, p.storm_per_tenant)
+        if per == 0:
+            continue
+        problems = p.tenants * per
+        b = _bucket(problems, 1)
+        rank = _bucket(max(8, 4 * p.tenants))
+        key = f"B{b}xK{rank}"
+        keys[key] = keys.get(key, 0) + 1
+        for s in preempt_shape_ladder(members, width=problems):
+            ladder_keys.add(f"B{s['B']}xK{s['K']}")
+    off = {k: n for k, n in keys.items() if k not in ladder_keys}
+    return {
+        "seed": seed, "samples": samples,
+        "topology": {"tenants": base.tenants, "cohorts": 1},
+        "keys": dict(sorted(keys.items(), key=lambda kv: -kv[1])),
+        "ladder_keys": sorted(ladder_keys),
+        "off_ladder": dict(sorted(off.items(), key=lambda kv: -kv[1])),
+        "suggested_rungs": sorted(off, key=lambda k: -off[k]),
+    }

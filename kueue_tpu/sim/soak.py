@@ -1,0 +1,436 @@
+"""Virtual-time soak harness: the scenario catalog composed into one
+multi-day schedule on ONE long-lived control plane (ISSUE 18 /
+ROADMAP item 4).
+
+Every catalog scenario (sim/scenarios.py) exercises one storm shape on
+a fresh manager and tears it down minutes later; the failure modes
+that killed real control planes are the ones that need DAYS of
+composed traffic to surface — a leak that only shows after the third
+diurnal wave, a requeue pile-up seeded by a quota edit two phases
+earlier, a failover landing on a process already aged by a cluster
+outage. This module runs that composition: diurnal waves into quota
+churn into cluster loss into a readiness outage into a crash (cold
+restore) into a MID-STORM failover (hot-standby promotion), all on one
+``ScenarioHarness``/DurableLog/FakeClock, with phase tags on every
+cycle trace and the AgingWatch sampled at every cycle seal.
+
+The soak verdict is one ``check_slo`` call over the composed run's
+ScenarioResult, gated on (SLOSpec soak fields, perf/checker.py):
+
+- the AgingWatch ending GREEN (``require_aging_green`` reads the
+  ``counters["aging"]`` gate dict — no monitor ``leaking`` or
+  ``over-bound`` at run end);
+- zero mid-traffic compiles after virtual day 1
+  (``max_mid_traffic_compiles_after_warm=0``; solver-less runs stamp
+  an honest 0);
+- bounded journey SLO burn rate per class
+  (``max_journey_burn_rate``);
+- zero live snapshot handouts at teardown
+  (``require_zero_live_handouts``, stamped after manager shutdown);
+
+plus the usual queueing gates (zero starvation, bounded per-class p99
+TTA, bounded requeue amplification) and the soak's own structural
+checks: the schedule actually crashed AND failed over, and every
+bounded harness structure (retention_status) stayed inside its cap.
+
+Deterministic per (params, seed): virtual time only, seeded traces,
+seeded kill points. ``SoakParams`` is the FULL parameter surface —
+serializable, so the adversarial search (sim/adversary.py) can mutate
+it, shrink a failing trace, and emit the minimum as a named scenario
+spec the catalog replays.
+
+Registered in the catalog as scenario ``soak`` (smoke = the sub-second
+tier-1 composition, full = the multi-day acceptance schedule).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import asdict, dataclass, field, fields
+
+from kueue_tpu import config as cfgpkg
+from kueue_tpu.perf.checker import SLOSpec, check_slo
+from kueue_tpu.sim.scenarios import (ScenarioHarness, ScenarioResult,
+                                     UNIT, _frange)
+from kueue_tpu.sim.traces import burst_trace, diurnal_trace, steady_trace
+
+
+@dataclass
+class SoakParams:
+    """The composed schedule's full parameter surface. Every knob the
+    adversary may mutate lives here — arrival mix, burst harmonics,
+    churn cadence, outage geometry, readiness-storm shape, kill-site
+    windows — so a failing trace is replayable from (params, seed)
+    alone and shrinkable one dimension at a time.
+
+    A virtual "day" is ``day_s`` seconds of the FakeClock; the
+    schedule runs ``days`` of them (minimum 3): days 1..N-2 are the
+    diurnal wave, day N-1 is churn -> cluster outage -> readiness
+    storm, day N is crash-storm -> failover-storm, then the drain."""
+
+    # horizon / clock
+    days: int = 3
+    day_s: float = 240.0
+    cycle_s: float = 5.0
+    # cluster shape
+    tenants: int = 3
+    quota_units: int = 10
+    # diurnal wave (sim/traces.py diurnal_trace)
+    base_rate: float = 0.05        # arrivals/s at the sinusoid's mean
+    amplitude: float = 0.8
+    burst_extra: float = 0.15      # burst harmonic height, arrivals/s
+    burst_width_frac: float = 0.05  # of the diurnal period
+    # background trickle on the storm days
+    trickle_interval_s: float = 40.0
+    # quota churn cadence (fraction of day_s between single-CQ edits)
+    churn_interval_frac: float = 0.08
+    churn_wiggle: tuple = (0, 2, 4, 2)   # extra quota units, cycled
+    # worker-cluster outage (MultiKueue w1 loss -> rejoin)
+    outage_start_frac: float = 0.15      # into the outage phase
+    outage_end_frac: float = 0.75
+    # synchronized storm shape (readiness / crash / failover phases)
+    storm_per_tenant: int = 4
+    storm_width_s: float = 5.0
+    storm_runtime_s: float = 60.0
+    # pods-ready outage inside the readiness phase: admitted pods stay
+    # NotReady this long (0 disables the readiness storm — the default
+    # composed soak keeps it off; the adversary turns it up)
+    pods_ready_outage_s: float = 0.0
+    # waitForPodsReady config (the planted-weakness slot: an
+    # undersized backoff_max_s is the fixture weakness the adversarial
+    # search must find traffic to expose)
+    pods_ready_timeout_s: float = 30.0
+    backoff_base_s: float = 10.0
+    backoff_max_s: float = 120.0
+    # seeded kill window (store-write hit counts, crash_run idiom)
+    kill_hit_lo: int = 2
+    kill_hit_hi: int = 30
+    # MultiKueue timings
+    worker_lost_timeout_s: float = 30.0
+    gc_interval_s: float = 20.0
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["churn_wiggle"] = list(self.churn_wiggle)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SoakParams":
+        known = {f.name for f in fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown SoakParams key(s): {sorted(unknown)}")
+        kw = dict(d)
+        if "churn_wiggle" in kw:
+            kw["churn_wiggle"] = tuple(kw["churn_wiggle"])
+        return cls(**kw)
+
+
+# Catalog presets: ``smoke`` must stay sub-second (it rides tier-1 CI);
+# ``full`` is the multi-day acceptance schedule — three real virtual
+# days at a 60 s cycle cadence.
+PRESETS = {
+    "smoke": SoakParams(),
+    "full": SoakParams(days=3, day_s=86_400.0, cycle_s=60.0, tenants=4,
+                       quota_units=12, base_rate=0.004, burst_extra=0.02,
+                       trickle_interval_s=600.0, churn_interval_frac=0.02,
+                       storm_per_tenant=8, storm_width_s=30.0,
+                       storm_runtime_s=600.0),
+}
+
+
+@dataclass
+class SoakPhase:
+    """One leg of the composed schedule: a phase tag for the cycle
+    traces, a duration, its arrivals (at_s relative to phase start)
+    and its hooks ((at_s, fn), same contract as ScenarioHarness.run)."""
+    name: str
+    duration_s: float
+    arrivals: list = field(default_factory=list)
+    hooks: list = field(default_factory=list)
+
+
+def _soak_cfg(params: SoakParams) -> cfgpkg.Configuration:
+    cfg = cfgpkg.Configuration(
+        wait_for_pods_ready=cfgpkg.WaitForPodsReady(
+            enable=True, timeout_seconds=params.pods_ready_timeout_s,
+            block_admission=False,
+            requeuing_strategy=cfgpkg.RequeuingStrategy(
+                backoff_base_seconds=params.backoff_base_s,
+                backoff_max_seconds=params.backoff_max_s)))
+    cfg.multi_kueue.worker_lost_timeout_seconds = \
+        params.worker_lost_timeout_s
+    cfg.multi_kueue.gc_interval_seconds = params.gc_interval_s
+    return cfg
+
+
+def build_phases(h: ScenarioHarness, params: SoakParams,
+                 rng: random.Random, state: dict) -> list:
+    """The composed schedule against a live harness. ``state`` is the
+    cross-phase scratchpad the run loop and the verdict read
+    (compile-counter warm snapshot, readiness bookkeeping)."""
+    from kueue_tpu.resilience import faultinject
+    from kueue_tpu.resilience.faultinject import FaultInjector
+
+    p = params
+    days = max(3, p.days)
+    seed = h.seed
+
+    def arm_kill() -> None:
+        # crash_run's sweep idiom generalized: the next store write
+        # numbered in [lo, hi] from NOW dies. Seeded, so the kill point
+        # is part of the replayable trace.
+        hit = rng.randint(p.kill_hit_lo, max(p.kill_hit_lo, p.kill_hit_hi))
+        faultinject.install(FaultInjector(
+            {faultinject.SITE_STORE: {hit: faultinject.CRASH}}))
+
+    phases = []
+
+    # --- days 1..N-2: the diurnal wave -------------------------------
+    wave_s = (days - 2) * p.day_s
+    period = p.day_s / 2.0
+    bursts = [(period * (k + 0.25), period * p.burst_width_frac,
+               p.burst_extra) for k in range(max(1, int(wave_s / period)))]
+    wave = diurnal_trace(seed, duration_s=wave_s, tenants=p.tenants,
+                         base_rate=p.base_rate, amplitude=p.amplitude,
+                         period_s=period, bursts=bursts)
+    # Warm horizon = end of virtual day 1: the compile-storm gate
+    # counts only variants first executed AFTER this snapshot.
+    def mark_warm() -> None:
+        state["compiles_at_warm"] = _compiles(h)
+    phases.append(SoakPhase("wave", wave_s, wave,
+                            hooks=[(p.day_s, mark_warm)]))
+
+    # --- day N-1 part 1: quota churn ---------------------------------
+    churn_s = 0.4 * p.day_s
+    churn_arrivals = steady_trace(seed + 1, churn_s, p.tenants,
+                                  interval_s=p.trickle_interval_s)
+    edits = state.setdefault("quota_edits", {"n": 0})
+
+    def churn() -> None:
+        t = edits["n"] % p.tenants
+        extra = p.churn_wiggle[edits["n"] % len(p.churn_wiggle)]
+        edits["n"] += 1
+        cq = h.mgr.store.get("ClusterQueue", "", f"cq-t{t}")
+        cq.spec.resource_groups[0].flavors[0].resources[0].nominal_quota = \
+            (p.quota_units + extra) * UNIT
+        h.mgr.store.update(cq)
+
+    interval = max(h.cycle_s, p.churn_interval_frac * p.day_s)
+    phases.append(SoakPhase(
+        "churn", churn_s, churn_arrivals,
+        hooks=[(off, churn) for off in _frange(interval, churn_s,
+                                               interval)]))
+
+    # --- day N-1 part 2: worker-cluster outage -----------------------
+    outage_s = 0.3 * p.day_s
+    outage_arrivals = steady_trace(seed + 2, outage_s, p.tenants,
+                                   interval_s=p.trickle_interval_s)
+
+    def lose() -> None:
+        # h.mgr may have been replaced by a restore by the time a hook
+        # fires — re-read the controller handle, never capture it
+        h.mgr.multikueue.mark_cluster_lost("w1")
+
+    def rejoin() -> None:
+        h.mgr.multikueue.mark_cluster_rejoined("w1")
+    phases.append(SoakPhase(
+        "outage", outage_s, outage_arrivals,
+        hooks=[(p.outage_start_frac * outage_s, lose),
+               (p.outage_end_frac * outage_s, rejoin)]))
+
+    # --- day N-1 part 3: readiness storm -----------------------------
+    # A synchronized same-class wave whose pods stay NotReady for the
+    # outage window: every victim laps through PodsReady timeout ->
+    # eviction -> jittered backoff -> re-admission until readiness
+    # returns. THIS is the phase whose shape the adversary tunes
+    # against an undersized backoff bound. Disabled (trickle only)
+    # when the outage window or the storm size is zero.
+    # The phase stretches to CONTAIN the outage (plus recovery head-
+    # room): a weak backoff's laps accumulate linearly with the outage
+    # length, which is exactly the dose-response the adversary probes.
+    ready_s = max(0.3 * p.day_s, 1.25 * p.pods_ready_outage_s)
+    ready_arrivals = steady_trace(seed + 3, ready_s, p.tenants,
+                                  interval_s=p.trickle_interval_s)
+    hooks = []
+    if p.pods_ready_outage_s > 0 and p.storm_per_tenant > 0:
+        ready_arrivals += burst_trace(
+            seed + 4, tenants=p.tenants, per_tenant=p.storm_per_tenant,
+            at_s=0.0, width_s=p.storm_width_s,
+            runtime_s=p.storm_runtime_s)
+        ready_arrivals.sort(key=lambda a: a.at_s)
+
+        def not_ready_on() -> None:
+            state["pods_down"] = True
+
+        def not_ready_off() -> None:
+            state["pods_down"] = False
+            # the infra issue clears: pods of everything still admitted
+            # start reaching readiness (requeue_flood's storm_off)
+            now = h.clock.now()
+            for name in list(h._reserved):
+                h._ready_at.setdefault(name, now)
+        hooks = [(0.0, not_ready_on),
+                 (min(p.pods_ready_outage_s, ready_s), not_ready_off)]
+    phases.append(SoakPhase("readiness", ready_s, ready_arrivals, hooks))
+
+    # --- day N part 1: crash storm (cold restore) --------------------
+    crash_s = 0.5 * p.day_s
+    crash_arrivals = steady_trace(seed + 5, crash_s, p.tenants,
+                                  interval_s=p.trickle_interval_s)
+    crash_arrivals += burst_trace(
+        seed + 6, tenants=p.tenants,
+        per_tenant=max(1, p.storm_per_tenant // 2), at_s=0.0,
+        width_s=p.storm_width_s, runtime_s=p.storm_runtime_s)
+    crash_arrivals.sort(key=lambda a: a.at_s)
+    phases.append(SoakPhase("crash-storm", crash_s, crash_arrivals,
+                            hooks=[(0.25 * crash_s, arm_kill)]))
+
+    # --- day N part 2: mid-storm failover ----------------------------
+    # The standby is enabled LIVE (replica.lead + a warm follower
+    # tailing the WAL) on the already-aged plane, a storm lands, and
+    # the leader is killed mid-storm: the next crash must PROMOTE, not
+    # cold-restore.
+    fail_s = 0.5 * p.day_s
+    fail_arrivals = steady_trace(seed + 7, fail_s, p.tenants,
+                                 interval_s=p.trickle_interval_s)
+    fail_arrivals += burst_trace(
+        seed + 8, tenants=p.tenants, per_tenant=p.storm_per_tenant,
+        at_s=0.2 * fail_s, width_s=p.storm_width_s,
+        runtime_s=p.storm_runtime_s)
+    fail_arrivals.sort(key=lambda a: a.at_s)
+
+    def enable_standby() -> None:
+        from kueue_tpu.resilience.replica import lead
+        lead(h.mgr, h.durable, identity="soak-leader", force=True)
+        h._want_standby = True
+        h.standby = h._make_standby()
+    phases.append(SoakPhase(
+        "failover-storm", fail_s, fail_arrivals,
+        hooks=[(0.0, enable_standby), (0.4 * fail_s, arm_kill)]))
+
+    return phases
+
+
+def _compiles(h: ScenarioHarness) -> int:
+    sv = h._solver
+    if sv is None:
+        return 0
+    return int(getattr(sv, "counters", {}).get("mid_traffic_compiles", 0))
+
+
+def soak_slo(params: SoakParams, total_arrivals: int) -> SLOSpec:
+    """The composed run's gate: queueing bounds scaled to the day
+    length plus the four soak gates (ISSUE 18 tentpole verdict)."""
+    d = params.day_s
+    return SLOSpec(
+        min_admitted=total_arrivals,
+        class_max_p99_tta_s={"prod": 0.5 * d, "standard": 1.0 * d,
+                             "batch": 2.0 * d},
+        # outage + readiness evictions give every victim ~one extra
+        # admission lap; a healthy backoff keeps laps near one per
+        # outage — the ADVERSARY's job is to find the shape that
+        # breaks this bound against a weak backoff fixture
+        max_requeue_amplification=3.0,
+        require_aging_green=True,
+        max_journey_burn_rate=1.0,
+        max_mid_traffic_compiles_after_warm=0,
+        require_zero_live_handouts=True)
+
+
+def run_soak(params: SoakParams, seed: int = 0,
+             scale: str = "custom") -> ScenarioResult:
+    """Run the composed schedule; returns a ScenarioResult named
+    ``soak`` whose violations ARE the soak verdict. Deterministic per
+    (params, seed)."""
+    from kueue_tpu.resilience import faultinject
+
+    p = params
+    h = ScenarioHarness("soak", seed, tenants=p.tenants,
+                        quota_units=p.quota_units, cfg=_soak_cfg(p),
+                        cycle_s=p.cycle_s, mk_check=True,
+                        remote_clusters=["w1", "w2"], durable=True)
+    rng = random.Random(seed ^ 0x50A4)
+    state: dict = {"pods_down": False, "compiles_at_warm": None}
+    # Pods reach readiness immediately — except while the readiness
+    # phase holds them down (then every admission laps through the
+    # PodsReady timeout + requeue backoff).
+    h.pods_ready_policy = \
+        lambda name: None if state["pods_down"] else 0.0
+
+    phases = build_phases(h, p, rng, state)
+    total = sum(len(ph.arrivals) for ph in phases)
+    slo = soak_slo(p, total)
+    # the journey ledger prices its live SLI stream against the same
+    # objectives the soak gates on (burn-rate gate is non-vacuous)
+    h.set_objectives(slo)
+
+    per_phase = []
+    try:
+        for ph in phases:
+            h.set_phase(ph.name)
+            h.run(ph.arrivals, ph.duration_s, hooks=ph.hooks)
+            led = getattr(h.mgr, "journey_ledger", None)
+            per_phase.append({
+                "phase": ph.name,
+                "t_end_s": round(h.clock.now() - h.t0, 1),
+                "cycles": h.cycles,
+                "submitted": h.submitted,
+                "admissions": h.admissions,
+                "evictions": h._evictions_carry
+                + h.mgr.recorder.count_by_reason_prefix("EvictedDueTo"),
+                "restarts": h.restarts,
+                "promotions": h.promotions,
+                "aging": h.mgr.aging_watch.gate(),
+                "burn_rates": led.burn_rates() if led is not None else {},
+            })
+        h.set_phase("drain")
+        h.drain(max_cycles=240)
+    finally:
+        faultinject.uninstall()
+
+    if state["compiles_at_warm"] is None:      # wave shorter than a day
+        state["compiles_at_warm"] = 0
+    res = h.result(scale, slo)
+    res.counters["soak"] = {
+        "days": max(3, p.days), "day_s": p.day_s,
+        "phases": per_phase,
+        "phase_transitions": len(per_phase) + 1,   # + the drain flip
+        "quota_edits": state.get("quota_edits", {}).get("n", 0),
+        "params": p.to_dict(),
+    }
+    res.counters["mid_traffic_compiles_after_warm"] = \
+        _compiles(h) - state["compiles_at_warm"]
+    ret = h.retention_status()
+    res.counters["retention"] = ret
+
+    # Teardown: the handout-leak gate needs the manager down first.
+    h.mgr.shutdown(checkpoint=False)
+    res.counters["live_handouts_at_teardown"] = h.mgr.cache.live_handouts
+    res.violations = check_slo(res, slo)
+
+    # Structural checks: the composition must actually have crashed,
+    # failed over, and stayed inside every retention cap.
+    if h.restarts < 1:
+        res.violations.append(
+            "composed soak never cold-restarted (crash-storm kill "
+            "mis-armed?)")
+    if h.promotions < 1:
+        res.violations.append(
+            "composed soak never promoted a standby (failover-storm "
+            "kill mis-armed?)")
+    for val_k, cap_k in (("cycle_routes", "cycle_routes_cap"),
+                         ("flight_ring", "flight_ring_cap"),
+                         ("event_window", "event_window_cap"),
+                         ("journeys_retained", "journeys_retained_cap")):
+        if ret[cap_k] and ret[val_k] > ret[cap_k]:
+            res.violations.append(
+                f"harness retention {val_k}={ret[val_k]} exceeds its "
+                f"cap {ret[cap_k]} over the composed run")
+    return res
+
+
+def run_soak_scenario(seed: int = 0, scale: str = "full") -> ScenarioResult:
+    """Catalog entry (sim/scenarios.py SCENARIOS['soak']): the composed
+    multi-day soak at the preset for ``scale``."""
+    return run_soak(PRESETS[scale], seed=seed, scale=scale)

@@ -640,6 +640,102 @@ class TestAgingWatch:
         # flagged within the EWMA window (bounded detection latency)
         assert mon.samples - leak_start <= mon.warmup + mon.window + 8
 
+    def test_verdict_walks_warming_ok_growing_leaking(self):
+        """The full verdict ladder in one monitor's life: warming
+        through the warmup, ok while flat, growing once the slope EWMA
+        crosses the threshold, leaking only after WINDOW consecutive
+        above-threshold samples."""
+        mon = TrendMonitor("walk", slope_threshold=0.05, alpha=0.5,
+                           window=6, warmup=4)
+        for _ in range(4):
+            mon.sample(10.0)
+            assert mon.verdict() == "warming"
+        mon.sample(10.0)
+        assert mon.verdict() == "ok"
+        v, seen = 10.0, []
+        while mon.verdict() != "leaking":
+            v += 1.0
+            mon.sample(v)
+            seen.append(mon.verdict())
+        # never leaking before the window sustained it — every
+        # intermediate verdict is growing
+        assert seen[:-1] == ["growing"] * (len(seen) - 1)
+        assert mon.sustained >= mon.window
+
+    def test_over_bound_outranks_every_other_verdict(self):
+        """A level past the hard bound is a violation NOW — even
+        during warmup (a fresh process may legitimately grow, but
+        never past the ceiling), and regardless of slope."""
+        mon = TrendMonitor("ceil", slope_threshold=0.05, bound=100.0,
+                           window=6, warmup=4)
+        mon.sample(150.0)
+        assert mon.samples <= mon.warmup     # still warming by count
+        assert mon.verdict() == "over-bound"
+        mon.sample(50.0)                     # back under: re-judged
+        assert mon.verdict() == "warming"
+
+    def test_slope_ewma_decays_back_to_ok_after_leak(self):
+        """Verdicts are live, not latched: once the growth stops, the
+        slope EWMA decays below the threshold and a leaking monitor
+        returns to ok — the soak gate reads the END state."""
+        mon = TrendMonitor("decay", slope_threshold=0.05, alpha=0.5,
+                           window=4, warmup=2)
+        v = 0.0
+        for _ in range(12):
+            v += 1.0
+            mon.sample(v)
+        assert mon.verdict() == "leaking"
+        flats = 0
+        while mon.verdict() != "ok":
+            mon.sample(v)
+            flats += 1
+            assert flats < 20, "slope EWMA never decayed"
+        assert mon.sustained == 0
+
+    def test_dead_source_counted_per_pass_never_failing(self):
+        """A raising source is counted on EVERY sampling pass and
+        skipped — it must neither kill the cycle nor read as a leak —
+        while healthy monitors alongside keep sampling."""
+        watch = AgingWatch()
+
+        def boom():
+            raise RuntimeError("dead source")
+        watch.add("bad", boom, slope_threshold=0.1)
+        watch.add("good", lambda: 1.0, slope_threshold=0.1, warmup=0)
+        for _ in range(3):
+            watch.sample()
+        assert watch.monitors["bad"].sample_errors == 3
+        assert watch.monitors["bad"].samples == 0
+        assert watch.monitors["good"].samples == 3
+        assert watch.failing == []
+        assert watch.gate()["ok"] is True
+
+    def test_gate_contract_and_status_carry_it_verbatim(self):
+        """gate() is the one machine-readable verdict every consumer
+        (soak harness, scenario counters, /debug/aging) shares:
+        warming/growing count green, leaking flips ok to False, and
+        status() embeds the same dict."""
+        watch = AgingWatch()
+        watch.add("flat", lambda: 5.0, slope_threshold=0.1, warmup=0)
+        leak = {"v": 0.0}
+
+        def leaking():
+            leak["v"] += 1.0
+            return leak["v"]
+        watch.add("leak", leaking, slope_threshold=0.05, alpha=0.5,
+                  window=4, warmup=2)
+        watch.sample()
+        g = watch.gate()
+        assert set(g) == {"ok", "failing", "verdicts"}
+        assert g["ok"] is True and g["failing"] == []    # warming=green
+        for _ in range(12):
+            watch.sample()
+        g = watch.gate()
+        assert g["ok"] is False and g["failing"] == ["leak"]
+        assert g["verdicts"]["leak"] == "leaking"
+        assert g["verdicts"]["flat"] == "ok"
+        assert watch.status()["gate"] == g
+
     def test_aging_endpoint_payload(self, clock):
         mgr = make_mgr(clock)
         submit_n(mgr, 2)

@@ -199,12 +199,13 @@ class TestEventRecorderRing:
 # ----------------------------------------------------------------------
 
 class TestScenarioSmoke:
-    def test_catalog_lists_all_ten(self):
+    def test_catalog_lists_all_builtins(self):
         assert list_scenarios() == ["cluster_loss", "cluster_rebalance",
                                     "diurnal", "failover",
                                     "flavor_churn", "mixed_jobs",
                                     "requeue_flood", "restart_storm",
-                                    "tenant_storm", "visibility_storm"]
+                                    "soak", "tenant_storm",
+                                    "visibility_storm"]
 
     def test_unknown_scenario_and_scale_rejected(self):
         with pytest.raises(KeyError):

@@ -1,0 +1,298 @@
+"""Virtual-time soak harness + adversarial traffic search (sim/soak.py,
+sim/adversary.py; ISSUE 18).
+
+Tier-1 runs the composed smoke soak (seeded, virtual-time, ~a second:
+diurnal wave -> quota churn -> cluster loss -> crash -> mid-storm
+failover on ONE manager) plus the params/spec serialization contracts,
+the SLOSpec soak-gate units, the harness retention regression and the
+adversary's search/shrink machinery against a stub runner. The
+``slow`` tier runs the multi-day full preset and the end-to-end
+acceptance hunt: given the planted weak-backoff fixture, the search
+must find a violating trace, shrink it to a minimal seeded repro, and
+that repro must replay red standalone while the same shape stays green
+under the healthy backoff config.
+"""
+
+import json
+import random
+from dataclasses import replace
+
+import pytest
+
+from kueue_tpu.perf.checker import SLOSpec, check_slo
+from kueue_tpu.sim import adversary
+from kueue_tpu.sim.scenarios import SCENARIOS, ScenarioResult
+from kueue_tpu.sim.soak import PRESETS, SoakParams, run_soak
+
+
+# ----------------------------------------------------------------------
+# params serialization (the adversary's substrate)
+# ----------------------------------------------------------------------
+
+class TestSoakParams:
+    def test_round_trip_and_unknown_key_rejected(self):
+        p = replace(SoakParams(), storm_per_tenant=7,
+                    pods_ready_outage_s=33.5)
+        d = json.loads(json.dumps(p.to_dict()))   # JSON-safe
+        assert SoakParams.from_dict(d) == p
+        with pytest.raises(ValueError, match="unknown SoakParams"):
+            SoakParams.from_dict({**d, "bogus_knob": 1})
+
+    def test_spec_round_trip(self):
+        p = replace(SoakParams(), backoff_max_s=2.0)
+        spec = adversary.to_spec("soak_repro_s3", p, seed=3)
+        name, seed, params = adversary.from_spec(
+            json.loads(json.dumps(spec)))
+        assert (name, seed, params) == ("soak_repro_s3", 3, p)
+
+
+# ----------------------------------------------------------------------
+# SLOSpec soak gates (perf/checker.py; counters-backed)
+# ----------------------------------------------------------------------
+
+def soak_result(**counters) -> ScenarioResult:
+    res = ScenarioResult(name="unit", seed=0, scale="smoke")
+    res.admitted = res.admissions = res.submitted = 1
+    res.counters = counters
+    return res
+
+
+class TestSoakSLOGates:
+    SPEC = SLOSpec(require_aging_green=True, max_journey_burn_rate=1.0,
+                   max_mid_traffic_compiles_after_warm=0,
+                   require_zero_live_handouts=True)
+    GREEN = dict(
+        aging={"ok": True, "failing": [], "verdicts": {}},
+        journeys={"burn_rates": {"prod": 0.2}},
+        mid_traffic_compiles_after_warm=0,
+        live_handouts_at_teardown=0)
+
+    def test_green_counters_pass(self):
+        assert check_slo(soak_result(**self.GREEN), self.SPEC) == []
+
+    def test_each_gate_trips_alone(self):
+        red = {
+            "aging": {"ok": False, "failing": ["rss_kb"],
+                      "verdicts": {"rss_kb": "leaking"}},
+            "journeys": {"burn_rates": {"prod": 2.5}},
+            "mid_traffic_compiles_after_warm": 3,
+            "live_handouts_at_teardown": 2,
+        }
+        for key, bad in red.items():
+            viols = check_slo(
+                soak_result(**{**self.GREEN, key: bad}), self.SPEC)
+            assert len(viols) == 1, (key, viols)
+
+    def test_missing_evidence_is_a_violation_not_a_pass(self):
+        """A soak whose instrumentation never produced the counter
+        must fail the gate — absence of evidence is absence of a
+        green."""
+        for key in self.GREEN:
+            counters = {k: v for k, v in self.GREEN.items() if k != key}
+            assert check_slo(soak_result(**counters), self.SPEC), key
+
+    def test_gates_default_off(self):
+        # a plain SLOSpec without soak fields ignores the counters
+        assert check_slo(soak_result(), SLOSpec()) == []
+
+
+# ----------------------------------------------------------------------
+# the composed smoke soak (tier-1: ~a second, seeded, virtual time)
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def smoke_result():
+    return run_soak(PRESETS["smoke"], seed=0, scale="smoke")
+
+
+class TestComposedSoakSmoke:
+    def test_green_with_crash_failover_and_transitions(self, smoke_result):
+        res = smoke_result
+        assert res.violations == []
+        soak = res.counters["soak"]
+        # >= 4 phase transitions including a crash AND a failover
+        assert soak["phase_transitions"] >= 4
+        phases = [p["phase"] for p in soak["phases"]]
+        assert phases == ["wave", "churn", "outage", "readiness",
+                          "crash-storm", "failover-storm"]
+        assert res.restarts >= 1 and res.promotions >= 1
+        assert soak["quota_edits"] >= 2
+
+    def test_aging_gate_green_at_run_end(self, smoke_result):
+        aging = smoke_result.counters["aging"]
+        assert aging["ok"] is True and aging["failing"] == []
+        # every wired monitor rendered a verdict
+        assert "live_handouts" in aging["verdicts"]
+
+    def test_soak_gate_counters_stamped(self, smoke_result):
+        c = smoke_result.counters
+        assert c["mid_traffic_compiles_after_warm"] == 0
+        assert c["live_handouts_at_teardown"] == 0
+        assert c["journeys"]["burn_rates"]
+
+    def test_retention_bounded_at_steady_state(self, smoke_result):
+        """ISSUE 18 satellite: every long-lived harness structure
+        reports its occupancy against an explicit cap — the memory
+        shape a multi-day run must hold."""
+        ret = smoke_result.counters["retention"]
+        for val_k, cap_k in (("cycle_routes", "cycle_routes_cap"),
+                             ("flight_ring", "flight_ring_cap"),
+                             ("event_window", "event_window_cap"),
+                             ("journeys_retained",
+                              "journeys_retained_cap")):
+            assert 0 < ret[val_k] <= ret[cap_k], (val_k, ret)
+        # the route mix stays a small keyed dict, not a per-cycle log
+        assert ret["route_mix_keys"] <= 64
+
+    def test_deterministic_per_seed(self):
+        a = run_soak(PRESETS["smoke"], seed=1, scale="smoke")
+        b = run_soak(PRESETS["smoke"], seed=1, scale="smoke")
+        assert json.dumps(a.to_dict(), sort_keys=True) == \
+            json.dumps(b.to_dict(), sort_keys=True)
+
+
+# ----------------------------------------------------------------------
+# adversary machinery (stub runner: no control-plane runs in tier 1)
+# ----------------------------------------------------------------------
+
+class StubRun:
+    def __init__(self, violations):
+        self.violations = violations
+
+
+def stub_runner(threshold=100.0):
+    """Red iff the readiness outage exceeds ``threshold`` — a planted
+    one-dimensional weakness with a known minimal repro."""
+    def run(params, seed=0, scale="stub"):
+        if params.pods_ready_outage_s > threshold:
+            return StubRun([
+                f"requeue amplification "
+                f"{3.0 + params.pods_ready_outage_s / 100:.2f} "
+                f"exceeds 3.00"])
+        return StubRun([])
+    return run
+
+
+class TestAdversary:
+    def test_mutate_seeded_and_constrained(self):
+        base = SoakParams()
+        a = adversary.mutate(base, random.Random(5))
+        b = adversary.mutate(base, random.Random(5))
+        assert a == b and a != base
+        for i in range(200):
+            m = adversary.mutate(base, random.Random(i))
+            assert m.kill_hit_hi >= m.kill_hit_lo
+            assert m.outage_end_frac > m.outage_start_frac
+            # fair-play envelope: storm work stays drainable
+            assert m.storm_per_tenant * m.storm_runtime_s <= \
+                0.5 * m.day_s * m.quota_units + 1e-6
+            for name, (lo, hi, _) in adversary.DIMENSIONS.items():
+                if name not in ("kill_hit_hi", "outage_end_frac",
+                                "storm_runtime_s"):
+                    assert lo <= getattr(m, name) <= hi, name
+
+    def test_interesting_filters_structural_artifacts(self):
+        assert adversary.interesting([
+            "composed soak never cold-restarted (crash-storm kill "
+            "mis-armed?)",
+            "requeue amplification 3.57 exceeds 3.00",
+        ]) == ["requeue amplification 3.57 exceeds 3.00"]
+
+    def test_search_finds_and_shrinks_to_minimal_repro(self):
+        """Against the stub weakness the search must find a red probe
+        and shrink it to the ONE dimension that matters, bisected to
+        just past the threshold."""
+        base = SoakParams()
+        rep = adversary.search(base, seed=3, budget=16,
+                               runner=stub_runner(threshold=100.0))
+        assert rep["findings"]
+        assert rep["probes"][0]["base"] and \
+            not rep["probes"][0]["violations"]
+        assert rep["repro"] is not None
+        _, _, mini = adversary.from_spec(rep["repro"])
+        delta = {k for k in SoakParams.__dataclass_fields__
+                 if getattr(mini, k) != getattr(base, k)}
+        assert delta == {"pods_ready_outage_s"}
+        # bisection walked it toward the threshold, not the range top
+        assert 100.0 < mini.pods_ready_outage_s < 125.0
+        assert rep["shrink"]["violations"]
+
+    def test_search_reports_red_base_without_shrink(self):
+        def always_red(params, seed=0, scale=""):
+            return StubRun(["requeue amplification 9.00 exceeds 3.00"])
+        rep = adversary.search(SoakParams(), seed=0, budget=2,
+                               runner=always_red)
+        # base itself red -> reported, and shrink targets a MUTANT
+        assert rep["probes"][0]["violations"]
+        assert rep["findings"][0]["probe"] == 0
+
+    def test_register_repro_installs_catalog_entry(self):
+        spec = adversary.to_spec("soak_repro_unit", SoakParams(), seed=0)
+        name = adversary.register_repro(spec)
+        try:
+            assert name == "soak_repro_unit"
+            assert callable(SCENARIOS[name])
+        finally:
+            del SCENARIOS[name]
+
+    def test_shape_report_feeds_the_ladder(self):
+        """Satellite: adversarial storm geometries bucket to (B, rank)
+        keys; the report is seeded-deterministic and flags only keys
+        the current preempt ladder would not precompile."""
+        rep = adversary.preempt_shape_report(SoakParams(), seed=2,
+                                             samples=64)
+        assert rep == adversary.preempt_shape_report(
+            SoakParams(), seed=2, samples=64)
+        assert rep["keys"] and rep["ladder_keys"]
+        assert set(rep["off_ladder"]) <= set(rep["keys"])
+        assert set(rep["off_ladder"]).isdisjoint(rep["ladder_keys"])
+        assert rep["suggested_rungs"] == sorted(
+            rep["off_ladder"], key=lambda k: -rep["off_ladder"][k])
+
+
+# ----------------------------------------------------------------------
+# slow tier: the multi-day schedule + the end-to-end acceptance hunt
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestSoakFull:
+    def test_full_preset_three_virtual_days_green(self):
+        res = run_soak(PRESETS["full"], seed=0, scale="full")
+        assert res.violations == []
+        soak = res.counters["soak"]
+        assert soak["days"] >= 3 and soak["day_s"] >= 86_400.0
+        assert soak["phase_transitions"] >= 4
+        assert res.restarts >= 1 and res.promotions >= 1
+        assert res.counters["aging"]["ok"] is True
+
+    def test_hunt_finds_planted_weakness_shrinks_and_replays(self):
+        """ISSUE 18 acceptance: against the weak-backoff fixture the
+        search finds a violating trace, shrinks it to a minimal seeded
+        repro, and the emitted spec replays RED standalone while the
+        same traffic shape is GREEN under the healthy backoff config —
+        the violation attributes to the planted weakness, not to the
+        weather."""
+        rep = adversary.search(adversary.weak_backoff_fixture(),
+                               seed=0, budget=12)
+        assert rep["findings"], "hunt never found the planted weakness"
+        assert rep["repro"] is not None
+        name, seed, mini = adversary.from_spec(rep["repro"])
+
+        # the minimal repro replays red standalone through the catalog
+        adversary.register_repro(rep["repro"])
+        try:
+            replay = SCENARIOS[name]()
+            assert adversary.interesting(replay.violations), \
+                "shrunk repro did not replay red"
+        finally:
+            del SCENARIOS[name]
+
+        # the same shape under the HEALTHY backoff config stays green:
+        # exponential backoff keeps the eviction laps logarithmic
+        healthy = replace(mini,
+                          pods_ready_timeout_s=SoakParams().pods_ready_timeout_s,
+                          backoff_base_s=SoakParams().backoff_base_s,
+                          backoff_max_s=SoakParams().backoff_max_s)
+        res = run_soak(healthy, seed=seed, scale="healthy")
+        assert adversary.interesting(res.violations) == [], \
+            res.violations

@@ -591,6 +591,47 @@ class TestJourneyProbe:
         assert verdict["aging_failing"] == []
 
 
+class TestSoakRun:
+    @pytest.fixture
+    def mod(self):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "soak_run", os.path.join(os.path.dirname(__file__), "..",
+                                     "tools", "soak_run.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_smoke_composed_soak_green(self, mod, capsys):
+        """Tier-1 smoke for tools/soak_run.py (chaos_run CLI contract):
+        the smoke-scale composed soak — all six phases, crash AND
+        failover included — must pass the soak gate, print the result
+        JSON line to stderr and a parseable verdict to stdout."""
+        assert mod.main(["--seed", "0", "--scale", "smoke"]) == 0
+        captured = capsys.readouterr()
+        json.loads(captured.err.strip().splitlines()[-1])  # result line
+        verdict = json.loads(captured.out.strip().splitlines()[-1])
+        assert verdict["tool"] == "soak_run"
+        assert verdict["ok"] is True
+        assert verdict["violations"] == []
+        assert verdict["restarts"] >= 1 and verdict["promotions"] >= 1
+        assert verdict["phase_transitions"] >= 4
+        assert verdict["aging_ok"] is True
+
+    def test_shapes_mode_prints_ladder_feed(self, mod, capsys):
+        """--shapes is pure shape arithmetic (no soak runs): the
+        warm-ladder feed must be parseable and carry the (B, rank)
+        bucket keys plus the current ladder's own rungs."""
+        assert mod.main(["--shapes", "--seed", "1",
+                         "--samples", "8"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["samples"] == 8
+        assert report["keys"] and all("x" in k for k in report["keys"])
+        assert report["ladder_keys"]
+        assert set(report["suggested_rungs"]) == set(report["off_ladder"])
+
+
 class TestDumper:
     def test_dump_contains_state(self, mgr):
         submit_n(mgr, 2)

@@ -1,0 +1,137 @@
+"""Soak driver: the composed multi-day schedule + the adversarial
+hunt end-to-end (ISSUE 18 tooling; see kueue_tpu/sim/SCENARIOS.md and
+RESILIENCE.md §8).
+
+Default mode runs the composed virtual-time soak (sim/soak.py) at a
+preset scale through the FULL control plane — diurnal waves -> quota
+churn -> cluster loss -> readiness storm -> crash -> mid-storm
+failover on ONE manager/DurableLog/FakeClock — and evaluates the soak
+gate: AgingWatch green at run end, zero mid-traffic compiles after
+virtual day 1, bounded journey burn rate, zero live snapshot handouts
+at teardown, plus the queueing SLOs and the harness retention caps.
+
+``--hunt N`` runs the adversarial search instead (sim/adversary.py):
+N seeded mutants of the schedule, first interesting failure shrunk to
+its minimal perturbation and emitted as a replayable scenario spec
+(``--json DIR`` writes it as ``soak_repro_s<seed>.json``). The hunt
+exits non-zero when it FOUND a violation — red means the config under
+test broke, which is what CI must surface. ``--weak`` plants the
+undersized-backoff fixture (the acceptance weakness) under the hunt.
+
+``--replay SPEC.json`` replays a repro spec standalone and gates it
+like a normal soak run — the repro corpus workflow.
+
+``--shapes`` prints the warm-ladder feed: adversarially-synthesized
+preempt-storm geometries bucketed to (B, rank) keys, with the keys the
+current preempt_shape_ladder would NOT precompile (no soak runs; pure
+shape arithmetic).
+
+Deterministic for a (params, seed) pair: virtual time only, seeded
+traces, seeded kill points, seeded mutation draws. Prints one JSON
+line per run to stderr plus a final verdict line on stdout
+(chaos_run.py's contract); exits non-zero on red.
+
+Usage:
+  python tools/soak_run.py [--seed N] [--scale smoke|full] [--json DIR]
+                           [--hunt BUDGET] [--weak] [--shapes]
+                           [--samples N] [--replay SPEC.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kueue_tpu.sim import adversary  # noqa: E402
+from kueue_tpu.sim.soak import PRESETS, run_soak  # noqa: E402
+
+
+def _verdict(res, seed: int, scale: str) -> dict:
+    soak = res.counters.get("soak", {})
+    return {
+        "tool": "soak_run", "seed": seed, "scale": scale, "ok": res.ok,
+        "days": soak.get("days"), "cycles": res.cycles,
+        "phase_transitions": soak.get("phase_transitions"),
+        "submitted": res.submitted, "admitted": res.admitted,
+        "restarts": res.restarts, "promotions": res.promotions,
+        "aging_ok": res.counters.get("aging", {}).get("ok"),
+        "violations": list(res.violations),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Composed virtual-time soak + adversarial traffic hunt")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--scale", choices=sorted(PRESETS), default="smoke")
+    ap.add_argument("--json", metavar="DIR", default=None,
+                    help="write result/repro JSON artifacts")
+    ap.add_argument("--hunt", type=int, metavar="BUDGET", default=None,
+                    help="adversarial search with BUDGET mutant probes")
+    ap.add_argument("--weak", action="store_true",
+                    help="plant the weak-backoff fixture under the hunt "
+                         "(the acceptance weakness)")
+    ap.add_argument("--shapes", action="store_true",
+                    help="print the preempt-storm (B, rank) ladder feed "
+                         "and exit")
+    ap.add_argument("--samples", type=int, default=64,
+                    help="--shapes: geometries to synthesize")
+    ap.add_argument("--replay", metavar="SPEC.json", default=None,
+                    help="replay a shrunk repro spec standalone")
+    args = ap.parse_args(argv)
+    if args.json:
+        os.makedirs(args.json, exist_ok=True)
+
+    base = PRESETS[args.scale]
+    if args.weak:
+        base = adversary.weak_backoff_fixture(base)
+
+    if args.shapes:
+        print(json.dumps(adversary.preempt_shape_report(
+            base, seed=args.seed, samples=args.samples), indent=2))
+        return 0
+
+    if args.replay:
+        with open(args.replay) as f:
+            spec = json.load(f)
+        name, seed, params = adversary.from_spec(spec)
+        res = run_soak(params, seed=seed, scale=name)
+        print(json.dumps(res.to_dict()), file=sys.stderr)
+        print(json.dumps(_verdict(res, seed, name)))
+        return 0 if res.ok else 1
+
+    if args.hunt is not None:
+        rep = adversary.search(base, seed=args.seed, budget=args.hunt,
+                               scale=args.scale)
+        for probe in rep["probes"]:
+            print(json.dumps(probe), file=sys.stderr)
+        found = bool(rep["findings"])
+        if rep["repro"] and args.json:
+            path = os.path.join(args.json,
+                                rep["repro"]["scenario"] + ".json")
+            with open(path, "w") as f:
+                json.dump(rep["repro"], f, indent=2, sort_keys=True)
+        print(json.dumps({
+            "tool": "soak_run", "mode": "hunt", "seed": args.seed,
+            "scale": args.scale, "weak": args.weak,
+            "budget": args.hunt, "evals": rep["evals"],
+            # red == the hunt FOUND a gate violation
+            "ok": not found, "findings": len(rep["findings"]),
+            "shrink": rep["shrink"], "repro": rep["repro"],
+        }))
+        return 1 if found else 0
+
+    res = run_soak(base, seed=args.seed, scale=args.scale)
+    print(json.dumps(res.to_dict()), file=sys.stderr)
+    if args.json:
+        with open(os.path.join(args.json, "soak.json"), "w") as f:
+            json.dump(res.to_dict(), f, indent=2, sort_keys=True)
+    print(json.dumps(_verdict(res, args.seed, args.scale)))
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2618,6 +2618,117 @@ def bench_multihost():
     return row
 
 
+def bench_sharded_admission(num_cqs=256, num_cohorts=64, backlog_waves=8,
+                            layouts=(1, 2, 4, 8), budget_s=420.0):
+    """ISSUE 20 row: the sharded admission control plane
+    (parallel/shards.py, RESILIENCE.md §9) at storm scale — one shared
+    watch/store plane, N leased admission shards draining a pre-loaded
+    backlog, admitted/sec per 1/2/4/8-shard layout.
+
+    Target scenario: 1M pending workloads x 16k CQs, shards as separate
+    processes. This harness simulates shards as sequential scheduler
+    instances inside ONE interpreter (the simulated-process stance the
+    crash/failover benches share), so the admitted/sec SCALING gate
+    over layouts is physically unwitnessable here — shards contend for
+    the same core the plane runs on — and is REFUSED into the
+    device-witness-debt manifest; the per-layout curve, the planner's
+    layout balance and the exactly-once cross-checks are judged on
+    every backend (a double admission or a lost workload fails the
+    bench regardless of where it runs)."""
+    from kueue_tpu.api.meta import FakeClock
+    from kueue_tpu.core import workload as wlpkg
+    from kueue_tpu.parallel.shards import ShardedControlPlane
+    from kueue_tpu.perf.checker import record_refusal
+    from kueue_tpu.sim.scenarios import _usage_consistent
+
+    row = {
+        "bench": "sharded_admission",
+        "target_scenario": {"pending": 1_000_000, "cqs": 16_384,
+                            "shards": list(layouts),
+                            "deployment": "process-per-shard"},
+        "harness": {"cqs": num_cqs, "cohorts": num_cohorts,
+                    "backlog": num_cqs * backlog_waves},
+    }
+    total = num_cqs * backlog_waves
+    curve = []
+    t_start = time.perf_counter()
+    for n_shards in layouts:
+        clock = FakeClock(1000.0)
+        scp = ShardedControlPlane(n_shards, clock=clock,
+                                  checkpoint_every=4096)
+        for obj in ([make_flavor("f0")]
+                    + [make_cq(f"cq{i}", f"cohort-{i % num_cohorts}",
+                               ["f0"], nominal_units=10 * backlog_waves)
+                       for i in range(num_cqs)]
+                    + [make_lq(f"lq{i}", f"cq{i}")
+                       for i in range(num_cqs)]):
+            scp.plane.store.create(obj)
+        scp.plane.run_until_idle(max_iterations=10_000_000)
+        n = 0
+        for wave in range(backlog_waves):
+            for i in range(num_cqs):
+                scp.plane.store.create(make_workload(
+                    f"s{n_shards}-w{n}", f"lq{i}", cpu_units=1,
+                    creation=float(n)))
+                n += 1
+        scp.plane.run_until_idle(max_iterations=10_000_000)
+        scp.replan()
+
+        def admitted():
+            return sum(1 for wl in scp.plane.store.list(
+                "Workload", copy_objects=False)
+                if wlpkg.has_quota_reservation(wl))
+
+        cycles = 0
+        t0 = time.perf_counter()
+        while admitted() < total:
+            scp.cycle()
+            clock.advance(1.0)
+            scp.renew_leases()
+            cycles += 1
+            if time.perf_counter() - t_start > budget_s:
+                break
+        wall = time.perf_counter() - t0
+        got = admitted()
+        assert got == total, \
+            f"{n_shards}-shard layout stranded {total - got}/{total}"
+        ok, msg = _usage_consistent(scp.plane)
+        assert ok, f"{n_shards}-shard exactly-once cross-check: {msg}"
+        shard_sum = sum(s.admitted_total for s in scp.shards)
+        assert shard_sum == total, \
+            f"shard counters {shard_sum} != store {total} (double count)"
+        curve.append({
+            "shards": n_shards,
+            "admitted": got,
+            "cycles": cycles,
+            "wall_s": round(wall, 3),
+            "admitted_per_sec": round(got / max(wall, 1e-9), 1),
+            "plan_imbalance": round(scp.plan.imbalance, 3),
+            "units": len(scp.plan.units),
+        })
+        scp.shutdown()
+        assert scp.plane.cache.live_handouts == 0
+    row["curve"] = curve
+    base = curve[0]["admitted_per_sec"]
+    row["scaling_x"] = {str(c["shards"]):
+                        round(c["admitted_per_sec"] / max(base, 1e-9), 3)
+                        for c in curve}
+    # the planner's balance IS judged here: every layout must spread
+    # cohort units within the LPT bound
+    assert all(c["plan_imbalance"] <= 1.5 for c in curve), \
+        f"planner imbalance out of bound: {row['scaling_x']}"
+    note = ("admitted/sec scaling over shard layouts requires a "
+            "process-per-shard deployment; this harness drives shards "
+            "sequentially inside one interpreter (simulated-process "
+            f"stance, backend={BACKEND.get('backend')}), so layout "
+            "scaling is physically unwitnessable here")
+    record_refusal("bench.sharded_admission", "admitted_per_sec_scaling",
+                   note, spec_backend="multiprocess")
+    row["scaling_gate"] = {"refused": note}
+    log(row)
+    return row
+
+
 def main():
     import jax
     from kueue_tpu.perf import checker as checkerpkg
@@ -2640,6 +2751,7 @@ def main():
     bench_restart_recovery()
     bench_failover_recovery()
     bench_multihost()
+    bench_sharded_admission()
     hit_rate = bench_speculative_pipeline()
     rows = {}
     admitted_per_sec, speedup = bench_e2e_progressive()

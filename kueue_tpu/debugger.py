@@ -51,9 +51,27 @@ class Dumper:
         from kueue_tpu.obs import (arena_status, breaker_status,
                                    degrade_status, pipeline_status,
                                    recovery_status, router_status,
-                                   warmup_status)
+                                   shards_status, warmup_status)
         sched = self.scheduler
         lines = []
+        sh = shards_status(sched)
+        if sh.get("attached"):
+            lines.append("-- shards --")
+            plan = sh["plan"]
+            lines.append(f"n_shards={sh['n_shards']} "
+                         f"plan={plan['fingerprint']} "
+                         f"units={plan['units']} "
+                         f"imbalance={plan['imbalance']} "
+                         f"loads={plan['loads']} "
+                         f"rebalances={sh['rebalances']}")
+            for s in sh["shards"]:
+                lines.append(f"  {s['shard']}: state={s['state']} "
+                             f"epoch={s['epoch']} "
+                             f"cqs={len(s['cluster_queues'])} "
+                             f"backlog={s['pending_backlog']} "
+                             f"cycles={s['cycles']} "
+                             f"admitted={s['admitted_total']} "
+                             f"promotions={s['promotions']}")
         rc = recovery_status(sched)
         if rc["restored"]:
             lines.append("-- recovery --")

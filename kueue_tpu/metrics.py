@@ -431,6 +431,23 @@ class Registry:
             "kueue_reconcile_event_seconds",
             "Reconcile latency by controller and handled event",
             ["controller", "event"], buckets=_PHASE_BUCKETS)
+        # Sharded admission control plane (parallel/shards.py +
+        # RESILIENCE.md §9): per-shard lifecycle state, planner-driven
+        # cohort moves, and per-shard admission throughput.
+        self.shard_state = Gauge(
+            "kueue_shard_state",
+            "Admission shard lifecycle state "
+            "(0=active 1=killed 2=fenced)", ["shard"])
+        self.shard_rebalances_total = Counter(
+            "kueue_shard_rebalances_total",
+            "Planner-driven cohort moves between admission shards")
+        self.shard_admitted_total = Counter(
+            "kueue_shard_admitted_total",
+            "Workloads admitted, by owning admission shard", ["shard"])
+        self.shard_promotions_total = Counter(
+            "kueue_shard_promotions_total",
+            "Hot-promotions of a replacement shard over a killed or "
+            "fenced one", ["shard"])
         self._all = [v for v in vars(self).values() if isinstance(v, _Metric)]
 
     # --- report helpers (reference: metrics.go:262-400) ---
@@ -513,6 +530,23 @@ class Registry:
 
     def set_fencing_epoch(self, epoch: int) -> None:
         self.fencing_epoch_gauge.set(epoch)
+
+    def set_shard_state(self, shard: str, state: str) -> None:
+        # The shard module owns the encoding (like the ladder/governor
+        # patterns above); lazy import keeps metrics free of the
+        # manager-assembly import chain shards.py pulls in.
+        from kueue_tpu.parallel.shards import SHARD_STATE_CODES
+        self.shard_state.set(SHARD_STATE_CODES.get(state, -1), shard=shard)
+
+    def shard_admitted(self, shard: str, n: int) -> None:
+        if n:
+            self.shard_admitted_total.inc(n, shard=shard)
+
+    def shard_rebalanced(self) -> None:
+        self.shard_rebalances_total.inc()
+
+    def shard_promoted(self, shard: str) -> None:
+        self.shard_promotions_total.inc(shard=shard)
 
     def replica_promoted(self, epoch: int, seconds: float) -> None:
         self.promotions_total.inc()

@@ -19,6 +19,7 @@ from kueue_tpu.obs.status import (
     queryplane_status,
     recovery_status,
     router_status,
+    shards_status,
     warmup_status,
 )
 from kueue_tpu.obs.trend import AgingWatch, TrendMonitor
@@ -43,5 +44,6 @@ __all__ = [
     "queryplane_status",
     "recovery_status",
     "router_status",
+    "shards_status",
     "warmup_status",
 ]

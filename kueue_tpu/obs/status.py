@@ -191,6 +191,22 @@ def aging_status(scheduler) -> dict:
     return st
 
 
+def shards_status(scheduler) -> dict:
+    """Sharded-control-plane layout (/debug/shards): the live shard
+    plan (fingerprint, unit->shard bins, load imbalance), rebalance
+    count, and per-shard state/epoch/backlog/admission counters — the
+    SAME producer tools/shard_probe.py and the SIGUSR2 dumper read, so
+    every consumer shows the same numbers (RESILIENCE.md §9). The
+    plane wires its status() onto the scheduler it fronts; ``attached``
+    False = this process runs a single unsharded manager."""
+    prod = getattr(scheduler, "shards_status", None)
+    if prod is None:
+        return {"attached": False}
+    st = prod()
+    st["attached"] = True
+    return st
+
+
 def arena_status(solver) -> dict:
     """Encode-arena slot occupancy and churn counters."""
     arena = getattr(solver, "_arena", None)
@@ -260,6 +276,8 @@ class DebugEndpoints:
             return self._journeys(params)
         if path == "/debug/aging":
             return aging_status(self.scheduler)
+        if path == "/debug/shards":
+            return shards_status(self.scheduler)
         if path == "/debug/arena":
             if self.scheduler.solver is None:
                 return {"bound": False}

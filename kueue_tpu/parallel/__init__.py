@@ -5,4 +5,9 @@ conflict-domain planner, and the cohort-parallel sharded solve
 ``domains`` is import-light (numpy only) — the planner is usable from
 host-side tooling without initializing a jax backend; ``mesh`` pulls in
 jax on first import.
+
+``shards`` promotes the SAME planner decision to control-plane layout
+(RESILIENCE.md §9): N leased admission shards over one shared
+watch/store plane, each owning a planner-assigned set of cohort
+subtrees, fenced per-shard through the durable log's named leases.
 """

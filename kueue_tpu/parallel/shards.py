@@ -1,0 +1,587 @@
+"""Sharded admission control plane (RESILIENCE.md §9, ROADMAP item 1).
+
+The reference Kueue is single-scheduler by design (SURVEY.md L4): one
+process owns every queue heap, reconciler and apply loop — both the
+throughput wall at the 1M×16k target shape and a single fault domain.
+This module composes the safety pieces earlier PRs built (per-lease
+fencing epochs, exactly-once store admission records, durable-log
+arbitration) into N **admission shards** over one shared watch/store
+plane:
+
+- The **plane** is a stock ``KueueManager`` owning the store, the
+  durable log, every controller/webhook, the queue heaps and the cache
+  — all watch-driven state, maintained exactly once. Its own scheduler
+  never admits (leader gate pinned closed); it exists for the shared
+  wiring (client, flight recorder, metrics).
+- Each **shard** is a leased ``Scheduler`` instance with its OWN
+  speculative pipeline, degradation ladder, breaker/watchdog and (when
+  a solver is attached) arena + compile governor, popping ONLY the CQs
+  the planner assigns it (``Scheduler.cq_filter`` →
+  ``queue.Manager.heads``). Shards coordinate exclusively through the
+  durable log: each holds a named-lease ``FencingToken``
+  (``shard-<i>``), swapped into ``Store.fencing`` for the duration of
+  its cycle, so every admission write a shard commits is epoch-checked
+  under the log lock — a deposed or zombie shard can never author an
+  admission record, and the store's admission records keep cross-shard
+  admission exactly-once.
+- The **layout** is the planner's (ROADMAP invariant: exactly ONE
+  layout decision): ``plan_shards`` rides ``domains.balanced_partition``
+  — the same deterministic LPT that places conflict-domain columns on
+  devices — over whole cohort subtrees (a preemption victim always
+  lives in the preemptor's cohort tree, so whole-cohort assignment
+  keeps every victim inside the owning shard's write set). Cohortless
+  CQs are their own unit. Cross-shard capacity for future shared
+  cohorts scores through the PR-13 cluster-column mechanism the way
+  remote clusters already do.
+
+Fault protocol (proven by tools/shard_probe.py, tools/crash_run.py's
+shard sweep and the ``shard_rebalance``/``shard_storm`` scenarios):
+
+- **kill**: an ``InjectedCrash`` mid-cycle (the shard's own faultinject
+  scope — co-resident shards' scripted schedules are isolated) leaves
+  the shard ``killed``: its in-memory pipeline state is discarded like
+  a real process death; the shared store/queues/cache are the OTHER
+  fault domain and stay live, so surviving shards keep admitting their
+  cohorts through the same heaps.
+- **promote**: a replacement acquires the shard's named lease under a
+  fresh identity — the epoch bump fences the dead shard's zombie
+  writes — and a fresh ``Scheduler`` adopts the cohort set with the
+  restore() posture (first cycle pinned synchronous). Because the
+  plane's watch-driven state never died, promotion is sub-cycle by
+  construction: no replay, no rebuild.
+- **rebalance**: the planner moves a unit between shards under
+  traffic: fence the old owner (epoch bump — its in-flight speculation
+  can no longer commit), drain (abandon its pipeline; heads re-heap),
+  reassign the layout, and the new owner admits on its next cycle.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import uuid
+from dataclasses import dataclass
+from typing import Optional
+
+from kueue_tpu.api.meta import REAL_CLOCK, Clock
+from kueue_tpu.parallel.domains import balanced_partition, imbalance_ratio
+from kueue_tpu.resilience import faultinject
+from kueue_tpu.resilience.replica import FencingToken
+from kueue_tpu.utils import vlog
+
+# Shard lifecycle states (the shard_state{shard} gauge encoding).
+SHARD_ACTIVE = "active"
+SHARD_KILLED = "killed"   # crashed mid-cycle; awaiting promotion
+SHARD_FENCED = "fenced"   # deposed by a newer epoch (zombie)
+SHARD_STATE_CODES = {SHARD_ACTIVE: 0, SHARD_KILLED: 1, SHARD_FENCED: 2}
+
+DEFAULT_SHARD_LEASE_S = 15.0
+
+
+# --- the ONE control-plane layout decision ---------------------------------
+
+
+def shard_units(cache) -> dict:
+    """cq name -> assignment-unit name. The unit is the ROOT cohort
+    (whole subtrees move together — preemption victims always live in
+    the preemptor's cohort tree, so whole-unit ownership keeps every
+    victim inside the owning shard's write set); a cohortless CQ is its
+    own unit."""
+    units = {}
+    for name, cqc in cache.hm.cluster_queues.items():
+        c = getattr(cqc, "cohort", None)
+        units[name] = f"cohort:{c.root().name}" if c is not None \
+            else f"cq:{name}"
+    return units
+
+
+@dataclass(frozen=True)
+class ShardPlan:
+    """Unit -> shard layout. Deterministic (LPT with stable
+    tie-breaks) and fingerprinted the way ``DomainPlan`` is (blake2b
+    over the assignment bytes, never ``hash()``), so two processes
+    planning from the same topology agree bit-for-bit — the property
+    that lets the plan BE the ownership contract."""
+
+    n_shards: int
+    units: tuple          # unit names, sorted
+    shard_of_unit: dict   # unit name -> shard index
+    cq_shard: dict        # cq name -> shard index
+    loads: tuple          # per-shard weighted load
+    imbalance: float
+    fingerprint: str
+
+    def cqs_of(self, shard: int) -> tuple:
+        return tuple(sorted(c for c, s in self.cq_shard.items()
+                            if s == shard))
+
+    def units_of(self, shard: int) -> tuple:
+        return tuple(u for u in self.units
+                     if self.shard_of_unit[u] == shard)
+
+
+def _plan_fingerprint(n_shards: int, units: tuple, bins) -> str:
+    h = hashlib.blake2b(digest_size=8)
+    h.update(str(n_shards).encode())
+    for u, b in zip(units, bins):
+        h.update(u.encode())
+        h.update(int(b).to_bytes(4, "little"))
+    return h.hexdigest()
+
+
+def plan_shards(cq_units: dict, weights: Optional[dict],
+                n_shards: int) -> ShardPlan:
+    """Cost-balanced unit -> shard layout over ``cq_units`` (from
+    ``shard_units``). ``weights`` maps cq name -> load (pending count,
+    flavor width — any monotone cost proxy; default 1 per CQ); a
+    unit's weight is the sum over its member CQs, floored at 1 so an
+    idle cohort still gets an owner. Rides
+    ``domains.balanced_partition`` — the ROADMAP invariant that device
+    layout and control-plane layout are the same planner decision."""
+    n_shards = max(int(n_shards), 1)
+    unit_w: dict = {}
+    for cq, unit in cq_units.items():
+        w = (weights or {}).get(cq, 1)
+        unit_w[unit] = unit_w.get(unit, 0) + max(int(w), 0)
+    units = tuple(sorted(unit_w))
+    wvec = [max(unit_w[u], 1) for u in units]
+    bin_of, loads = balanced_partition(wvec, n_shards)
+    shard_of_unit = {u: int(b) for u, b in zip(units, bin_of)}
+    cq_shard = {cq: shard_of_unit[unit] for cq, unit in cq_units.items()}
+    return ShardPlan(
+        n_shards=n_shards, units=units, shard_of_unit=shard_of_unit,
+        cq_shard=cq_shard, loads=tuple(int(x) for x in loads),
+        imbalance=imbalance_ratio(loads),
+        fingerprint=_plan_fingerprint(n_shards, units, bin_of))
+
+
+# --- shards ----------------------------------------------------------------
+
+
+class AdmissionShard:
+    """One leased scheduler instance over the shared plane. Holds its
+    fencing token (named lease ``shard-<i>``), its lifecycle state and
+    the admitted-counter watermark the per-shard metric feeds from."""
+
+    def __init__(self, index: int, scheduler, token: FencingToken):
+        self.index = index
+        self.name = f"shard-{index}"
+        self.scheduler = scheduler
+        self.token = token
+        self.state = SHARD_ACTIVE
+        self.cycles = 0
+        # Admissions by PRIOR incarnations of this slot: promote_shard
+        # freezes the dead scheduler's count in here so admitted_total
+        # is cumulative per shard slot, not per scheduler object.
+        self.admitted_at_death = 0
+        self.promotions = 0
+
+    @property
+    def epoch(self) -> int:
+        return self.token.epoch
+
+    @property
+    def admitted_total(self) -> int:
+        return self.admitted_at_death + self.scheduler.admitted_total
+
+    def status(self, plan: ShardPlan, queues=None) -> dict:
+        """The single producer /debug/shards, the SIGUSR2 dumper and
+        tools/shard_probe.py share (the status-producer contract,
+        obs/status.py)."""
+        cqs = plan.cqs_of(self.index)
+        pending = None
+        if queues is not None:
+            pending = sum(queues.pending(c) for c in cqs)
+        return {
+            "shard": self.name,
+            "state": self.state,
+            "epoch": self.epoch,
+            "identity": self.token.identity,
+            "lease": self.token.log.lease_status(name=self.name),
+            "units": list(plan.units_of(self.index)),
+            "cluster_queues": list(cqs),
+            "pending_backlog": pending,
+            "cycles": self.cycles,
+            "admitted_total": self.admitted_total,
+            "promotions": self.promotions,
+        }
+
+
+class ShardedControlPlane:
+    """N admission shards over one shared watch/store plane. Drive it
+    deterministically: ``cycle()`` runs every active shard's admission
+    cycle once (round-robin, each under its own fencing token and
+    faultinject scope) and settles the plane's reconcilers.
+
+    Concurrency note: shards simulate separate processes inside one
+    interpreter (the same stance as the multihost mesh harness), so
+    cycles run sequentially and the ``Store.fencing`` swap per cycle
+    is single-threaded by construction. The safety story does NOT rely
+    on that: every fence is re-checked under the durable log's own
+    lock at append time."""
+
+    def __init__(self, n_shards: int, cfg=None, clock: Clock = REAL_CLOCK,
+                 solver=None, durable=None, checkpoint_every: int = 256,
+                 lease_duration: float = DEFAULT_SHARD_LEASE_S,
+                 weights: Optional[dict] = None):
+        from kueue_tpu.manager import KueueManager
+        from kueue_tpu.sim import Store
+        from kueue_tpu.sim.durable import DurableLog
+
+        self.clock = clock
+        self.lease_duration = lease_duration
+        self.durable = durable if durable is not None else DurableLog(
+            checkpoint_every=checkpoint_every)
+        store = Store(clock)
+        store.attach_durable(self.durable)
+        self.plane = KueueManager(cfg=cfg, clock=clock, solver=solver,
+                                  store=store)
+        self.plane.durable = self.durable
+        # The plane's own scheduler NEVER admits — the shards do. Pin
+        # its leader gate closed (same mechanism the hot standby uses).
+        self.plane.scheduler.leader_check = lambda: False
+        self.metrics = self.plane.metrics
+        self.log = vlog.logger("shards")
+        self.n_shards = max(int(n_shards), 1)
+        self.rebalances = 0
+        self.plan = ShardPlan(n_shards=self.n_shards, units=(),
+                              shard_of_unit={}, cq_shard={}, loads=(),
+                              imbalance=1.0, fingerprint="")
+        self.shards: list = []
+        for i in range(self.n_shards):
+            self.shards.append(self._build_shard(i))
+        # Shard status on the plane's debug surface: /debug/shards and
+        # the SIGUSR2 dumper read this one producer (obs/status.py).
+        self.plane.scheduler.shards_status = self.status
+
+    # -- construction ---------------------------------------------------
+
+    def _new_scheduler(self):
+        """A shard's scheduler over the SHARED queues/cache/client —
+        the manager's construction recipe, minus the solver plumbing
+        (shards share the plane's flight recorder and metrics; each
+        gets its own pipeline/ladder/breaker state by construction)."""
+        from kueue_tpu.scheduler.scheduler import Scheduler
+        p = self.plane
+        sched = Scheduler(
+            p.queues, p.cache, p.scheduler_client,
+            ordering=p.scheduler.ordering,
+            fair_sharing_enabled=p.cfg.fair_sharing.enable,
+            fs_preemption_strategies=(
+                p.cfg.fair_sharing.preemption_strategies),
+            clock=self.clock, metrics=p.metrics,
+            solver_min_heads=p.cfg.solver.min_heads,
+            recorder=p.flight_recorder)
+        sched.journeys = p.journey_ledger
+        return sched
+
+    def _lease_shard(self, index: int) -> FencingToken:
+        """Acquire shard ``index``'s named lease under a FRESH identity
+        — every (re)lease bumps the epoch, which is exactly the fence:
+        the previous holder's in-flight writes die at the log."""
+        name = f"shard-{index}"
+        identity = f"{name}-{uuid.uuid4().hex[:8]}"
+        epoch = self.durable.acquire_lease(
+            identity, now=self.clock.now(),
+            duration=self.lease_duration, force=True, name=name)
+        return FencingToken(self.durable, identity, epoch, name=name)
+
+    def _build_shard(self, index: int) -> AdmissionShard:
+        sched = self._new_scheduler()
+        token = self._lease_shard(index)
+        sched.fencing_check = token.valid
+        sched.leader_check = token.valid
+        sched.cq_filter = self._cq_filter(index)
+        shard = AdmissionShard(index, sched, token)
+        if self.metrics is not None:
+            self.metrics.set_shard_state(shard.name, shard.state)
+        return shard
+
+    def _cq_filter(self, index: int):
+        def owns(cq_name: str, _i=index) -> bool:
+            # Unmapped CQs (created after the last replan) default to
+            # shard 0 so no head is ever orphaned between replans.
+            return self.plan.cq_shard.get(cq_name, 0) == _i
+        return owns
+
+    # -- layout ---------------------------------------------------------
+
+    def replan(self, weights: Optional[dict] = None) -> ShardPlan:
+        """(Re)compute the unit -> shard layout from the live cache
+        topology. Call after seeding CQs, and at any topology change
+        big enough to matter — between cycles, never during one."""
+        units = shard_units(self.plane.cache)
+        if weights is None:
+            weights = {cq: max(self.plane.queues.pending(cq), 1)
+                       for cq in units}
+        self.plan = plan_shards(units, weights, self.n_shards)
+        return self.plan
+
+    # -- driving --------------------------------------------------------
+
+    @property
+    def store(self):
+        return self.plane.store
+
+    def renew_leases(self) -> None:
+        """Renew every ACTIVE shard's lease at the current clock —
+        the harness's heartbeat. A dead shard's lease is deliberately
+        left to expire (or be force-taken at promotion)."""
+        now = self.clock.now()
+        for shard in self.shards:
+            if shard.state == SHARD_ACTIVE:
+                shard.token.renew(now)
+
+    def shard_cycle(self, index: int, timeout: Optional[float] = 0):
+        """One admission cycle of shard ``index``, under its fencing
+        token and its own faultinject scope. An ``InjectedCrash``
+        marks the shard killed (its in-memory state is dead — exactly
+        a process death) and re-raises nothing: the shared plane is
+        the surviving fault domain."""
+        from kueue_tpu.resilience.faultinject import InjectedCrash
+        shard = self.shards[index]
+        if shard.state != SHARD_ACTIVE:
+            return None
+        store = self.plane.store
+        prev = store.fencing
+        store.fencing = shard.token
+        try:
+            with faultinject.scope(shard.name):
+                sig = shard.scheduler.schedule(timeout=timeout)
+            shard.cycles += 1
+            return sig
+        except InjectedCrash:
+            self._mark_dead(shard, SHARD_KILLED)
+            return None
+        finally:
+            store.fencing = prev
+            if self.metrics is not None:
+                self.metrics.shard_admitted(
+                    shard.name,
+                    shard.scheduler.admitted_total
+                    - getattr(shard, "_metric_mark", 0))
+                shard._metric_mark = shard.scheduler.admitted_total
+
+    def cycle(self, settle: bool = True) -> dict:
+        """One round-robin pass: every ACTIVE shard runs one admission
+        cycle; the plane's reconcilers settle between shards so each
+        shard sees the previous one's committed writes (the same
+        ordering a real apiserver's watch stream gives co-resident
+        schedulers). Returns {shard name: signal-or-None}."""
+        out = {}
+        for shard in list(self.shards):
+            if settle:
+                self.plane.run_until_idle()
+            out[shard.name] = self.shard_cycle(shard.index)
+        if settle:
+            self.plane.run_until_idle()
+        return out
+
+    # -- fault protocol -------------------------------------------------
+
+    def _mark_dead(self, shard: AdmissionShard, state: str) -> None:
+        # The watermark is NOT advanced here: the dead scheduler stays
+        # attached, so admitted_total still reads base + its count.
+        # Only promote_shard freezes the dead incarnation into the base.
+        shard.state = state
+        self.log.v(1, "shards.dead", shard=shard.name, state=state,
+                   epoch=shard.epoch)
+        if self.metrics is not None:
+            self.metrics.set_shard_state(shard.name, state)
+
+    def kill_shard(self, index: int) -> None:
+        """Simulate shard process death between cycles (mid-cycle
+        deaths arrive as InjectedCrash through shard_cycle). The dead
+        scheduler's in-flight speculation is NOT drained — a real
+        SIGKILL drains nothing; un-popped heads simply stay heaped and
+        popped-but-uncommitted heads re-heap at promotion."""
+        shard = self.shards[index]
+        if shard.state == SHARD_ACTIVE:
+            self._mark_dead(shard, SHARD_KILLED)
+
+    def promote_shard(self, index: int) -> AdmissionShard:
+        """Hot-promote a replacement over shard ``index``: bump the
+        named lease's epoch under a fresh identity (fencing the dead
+        holder's zombie writes FIRST — the promotion ordering argument
+        from RESILIENCE.md §7), then adopt the cohort set with a fresh
+        scheduler in the restore() posture (first cycle pinned
+        synchronous, breaker/ladder at their fresh rungs). Sub-cycle
+        by construction: the plane's watch-driven state never died.
+
+        The dead scheduler's abandoned pipeline state is reconciled
+        here: heads it popped but never committed re-heap (requeue by
+        key), so no workload is stranded."""
+        old = self.shards[index]
+        prior_cycles = old.cycles
+        prior_admitted = old.admitted_total
+        promotions = old.promotions + 1
+        # Requeue anything the dead shard popped and never committed.
+        # Its scheduler object is our window into the dead process's
+        # final memory — the harness's stand-in for "the workloads the
+        # store still says are pending".
+        try:
+            if old.scheduler._inflight_q or old.scheduler._inflight:
+                old.scheduler._abandon_pipeline()
+        except Exception:  # noqa: BLE001 — dead state may be torn
+            pass
+        # Release any snapshot handout the dead cycle still held (a
+        # crash between take and retire): the shared cache's handout
+        # ledger survives the shard, the aborted frame's local doesn't.
+        try:
+            old.scheduler._flush_seal_snapshot()
+        except Exception:  # noqa: BLE001
+            pass
+        shard = self._build_shard(index)
+        shard.cycles = prior_cycles
+        shard.admitted_at_death = prior_admitted
+        shard.promotions = promotions
+        # Takeover posture: never a speculative first cycle over state
+        # another holder touched (mirrors StandbyReplica.promote()).
+        shard.scheduler._pipeline_cooldown = max(
+            shard.scheduler._pipeline_cooldown, 1)
+        self.shards[index] = shard
+        self._resync_shard(index)
+        self.log.v(1, "shards.promoted", shard=shard.name,
+                   epoch=shard.epoch, promotions=promotions)
+        if self.metrics is not None:
+            self.metrics.shard_promoted(shard.name)
+            self.metrics.set_shard_state(shard.name, shard.state)
+        return shard
+
+    def _resync_shard(self, index: int) -> None:
+        """Store-driven repair after a shard death. The whole-plane
+        restore path rebuilds queues/cache wholesale from the WAL; here
+        the plane SURVIVES the shard, so only the dead scheduler's torn
+        mid-cycle residue needs reconciling against the store — the
+        durable admission records are the arbiter either way:
+
+        - an ASSUMED cache entry with no durable admission is the
+          ``apply_commit`` tear (cache counted it, the store write
+          never happened): forget it — the store still says pending;
+        - a pending store workload absent from its CQ heap is a
+          popped-but-uncommitted head the dead cycle took with it:
+          requeue it, so nothing is stranded.
+
+        Scoped to the whole plane, not just the promoted shard's CQs:
+        the repair is idempotent (requeue_if_not_present, forget only
+        on divergence) and a rebalance may have moved units since the
+        death."""
+        from kueue_tpu.core import workload as wlpkg
+        from kueue_tpu.queue.cluster_queue import RequeueReason
+
+        store = self.plane.store
+        cache = self.plane.cache
+        queues = self.plane.queues
+        for key, _cq in list(cache.assumed_workloads.items()):
+            ns, _, name = key.partition("/")
+            wl = store.try_get("Workload", ns, name)
+            if wl is None or not wlpkg.has_quota_reservation(wl):
+                cached = cache.hm.cluster_queues.get(_cq)
+                stale = (cached.workloads.get(key).obj
+                         if cached is not None
+                         and key in cached.workloads else None)
+                target = stale if stale is not None else wl
+                if target is not None:
+                    cache.forget_workload(target)
+        for wl in store.list("Workload", copy_objects=False):
+            if wlpkg.has_quota_reservation(wl) or not wlpkg.is_active(wl):
+                continue
+            info = wlpkg.Info(wl)
+            info.cluster_queue = queues.cluster_queue_for_workload(wl)
+            if info.cluster_queue is None:
+                continue
+            queues.requeue_workload(
+                info, RequeueReason.FAILED_AFTER_NOMINATION)
+
+    def rebalance(self, unit: str, to_shard: int) -> dict:
+        """Planner-driven unit move under traffic. Protocol (the
+        §9 rebalance contract, gated by the shard_rebalance scenario):
+        (1) FENCE the old owner — re-lease its slot at a bumped epoch,
+        so its in-flight speculative cycle can no longer commit stale
+        admissions for the moved cohort; (2) DRAIN — the old owner's
+        pipeline abandons (heads re-heap; nothing is lost because
+        nothing uncommitted is kept); (3) REASSIGN the layout; (4) the
+        new owner admits on its next cycle. Returns a small report."""
+        if unit not in self.plan.shard_of_unit:
+            raise ValueError(f"unknown unit {unit!r}")
+        to_shard = int(to_shard)
+        if not 0 <= to_shard < self.n_shards:
+            raise ValueError(f"shard {to_shard} out of range")
+        from_shard = self.plan.shard_of_unit[unit]
+        if from_shard == to_shard:
+            return {"unit": unit, "from": from_shard, "to": to_shard,
+                    "moved": False}
+        old = self.shards[from_shard]
+        # (1) fence + (2) drain the old owner, then re-arm it as the
+        # same shard at the new epoch (it keeps its other units).
+        if old.state == SHARD_ACTIVE:
+            try:
+                if old.scheduler._inflight_q or old.scheduler._inflight:
+                    old.scheduler._abandon_pipeline()
+            except Exception:  # noqa: BLE001
+                pass
+            token = self._lease_shard(from_shard)
+            old.token = token
+            old.scheduler.fencing_check = token.valid
+            old.scheduler.leader_check = token.valid
+            old.scheduler._pipeline_cooldown = max(
+                old.scheduler._pipeline_cooldown, 1)
+        # (3) reassign: a NEW plan object (the fingerprint moves with
+        # the layout — two planes comparing fingerprints agree on
+        # ownership or refuse).
+        shard_of_unit = dict(self.plan.shard_of_unit)
+        shard_of_unit[unit] = to_shard
+        cq_units = shard_units(self.plane.cache)
+        cq_shard = {cq: shard_of_unit.get(u, 0)
+                    for cq, u in cq_units.items()}
+        units = self.plan.units
+        bins = [shard_of_unit[u] for u in units]
+        self.plan = ShardPlan(
+            n_shards=self.n_shards, units=units,
+            shard_of_unit=shard_of_unit, cq_shard=cq_shard,
+            loads=self.plan.loads, imbalance=self.plan.imbalance,
+            fingerprint=_plan_fingerprint(self.n_shards, units, bins))
+        self.rebalances += 1
+        if self.metrics is not None:
+            self.metrics.shard_rebalanced()
+        self.log.v(1, "shards.rebalance", unit=unit,
+                   src=from_shard, dst=to_shard,
+                   fingerprint=self.plan.fingerprint)
+        return {"unit": unit, "from": from_shard, "to": to_shard,
+                "moved": True,
+                "old_owner_epoch": self.shards[from_shard].epoch}
+
+    # -- operator surface ----------------------------------------------
+
+    def status(self) -> dict:
+        """The /debug/shards payload: layout fingerprint + per-shard
+        epoch/lease/cohort set/backlog (obs/status.shards_status)."""
+        return {
+            "n_shards": self.n_shards,
+            "plan": {
+                "fingerprint": self.plan.fingerprint,
+                "units": len(self.plan.units),
+                "imbalance": round(self.plan.imbalance, 4),
+                "loads": list(self.plan.loads),
+            },
+            "rebalances": self.rebalances,
+            "shards": [s.status(self.plan, self.plane.queues)
+                       for s in self.shards],
+        }
+
+    def shutdown(self) -> None:
+        for shard in self.shards:
+            try:
+                if (shard.scheduler._inflight_q
+                        or shard.scheduler._inflight):
+                    shard.scheduler._abandon_pipeline()
+            except Exception:  # noqa: BLE001
+                pass
+            # Dead or alive: release any snapshot handout the shard's
+            # last cycle still held against the shared cache.
+            try:
+                shard.scheduler._flush_seal_snapshot()
+            except Exception:  # noqa: BLE001
+                pass
+            if shard.state == SHARD_ACTIVE:
+                shard.token.release()
+        self.plane.shutdown(checkpoint=False)

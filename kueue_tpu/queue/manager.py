@@ -299,26 +299,33 @@ class Manager:
 
     # --- heads (reference: manager.go:471-509) ---
 
-    def heads(self, timeout: Optional[float] = None) -> list:
+    def heads(self, timeout: Optional[float] = None,
+              cq_filter=None) -> list:
         """Block until any CQ has a head, then pop one head per CQ.
-        Returns [] when stopped (or on timeout if given)."""
+        Returns [] when stopped (or on timeout if given).
+        ``cq_filter(cq_name) -> bool`` restricts the pop to owned CQs —
+        an admission shard pops only the CQs its layout assigns it, so
+        co-resident shards never race for the same head
+        (parallel/shards.py)."""
         with self._cond:
             while not self._stopped:
-                h = self._heads_locked()
+                h = self._heads_locked(cq_filter)
                 if h:
                     return h
                 if not self._cond.wait(timeout=timeout):
                     return []
             return []
 
-    def heads_nonblocking(self) -> list:
+    def heads_nonblocking(self, cq_filter=None) -> list:
         with self._lock:
-            return self._heads_locked()
+            return self._heads_locked(cq_filter)
 
-    def _heads_locked(self) -> list:
+    def _heads_locked(self, cq_filter=None) -> list:
         out = []
         for cqh in self.cluster_queues.values():
             if not cqh.active:
+                continue
+            if cq_filter is not None and not cq_filter(cqh.name):
                 continue
             info = cqh.pop()
             if info is not None:

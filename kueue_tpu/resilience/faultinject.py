@@ -196,33 +196,82 @@ class FaultInjector:
 # the entire per-site cost when no injector is installed.
 _active: Optional[FaultInjector] = None
 
+# Scoped arming (RESILIENCE.md §9): co-resident managers — N admission
+# shards in one process — each arm their OWN injector under a scope
+# name, entered for the duration of that manager's cycle via the
+# ``scope(...)`` context manager. Inside a scope, ONLY that scope's
+# injector fires (a shard sweep killing shard 2 must not consume shard
+# 0's scripted schedule); outside any scope only the module-global
+# injector fires — the pre-shard contract, unchanged. The current
+# scope is thread-local: shard cycles on different threads never see
+# each other's arming.
+_scoped: dict = {}
+_scope_local = threading.local()
 
-def install(injector: FaultInjector) -> FaultInjector:
+
+def install(injector: FaultInjector,
+            scope: Optional[str] = None) -> FaultInjector:
     global _active
+    if scope is not None:
+        _scoped[scope] = injector
+        return injector
     _active = injector
     return injector
 
 
-def uninstall() -> None:
+def uninstall(scope: Optional[str] = None) -> None:
     global _active
+    if scope is not None:
+        _scoped.pop(scope, None)
+        return
     _active = None
 
 
-def active() -> Optional[FaultInjector]:
+def active(scope: Optional[str] = None) -> Optional[FaultInjector]:
+    if scope is not None:
+        return _scoped.get(scope)
     return _active
 
 
-class installed:
-    """Context manager: install an injector for the block's duration."""
+def current_scope() -> Optional[str]:
+    return getattr(_scope_local, "name", None)
 
-    def __init__(self, injector: FaultInjector):
+
+class installed:
+    """Context manager: install an injector for the block's duration
+    (module-global by default, or under ``scope``)."""
+
+    def __init__(self, injector: FaultInjector,
+                 scope: Optional[str] = None):
         self.injector = injector
+        self.scope = scope
 
     def __enter__(self) -> FaultInjector:
-        return install(self.injector)
+        return install(self.injector, scope=self.scope)
 
     def __exit__(self, *exc) -> None:
-        uninstall()
+        uninstall(scope=self.scope)
+
+
+class scope:
+    """Context manager: attribute every ``site()`` hit on this thread
+    to ``name``'s scoped injector for the block's duration. With no
+    injector armed under ``name`` the sites are inert inside the block
+    — the module-global injector does NOT leak in, which is the
+    isolation property the shard sweep relies on. Re-entrant nesting
+    restores the outer scope on exit."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._outer: Optional[str] = None
+
+    def __enter__(self) -> "scope":
+        self._outer = getattr(_scope_local, "name", None)
+        _scope_local.name = self.name
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _scope_local.name = self._outer
 
 
 def site(name: str, payload=None,
@@ -234,8 +283,11 @@ def site(name: str, payload=None,
     InjectedFault, ``(DELAY, s)`` sleeps ``s`` (simulating a wedged
     device call — the watchdog deadline is expected to fire), CORRUPT
     returns ``corrupt(payload)`` (or the payload untouched when the
-    call site passed no corruptor — e.g. raise-only sites)."""
-    inj = _active
+    call site passed no corruptor — e.g. raise-only sites). Inside a
+    ``scope(...)`` block the hit resolves against that scope's
+    injector alone; outside, against the module-global one."""
+    cur = getattr(_scope_local, "name", None)
+    inj = _scoped.get(cur) if cur is not None else _active
     if inj is None:
         return payload
     hit, action = inj._next(name)

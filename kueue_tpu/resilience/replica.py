@@ -73,15 +73,20 @@ LAG_WARMUP = 4
 
 @dataclass
 class FencingToken:
-    """One replica's claim to the leader lease at a specific epoch.
-    ``valid()`` is the cheap gate the scheduler polls; ``check()`` is
-    the raising form the store's commit path uses. The token never
-    refreshes its epoch — a deposed replica must construct a new one
-    by re-acquiring the lease (and will get a HIGHER epoch)."""
+    """One replica's claim to a lease at a specific epoch. ``name``
+    selects WHICH lease on the log: "" is the whole-plane leader lease
+    (the hot-standby mode); an admission shard's token carries its
+    shard name, so N shards hold N independent epochs on one durable
+    medium (RESILIENCE.md §9). ``valid()`` is the cheap gate the
+    scheduler polls; ``check()`` is the raising form the store's
+    commit path uses. The token never refreshes its epoch — a deposed
+    replica must construct a new one by re-acquiring the lease (and
+    will get a HIGHER epoch)."""
 
     log: DurableLog
     identity: str
     epoch: int
+    name: str = ""
 
     def valid(self) -> bool:
         try:
@@ -91,13 +96,13 @@ class FencingToken:
             return False
 
     def check(self) -> None:
-        self.log.check_epoch(self.identity, self.epoch)
+        self.log.check_epoch(self.identity, self.epoch, self.name)
 
     def renew(self, now: float) -> bool:
-        return self.log.renew_lease(self.identity, now)
+        return self.log.renew_lease(self.identity, now, self.name)
 
     def release(self) -> None:
-        self.log.release_lease(self.identity)
+        self.log.release_lease(self.identity, self.name)
 
 
 def lead(mgr, durable: DurableLog, identity: str = "",

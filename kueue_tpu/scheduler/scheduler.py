@@ -117,6 +117,11 @@ class Scheduler:
         self.fair_sharing_enabled = fair_sharing_enabled
         self.clock = clock
         self.attempt_count = 0
+        # Cumulative admissions sealed by this scheduler instance's
+        # cycles — the per-shard admitted_total feed (parallel/shards.py
+        # reads the delta per cycle; standalone managers just get a
+        # free lifetime counter).
+        self.admitted_total = 0
         self.preemption_fallbacks = 0  # device-preemption error fallbacks
         self.metrics = metrics
         # Optional kueue_tpu.solver.BatchSolver: batched fit-mode admission
@@ -267,6 +272,13 @@ class Scheduler:
         self.query_plane = None
         self._cycle_order: Optional[list] = None  # admission-sorted keys
         self._seal_snapshot = None  # handout pending transfer at seal
+        # The sync cycle's live snapshot handout, tracked between take
+        # and retire so an abandonment path (a crash that escaped
+        # mid-cycle, a sharded plane discarding a dead shard's
+        # scheduler) can release it — the local in the aborted
+        # schedule() frame is otherwise unreachable and would leak a
+        # handout the shared cache counts forever.
+        self._cycle_snapshot = None
         # Workload journey ledger (obs/journey.py + ISSUE 14): when
         # attached (manager wiring), every admit/requeue/shed/defer
         # site stamps a causally-tagged journey span, and the ledger
@@ -336,6 +348,15 @@ class Scheduler:
         # /debug/recovery (obs/status.recovery_status).
         self.standby_status: Optional[Callable[[], dict]] = None
         self.last_promotion: Optional[dict] = None
+        # Sharded control plane (parallel/shards.py): an admission
+        # shard's scheduler pops ONLY the CQs its layout assigns it —
+        # cq_filter(cq_name) -> bool, threaded into every heads() pop.
+        # None = unsharded (pop everything), the standalone default.
+        self.cq_filter: Optional[Callable[[str], bool]] = None
+        # /debug/shards producer: the ShardedControlPlane wires its
+        # status() onto the PLANE manager's scheduler (the one serving
+        # the debug surface), mirroring standby_status above.
+        self.shards_status: Optional[Callable[[], dict]] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -390,7 +411,8 @@ class Scheduler:
                 and getattr(self.solver, "_recorder", None)
                 is not self.recorder):
             self.solver.bind_recorder(self.recorder)
-        heads = self.queues.heads(timeout=timeout)
+        heads = self.queues.heads(timeout=timeout,
+                                  cq_filter=self.cq_filter)
         if not heads:
             if self._inflight is not None:
                 # A headless drain still round-trips the device (collect
@@ -534,6 +556,7 @@ class Scheduler:
 
         t_ph = _time.perf_counter()
         snapshot = self.cache.snapshot()
+        self._cycle_snapshot = snapshot
         self._span("snapshot", t_ph)
         vlog.dump_snapshot(self.log, snapshot)
 
@@ -746,6 +769,7 @@ class Scheduler:
                 result_success = True
                 admitted_n += 1
                 self._solver_release_workload(e.info.key)
+        self.admitted_total += admitted_n
         self._span("requeue", t_ph)
         regime = "preempt" if any(
             e.preemption_targets
@@ -885,6 +909,12 @@ class Scheduler:
         snap, self._seal_snapshot = self._seal_snapshot, None
         if snap is not None:
             self.cache.release_snapshot(snap)
+        # A cycle snapshot still tracked here means the previous cycle
+        # aborted between take and retire (an InjectedCrash escaping
+        # mid-cycle) — release it the same way.
+        snap, self._cycle_snapshot = self._cycle_snapshot, None
+        if snap is not None:
+            self.cache.release_snapshot(snap)
 
     def _retire_cycle_snapshot(self, snapshot: Snapshot) -> None:
         """The sync cycle is done with its snapshot handout. Without a
@@ -894,6 +924,7 @@ class Scheduler:
         the next full-snapshot view rotates it out, and it stays
         counted in ``cache.live_handouts`` while held (the SNAPSHOTS.md
         reader-consumer contract)."""
+        self._cycle_snapshot = None
         if self.query_plane is None:
             self.cache.release_snapshot(snapshot)
         else:
@@ -1654,6 +1685,7 @@ class Scheduler:
                 result_success = True
                 admitted_n += 1
                 self._solver_release_workload(e.info.key)
+        self.admitted_total += admitted_n
         self._span("requeue", t_ph)
         self._last_cycle_admitted = admitted_n
         self.cycle_counts["device-pipelined"] = \

@@ -320,8 +320,16 @@ def preempt_shape_report(base: SoakParams = None, seed: int = 0,
     rng = random.Random(seed ^ 0x5AFE)
     # harness topology: cohorts=1, so one cohort holds every tenant CQ
     members = {"cohort-0": base.tenants}
+    # The baseline is the ladder the DEPLOYED governor precompiles: the
+    # base topology at the base storm width. Comparing each mutated
+    # sample against a ladder recomputed at its own width would
+    # self-cover by construction (B buckets by problem count — the
+    # full-backlog rung always matches) and report nothing off-ladder.
+    base_problems = max(1, base.tenants * max(0, base.storm_per_tenant))
+    ladder_keys = {f"B{s['B']}xK{s['K']}"
+                   for s in preempt_shape_ladder(members,
+                                                 width=base_problems)}
     keys: dict = {}
-    ladder_keys: set = set()
     for _ in range(max(1, samples)):
         p = mutate(base, rng)
         per = max(0, p.storm_per_tenant)
@@ -332,8 +340,6 @@ def preempt_shape_report(base: SoakParams = None, seed: int = 0,
         rank = _bucket(max(8, 4 * p.tenants))
         key = f"B{b}xK{rank}"
         keys[key] = keys.get(key, 0) + 1
-        for s in preempt_shape_ladder(members, width=problems):
-            ladder_keys.add(f"B{s['B']}xK{s['K']}")
     off = {k: n for k, n in keys.items() if k not in ladder_keys}
     return {
         "seed": seed, "samples": samples,

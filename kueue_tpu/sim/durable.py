@@ -219,14 +219,14 @@ class DurableLog:
         # stamped into tail cursors so a follower can tell compaction
         # from truncation.
         self.generation = 0
-        # Leader lease (fencing): holder identity, the monotone fencing
-        # epoch, and the renew clock. All times are caller-supplied
-        # (the log has no clock of its own — virtual-time harnesses
-        # pass their FakeClock readings).
-        self._lease_holder = ""
-        self._lease_epoch = 0
-        self._lease_renew_t = 0.0
-        self._lease_duration = 0.0
+        # Leases (fencing): NAMED lease slots, each with its own holder
+        # identity, monotone fencing epoch, and renew clock. Name ""
+        # is the whole-plane leader lease every pre-shard caller uses;
+        # shard leases ("shard-0", ...) arbitrate per-shard ownership
+        # on the same durable medium (RESILIENCE.md §9). All times are
+        # caller-supplied (the log has no clock of its own —
+        # virtual-time harnesses pass their FakeClock readings).
+        self._leases: dict = {}
         self.log = vlog.logger("durable")
         if dir is None:
             self._wal = bytearray()
@@ -329,85 +329,113 @@ class DurableLog:
             self.checkpoints += 1
             self.records_since_checkpoint = 0
 
-    # -- leader lease + fencing (RESILIENCE.md §7) ---------------------
+    # -- leases + fencing (RESILIENCE.md §7, §9) -----------------------
+
+    def _lease_locked(self, name: str) -> dict:
+        lease = self._leases.get(name)
+        if lease is None:
+            lease = {"holder": "", "epoch": 0, "renew_t": 0.0,
+                     "duration": 0.0}
+            self._leases[name] = lease
+        return lease
 
     def acquire_lease(self, identity: str, now: float,
                       duration: float = 15.0,
-                      force: bool = False) -> Optional[int]:
-        """Take (or retake) the leader lease. Returns the fencing epoch
-        on success, None when another holder's lease is still live and
-        ``force`` is False. Every change of holder — including a
-        returning holder re-acquiring after expiry — bumps the epoch,
-        so a write stamped with the previous epoch is fenced the
-        instant the new holder wins. A current holder calling this is
-        a renewal (same epoch). ``force`` is the operator/harness
+                      force: bool = False,
+                      name: str = "") -> Optional[int]:
+        """Take (or retake) the ``name`` lease ("" = the whole-plane
+        leader lease; shard leases carry the shard's name). Returns the
+        fencing epoch on success, None when another holder's lease is
+        still live and ``force`` is False. Every change of holder —
+        including a returning holder re-acquiring after expiry — bumps
+        the epoch, so a write stamped with the previous epoch is fenced
+        the instant the new holder wins. A current holder calling this
+        is a renewal (same epoch). ``force`` is the operator/harness
         "I know the leader is dead" path (a crash leaves the lease
         formally unexpired until ``duration`` passes)."""
         with self._lock:
-            if self._lease_holder == identity and self._lease_epoch > 0:
-                self._lease_renew_t = now
-                self._lease_duration = duration
-                return self._lease_epoch
-            held = (self._lease_holder
-                    and now < self._lease_renew_t + self._lease_duration)
+            lease = self._lease_locked(name)
+            if lease["holder"] == identity and lease["epoch"] > 0:
+                lease["renew_t"] = now
+                lease["duration"] = duration
+                return lease["epoch"]
+            held = (lease["holder"]
+                    and now < lease["renew_t"] + lease["duration"])
             if held and not force:
                 return None
-            self._lease_holder = identity
-            self._lease_epoch += 1
-            self._lease_renew_t = now
-            self._lease_duration = duration
+            lease["holder"] = identity
+            lease["epoch"] += 1
+            lease["renew_t"] = now
+            lease["duration"] = duration
             self.log.v(1, "durable.lease.acquired", holder=identity,
-                       epoch=self._lease_epoch, forced=bool(held))
-            return self._lease_epoch
+                       epoch=lease["epoch"], lease=name or "leader",
+                       forced=bool(held))
+            return lease["epoch"]
 
-    def renew_lease(self, identity: str, now: float) -> bool:
+    def renew_lease(self, identity: str, now: float,
+                    name: str = "") -> bool:
         """Extend the current holder's lease; False if this identity no
         longer holds it (it was deposed — stop committing)."""
         with self._lock:
-            if self._lease_holder != identity:
+            lease = self._lease_locked(name)
+            if lease["holder"] != identity:
                 return False
-            self._lease_renew_t = now
+            lease["renew_t"] = now
             return True
 
-    def release_lease(self, identity: str) -> None:
+    def release_lease(self, identity: str, name: str = "") -> None:
         """Voluntary hand-off (graceful shutdown): the next replica
         acquires immediately instead of waiting out the duration. The
         epoch is NOT bumped here — the successor's acquire bumps it."""
         with self._lock:
-            if self._lease_holder == identity:
-                self._lease_holder = ""
-                self._lease_renew_t = 0.0
+            lease = self._lease_locked(name)
+            if lease["holder"] == identity:
+                lease["holder"] = ""
+                lease["renew_t"] = 0.0
 
-    def lease_status(self, now: Optional[float] = None) -> dict:
+    def lease_status(self, now: Optional[float] = None,
+                     name: str = "") -> dict:
         with self._lock:
-            st = {"holder": self._lease_holder,
-                  "epoch": self._lease_epoch,
-                  "renew_t": self._lease_renew_t,
-                  "duration_s": self._lease_duration}
+            lease = self._lease_locked(name)
+            st = {"holder": lease["holder"],
+                  "epoch": lease["epoch"],
+                  "renew_t": lease["renew_t"],
+                  "duration_s": lease["duration"]}
             if now is not None:
-                st["expired"] = (not self._lease_holder
-                                 or now >= self._lease_renew_t
-                                 + self._lease_duration)
+                st["expired"] = (not lease["holder"]
+                                 or now >= lease["renew_t"]
+                                 + lease["duration"])
             return st
+
+    def lease_table(self, now: Optional[float] = None) -> dict:
+        """Every named lease's status — the /debug/shards raw table."""
+        with self._lock:
+            names = list(self._leases)
+        return {n: self.lease_status(now, name=n) for n in names}
 
     @property
     def fencing_epoch(self) -> int:
-        return self._lease_epoch
-
-    def check_epoch(self, identity: str, epoch: int) -> None:
-        """Raise ``Fenced`` unless ``identity`` still holds the lease
-        at ``epoch`` (the Store's commit-path validity check)."""
         with self._lock:
-            self._check_epoch_locked(identity, epoch)
+            return self._lease_locked("")["epoch"]
 
-    def _check_epoch_locked(self, identity: str, epoch: int) -> None:
-        if self._lease_epoch == 0:
+    def check_epoch(self, identity: str, epoch: int,
+                    name: str = "") -> None:
+        """Raise ``Fenced`` unless ``identity`` still holds the
+        ``name`` lease at ``epoch`` (the Store's commit-path validity
+        check)."""
+        with self._lock:
+            self._check_epoch_locked(identity, epoch, name)
+
+    def _check_epoch_locked(self, identity: str, epoch: int,
+                            name: str = "") -> None:
+        lease = self._lease_locked(name)
+        if lease["epoch"] == 0:
             return  # no lease regime in effect (standalone durability)
-        if self._lease_holder != identity or self._lease_epoch != epoch:
+        if lease["holder"] != identity or lease["epoch"] != epoch:
             raise Fenced(
-                f"writer {identity!r} (epoch {epoch}) fenced: lease "
-                f"held by {self._lease_holder!r} at epoch "
-                f"{self._lease_epoch}")
+                f"writer {identity!r} (epoch {epoch}) fenced: "
+                f"{name or 'leader'} lease held by "
+                f"{lease['holder']!r} at epoch {lease['epoch']}")
 
     # -- tail streaming (RESILIENCE.md §7) -----------------------------
 

@@ -2041,6 +2041,22 @@ def _run_soak(seed: int = 0, scale: str = "full") -> ScenarioResult:
 
 
 # ----------------------------------------------------------------------
+# scenarios (l, m): sharded admission control plane (sim/shardstorm.py
+# + ISSUE 20 / RESILIENCE.md §9) — lazy for the same reason as soak.
+# ----------------------------------------------------------------------
+
+def _run_shard_storm(seed: int = 0, scale: str = "full") -> ScenarioResult:
+    from kueue_tpu.sim.shardstorm import run_shard_storm
+    return run_shard_storm(seed=seed, scale=scale)
+
+
+def _run_shard_rebalance(seed: int = 0,
+                         scale: str = "full") -> ScenarioResult:
+    from kueue_tpu.sim.shardstorm import run_shard_rebalance
+    return run_shard_rebalance(seed=seed, scale=scale)
+
+
+# ----------------------------------------------------------------------
 
 SCENARIOS = {
     "diurnal": run_diurnal,
@@ -2054,6 +2070,8 @@ SCENARIOS = {
     "failover": run_failover,
     "visibility_storm": run_visibility_storm,
     "soak": _run_soak,
+    "shard_storm": _run_shard_storm,
+    "shard_rebalance": _run_shard_rebalance,
 }
 
 # Names above are the BUILT-IN catalog; adversarial repro specs

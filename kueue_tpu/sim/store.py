@@ -128,8 +128,9 @@ class Store:
                 f = self.fencing
                 self._durable.checkpoint(
                     self._objects, self._rv,
-                    fence=(f.identity, f.epoch) if f is not None
-                    else None)
+                    fence=(f.identity, f.epoch,
+                           getattr(f, "name", ""))
+                    if f is not None else None)
 
     def _persist(self, event: str, kind: str, key: str, stored) -> None:
         """The commit point every mutation passes through, just before
@@ -150,7 +151,7 @@ class Store:
             fence.check()
         if d is None:
             return
-        ftup = ((fence.identity, fence.epoch)
+        ftup = ((fence.identity, fence.epoch, getattr(fence, "name", ""))
                 if fence is not None else None)
         d.append(event, kind, key, stored, t=self._clock.now(),
                  fence=ftup)

@@ -113,7 +113,23 @@ def rank_ladder(cohort_members: dict) -> tuple:
     return tuple(ranks)
 
 
-def preempt_shape_ladder(cohort_members: dict, width: int) -> tuple:
+def parse_shape_rung(key) -> tuple:
+    """Normalize one synthesized warm rung to a (B, K) pair. Accepts
+    the ``"B{b}xK{k}"`` strings ``sim/adversary.preempt_shape_report``
+    emits (``suggested_rungs`` — the ``soak_run --shapes`` feed) or a
+    bare ``(B, K)`` tuple."""
+    if isinstance(key, str):
+        b_part, _, k_part = key.partition("x")
+        if not (b_part.startswith("B") and k_part.startswith("K")):
+            raise ValueError(f"bad shape rung {key!r} "
+                             "(want 'B<n>xK<n>' or a (B, K) pair)")
+        return int(b_part[1:]), int(k_part[1:])
+    b, k = key
+    return int(b), int(k)
+
+
+def preempt_shape_ladder(cohort_members: dict, width: int,
+                         extra=()) -> tuple:
     """Bucketed preemption-batch shapes {B,K,QL,CL,RF,U} the warm walk
     precompiles (encode_problems buckets every dim, so a handful of
     shape dicts cover the common storm geometries):
@@ -143,7 +159,16 @@ def preempt_shape_ladder(cohort_members: dict, width: int) -> tuple:
     then holds for the process and the persistent cache across
     restarts; request()'s background backfill is width-keyed and does
     not re-warm preemption shapes. Tuning U rungs from production
-    compile_events data is a ROADMAP follow-up."""
+    compile_events data is a ROADMAP follow-up.
+
+    ``extra`` closes that loop for the (B, K) plane today: synthesized
+    off-ladder rungs — ``soak_run --shapes`` runs the adversarial
+    geometry sweep (sim/adversary.preempt_shape_report) and its
+    ``suggested_rungs`` are exactly the storm shapes the topology
+    ladder above would NOT precompile — are accepted here as
+    ``"B{b}xK{k}"`` strings or (B, K) pairs and become first-class
+    rungs at the reclaim geometry (QL = the member bucket; CL/RF/U at
+    their floors, like every topology rung)."""
     mm = max(cohort_members.values() or [1])
     k_reclaim = _bucket(max(8, 4 * mm))
     shapes = []
@@ -153,6 +178,10 @@ def preempt_shape_ladder(cohort_members: dict, width: int) -> tuple:
                        "CL": 8, "RF": 8, "U": 4})
         shapes.append({"B": b, "K": 8, "QL": 1, "CL": 8, "RF": 8,
                        "U": 4})
+    for rung in extra:
+        b, k = parse_shape_rung(rung)
+        shapes.append({"B": _bucket(b, 1), "K": _bucket(k),
+                       "QL": _bucket(mm, 1), "CL": 8, "RF": 8, "U": 4})
     # cohort-less topologies collapse the two geometries (QL bucket 1,
     # K floor 8): dedup so each variant compiles once
     out, seen = [], set()
@@ -285,7 +314,8 @@ class CompileGovernor:
                  max_attempts: int = DEFAULT_MAX_ATTEMPTS,
                  expected_pending: Optional[int] = None,
                  fair_sharing: bool = False,
-                 warm_preempt: bool = True, fs_flags: tuple = ()):
+                 warm_preempt: bool = True, fs_flags: tuple = (),
+                 extra_preempt_rungs: tuple = ()):
         self.solver = solver
         self.cache = cache
         self.metrics = metrics
@@ -310,6 +340,9 @@ class CompileGovernor:
         # (fairpreempt.strategy_flags) -- a mismatched tuple warms a
         # program nobody runs.
         self.warm_preempt = warm_preempt
+        # Synthesized (B, K) rungs beyond the topology ladder — the
+        # soak_run --shapes feed (see preempt_shape_ladder's ``extra``).
+        self.extra_preempt_rungs = tuple(extra_preempt_rungs)
         self.fs_flags = tuple(fs_flags)
         self._preempt_shapes: tuple = ()
         self.state = GOV_IDLE
@@ -528,7 +561,8 @@ class CompileGovernor:
         members = snapshot_cohort_members(snapshot)
         ranks = rank_ladder(members)
         if self.warm_preempt:
-            self._preempt_shapes = preempt_shape_ladder(members, widths[0])
+            self._preempt_shapes = preempt_shape_ladder(
+                members, widths[0], extra=self.extra_preempt_rungs)
         with self._lock:
             self._ranks = ranks
             self.state = GOV_WARMING

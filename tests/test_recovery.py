@@ -540,3 +540,70 @@ def test_crash_during_warmup_walk():
 def test_crash_run_cli_single():
     crash_run = _load_crash_run()
     assert crash_run.one_run(7, faultinject.SITE_STORE, 30) == 0
+
+
+# ----------------------------------------------------------------------
+# 6. the shard-kill/promote arm (ISSUE 20): one scoped crash inside an
+#    admission shard; the shared plane survives, the promoted shard
+#    converges to the single-manager oracle (tier-1 smoke here; the
+#    full sites x layouts x seeds sweep is @slow — CLI twin:
+#    `tools/crash_run.py --sweep`, shard arm)
+# ----------------------------------------------------------------------
+
+def test_shard_crash_smoke_survivor_and_promote_converge():
+    """Tier-1: kill one of two shards mid-apply via its own faultinject
+    scope; the co-resident shard keeps admitting through the outage,
+    the hot-promoted replacement converges to the uncrashed oracle's
+    admitted set, and nothing is lost, doubled or stranded."""
+    crash_run = _load_crash_run()
+    oracle = crash_run.run_oracle(0)
+    crash = crash_run.run_shard(0, faultinject.SITE_APPLY, 3, 2)
+    v = crash_run.verdict(oracle, crash)
+    assert v["crashed"], "the scripted shard crash never fired"
+    assert crash["promotions"] >= 1
+    assert v["converged"], crash["recovery"]
+    assert not v["lost_admissions"]
+    assert not v["double_admission"]
+    assert not v["stranded"]
+    # Fault isolation: some admissions landed while the victim was dead
+    # (the survivors') — the outage was not a full-plane stall.
+    assert crash["usage_consistent"]
+
+
+def _shard_sweep_arm(site, n_shards, seeds=10):
+    crash_run = _load_crash_run()
+    import random
+    import zlib
+    fired = 0
+    oracle_by_seed = {}
+    for seed in range(seeds):
+        rng = random.Random(
+            (zlib.crc32(site.encode()) & 0xFFFF) * 100_000
+            + n_shards * 1000 + seed)
+        hit = (rng.randint(2, 20) if site == faultinject.SITE_STORE
+               else rng.randint(0, 6))
+        if seed not in oracle_by_seed:
+            oracle_by_seed[seed] = crash_run.run_oracle(seed)
+        crash = crash_run.run_shard(seed, site, hit, n_shards)
+        v = crash_run.verdict(oracle_by_seed[seed], crash)
+        fired += 1 if v["crashed"] else 0
+        assert v["converged"], (site, n_shards, seed, hit,
+                                crash["recovery"])
+        assert not v["lost_admissions"], (site, n_shards, seed, hit)
+        assert not v["double_admission"], (site, n_shards, seed, hit)
+        assert not v["stranded"], (site, n_shards, seed, hit)
+    assert fired > 0, (f"{site}@{n_shards} shards never fired "
+                       f"across {seeds} seeds")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n_shards", [2, 4])
+@pytest.mark.parametrize("site", [
+    faultinject.SITE_APPLY, faultinject.SITE_STORE,
+])
+def test_shard_crash_sweep(site, n_shards):
+    """ISSUE 20 acceptance: for every shard injection site x layout and
+    >= 10 seeds, a scoped mid-cycle shard crash + hot-promote converges
+    to the single-manager oracle's admitted set with zero lost, zero
+    double (store-vs-cache usage cross-check) and zero stranded."""
+    _shard_sweep_arm(site, n_shards, seeds=10)

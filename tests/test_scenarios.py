@@ -204,6 +204,7 @@ class TestScenarioSmoke:
                                     "diurnal", "failover",
                                     "flavor_churn", "mixed_jobs",
                                     "requeue_flood", "restart_storm",
+                                    "shard_rebalance", "shard_storm",
                                     "soak", "tenant_storm",
                                     "visibility_storm"]
 

@@ -1,0 +1,301 @@
+"""Sharded admission control plane (parallel/shards.py, RESILIENCE.md
+§9): named leases on one durable log, the planner-owned unit layout,
+kill/promote fault isolation, rebalance handoff, scoped fault
+injection, and the exactly-once cross-checks the probes gate on."""
+
+import pytest
+
+from kueue_tpu.api.meta import FakeClock
+from kueue_tpu.core import workload as wlpkg
+from kueue_tpu.parallel.shards import (SHARD_ACTIVE, SHARD_KILLED,
+                                       ShardedControlPlane, plan_shards,
+                                       shard_units)
+from kueue_tpu.resilience import faultinject
+from kueue_tpu.resilience.faultinject import CRASH, FaultInjector
+from kueue_tpu.sim.durable import DurableLog, Fenced
+from kueue_tpu.sim.shardstorm import _admitted, _objects, _workload
+
+
+def _build(n_shards=2, num_cqs=4, quota=50_000):
+    clock = FakeClock(1000.0)
+    scp = ShardedControlPlane(n_shards, clock=clock)
+    for obj in _objects(num_cqs, quota):
+        scp.plane.store.create(obj)
+    scp.plane.run_until_idle(max_iterations=1_000_000)
+    scp.replan()
+    return scp, clock
+
+
+def _wave(scp, wave, num_cqs, n0):
+    for i in range(num_cqs):
+        scp.plane.store.create(_workload(wave, i, n0 + i))
+    scp.plane.run_until_idle(max_iterations=1_000_000)
+    return n0 + num_cqs
+
+
+# ----------------------------------------------------------------------
+# named leases on one durable log
+# ----------------------------------------------------------------------
+
+class TestNamedLeases:
+    def test_leases_are_independent(self):
+        log = DurableLog()
+        e0 = log.acquire_lease("a", now=0.0, name="shard-0")
+        e1 = log.acquire_lease("b", now=0.0, name="shard-1")
+        assert e0 == 1 and e1 == 1  # separate epoch sequences
+        # Each identity is valid only against its OWN lease name.
+        log.check_epoch("a", 1, name="shard-0")
+        log.check_epoch("b", 1, name="shard-1")
+        with pytest.raises(Fenced):
+            log.check_epoch("a", 1, name="shard-1")
+
+    def test_holder_change_bumps_only_that_lease(self):
+        log = DurableLog()
+        log.acquire_lease("a", now=0.0, name="shard-0")
+        log.acquire_lease("b", now=0.0, name="shard-1")
+        e = log.acquire_lease("a2", now=0.0, force=True, name="shard-0")
+        assert e == 2
+        with pytest.raises(Fenced):
+            log.check_epoch("a", 1, name="shard-0")  # deposed
+        log.check_epoch("b", 1, name="shard-1")      # untouched
+
+    def test_legacy_unnamed_lease_back_compat(self):
+        log = DurableLog()
+        e = log.acquire_lease("leader", now=0.0)
+        assert e == 1 and log.fencing_epoch == 1
+        log.acquire_lease("s", now=0.0, name="shard-0")
+        assert log.fencing_epoch == 1  # shard lease is a different row
+        table = log.lease_table(now=0.0)
+        assert set(table) == {"", "shard-0"}
+
+    def test_unleased_name_is_open_regime(self):
+        # A name that never had a holder doesn't fence anything —
+        # standalone durability keeps working without leases.
+        DurableLog().check_epoch("anyone", 0, name="shard-9")
+
+
+# ----------------------------------------------------------------------
+# the planner-owned layout
+# ----------------------------------------------------------------------
+
+class TestShardPlan:
+    def test_plan_deterministic_and_fingerprinted(self):
+        units = {f"cq{i}": f"cohort:c{i % 3}" for i in range(9)}
+        w = {f"cq{i}": i + 1 for i in range(9)}
+        p1 = plan_shards(units, w, 2)
+        p2 = plan_shards(dict(reversed(list(units.items()))), w, 2)
+        assert p1.fingerprint == p2.fingerprint
+        assert p1.shard_of_unit == p2.shard_of_unit
+        assert len(p1.fingerprint) == 16
+
+    def test_whole_cohorts_move_together(self):
+        scp, _ = _build(n_shards=2, num_cqs=4)
+        units = shard_units(scp.plane.cache)
+        # _objects puts cq{i} in cohort-{i%2}: cohort-mates share a unit.
+        assert units["cq0"] == units["cq2"] == "cohort:cohort-0"
+        assert units["cq1"] == units["cq3"] == "cohort:cohort-1"
+        for cq, unit in units.items():
+            assert scp.plan.cq_shard[cq] == scp.plan.shard_of_unit[unit]
+        scp.shutdown()
+
+    def test_unmapped_cq_defaults_to_shard_zero(self):
+        scp, _ = _build(n_shards=2, num_cqs=4)
+        owns0 = scp.shards[0].scheduler.cq_filter
+        owns1 = scp.shards[1].scheduler.cq_filter
+        assert owns0("brand-new-cq") is True
+        assert owns1("brand-new-cq") is False
+        scp.shutdown()
+
+    def test_every_shard_owns_some_unit_here(self):
+        scp, _ = _build(n_shards=2, num_cqs=4)
+        assert all(scp.plan.units_of(i) for i in range(2))
+        scp.shutdown()
+
+
+# ----------------------------------------------------------------------
+# kill / promote
+# ----------------------------------------------------------------------
+
+class TestKillPromote:
+    def test_shards_admit_only_owned_cqs(self):
+        scp, clock = _build()
+        n = _wave(scp, 0, 4, 0)
+        scp.cycle()
+        assert _admitted(scp.plane) == n
+        own0 = set(scp.plan.cqs_of(0))
+        for wl in scp.plane.store.list("Workload", copy_objects=False):
+            if not wlpkg.has_quota_reservation(wl):
+                continue
+            cq = wl.status.admission.cluster_queue
+            # cq{i} drains through lq{i} -> cq{i}; ownership is by plan.
+            expected = 0 if cq in own0 else 1
+            assert scp.plan.cq_shard[cq] == expected
+        scp.shutdown()
+
+    def test_survivor_keeps_admitting_and_dead_admits_nothing(self):
+        scp, clock = _build()
+        n = _wave(scp, 0, 4, 0)
+        scp.cycle()
+        scp.kill_shard(0)
+        before = [s.admitted_total for s in scp.shards]
+        n = _wave(scp, 1, 4, n)
+        scp.cycle()
+        assert scp.shards[0].admitted_total == before[0]
+        assert scp.shards[1].admitted_total > before[1]
+        scp.shutdown()
+
+    def test_promote_bumps_epoch_and_fences_zombie(self):
+        scp, clock = _build()
+        _wave(scp, 0, 4, 0)
+        scp.cycle()
+        zombie = scp.shards[0].token
+        scp.kill_shard(0)
+        promoted = scp.promote_shard(0)
+        assert promoted.epoch == zombie.epoch + 1
+        assert promoted.state == SHARD_ACTIVE
+        assert not zombie.valid()
+        saved = scp.store.fencing
+        scp.store.fencing = zombie
+        try:
+            with pytest.raises(Fenced):
+                scp.plane.store.create(_workload(99, 0, 999))
+        finally:
+            scp.store.fencing = saved
+        scp.shutdown()
+
+    def test_admitted_total_watermark_survives_promotion(self):
+        scp, clock = _build()
+        n = _wave(scp, 0, 4, 0)
+        scp.cycle()
+        total_before = scp.shards[0].admitted_total
+        assert total_before > 0
+        scp.kill_shard(0)
+        # While killed, the counter neither doubles nor resets.
+        assert scp.shards[0].admitted_total == total_before
+        scp.promote_shard(0)
+        assert scp.shards[0].admitted_total == total_before
+        n = _wave(scp, 1, 4, n)
+        scp.cycle()
+        assert scp.shards[0].admitted_total > total_before
+        # Exactly-once: counters sum to the store's admitted count
+        # (valid for clean kills — no mid-cycle tear here).
+        total = sum(s.admitted_total for s in scp.shards)
+        assert total == _admitted(scp.plane)
+        scp.shutdown()
+
+
+# ----------------------------------------------------------------------
+# scoped fault injection (satellite: per-manager arming)
+# ----------------------------------------------------------------------
+
+class TestScopedFaults:
+    def test_crash_in_one_scope_spares_the_sibling(self):
+        scp, clock = _build()
+        n = _wave(scp, 0, 4, 0)
+        faultinject.install(
+            FaultInjector({faultinject.SITE_APPLY: {0: CRASH}}),
+            scope="shard-0")
+        try:
+            before1 = scp.shards[1].admitted_total
+            scp.cycle()
+            assert scp.shards[0].state == SHARD_KILLED
+            assert scp.shards[1].state == SHARD_ACTIVE
+            assert scp.shards[1].admitted_total > before1
+        finally:
+            faultinject.uninstall(scope="shard-0")
+        # Promote + resync: the mid-apply tear heals against the store
+        # and everything still pending eventually admits exactly once.
+        scp.promote_shard(0)
+        for cycle in range(4):
+            scp.cycle()
+            clock.advance(1.0)
+            scp.renew_leases()
+        assert _admitted(scp.plane) == n
+        from kueue_tpu.sim.scenarios import _usage_consistent
+        ok, msg = _usage_consistent(scp.plane)
+        assert ok, msg
+        scp.shutdown()
+        assert scp.plane.cache.live_handouts == 0
+
+    def test_scoped_injector_never_fires_unscoped(self):
+        inj = FaultInjector({faultinject.SITE_APPLY: {0: CRASH}})
+        faultinject.install(inj, scope="shard-7")
+        try:
+            faultinject.site(faultinject.SITE_APPLY)  # no scope: no-op
+            with pytest.raises(faultinject.InjectedCrash):
+                with faultinject.scope("shard-7"):
+                    faultinject.site(faultinject.SITE_APPLY)
+        finally:
+            faultinject.uninstall(scope="shard-7")
+
+
+# ----------------------------------------------------------------------
+# rebalance
+# ----------------------------------------------------------------------
+
+class TestRebalance:
+    def test_move_fences_old_owner_and_new_owner_admits(self):
+        scp, clock = _build()
+        n = _wave(scp, 0, 4, 0)
+        scp.cycle()
+        unit = scp.plan.units_of(0)[0]
+        old_epoch = scp.shards[0].epoch
+        old_fp = scp.plan.fingerprint
+        rep = scp.rebalance(unit, 1)
+        assert rep["moved"] is True
+        assert scp.shards[0].epoch == old_epoch + 1  # fenced + re-armed
+        assert scp.plan.fingerprint != old_fp
+        assert scp.plan.shard_of_unit[unit] == 1
+        assert scp.rebalances == 1
+        before = [s.admitted_total for s in scp.shards]
+        n = _wave(scp, 1, 4, n)
+        scp.cycle()
+        moved_cqs = {cq for cq, u in
+                     shard_units(scp.plane.cache).items() if u == unit}
+        admitted_by_1 = scp.shards[1].admitted_total - before[1]
+        # New owner picked up the moved cohort's traffic (its own plus
+        # the moved unit's wave = one per owned CQ).
+        assert admitted_by_1 == len(scp.plan.cqs_of(1))
+        assert moved_cqs <= set(scp.plan.cqs_of(1))
+        scp.shutdown()
+
+    def test_noop_move_and_bad_args(self):
+        scp, _ = _build()
+        unit = scp.plan.units_of(0)[0]
+        assert scp.rebalance(unit, 0)["moved"] is False
+        with pytest.raises(ValueError):
+            scp.rebalance("cohort:nope", 1)
+        with pytest.raises(ValueError):
+            scp.rebalance(unit, 9)
+        scp.shutdown()
+
+
+# ----------------------------------------------------------------------
+# the catalog scenarios (tier-1 at smoke scale, seeds 0-2)
+# ----------------------------------------------------------------------
+
+class TestShardScenarios:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_shard_rebalance_smoke(self, seed):
+        from kueue_tpu.sim.scenarios import run_scenario
+        r = run_scenario("shard_rebalance", seed=seed, scale="smoke")
+        assert r.ok, r.violations
+        assert r.admitted == r.submitted
+        assert r.counters["moves"]
+        for mv in r.counters["moves"]:
+            assert mv["ttfa_cycles"] is not None
+
+    def test_shard_storm_smoke(self):
+        from kueue_tpu.sim.scenarios import run_scenario
+        r = run_scenario("shard_storm", seed=0, scale="smoke")
+        assert r.ok, r.violations
+        assert r.promotions > 0
+        assert r.admitted == r.submitted
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("name", ["shard_storm", "shard_rebalance"])
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_full_scale(self, name, seed):
+        from kueue_tpu.sim.scenarios import run_scenario
+        r = run_scenario(name, seed=seed, scale="full")
+        assert r.ok, r.violations

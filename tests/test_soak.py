@@ -14,6 +14,7 @@ under the healthy backoff config.
 """
 
 import json
+import os
 import random
 from dataclasses import replace
 
@@ -248,6 +249,47 @@ class TestAdversary:
         assert set(rep["off_ladder"]).isdisjoint(rep["ladder_keys"])
         assert rep["suggested_rungs"] == sorted(
             rep["off_ladder"], key=lambda k: -rep["off_ladder"][k])
+
+
+# ----------------------------------------------------------------------
+# the checked-in repro corpus (corpus/*.json): regression-locked, not
+# aspirational — every entry was hunted + shrunk by soak_run --hunt
+# against the weak-backoff fixture and must keep replaying RED through
+# the catalog; a harness change that silences the detector fails here
+# ----------------------------------------------------------------------
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "..", "corpus")
+
+
+def _corpus_specs():
+    import glob
+    return sorted(glob.glob(os.path.join(CORPUS_DIR, "*.json")))
+
+
+class TestReproCorpus:
+    def test_corpus_is_not_empty(self):
+        assert _corpus_specs(), \
+            "corpus/ has no checked-in repro entries"
+
+    @pytest.mark.parametrize(
+        "path", _corpus_specs(),
+        ids=[os.path.basename(p) for p in _corpus_specs()])
+    def test_corpus_entry_replays_red_through_the_catalog(self, path):
+        with open(path) as f:
+            spec = json.load(f)
+        name = adversary.register_repro(spec)
+        try:
+            assert name == spec["scenario"]
+            replay = SCENARIOS[name]()
+            assert adversary.interesting(replay.violations), (
+                f"{os.path.basename(path)} no longer replays red — "
+                "if a real fix made it green, move the entry to a "
+                "green regression gate instead of deleting it")
+            # seeded determinism: the lock is byte-stable run to run
+            again = SCENARIOS[name]()
+            assert again.violations == replay.violations
+        finally:
+            del SCENARIOS[name]
 
 
 # ----------------------------------------------------------------------

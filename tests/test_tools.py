@@ -563,6 +563,39 @@ class TestFailoverProbe:
         assert verdict["live_handouts_after_shutdown"] == 0
 
 
+class TestShardProbe:
+    def test_probe_smoke_exactly_once_fenced_bounded_resume(self, capsys):
+        """Tier-1 smoke for tools/shard_probe.py (chaos_run CLI
+        contract): a tiny sharded run must render the per-wave table,
+        report a parseable verdict, and find exactly-once admission at
+        every wave, the zombie shard's post-promotion write fenced, a
+        bounded promote-to-resume lag, a clean rebalance handoff, and
+        zero leaked snapshot handouts."""
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "shard_probe",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "shard_probe.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main(["3", "2", "4"]) == 0
+        captured = capsys.readouterr()
+        assert "per-shard" in captured.err      # the operator table
+        assert "rebalance:" in captured.err
+        verdict = json.loads(captured.out.strip().splitlines()[-1])
+        assert verdict["ok"] is True
+        assert verdict["consistency_failures"] == 0
+        assert verdict["dead_shard_admissions"] == 0
+        assert verdict["survivor_admitted_during_outage"] > 0
+        assert verdict["leaked_writes"] == 0
+        assert verdict["fenced_writes"] == 1
+        assert verdict["cycles_to_resume"] <= mod.MAX_CYCLES_TO_RESUME
+        assert verdict["rebalance_old_owner_admitted"] == 0
+        assert verdict["final_exactly_once"] is True
+        assert verdict["live_handouts_after_shutdown"] == 0
+
+
 class TestJourneyProbe:
     def test_probe_smoke_complete_timelines_no_leaks(self, capsys):
         """Tier-1 smoke for tools/journey_probe.py (chaos_run CLI

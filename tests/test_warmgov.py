@@ -93,6 +93,43 @@ class TestLadderDerivation:
         assert snapshot_cohort_members(env2.cache.snapshot()) \
             == {"cq0": 1, "cq1": 1}
 
+    def test_extra_rungs_become_warmed_shapes(self):
+        """Satellite: the soak_run --shapes feed closes the loop — an
+        adversarially-synthesized off-ladder (B, K) key, fed back as an
+        ``extra`` rung, becomes a first-class warmed shape at the
+        reclaim geometry."""
+        from kueue_tpu.sim.adversary import preempt_shape_report
+        from kueue_tpu.solver.warmgov import (parse_shape_rung,
+                                              preempt_shape_ladder)
+        rep = preempt_shape_report(seed=0, samples=32)
+        assert rep["off_ladder"], "sweep found no off-ladder shapes"
+        rung = rep["suggested_rungs"][0]
+        members = {"cohort-0": rep["topology"]["tenants"]}
+        base = preempt_shape_ladder(members, width=64)
+        fed = preempt_shape_ladder(members, width=64, extra=[rung])
+        b, k = parse_shape_rung(rung)
+        keys = {(s["B"], s["K"]) for s in fed}
+        assert (b, k) in keys
+        assert (b, k) not in {(s["B"], s["K"]) for s in base}
+        # dedup: feeding a rung the ladder already covers is a no-op
+        covered = (base[0]["B"], base[0]["K"])
+        assert preempt_shape_ladder(members, width=64,
+                                    extra=[covered]) == base
+        # both accepted spellings agree
+        assert parse_shape_rung(f"B{b}xK{k}") == parse_shape_rung((b, k))
+
+    def test_governor_plumbs_extra_rungs(self):
+        """extra_preempt_rungs reaches the governor's warm walk: the
+        synthesized rung shows up in the preempt shape set start()
+        derives."""
+        from kueue_tpu.solver.warmgov import CompileGovernor
+        env = simple_env(num_cqs=2, cohort="co")
+        gov = CompileGovernor(StubWarmSolver(), env.cache,
+                              extra_preempt_rungs=("B256xK512",))
+        gov.run_sync()
+        keys = {(s["B"], s["K"]) for s in gov._preempt_shapes}
+        assert (256, 512) in keys
+
 
 class TestRouteGate:
     def test_idle_governor_never_gates(self):

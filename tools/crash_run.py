@@ -32,11 +32,21 @@ Verifies the recovery contract (RESILIENCE.md §6/§7) either way:
 Usage:
   python tools/crash_run.py [seed] [site] [hit]        one seeded kill
   python tools/crash_run.py --failover [seed] [site] [hit] [lag]
+  python tools/crash_run.py --shard [seed] [site] [hit] [n_shards]
+                                              kill ONE admission shard
+                                              of a sharded plane
+                                              (ISSUE 20) mid-cycle via
+                                              its scoped injector; the
+                                              survivors keep admitting
+                                              and the dead shard is
+                                              hot-promoted
   python tools/crash_run.py --sweep [seeds]   every site x seeds, the
                                               cold-restore sweep PLUS
                                               the promotion-timing
                                               sweep (lag state varied
-                                              per seed)
+                                              per seed) PLUS the
+                                              shard-kill sweep (site x
+                                              layout x seed)
 
 Prints one JSON line per run to stderr plus a final verdict line to
 stdout; exits non-zero on any divergence. Deterministic for a given
@@ -81,6 +91,17 @@ CRASH_SITES = (faultinject.SITE_STORE, faultinject.SITE_APPLY,
 # standby polls (0 = never polled until the promotion itself, so the
 # entire tail drains inside promote()).
 LAG_MODES = {"hot": 1, "lagged": 3, "cold": 0}
+
+# Shard-kill sites (ISSUE 20): the sites a SHARD's admission cycle
+# actually crosses on the cpu route — apply_commit (the assumed-but-
+# unwritten tear) and store_write (the shard dies inside the shared
+# apiserver's commit, after the WAL append). Device-path sites are the
+# solver's; shard schedulers in this harness run solverless, so a kill
+# there would be vacuous.
+SHARD_CRASH_SITES = (faultinject.SITE_APPLY, faultinject.SITE_STORE)
+# "every injection site x N-shard layouts x seeds": both layouts per
+# sweep cell.
+SHARD_LAYOUTS = (2, 4)
 
 
 def make_objects():
@@ -340,6 +361,95 @@ def run_failover(seed: int, site: str, hit: int,
     return out
 
 
+def drive_shards(scp, clock, next_wave, waves, max_cycles=MAX_CYCLES,
+                 promote=True):
+    """Round-robin the shards over the arrival schedule, auto-promoting
+    any shard found dead at the top of the loop (the harness plays the
+    shard supervisor). Returns (next wave, settled?, promotions)."""
+    from kueue_tpu.parallel.shards import SHARD_ACTIVE
+    settled = 0
+    promotions = 0
+    admitted_at_death = None
+    for _cycle in range(max_cycles):
+        if promote:
+            for s in list(scp.shards):
+                if s.state != SHARD_ACTIVE:
+                    if admitted_at_death is None:
+                        # What the WAL had durably admitted when the
+                        # kill surfaced — the no-lost-admissions
+                        # baseline, same arbiter as the restore arm.
+                        loaded = scp.durable.load()
+                        admitted_at_death = sorted(
+                            wlpkg.key(wl) for wl in
+                            loaded.objects.get("Workload", {}).values()
+                            if wlpkg.has_quota_reservation(wl))
+                    scp.promote_shard(s.index)
+                    promotions += 1
+        if next_wave < waves:
+            deliver_wave(scp.plane, next_wave)
+            next_wave += 1
+            scp.plane.run_until_idle(max_iterations=1_000_000)
+        before = len(admitted_keys(scp.plane))
+        scp.cycle()
+        clock.advance(1.0)
+        scp.renew_leases()
+        progressed = len(admitted_keys(scp.plane)) > before
+        busy = progressed or next_wave < waves
+        settled = 0 if busy else settled + 1
+        if settled >= 3:
+            return next_wave, True, promotions, admitted_at_death
+    return next_wave, False, promotions, admitted_at_death
+
+
+def run_shard(seed: int, site: str, hit: int, n_shards: int = 2) -> dict:
+    """The shard-kill/promote arm (ISSUE 20): the seeded (site, hit)
+    crash is armed in ONE shard's faultinject scope — co-resident
+    shards' cycles never consume it — and fires mid-cycle inside that
+    shard; the shared plane survives, the other shards keep admitting
+    their cohorts, and the harness hot-promotes the dead shard. The
+    verdict contract is run_crash's, against the same single-manager
+    oracle: the sharded layout must converge to the identical admitted
+    set with zero lost/double/stranded."""
+    from kueue_tpu.parallel.shards import ShardedControlPlane
+
+    cfg = cfgpkg.Configuration()
+    clock = FakeClock(1000.0)
+    scp = ShardedControlPlane(n_shards, cfg=cfg, clock=clock,
+                              checkpoint_every=64)
+    for obj in make_objects():
+        scp.plane.store.create(obj)
+    scp.plane.run_until_idle(max_iterations=1_000_000)
+    scp.replan()
+
+    victim = seed % n_shards
+    faultinject.install(FaultInjector({site: {hit: CRASH}}),
+                        scope=f"shard-{victim}")
+    try:
+        # The crash never propagates: shard_cycle absorbs it and marks
+        # the victim killed; drive_shards promotes on the next pass.
+        next_wave, settled, promotions, at_death = drive_shards(
+            scp, clock, 0, WAVES)
+    finally:
+        faultinject.uninstall(scope=f"shard-{victim}")
+    crashed = promotions > 0
+    ok_usage, usage_msg = usage_consistent(scp.plane)
+    out = {
+        "mode": "shard", "seed": seed, "site": site, "hit": hit,
+        "n_shards": n_shards, "victim": victim, "crashed": crashed,
+        "settled": settled, "promotions": promotions,
+        "admitted": admitted_keys(scp.plane),
+        "pre_crash_admitted": at_death or [],
+        "usage_consistent": ok_usage, "usage_msg": usage_msg,
+        "per_shard_admitted": [s.admitted_total for s in scp.shards],
+        "epochs": [s.epoch for s in scp.shards],
+    }
+    scp.shutdown()
+    out["inflight_after_shutdown"] = any(
+        s.scheduler._inflight is not None for s in scp.shards)
+    out["live_handouts"] = scp.plane.cache.live_handouts
+    return out
+
+
 def verdict(oracle: dict, crash: dict) -> dict:
     lost = sorted(set(crash["pre_crash_admitted"])
                   - set(crash["admitted"]))
@@ -355,10 +465,14 @@ def verdict(oracle: dict, crash: dict) -> dict:
 
 
 def one_run(seed: int, site: str, hit: int,
-            lag_mode: str = "") -> int:
+            lag_mode: str = "", n_shards: int = 0) -> int:
     oracle = run_oracle(seed)
-    crash = (run_failover(seed, site, hit, lag_mode) if lag_mode
-             else run_crash(seed, site, hit))
+    if n_shards:
+        crash = run_shard(seed, site, hit, n_shards)
+    elif lag_mode:
+        crash = run_failover(seed, site, hit, lag_mode)
+    else:
+        crash = run_crash(seed, site, hit)
     for r in (oracle, crash):
         print(json.dumps({**r, "admitted": len(r["admitted"])}),
               file=sys.stderr)
@@ -368,7 +482,10 @@ def one_run(seed: int, site: str, hit: int,
     line = {"tool": "crash_run", "mode": crash["mode"], "seed": seed,
             "site": site, "hit": hit, "ok": ok, **v,
             "admitted": len(crash["admitted"])}
-    if lag_mode:
+    if n_shards:
+        line["n_shards"] = n_shards
+        line["promotions"] = crash["promotions"]
+    elif lag_mode:
         line["lag_mode"] = lag_mode
         line["promotion"] = crash["promotion"]
     print(json.dumps(line))
@@ -423,11 +540,45 @@ def sweep(seeds: int) -> int:
                 print(json.dumps(line), file=sys.stderr)
                 if not ok:
                     failures.append(line)
+    # The shard-kill/promote arm (ISSUE 20): every shard crash site x
+    # N-shard layout x seed. The victim shard rotates with the seed;
+    # each cell must fire at least once across its seeds or the arm is
+    # vacuous.
+    for site in SHARD_CRASH_SITES:
+        for n_shards in SHARD_LAYOUTS:
+            fired[("shard", f"{site}@{n_shards}")] = 0
+            for seed in range(seeds):
+                rng = random.Random(
+                    (zlib.crc32(site.encode()) & 0xFFFF) * 100_000
+                    + n_shards * 1000 + seed)
+                # A shard's scoped hit counter only advances inside its
+                # own cycles, and a 4-shard victim owns a single CQ —
+                # keep kill points shallow enough to land for the
+                # smallest ownership slice.
+                hit = (rng.randint(2, 20)
+                       if site == faultinject.SITE_STORE
+                       else rng.randint(0, 6))
+                if seed not in oracle_by_seed:
+                    oracle_by_seed[seed] = run_oracle(seed)
+                crash = run_shard(seed, site, hit, n_shards)
+                v = verdict(oracle_by_seed[seed], crash)
+                fired[("shard", f"{site}@{n_shards}")] += (
+                    1 if crash["crashed"] else 0)
+                ok = (v["converged"] and not v["lost_admissions"]
+                      and not v["double_admission"]
+                      and not v["stranded"])
+                line = {"arm": "shard", "site": site, "seed": seed,
+                        "hit": hit, "n_shards": n_shards, "ok": ok,
+                        **{k: v[k] for k in ("converged", "crashed")}}
+                print(json.dumps(line), file=sys.stderr)
+                if not ok:
+                    failures.append(line)
     vacuous = [f"{m}:{s}" for (m, s), n in fired.items() if n == 0]
     ok = not failures and not vacuous
     print(json.dumps({"tool": "crash_run", "mode": "sweep",
                       "seeds": seeds, "sites": len(CRASH_SITES),
-                      "arms": ["restore", "promote"],
+                      "arms": ["restore", "promote", "shard"],
+                      "shard_layouts": list(SHARD_LAYOUTS),
                       "ok": ok, "failures": failures,
                       "fired": {f"{m}:{s}": n
                                 for (m, s), n in fired.items()},
@@ -437,12 +588,16 @@ def sweep(seeds: int) -> int:
 
 def main():
     argv = sys.argv[1:]
-    args = [a for a in argv if a not in ("--sweep", "--failover")]
+    args = [a for a in argv
+            if a not in ("--sweep", "--failover", "--shard")]
     if "--sweep" in argv:
         return sweep(int(args[0]) if args else 20)
     seed = int(args[0]) if args else 1234
     site = args[1] if len(args) > 1 else faultinject.SITE_STORE
     hit = int(args[2]) if len(args) > 2 else 40
+    if "--shard" in argv:
+        n_shards = int(args[3]) if len(args) > 3 else 2
+        return one_run(seed, site, hit, n_shards=n_shards)
     if "--failover" in argv:
         lag = args[3] if len(args) > 3 else "hot"
         return one_run(seed, site, hit, lag_mode=lag)

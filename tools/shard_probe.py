@@ -1,0 +1,263 @@
+"""Shard probe: sharded admission control plane health table.
+
+Drives a ``ShardedControlPlane`` (RESILIENCE.md §9) — N leased
+admission shards over one shared watch/store plane — through waves of
+traffic, printing one row per wave:
+
+    wave  created  admitted  per-shard admitted  backlog  epochs
+
+Then exercises the two failure modes the subsystem exists for:
+
+- a KILL/PROMOTE storm on one shard: the survivor keeps admitting its
+  own cohorts during the outage, the dead shard's zombie token is
+  fenced at the durable log (ONE write slipping through is a
+  violation), and the promoted shard resumes admitting its cohorts
+  within a bounded number of cycles (unbounded resume lag fails);
+- a REBALANCE: a cohort unit is fenced away from its owner and
+  reassigned; the new owner admits it, the old owner admits none of
+  it, and the exactly-once cross-check holds throughout.
+
+Exactly-once is checked two ways after every phase: the per-CQ cache
+usage must match the store's admitted sum (a cross-shard double
+admission double-counts usage), and the per-shard ``admitted_total``
+counters must sum to the store's admitted workload count (an admission
+counted by two shards makes the sum exceed the store).
+
+Same CLI contract as tools/chaos_run.py / failover_probe.py: the human
+table (or --json report) goes to stderr, one parseable JSON verdict
+line to stdout, exit non-zero on a double admission, a leaked zombie
+write, or unbounded resume lag.
+
+Usage: python tools/shard_probe.py [waves] [shards] [cqs] [--json]
+"""
+
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)  # for failover_probe when loaded by path
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kueue_tpu.api.meta import FakeClock  # noqa: E402
+from kueue_tpu.parallel.shards import (  # noqa: E402
+    SHARD_ACTIVE, ShardedControlPlane)
+from kueue_tpu.sim.durable import Fenced  # noqa: E402
+
+from failover_probe import (  # noqa: E402
+    admitted_count, make_objects, make_workload, usage_consistent)
+
+DEFAULT_WAVES = 6
+DEFAULT_SHARDS = 2
+DEFAULT_CQS = 6
+MAX_CYCLES_TO_RESUME = 3
+
+
+def exactly_once(scp) -> tuple:
+    """The cross-shard exactly-once cross-check: cache usage must match
+    the store's admitted sum AND the per-shard admission counters must
+    sum to the store's admitted workload count."""
+    ok, msg = usage_consistent(scp.plane)
+    if not ok:
+        return False, f"usage: {msg}"
+    store_admitted = admitted_count(scp.plane)
+    shard_sum = sum(s.admitted_total for s in scp.shards)
+    if shard_sum != store_admitted:
+        return False, (f"shard counters say {shard_sum} admissions, "
+                       f"store says {store_admitted}")
+    return True, ""
+
+
+def probe(waves: int = DEFAULT_WAVES, n_shards: int = DEFAULT_SHARDS,
+          num_cqs: int = DEFAULT_CQS) -> dict:
+    clock = FakeClock(1000.0)
+    scp = ShardedControlPlane(n_shards, clock=clock)
+    for obj in make_objects(num_cqs):
+        scp.plane.store.create(obj)
+    scp.plane.run_until_idle(max_iterations=1_000_000)
+    plan = scp.replan()
+
+    windows = []
+    n = 0
+    consistency_failures = 0
+    for wave in range(waves):
+        for i in range(num_cqs):
+            scp.plane.store.create(make_workload(wave, i, n))
+            n += 1
+        scp.plane.run_until_idle(max_iterations=1_000_000)
+        scp.cycle()
+        clock.advance(1.0)
+        scp.renew_leases()
+        ok, msg = exactly_once(scp)
+        if not ok:
+            consistency_failures += 1
+        windows.append({
+            "wave": wave, "created": num_cqs,
+            "admitted": admitted_count(scp.plane),
+            "per_shard": [s.admitted_total for s in scp.shards],
+            "backlog": [scp.plane.queues.pending(cq) or 0
+                        for cq in sorted(scp.plan.cq_shard)],
+            "epochs": [s.token.epoch for s in scp.shards],
+            "exactly_once": ok, "msg": msg})
+
+    # --- the kill/promote storm on shard 0 ---------------------------
+    victim = scp.shards[0]
+    victim_cqs = set(plan.cqs_of(0))
+    zombie = victim.token
+    scp.kill_shard(0)
+
+    # Survivor keeps admitting its OWN cohorts during the outage.
+    survivor_before = [s.admitted_total for s in scp.shards]
+    for i in range(num_cqs):
+        scp.plane.store.create(make_workload(100, i, n))
+        n += 1
+    scp.plane.run_until_idle(max_iterations=1_000_000)
+    scp.cycle()
+    clock.advance(1.0)
+    survivor_admitted = sum(
+        s.admitted_total - b
+        for s, b in zip(scp.shards[1:], survivor_before[1:]))
+    dead_admitted = scp.shards[0].admitted_total - survivor_before[0]
+
+    # Promote: the new incarnation resumes the dead shard's cohorts
+    # within a bounded number of cycles (unbounded resume lag fails).
+    # The lease epoch bumps FIRST — from here the dead holder's token
+    # is a zombie and every write under it must fence (before the
+    # takeover the lease is legitimately still the dead holder's;
+    # that window is bounded by the lease duration, not tested here).
+    promoted = scp.promote_shard(0)
+    fenced_writes = 0
+    leaked_writes = 0
+    saved = scp.store.fencing
+    scp.store.fencing = zombie
+    try:
+        try:
+            scp.plane.store.create(make_workload(998, 0, 10_000))
+            leaked_writes += 1
+        except Fenced:
+            fenced_writes += 1
+    finally:
+        scp.store.fencing = saved
+    cycles_to_resume = None
+    resume_before = scp.shards[0].admitted_total
+    for cycle in range(MAX_CYCLES_TO_RESUME + 2):
+        for i in range(num_cqs):
+            scp.plane.store.create(make_workload(200 + cycle, i, n))
+            n += 1
+        scp.plane.run_until_idle(max_iterations=1_000_000)
+        scp.cycle()
+        clock.advance(1.0)
+        if scp.shards[0].admitted_total > resume_before:
+            cycles_to_resume = cycle + 1
+            break
+    ok_storm, storm_msg = exactly_once(scp)
+
+    # --- the rebalance: move shard 0's first unit to shard 1 ----------
+    moved_unit = plan.units_of(0)[0] if plan.units_of(0) else None
+    rebalance_report = None
+    rebalance_new_owner_delta = 0
+    rebalance_old_owner_delta = 0
+    if moved_unit is not None and n_shards > 1:
+        rebalance_report = scp.rebalance(moved_unit, 1)
+        before = [s.admitted_total for s in scp.shards]
+        for i in range(num_cqs):
+            scp.plane.store.create(make_workload(300, i, n))
+            n += 1
+        scp.plane.run_until_idle(max_iterations=1_000_000)
+        for _ in range(2):
+            scp.cycle()
+            clock.advance(1.0)
+        moved_cqs = set(scp.plan.cqs_of(1)) & victim_cqs
+        rebalance_new_owner_delta = scp.shards[1].admitted_total - before[1]
+        rebalance_old_owner_delta = sum(
+            scp.shards[j].admitted_total - before[j]
+            for j in range(n_shards)
+            if not (set(scp.plan.cqs_of(j)) & moved_cqs) and j != 1)
+    ok_final, final_msg = exactly_once(scp)
+
+    report = {
+        "waves": waves, "shards": n_shards, "cqs": num_cqs,
+        "plan_fingerprint": plan.fingerprint,
+        "plan_imbalance": plan.imbalance,
+        "windows": windows,
+        "consistency_failures": consistency_failures,
+        "survivor_admitted_during_outage": survivor_admitted,
+        "dead_shard_admissions": dead_admitted,
+        "fenced_writes": fenced_writes,
+        "leaked_writes": leaked_writes,
+        "promoted_epoch": promoted.epoch,
+        "cycles_to_resume": cycles_to_resume,
+        "storm_exactly_once": ok_storm, "storm_msg": storm_msg,
+        "rebalance": rebalance_report,
+        "rebalance_new_owner_admitted": rebalance_new_owner_delta,
+        "rebalance_old_owner_admitted": rebalance_old_owner_delta,
+        "final_exactly_once": ok_final, "final_msg": final_msg,
+        "status": scp.status(),
+    }
+    scp.shutdown()
+    report["live_handouts_after_shutdown"] = scp.plane.cache.live_handouts
+    return report
+
+
+def render_table(report: dict) -> str:
+    head = (f"{'wave':>5} {'created':>8} {'admitted':>9} "
+            f"{'per-shard':>16} {'epochs':>10} {'ok':>3}")
+    lines = [head, "-" * len(head)]
+    for w in report["windows"]:
+        lines.append(
+            f"{w['wave']:>5} {w['created']:>8} {w['admitted']:>9} "
+            f"{str(w['per_shard']):>16} {str(w['epochs']):>10} "
+            f"{'y' if w['exactly_once'] else 'N':>3}")
+    lines.append("-" * len(head))
+    lines.append(
+        f"storm: survivor admitted {report['survivor_admitted_during_outage']} "
+        f"during outage  dead-shard admissions: "
+        f"{report['dead_shard_admissions']}  fenced: "
+        f"{report['fenced_writes']}  leaked: {report['leaked_writes']}")
+    lines.append(
+        f"promote: epoch {report['promoted_epoch']}  cycles to resume: "
+        f"{report['cycles_to_resume']}  exactly-once: "
+        f"{report['storm_exactly_once']}")
+    reb = report["rebalance"]
+    if reb:
+        lines.append(
+            f"rebalance: {reb['unit']} shard {reb['from']} -> "
+            f"{reb['to']}  new-owner admitted: "
+            f"{report['rebalance_new_owner_admitted']}  old-owner: "
+            f"{report['rebalance_old_owner_admitted']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    waves = int(argv[0]) if len(argv) > 0 else DEFAULT_WAVES
+    n_shards = int(argv[1]) if len(argv) > 1 else DEFAULT_SHARDS
+    num_cqs = int(argv[2]) if len(argv) > 2 else DEFAULT_CQS
+    report = probe(waves, n_shards, num_cqs)
+    if as_json:
+        print(json.dumps(report), file=sys.stderr, flush=True)
+    else:
+        print(render_table(report), file=sys.stderr, flush=True)
+    verdict = {k: v for k, v in report.items()
+               if k not in ("windows", "status")}
+    verdict["ok"] = (
+        report["consistency_failures"] == 0
+        and report["survivor_admitted_during_outage"] > 0
+        and report["dead_shard_admissions"] == 0
+        and report["leaked_writes"] == 0
+        and report["fenced_writes"] == 1
+        and report["cycles_to_resume"] is not None
+        and report["cycles_to_resume"] <= MAX_CYCLES_TO_RESUME
+        and report["storm_exactly_once"]
+        and report["rebalance_old_owner_admitted"] == 0
+        and report["final_exactly_once"]
+        and report["live_handouts_after_shutdown"] == 0)
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
